@@ -1,0 +1,21 @@
+"""lodestar-tpu: a TPU-native Ethereum consensus-layer framework.
+
+A brand-new implementation with the capabilities of ChainSafe Lodestar
+(reference: /root/reference), designed TPU-first:
+
+- ``ops/``: JAX/Pallas kernels — BLS12-381 limb-vectorized field arithmetic,
+  pairing, MSM, SHA-256 tree hashing (reference analog: @chainsafe/blst,
+  c-kzg, @chainsafe/as-sha256 — SURVEY.md §2.1).
+- ``bls/``: the TPU-backed signature verifier service keeping the reference's
+  ``IBlsVerifier`` contract (packages/beacon-node/src/chain/bls/interface.ts:25-68).
+- ``crypto/``: pure-Python BLS12-381 correctness oracle + host-side crypto.
+- ``ssz/``: SSZ serialization + merkleization (reference: @chainsafe/ssz).
+- ``params/ config/ types/``: spec presets, chain config, per-fork containers
+  (reference: packages/params, packages/config, packages/types).
+- ``statetransition/ forkchoice/``: consensus core (reference:
+  packages/state-transition, packages/fork-choice).
+- ``parallel/``: device mesh + sharded dispatch (host->device queues replacing
+  the reference's worker_threads topology, SURVEY.md §1 process topology).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""Runtime chain configuration and fork schedule.
+
+Reference analog: packages/config/src (chainConfig/, forkConfig/,
+beaconConfig.ts, networks.ts). ChainConfig holds yaml/env-overridable
+runtime values (fork epochs/versions, genesis, churn); ChainForkConfig adds
+fork-schedule helpers; BeaconConfig caches per-fork signing domains once the
+genesis validators root is known.
+"""
+
+from .chain_config import ChainConfig, MAINNET_CONFIG, MINIMAL_CONFIG
+from .fork_config import ChainForkConfig, ForkInfo
+from .beacon_config import BeaconConfig, create_beacon_config
+
+__all__ = [
+    "ChainConfig",
+    "MAINNET_CONFIG",
+    "MINIMAL_CONFIG",
+    "ChainForkConfig",
+    "ForkInfo",
+    "BeaconConfig",
+    "create_beacon_config",
+]

@@ -1,0 +1,76 @@
+"""BeaconConfig — ChainForkConfig + cached per-fork signing domains.
+
+Reference analog: packages/config/src/beaconConfig.ts (createBeaconConfig,
+getDomain with per-fork cache). Domain computation follows the spec
+(compute_domain / compute_fork_data_root); ForkData merkleization is two
+32-byte chunks so it reduces to a single SHA-256 of their concatenation.
+"""
+
+from hashlib import sha256
+
+from .chain_config import ChainConfig
+from .fork_config import ChainForkConfig
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    """hash_tree_root(ForkData(current_version, genesis_validators_root))."""
+    chunk0 = current_version + b"\x00" * 28
+    return sha256(chunk0 + genesis_validators_root).digest()
+
+
+def compute_fork_digest(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: bytes, fork_version: bytes, genesis_validators_root: bytes
+) -> bytes:
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + fork_data_root[:28]
+
+
+def compute_signing_root_from_roots(object_root: bytes, domain: bytes) -> bytes:
+    """hash_tree_root(SigningData(object_root, domain)) — two 32B chunks."""
+    return sha256(object_root + domain).digest()
+
+
+class BeaconConfig(ChainForkConfig):
+    """Fork config bound to a genesis_validators_root, with domain caching."""
+
+    def __init__(self, config: ChainConfig, genesis_validators_root: bytes):
+        super().__init__(config)
+        self.genesis_validators_root = genesis_validators_root
+        # fork name -> domain_type -> domain
+        self._domain_cache: dict[str, dict[bytes, bytes]] = {f: {} for f in self.forks}
+        self.fork_digests = {
+            name: compute_fork_digest(info.version, genesis_validators_root)
+            for name, info in self.forks.items()
+        }
+        self._digest_to_fork = {d: n for n, d in self.fork_digests.items()}
+
+    def get_domain(self, domain_type: bytes, epoch: int) -> bytes:
+        """Domain for the fork active at ``epoch``."""
+        return self.get_domain_at_fork(domain_type, self.get_fork_info(epoch).name)
+
+    def get_domain_at_fork(self, domain_type: bytes, fork_name: str) -> bytes:
+        fork = self.forks[fork_name]
+        cache = self._domain_cache[fork.name]
+        domain = cache.get(domain_type)
+        if domain is None:
+            domain = compute_domain(
+                domain_type, fork.version, self.genesis_validators_root
+            )
+            cache[domain_type] = domain
+        return domain
+
+    def fork_digest(self, epoch: int) -> bytes:
+        return self.fork_digests[self.get_fork_name(epoch)]
+
+    def fork_name_from_digest(self, digest: bytes) -> str:
+        return self._digest_to_fork[digest]
+
+
+def create_beacon_config(
+    config: ChainConfig, genesis_validators_root: bytes
+) -> BeaconConfig:
+    return BeaconConfig(config, genesis_validators_root)

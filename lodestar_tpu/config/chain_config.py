@@ -1,0 +1,120 @@
+"""ChainConfig — runtime-overridable chain parameters.
+
+Reference analog: packages/config/src/chainConfig/types.ts and
+configs/{mainnet,minimal}.ts. Matches ethereum/consensus-specs
+configs/{mainnet,minimal}.yaml.
+"""
+
+from dataclasses import dataclass, replace, fields
+
+
+@dataclass(frozen=True)
+class ChainConfig:
+    PRESET_BASE: str = "mainnet"
+    CONFIG_NAME: str = "mainnet"
+
+    # Transition
+    TERMINAL_TOTAL_DIFFICULTY: int = 58750000000000000000000
+    TERMINAL_BLOCK_HASH: bytes = b"\x00" * 32
+    TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH: int = 2**64 - 1
+
+    # Genesis
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: int = 16384
+    MIN_GENESIS_TIME: int = 1606824000
+    GENESIS_FORK_VERSION: bytes = bytes.fromhex("00000000")
+    GENESIS_DELAY: int = 604800
+
+    # Forking
+    ALTAIR_FORK_VERSION: bytes = bytes.fromhex("01000000")
+    ALTAIR_FORK_EPOCH: int = 74240
+    BELLATRIX_FORK_VERSION: bytes = bytes.fromhex("02000000")
+    BELLATRIX_FORK_EPOCH: int = 144896
+    CAPELLA_FORK_VERSION: bytes = bytes.fromhex("03000000")
+    CAPELLA_FORK_EPOCH: int = 194048
+    DENEB_FORK_VERSION: bytes = bytes.fromhex("04000000")
+    DENEB_FORK_EPOCH: int = 269568
+    ELECTRA_FORK_VERSION: bytes = bytes.fromhex("05000000")
+    ELECTRA_FORK_EPOCH: int = 2**64 - 1
+
+    # Time parameters
+    SECONDS_PER_SLOT: int = 12
+    SECONDS_PER_ETH1_BLOCK: int = 14
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY: int = 256
+    SHARD_COMMITTEE_PERIOD: int = 256
+    ETH1_FOLLOW_DISTANCE: int = 2048
+
+    # Validator cycle
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+    EJECTION_BALANCE: int = 16_000_000_000
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT: int = 8
+    CHURN_LIMIT_QUOTIENT: int = 65536
+    # Electra churn (Gwei)
+    MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA: int = 128_000_000_000
+    MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT: int = 256_000_000_000
+
+    # Fork choice
+    PROPOSER_SCORE_BOOST: int = 40
+    REORG_HEAD_WEIGHT_THRESHOLD: int = 20
+    REORG_PARENT_WEIGHT_THRESHOLD: int = 160
+    REORG_MAX_EPOCHS_SINCE_FINALIZATION: int = 2
+
+    # Deposit contract
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_NETWORK_ID: int = 1
+    DEPOSIT_CONTRACT_ADDRESS: bytes = bytes.fromhex(
+        "00000000219ab540356cbb839cbe05303d7705fa"
+    )
+
+    # Networking
+    MAX_REQUEST_BLOCKS: int = 1024
+    MIN_EPOCHS_FOR_BLOCK_REQUESTS: int = 33024
+    MAX_REQUEST_BLOCKS_DENEB: int = 128
+    MAX_REQUEST_BLOB_SIDECARS: int = 768
+    MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS: int = 4096
+    BLOB_SIDECAR_SUBNET_COUNT: int = 6
+
+    def with_overrides(self, **kwargs) -> "ChainConfig":
+        return replace(self, **kwargs)
+
+    def as_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+MAINNET_CONFIG = ChainConfig()
+
+MINIMAL_CONFIG = ChainConfig(
+    PRESET_BASE="minimal",
+    CONFIG_NAME="minimal",
+    TERMINAL_TOTAL_DIFFICULTY=2**256 - 2**10,
+    MIN_EPOCHS_FOR_BLOCK_REQUESTS=272,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=64,
+    MIN_GENESIS_TIME=1578009600,
+    GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
+    GENESIS_DELAY=300,
+    ALTAIR_FORK_VERSION=bytes.fromhex("01000001"),
+    ALTAIR_FORK_EPOCH=2**64 - 1,
+    BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
+    BELLATRIX_FORK_EPOCH=2**64 - 1,
+    CAPELLA_FORK_VERSION=bytes.fromhex("03000001"),
+    CAPELLA_FORK_EPOCH=2**64 - 1,
+    DENEB_FORK_VERSION=bytes.fromhex("04000001"),
+    DENEB_FORK_EPOCH=2**64 - 1,
+    ELECTRA_FORK_VERSION=bytes.fromhex("05000001"),
+    ELECTRA_FORK_EPOCH=2**64 - 1,
+    SECONDS_PER_SLOT=6,
+    SECONDS_PER_ETH1_BLOCK=14,
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY=256,
+    SHARD_COMMITTEE_PERIOD=64,
+    ETH1_FOLLOW_DISTANCE=16,
+    EJECTION_BALANCE=16_000_000_000,
+    MIN_PER_EPOCH_CHURN_LIMIT=2,
+    MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT=4,
+    CHURN_LIMIT_QUOTIENT=32,
+    MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA=64_000_000_000,
+    MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT=128_000_000_000,
+    DEPOSIT_CHAIN_ID=5,
+    DEPOSIT_NETWORK_ID=5,
+    DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("1234567890123456789012345678901234567890"),
+)

@@ -1,0 +1,73 @@
+"""ChainForkConfig — fork schedule helpers over a ChainConfig.
+
+Reference analog: packages/config/src/forkConfig/index.ts
+(getForkInfo/getForkName/getForkSeq/getForkVersion, forkSchedule).
+"""
+
+from dataclasses import dataclass
+
+from ..params import ForkName, ForkSeq, GENESIS_EPOCH
+from .chain_config import ChainConfig
+
+
+@dataclass(frozen=True)
+class ForkInfo:
+    name: str
+    seq: int
+    epoch: int
+    version: bytes
+    prev_version: bytes
+    prev_fork_name: str
+
+
+class ChainForkConfig:
+    """Fork-schedule view of a ChainConfig."""
+
+    def __init__(self, config: ChainConfig):
+        self.config = config
+        entries = [
+            (ForkName.phase0, ForkSeq.phase0, GENESIS_EPOCH, config.GENESIS_FORK_VERSION),
+            (ForkName.altair, ForkSeq.altair, config.ALTAIR_FORK_EPOCH, config.ALTAIR_FORK_VERSION),
+            (ForkName.bellatrix, ForkSeq.bellatrix, config.BELLATRIX_FORK_EPOCH, config.BELLATRIX_FORK_VERSION),
+            (ForkName.capella, ForkSeq.capella, config.CAPELLA_FORK_EPOCH, config.CAPELLA_FORK_VERSION),
+            (ForkName.deneb, ForkSeq.deneb, config.DENEB_FORK_EPOCH, config.DENEB_FORK_VERSION),
+            (ForkName.electra, ForkSeq.electra, config.ELECTRA_FORK_EPOCH, config.ELECTRA_FORK_VERSION),
+        ]
+        self.forks: dict[str, ForkInfo] = {}
+        prev_name, prev_version = entries[0][0], entries[0][3]
+        for name, seq, epoch, version in entries:
+            self.forks[name] = ForkInfo(
+                name=name,
+                seq=int(seq),
+                epoch=epoch,
+                version=version,
+                prev_version=prev_version,
+                prev_fork_name=prev_name,
+            )
+            prev_name, prev_version = name, version
+        # Scheduled forks, ascending epoch, genesis first. Forks with epoch
+        # FAR_FUTURE_EPOCH are unscheduled but still resolvable by name.
+        self.fork_schedule = sorted(self.forks.values(), key=lambda f: (f.epoch, f.seq))
+
+    def get_fork_info(self, epoch: int) -> ForkInfo:
+        active = self.forks[ForkName.phase0]
+        for fork in self.fork_schedule:
+            # epoch == FAR_FUTURE_EPOCH means the fork is unscheduled and
+            # never activates (spec semantics of *_FORK_EPOCH sentinels).
+            if fork.epoch != 2**64 - 1 and epoch >= fork.epoch:
+                # schedule is sorted; later matching entries supersede
+                if fork.seq >= active.seq:
+                    active = fork
+        return active
+
+    def get_fork_name(self, epoch: int) -> str:
+        return self.get_fork_info(epoch).name
+
+    def get_fork_seq(self, epoch: int) -> int:
+        return self.get_fork_info(epoch).seq
+
+    def get_fork_version(self, epoch: int) -> bytes:
+        return self.get_fork_info(epoch).version
+
+    def get_fork_info_at_slot(self, slot: int, slots_per_epoch: int) -> ForkInfo:
+        return self.get_fork_info(slot // slots_per_epoch)

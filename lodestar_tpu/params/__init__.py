@@ -1,0 +1,155 @@
+"""Spec parameters: presets, constants, fork names.
+
+Reference analog: packages/params/src (setPreset.ts, forkName.ts, index.ts).
+The active preset is selected via env ``LODESTAR_PRESET`` (same contract as
+reference packages/params/src/setPreset.ts) or `set_active_preset()` before
+any dependent module reads sizes.
+"""
+
+import os
+from enum import IntEnum
+
+from .presets import BeaconPreset, MAINNET_PRESET, MINIMAL_PRESET, PRESETS
+
+__all__ = [
+    "BeaconPreset",
+    "MAINNET_PRESET",
+    "MINIMAL_PRESET",
+    "PRESETS",
+    "ACTIVE_PRESET_NAME",
+    "preset",
+    "set_active_preset",
+    "ForkName",
+    "ForkSeq",
+    "FORK_ORDER",
+]
+
+
+# ---------------------------------------------------------------------------
+# Active preset (reference: params/src/setPreset.ts — env before import)
+# ---------------------------------------------------------------------------
+
+ACTIVE_PRESET_NAME = os.environ.get("LODESTAR_PRESET", "mainnet")
+_active_preset = PRESETS[ACTIVE_PRESET_NAME]
+_preset_frozen = False
+
+
+def preset() -> BeaconPreset:
+    """Return the active preset (freezes it on first use)."""
+    global _preset_frozen
+    _preset_frozen = True
+    return _active_preset
+
+
+def set_active_preset(name: str) -> None:
+    global _active_preset, ACTIVE_PRESET_NAME
+    if _preset_frozen and PRESETS[name] is not _active_preset:
+        raise RuntimeError("preset already in use; set LODESTAR_PRESET before import")
+    ACTIVE_PRESET_NAME = name
+    _active_preset = PRESETS[name]
+
+
+# ---------------------------------------------------------------------------
+# Fork names / ordering (reference: params/src/forkName.ts)
+# ---------------------------------------------------------------------------
+
+
+class ForkName:
+    phase0 = "phase0"
+    altair = "altair"
+    bellatrix = "bellatrix"
+    capella = "capella"
+    deneb = "deneb"
+    electra = "electra"
+
+
+class ForkSeq(IntEnum):
+    phase0 = 0
+    altair = 1
+    bellatrix = 2
+    capella = 3
+    deneb = 4
+    electra = 5
+
+
+FORK_ORDER = [
+    ForkName.phase0,
+    ForkName.altair,
+    ForkName.bellatrix,
+    ForkName.capella,
+    ForkName.deneb,
+    ForkName.electra,
+]
+
+
+# ---------------------------------------------------------------------------
+# Non-preset spec constants (reference: params/src/index.ts)
+# ---------------------------------------------------------------------------
+
+GENESIS_SLOT = 0
+GENESIS_EPOCH = 0
+FAR_FUTURE_EPOCH = 2**64 - 1
+UINT64_MAX = 2**64 - 1
+
+BASE_REWARDS_PER_EPOCH = 4
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+ENDIANNESS = "little"
+
+# NOTE: SECONDS_PER_SLOT lives in ChainConfig (runtime-overridable), not here.
+INTERVALS_PER_SLOT = 3
+
+# Withdrawal prefixes
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
+
+# Domain types (spec: beacon-chain.md#domain-types)
+DOMAIN_BEACON_PROPOSER = bytes.fromhex("00000000")
+DOMAIN_BEACON_ATTESTER = bytes.fromhex("01000000")
+DOMAIN_RANDAO = bytes.fromhex("02000000")
+DOMAIN_DEPOSIT = bytes.fromhex("03000000")
+DOMAIN_VOLUNTARY_EXIT = bytes.fromhex("04000000")
+DOMAIN_SELECTION_PROOF = bytes.fromhex("05000000")
+DOMAIN_AGGREGATE_AND_PROOF = bytes.fromhex("06000000")
+DOMAIN_SYNC_COMMITTEE = bytes.fromhex("07000000")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = bytes.fromhex("08000000")
+DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
+DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
+DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
+DOMAIN_APPLICATION_BUILDER = bytes.fromhex("00000001")
+
+# Participation flag indices (altair)
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT,
+    TIMELY_TARGET_WEIGHT,
+    TIMELY_HEAD_WEIGHT,
+]
+
+# Sync committee subnets (altair p2p)
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+ATTESTATION_SUBNET_COUNT = 64
+
+# Deneb blob constants
+BYTES_PER_FIELD_ELEMENT = 32
+BLOB_TX_TYPE = 0x03
+VERSIONED_HASH_VERSION_KZG = b"\x01"
+
+# Electra constants
+UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+FULL_EXIT_REQUEST_AMOUNT = 0
+
+# BLS (IETF BLS spec, ciphersuite used by Ethereum)
+BLS_DST_SIG = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+BLS_DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"

@@ -1,0 +1,101 @@
+"""Tests for params presets and chain config / fork schedule / domains."""
+
+from hashlib import sha256
+
+from lodestar_tpu import params
+from lodestar_tpu.params import presets
+from lodestar_tpu.config import (
+    ChainConfig,
+    MAINNET_CONFIG,
+    MINIMAL_CONFIG,
+    ChainForkConfig,
+    create_beacon_config,
+)
+from lodestar_tpu.config.beacon_config import (
+    compute_domain,
+    compute_fork_data_root,
+    compute_fork_digest,
+)
+
+
+def test_mainnet_preset_spec_values():
+    p = presets.MAINNET_PRESET
+    assert p.SLOTS_PER_EPOCH == 32
+    assert p.MAX_COMMITTEES_PER_SLOT == 64
+    assert p.SHUFFLE_ROUND_COUNT == 90
+    assert p.VALIDATOR_REGISTRY_LIMIT == 2**40
+    assert p.SYNC_COMMITTEE_SIZE == 512
+    assert p.MAX_EFFECTIVE_BALANCE_ELECTRA == 2048 * 10**9
+
+
+def test_minimal_preset_spec_values():
+    p = presets.MINIMAL_PRESET
+    assert p.SLOTS_PER_EPOCH == 8
+    assert p.SHUFFLE_ROUND_COUNT == 10
+    assert p.SYNC_COMMITTEE_SIZE == 32
+    assert p.EPOCHS_PER_ETH1_VOTING_PERIOD == 4
+
+
+def test_active_preset_default_mainnet():
+    import os
+
+    expected = {"mainnet": 32, "minimal": 8}[os.environ.get("LODESTAR_PRESET", "mainnet")]
+    assert params.preset().SLOTS_PER_EPOCH == expected
+
+
+def test_fork_schedule_mainnet():
+    fc = ChainForkConfig(MAINNET_CONFIG)
+    assert fc.get_fork_name(0) == "phase0"
+    assert fc.get_fork_name(74239) == "phase0"
+    assert fc.get_fork_name(74240) == "altair"
+    assert fc.get_fork_name(144896) == "bellatrix"
+    assert fc.get_fork_name(194048) == "capella"
+    assert fc.get_fork_name(269568) == "deneb"
+    assert fc.get_fork_name(10**7) == "deneb"  # electra unscheduled by default
+    assert fc.get_fork_seq(269568) == 4
+
+
+def test_fork_schedule_electra_scheduled():
+    cfg = MAINNET_CONFIG.with_overrides(ELECTRA_FORK_EPOCH=300000)
+    fc = ChainForkConfig(cfg)
+    assert fc.get_fork_name(299999) == "deneb"
+    assert fc.get_fork_name(300000) == "electra"
+
+
+def test_fork_info_prev_version():
+    fc = ChainForkConfig(MAINNET_CONFIG)
+    altair = fc.forks["altair"]
+    assert altair.prev_version == MAINNET_CONFIG.GENESIS_FORK_VERSION
+    assert altair.prev_fork_name == "phase0"
+
+
+def test_compute_fork_data_root_matches_manual_sha():
+    version = bytes.fromhex("00000000")
+    gvr = b"\x42" * 32
+    expected = sha256(version + b"\x00" * 28 + gvr).digest()
+    assert compute_fork_data_root(version, gvr) == expected
+    assert compute_fork_digest(version, gvr) == expected[:4]
+
+
+def test_compute_domain_layout():
+    domain = compute_domain(params.DOMAIN_BEACON_PROPOSER, b"\x01\x00\x00\x00", b"\x00" * 32)
+    assert len(domain) == 32
+    assert domain[:4] == params.DOMAIN_BEACON_PROPOSER
+
+
+def test_beacon_config_domain_cache_and_digests():
+    gvr = b"\x11" * 32
+    bc = create_beacon_config(MAINNET_CONFIG, gvr)
+    d1 = bc.get_domain(params.DOMAIN_BEACON_ATTESTER, 0)
+    d2 = bc.get_domain(params.DOMAIN_BEACON_ATTESTER, 5)
+    assert d1 == d2  # same fork -> cached
+    d3 = bc.get_domain(params.DOMAIN_BEACON_ATTESTER, 74240)
+    assert d3 != d1  # altair fork -> different fork version
+    digest = bc.fork_digest(0)
+    assert bc.fork_name_from_digest(digest) == "phase0"
+    assert bc.fork_digest(74240) != digest
+
+
+def test_minimal_config_distinct():
+    assert MINIMAL_CONFIG.SECONDS_PER_SLOT == 6
+    assert MINIMAL_CONFIG.GENESIS_FORK_VERSION != MAINNET_CONFIG.GENESIS_FORK_VERSION
