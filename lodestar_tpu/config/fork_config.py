@@ -6,7 +6,7 @@ Reference analog: packages/config/src/forkConfig/index.ts
 
 from dataclasses import dataclass
 
-from ..params import ForkName, ForkSeq, GENESIS_EPOCH
+from ..params import FAR_FUTURE_EPOCH, ForkName, ForkSeq, GENESIS_EPOCH
 from .chain_config import ChainConfig
 
 
@@ -54,7 +54,7 @@ class ChainForkConfig:
         for fork in self.fork_schedule:
             # epoch == FAR_FUTURE_EPOCH means the fork is unscheduled and
             # never activates (spec semantics of *_FORK_EPOCH sentinels).
-            if fork.epoch != 2**64 - 1 and epoch >= fork.epoch:
+            if fork.epoch != FAR_FUTURE_EPOCH and epoch >= fork.epoch:
                 # schedule is sorted; later matching entries supersede
                 if fork.seq >= active.seq:
                     active = fork

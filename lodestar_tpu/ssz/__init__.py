@@ -1,0 +1,74 @@
+"""SSZ: SimpleSerialize types, serialization and merkleization.
+
+Reference analog: @chainsafe/ssz v0.18 (SURVEY.md §2.1). Own implementation
+of the consensus-specs SSZ spec. Incremental/cached merkleization lives on
+top of these primitives (see lodestar_tpu.ssz.cached)."""
+
+from .core import (
+    SSZType,
+    merkleize,
+    mix_in_length,
+    mix_in_selector,
+    pack_bytes,
+    zero_hash,
+    hash_nodes,
+    next_pow_of_two,
+)
+from .basic import uint8, uint16, uint32, uint64, uint128, uint256, boolean, UintType, BooleanType
+from .composite import (
+    ByteVectorType,
+    ByteListType,
+    BitvectorType,
+    BitlistType,
+    VectorType,
+    ListType,
+    ContainerType,
+    ContainerValue,
+)
+
+# Common aliases matching spec names
+Bytes4 = ByteVectorType(4)
+Bytes20 = ByteVectorType(20)
+Bytes32 = ByteVectorType(32)
+Bytes48 = ByteVectorType(48)
+Bytes96 = ByteVectorType(96)
+
+Root = Bytes32
+BLSPubkey = Bytes48
+BLSSignature = Bytes96
+
+__all__ = [
+    "SSZType",
+    "merkleize",
+    "mix_in_length",
+    "mix_in_selector",
+    "pack_bytes",
+    "zero_hash",
+    "hash_nodes",
+    "next_pow_of_two",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "uint128",
+    "uint256",
+    "boolean",
+    "UintType",
+    "BooleanType",
+    "ByteVectorType",
+    "ByteListType",
+    "BitvectorType",
+    "BitlistType",
+    "VectorType",
+    "ListType",
+    "ContainerType",
+    "ContainerValue",
+    "Bytes4",
+    "Bytes20",
+    "Bytes32",
+    "Bytes48",
+    "Bytes96",
+    "Root",
+    "BLSPubkey",
+    "BLSSignature",
+]

@@ -1,0 +1,77 @@
+"""SSZ basic types: unsigned integers and boolean."""
+
+from __future__ import annotations
+
+from .core import SSZType, merkleize, pack_bytes
+
+
+class UintType(SSZType):
+    def __init__(self, byte_length: int):
+        if byte_length not in (1, 2, 4, 8, 16, 32):
+            raise ValueError(f"invalid uint byte length {byte_length}")
+        self.byte_length = byte_length
+        self.bits = byte_length * 8
+        self._max = (1 << self.bits) - 1
+
+    def __repr__(self) -> str:
+        return f"uint{self.bits}"
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return self.byte_length
+
+    def serialize(self, value: int) -> bytes:
+        if not 0 <= value <= self._max:
+            raise ValueError(f"uint{self.bits} out of range: {value}")
+        return int(value).to_bytes(self.byte_length, "little")
+
+    def deserialize(self, data: bytes) -> int:
+        if len(data) != self.byte_length:
+            raise ValueError(f"uint{self.bits}: expected {self.byte_length} bytes, got {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value: int) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)))
+
+    def default(self) -> int:
+        return 0
+
+
+class BooleanType(SSZType):
+    def __repr__(self) -> str:
+        return "boolean"
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return 1
+
+    def serialize(self, value: bool) -> bytes:
+        if value not in (True, False, 0, 1):
+            raise ValueError(f"invalid boolean {value!r}")
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes) -> bool:
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise ValueError(f"invalid boolean encoding {data.hex()}")
+
+    def hash_tree_root(self, value: bool) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)))
+
+    def default(self) -> bool:
+        return False
+
+
+uint8 = UintType(1)
+uint16 = UintType(2)
+uint32 = UintType(4)
+uint64 = UintType(8)
+uint128 = UintType(16)
+uint256 = UintType(32)
+boolean = BooleanType()

@@ -1,0 +1,524 @@
+"""SSZ composite types: vectors, lists, bitfields, containers.
+
+Follows consensus-specs ssz/simple-serialize.md. Values are plain Python:
+bytes for byte vectors/lists, list[bool] for bitfields, list for
+vectors/lists, and generated attribute-style objects for containers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .core import (
+    BYTES_PER_CHUNK,
+    SSZType,
+    merkleize,
+    mix_in_length,
+    pack_bytes,
+)
+from .basic import UintType, BooleanType
+
+OFFSET_SIZE = 4
+
+
+def _is_basic(t: SSZType) -> bool:
+    return isinstance(t, (UintType, BooleanType))
+
+
+def _serialize_sequence(element_types: list[SSZType], values: list[Any]) -> bytes:
+    """Serialize a heterogeneous field/element sequence per the SSZ spec
+    (fixed parts + offsets to variable parts)."""
+    fixed_parts: list[bytes | None] = []
+    variable_parts: list[bytes] = []
+    for t, v in zip(element_types, values):
+        if t.is_fixed_size():
+            fixed_parts.append(t.serialize(v))
+            variable_parts.append(b"")
+        else:
+            fixed_parts.append(None)
+            variable_parts.append(t.serialize(v))
+    fixed_length = sum(
+        len(p) if p is not None else OFFSET_SIZE for p in fixed_parts
+    )
+    variable_offsets = []
+    offset = fixed_length
+    for vp in variable_parts:
+        variable_offsets.append(offset)
+        offset += len(vp)
+    out = bytearray()
+    for p, off in zip(fixed_parts, variable_offsets):
+        if p is not None:
+            out += p
+        else:
+            out += off.to_bytes(OFFSET_SIZE, "little")
+    for vp in variable_parts:
+        out += vp
+    return bytes(out)
+
+
+def _deserialize_sequence(
+    element_types: list[SSZType], data: bytes
+) -> list[Any]:
+    """Inverse of _serialize_sequence for a known-length type sequence."""
+    # First pass: compute fixed segment layout
+    fixed_sizes: list[int | None] = [
+        t.fixed_size() if t.is_fixed_size() else None for t in element_types
+    ]
+    fixed_length = sum(s if s is not None else OFFSET_SIZE for s in fixed_sizes)
+    if len(data) < fixed_length:
+        raise ValueError("SSZ: data shorter than fixed segment")
+    if all(s is not None for s in fixed_sizes) and len(data) != fixed_length:
+        raise ValueError("SSZ: trailing bytes after fixed-size value")
+    pos = 0
+    offsets: list[int] = []
+    fixed_slices: list[bytes | None] = []
+    for s in fixed_sizes:
+        if s is not None:
+            fixed_slices.append(data[pos : pos + s])
+            pos += s
+        else:
+            off = int.from_bytes(data[pos : pos + OFFSET_SIZE], "little")
+            offsets.append(off)
+            fixed_slices.append(None)
+            pos += OFFSET_SIZE
+    # Validate offsets
+    if offsets:
+        if offsets[0] != fixed_length:
+            raise ValueError(
+                f"SSZ: first offset {offsets[0]} != fixed length {fixed_length}"
+            )
+        for a, b in zip(offsets, offsets[1:]):
+            if b < a:
+                raise ValueError("SSZ: decreasing offsets")
+        if offsets[-1] > len(data):
+            raise ValueError("SSZ: offset beyond data end")
+    # Second pass: decode
+    values: list[Any] = []
+    var_idx = 0
+    for t, fs in zip(element_types, fixed_slices):
+        if fs is not None:
+            values.append(t.deserialize(fs))
+        else:
+            start = offsets[var_idx]
+            end = offsets[var_idx + 1] if var_idx + 1 < len(offsets) else len(data)
+            values.append(t.deserialize(data[start:end]))
+            var_idx += 1
+    return values
+
+
+# ---------------------------------------------------------------------------
+# Byte vectors / lists
+# ---------------------------------------------------------------------------
+
+
+class ByteVectorType(SSZType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def __repr__(self) -> str:
+        return f"ByteVector[{self.length}]"
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return self.length
+
+    def serialize(self, value: bytes) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"ByteVector[{self.length}]: got {len(value)} bytes")
+        return bytes(value)
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) != self.length:
+            raise ValueError(f"ByteVector[{self.length}]: got {len(data)} bytes")
+        return bytes(data)
+
+    def chunk_count(self) -> int:
+        return (self.length + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)))
+
+    def default(self) -> bytes:
+        return b"\x00" * self.length
+
+
+class ByteListType(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def __repr__(self) -> str:
+        return f"ByteList[{self.limit}]"
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def min_size(self) -> int:
+        return 0
+
+    def max_size(self) -> int:
+        return self.limit
+
+    def serialize(self, value: bytes) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"ByteList[{self.limit}]: got {len(value)} bytes")
+        return bytes(value)
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) > self.limit:
+            raise ValueError(f"ByteList[{self.limit}]: got {len(data)} bytes")
+        return bytes(data)
+
+    def chunk_count(self) -> int:
+        return (self.limit + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        root = merkleize(pack_bytes(value), limit=self.chunk_count())
+        return mix_in_length(root, len(value))
+
+    def default(self) -> bytes:
+        return b""
+
+
+# ---------------------------------------------------------------------------
+# Bitfields (values: list[bool])
+# ---------------------------------------------------------------------------
+
+
+def _bits_to_bytes(bits: list[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, bit in enumerate(bits):
+        if bit:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def _bytes_to_bits(data: bytes, count: int) -> list[bool]:
+    return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(count)]
+
+
+class BitvectorType(SSZType):
+    def __init__(self, length: int):
+        if length <= 0:
+            raise ValueError("Bitvector length must be > 0")
+        self.length = length
+
+    def __repr__(self) -> str:
+        return f"Bitvector[{self.length}]"
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return (self.length + 7) // 8
+
+    def serialize(self, value: list[bool]) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"Bitvector[{self.length}]: got {len(value)} bits")
+        return _bits_to_bytes(value)
+
+    def deserialize(self, data: bytes) -> list[bool]:
+        if len(data) != self.fixed_size():
+            raise ValueError(f"Bitvector[{self.length}]: got {len(data)} bytes")
+        # Excess bits in the last byte must be zero
+        if self.length % 8:
+            if data[-1] >> (self.length % 8):
+                raise ValueError("Bitvector: non-zero padding bits")
+        return _bytes_to_bits(data, self.length)
+
+    def chunk_count(self) -> int:
+        return (self.length + 255) // 256
+
+    def hash_tree_root(self, value: list[bool]) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)), limit=self.chunk_count())
+
+    def default(self) -> list[bool]:
+        return [False] * self.length
+
+
+class BitlistType(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def __repr__(self) -> str:
+        return f"Bitlist[{self.limit}]"
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def min_size(self) -> int:
+        return 1
+
+    def max_size(self) -> int:
+        return (self.limit // 8) + 1
+
+    def serialize(self, value: list[bool]) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {len(value)} bits")
+        # delimiter bit marks the length
+        bits = list(value) + [True]
+        return _bits_to_bytes(bits)
+
+    def deserialize(self, data: bytes) -> list[bool]:
+        if len(data) == 0:
+            raise ValueError("Bitlist: empty data")
+        last = data[-1]
+        if last == 0:
+            raise ValueError("Bitlist: missing delimiter bit")
+        bit_len = (len(data) - 1) * 8 + last.bit_length() - 1
+        if bit_len > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {bit_len} bits")
+        return _bytes_to_bits(data, bit_len)
+
+    def chunk_count(self) -> int:
+        return (self.limit + 255) // 256
+
+    def hash_tree_root(self, value: list[bool]) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {len(value)} bits")
+        root = merkleize(pack_bytes(_bits_to_bytes(value)), limit=self.chunk_count())
+        return mix_in_length(root, len(value))
+
+    def default(self) -> list[bool]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Vector / List
+# ---------------------------------------------------------------------------
+
+
+class VectorType(SSZType):
+    def __init__(self, element_type: SSZType, length: int):
+        if length <= 0:
+            raise ValueError("Vector length must be > 0")
+        self.element_type = element_type
+        self.length = length
+
+    def __repr__(self) -> str:
+        return f"Vector[{self.element_type!r}, {self.length}]"
+
+    def is_fixed_size(self) -> bool:
+        return self.element_type.is_fixed_size()
+
+    def fixed_size(self) -> int:
+        return self.element_type.fixed_size() * self.length
+
+    def min_size(self) -> int:
+        et = self.element_type
+        if et.is_fixed_size():
+            return self.fixed_size()
+        return self.length * (OFFSET_SIZE + et.min_size())
+
+    def max_size(self) -> int:
+        et = self.element_type
+        if et.is_fixed_size():
+            return self.fixed_size()
+        return self.length * (OFFSET_SIZE + et.max_size())
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"Vector[{self.length}]: got {len(value)} elements")
+        if self.element_type.is_fixed_size():
+            return b"".join(self.element_type.serialize(v) for v in value)
+        return _serialize_sequence([self.element_type] * self.length, list(value))
+
+    def deserialize(self, data: bytes) -> list:
+        et = self.element_type
+        if et.is_fixed_size():
+            es = et.fixed_size()
+            if len(data) != es * self.length:
+                raise ValueError("Vector: wrong byte length")
+            return [et.deserialize(data[i * es : (i + 1) * es]) for i in range(self.length)]
+        return _deserialize_sequence([et] * self.length, data)
+
+    def chunk_count(self) -> int:
+        if _is_basic(self.element_type):
+            return (self.length * self.element_type.fixed_size() + 31) // 32
+        return self.length
+
+    def hash_tree_root(self, value: list) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"Vector[{self.length}]: got {len(value)} elements")
+        et = self.element_type
+        if _is_basic(et):
+            data = b"".join(et.serialize(v) for v in value)
+            return merkleize(pack_bytes(data), limit=self.chunk_count())
+        chunks = [et.hash_tree_root(v) for v in value]
+        return merkleize(chunks, limit=self.chunk_count())
+
+    def default(self) -> list:
+        return [self.element_type.default() for _ in range(self.length)]
+
+
+class ListType(SSZType):
+    def __init__(self, element_type: SSZType, limit: int):
+        self.element_type = element_type
+        self.limit = limit
+
+    def __repr__(self) -> str:
+        return f"List[{self.element_type!r}, {self.limit}]"
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def min_size(self) -> int:
+        return 0
+
+    def max_size(self) -> int:
+        et = self.element_type
+        per = et.fixed_size() if et.is_fixed_size() else OFFSET_SIZE + et.max_size()
+        return per * self.limit
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"List[{self.limit}]: got {len(value)} elements")
+        et = self.element_type
+        if et.is_fixed_size():
+            return b"".join(et.serialize(v) for v in value)
+        return _serialize_sequence([et] * len(value), list(value))
+
+    def deserialize(self, data: bytes) -> list:
+        et = self.element_type
+        if et.is_fixed_size():
+            es = et.fixed_size()
+            if es == 0 or len(data) % es:
+                raise ValueError("List: byte length not a multiple of element size")
+            n = len(data) // es
+            if n > self.limit:
+                raise ValueError(f"List[{self.limit}]: got {n} elements")
+            return [et.deserialize(data[i * es : (i + 1) * es]) for i in range(n)]
+        if len(data) == 0:
+            return []
+        # element count from the first offset
+        first = int.from_bytes(data[:OFFSET_SIZE], "little")
+        if first % OFFSET_SIZE or first == 0:
+            raise ValueError("List: invalid first offset")
+        n = first // OFFSET_SIZE
+        if n > self.limit:
+            raise ValueError(f"List[{self.limit}]: got {n} elements")
+        return _deserialize_sequence([et] * n, data)
+
+    def chunk_count(self) -> int:
+        if _is_basic(self.element_type):
+            return (self.limit * self.element_type.fixed_size() + 31) // 32
+        return self.limit
+
+    def hash_tree_root(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"List[{self.limit}]: got {len(value)} elements")
+        et = self.element_type
+        if _is_basic(et):
+            data = b"".join(et.serialize(v) for v in value)
+            root = merkleize(pack_bytes(data), limit=self.chunk_count())
+        else:
+            chunks = [et.hash_tree_root(v) for v in value]
+            root = merkleize(chunks, limit=self.chunk_count())
+        return mix_in_length(root, len(value))
+
+    def default(self) -> list:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class ContainerValue:
+    """Attribute-style value for ContainerType; generated per container."""
+
+    _type: "ContainerType"
+    __slots__ = ()
+
+    def __init__(self, **kwargs):
+        for name in self._type.field_names:
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            else:
+                setattr(self, name, self._type.field_types[name].default())
+        if kwargs:
+            raise TypeError(f"unknown fields {sorted(kwargs)} for {self._type.name}")
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ContainerValue) or other._type is not self._type:
+            return NotImplemented
+        return all(
+            getattr(self, n) == getattr(other, n) for n in self._type.field_names
+        )
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{n}={getattr(self, n)!r}" for n in self._type.field_names[:4]
+        )
+        more = "..." if len(self._type.field_names) > 4 else ""
+        return f"{self._type.name}({inner}{more})"
+
+    def copy(self):
+        return self._type.value_class(
+            **{n: getattr(self, n) for n in self._type.field_names}
+        )
+
+
+class ContainerType(SSZType):
+    def __init__(self, name: str, fields: list[tuple[str, SSZType]]):
+        if not fields:
+            raise ValueError("Container must have at least one field")
+        self.name = name
+        self.fields = list(fields)
+        self.field_names = [n for n, _ in fields]
+        self.field_types = dict(fields)
+        self._types_list = [t for _, t in fields]
+        self.value_class = type(
+            name,
+            (ContainerValue,),
+            {"_type": self, "__slots__": tuple(self.field_names)},
+        )
+        self._fixed = all(t.is_fixed_size() for t in self._types_list)
+
+    def __repr__(self) -> str:
+        return f"Container[{self.name}]"
+
+    def __call__(self, **kwargs) -> ContainerValue:
+        return self.value_class(**kwargs)
+
+    def is_fixed_size(self) -> bool:
+        return self._fixed
+
+    def fixed_size(self) -> int:
+        if not self._fixed:
+            raise ValueError(f"{self.name} is variable-size")
+        return sum(t.fixed_size() for t in self._types_list)
+
+    def min_size(self) -> int:
+        return sum(
+            t.fixed_size() if t.is_fixed_size() else OFFSET_SIZE + t.min_size()
+            for t in self._types_list
+        )
+
+    def max_size(self) -> int:
+        return sum(
+            t.fixed_size() if t.is_fixed_size() else OFFSET_SIZE + t.max_size()
+            for t in self._types_list
+        )
+
+    def serialize(self, value: ContainerValue) -> bytes:
+        values = [getattr(value, n) for n in self.field_names]
+        return _serialize_sequence(self._types_list, values)
+
+    def deserialize(self, data: bytes) -> ContainerValue:
+        values = _deserialize_sequence(self._types_list, data)
+        return self.value_class(**dict(zip(self.field_names, values)))
+
+    def chunk_count(self) -> int:
+        return len(self.fields)
+
+    def hash_tree_root(self, value: ContainerValue) -> bytes:
+        chunks = [
+            t.hash_tree_root(getattr(value, n)) for n, t in self.fields
+        ]
+        return merkleize(chunks)
+
+    def default(self) -> ContainerValue:
+        return self.value_class()

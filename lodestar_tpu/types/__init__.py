@@ -1,0 +1,11 @@
+"""Per-fork SSZ beacon types.
+
+Reference analog: packages/types/src/{phase0,altair,bellatrix,capella,deneb,
+electra}/sszTypes.ts + primitive types. Types are built per-preset via
+create_ssz_types(); module-level ``ssz_types()`` returns the registry for
+the active preset (cached).
+"""
+
+from .factory import SszTypes, create_ssz_types, ssz_types
+
+__all__ = ["SszTypes", "create_ssz_types", "ssz_types"]

@@ -1,0 +1,648 @@
+"""SSZ beacon container definitions for every fork, sized by preset.
+
+Reference analog: packages/types/src/sszTypes.ts and per-fork modules
+(packages/types/src/{phase0,altair,bellatrix,capella,deneb,electra}/sszTypes.ts).
+Field orders follow ethereum/consensus-specs — order is consensus-critical
+(it determines hash_tree_root).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from ..params import (
+    BeaconPreset,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    preset as active_preset,
+)
+from ..ssz import (
+    BLSPubkey,
+    BLSSignature,
+    BitlistType,
+    BitvectorType,
+    ByteListType,
+    ByteVectorType,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    ContainerType,
+    ListType,
+    Root,
+    VectorType,
+    boolean,
+    uint8,
+    uint64,
+    uint256,
+)
+
+
+class SszTypes(SimpleNamespace):
+    """Namespace of fork namespaces: .phase0, .altair, ... plus shared."""
+
+
+def _C(name, fields):
+    return ContainerType(name, fields)
+
+
+def create_ssz_types(p: BeaconPreset) -> SszTypes:  # noqa: PLR0915
+    t = SszTypes()
+    t.preset = p
+
+    # -- primitives / shared ------------------------------------------------
+    Epoch = uint64
+    Slot = uint64
+    ValidatorIndex = uint64
+    Gwei = uint64
+    CommitteeIndex = uint64
+    ExecutionAddress = Bytes20
+
+    t.Fork = _C("Fork", [
+        ("previous_version", Bytes4),
+        ("current_version", Bytes4),
+        ("epoch", Epoch),
+    ])
+    t.ForkData = _C("ForkData", [
+        ("current_version", Bytes4),
+        ("genesis_validators_root", Root),
+    ])
+    t.Checkpoint = _C("Checkpoint", [("epoch", Epoch), ("root", Root)])
+    t.SigningData = _C("SigningData", [("object_root", Root), ("domain", Bytes32)])
+    t.Validator = _C("Validator", [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("effective_balance", Gwei),
+        ("slashed", boolean),
+        ("activation_eligibility_epoch", Epoch),
+        ("activation_epoch", Epoch),
+        ("exit_epoch", Epoch),
+        ("withdrawable_epoch", Epoch),
+    ])
+    t.AttestationData = _C("AttestationData", [
+        ("slot", Slot),
+        ("index", CommitteeIndex),
+        ("beacon_block_root", Root),
+        ("source", t.Checkpoint),
+        ("target", t.Checkpoint),
+    ])
+    t.Eth1Data = _C("Eth1Data", [
+        ("deposit_root", Root),
+        ("deposit_count", uint64),
+        ("block_hash", Bytes32),
+    ])
+    t.DepositMessage = _C("DepositMessage", [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+    ])
+    t.DepositData = _C("DepositData", [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+        ("signature", BLSSignature),
+    ])
+    t.Deposit = _C("Deposit", [
+        ("proof", VectorType(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
+        ("data", t.DepositData),
+    ])
+    t.BeaconBlockHeader = _C("BeaconBlockHeader", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body_root", Root),
+    ])
+    t.SignedBeaconBlockHeader = _C("SignedBeaconBlockHeader", [
+        ("message", t.BeaconBlockHeader),
+        ("signature", BLSSignature),
+    ])
+    t.ProposerSlashing = _C("ProposerSlashing", [
+        ("signed_header_1", t.SignedBeaconBlockHeader),
+        ("signed_header_2", t.SignedBeaconBlockHeader),
+    ])
+    t.VoluntaryExit = _C("VoluntaryExit", [
+        ("epoch", Epoch),
+        ("validator_index", ValidatorIndex),
+    ])
+    t.SignedVoluntaryExit = _C("SignedVoluntaryExit", [
+        ("message", t.VoluntaryExit),
+        ("signature", BLSSignature),
+    ])
+    t.Eth1Block = _C("Eth1Block", [
+        ("timestamp", uint64),
+        ("deposit_root", Root),
+        ("deposit_count", uint64),
+    ])
+
+    CommitteeIndices = ListType(ValidatorIndex, p.MAX_VALIDATORS_PER_COMMITTEE)
+    t.IndexedAttestation = _C("IndexedAttestation", [
+        ("attesting_indices", CommitteeIndices),
+        ("data", t.AttestationData),
+        ("signature", BLSSignature),
+    ])
+    t.AttesterSlashing = _C("AttesterSlashing", [
+        ("attestation_1", t.IndexedAttestation),
+        ("attestation_2", t.IndexedAttestation),
+    ])
+    t.Attestation = _C("Attestation", [
+        ("aggregation_bits", BitlistType(p.MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", t.AttestationData),
+        ("signature", BLSSignature),
+    ])
+    t.PendingAttestation = _C("PendingAttestation", [
+        ("aggregation_bits", BitlistType(p.MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", t.AttestationData),
+        ("inclusion_delay", Slot),
+        ("proposer_index", ValidatorIndex),
+    ])
+    t.AggregateAndProof = _C("AggregateAndProof", [
+        ("aggregator_index", ValidatorIndex),
+        ("aggregate", t.Attestation),
+        ("selection_proof", BLSSignature),
+    ])
+    t.SignedAggregateAndProof = _C("SignedAggregateAndProof", [
+        ("message", t.AggregateAndProof),
+        ("signature", BLSSignature),
+    ])
+
+    BlockRoots = VectorType(Root, p.SLOTS_PER_HISTORICAL_ROOT)
+    StateRoots = VectorType(Root, p.SLOTS_PER_HISTORICAL_ROOT)
+    HistoricalRoots = ListType(Root, p.HISTORICAL_ROOTS_LIMIT)
+    Eth1DataVotes = ListType(
+        t.Eth1Data, p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH
+    )
+    Validators = ListType(t.Validator, p.VALIDATOR_REGISTRY_LIMIT)
+    Balances = ListType(Gwei, p.VALIDATOR_REGISTRY_LIMIT)
+    RandaoMixes = VectorType(Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR)
+    Slashings = VectorType(Gwei, p.EPOCHS_PER_SLASHINGS_VECTOR)
+    JustificationBits = BitvectorType(JUSTIFICATION_BITS_LENGTH)
+    EpochAttestations = ListType(
+        t.PendingAttestation, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH
+    )
+    t.HistoricalBatch = _C("HistoricalBatch", [
+        ("block_roots", BlockRoots),
+        ("state_roots", StateRoots),
+    ])
+
+    # == phase0 =============================================================
+    phase0 = SimpleNamespace()
+    phase0.BeaconBlockBody = _C("BeaconBlockBodyPhase0", [
+        ("randao_reveal", BLSSignature),
+        ("eth1_data", t.Eth1Data),
+        ("graffiti", Bytes32),
+        ("proposer_slashings", ListType(t.ProposerSlashing, p.MAX_PROPOSER_SLASHINGS)),
+        ("attester_slashings", ListType(t.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS)),
+        ("attestations", ListType(t.Attestation, p.MAX_ATTESTATIONS)),
+        ("deposits", ListType(t.Deposit, p.MAX_DEPOSITS)),
+        ("voluntary_exits", ListType(t.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS)),
+    ])
+    phase0.BeaconBlock = _C("BeaconBlockPhase0", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", phase0.BeaconBlockBody),
+    ])
+    phase0.SignedBeaconBlock = _C("SignedBeaconBlockPhase0", [
+        ("message", phase0.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    phase0.BeaconState = _C("BeaconStatePhase0", [
+        ("genesis_time", uint64),
+        ("genesis_validators_root", Root),
+        ("slot", Slot),
+        ("fork", t.Fork),
+        ("latest_block_header", t.BeaconBlockHeader),
+        ("block_roots", BlockRoots),
+        ("state_roots", StateRoots),
+        ("historical_roots", HistoricalRoots),
+        ("eth1_data", t.Eth1Data),
+        ("eth1_data_votes", Eth1DataVotes),
+        ("eth1_deposit_index", uint64),
+        ("validators", Validators),
+        ("balances", Balances),
+        ("randao_mixes", RandaoMixes),
+        ("slashings", Slashings),
+        ("previous_epoch_attestations", EpochAttestations),
+        ("current_epoch_attestations", EpochAttestations),
+        ("justification_bits", JustificationBits),
+        ("previous_justified_checkpoint", t.Checkpoint),
+        ("current_justified_checkpoint", t.Checkpoint),
+        ("finalized_checkpoint", t.Checkpoint),
+    ])
+    t.phase0 = phase0
+
+    # == altair =============================================================
+    altair = SimpleNamespace()
+    t.SyncCommittee = _C("SyncCommittee", [
+        ("pubkeys", VectorType(BLSPubkey, p.SYNC_COMMITTEE_SIZE)),
+        ("aggregate_pubkey", BLSPubkey),
+    ])
+    t.SyncAggregate = _C("SyncAggregate", [
+        ("sync_committee_bits", BitvectorType(p.SYNC_COMMITTEE_SIZE)),
+        ("sync_committee_signature", BLSSignature),
+    ])
+    t.SyncCommitteeMessage = _C("SyncCommitteeMessage", [
+        ("slot", Slot),
+        ("beacon_block_root", Root),
+        ("validator_index", ValidatorIndex),
+        ("signature", BLSSignature),
+    ])
+    t.SyncCommitteeContribution = _C("SyncCommitteeContribution", [
+        ("slot", Slot),
+        ("beacon_block_root", Root),
+        ("subcommittee_index", uint64),
+        ("aggregation_bits", BitvectorType(p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT)),
+        ("signature", BLSSignature),
+    ])
+    t.ContributionAndProof = _C("ContributionAndProof", [
+        ("aggregator_index", ValidatorIndex),
+        ("contribution", t.SyncCommitteeContribution),
+        ("selection_proof", BLSSignature),
+    ])
+    t.SignedContributionAndProof = _C("SignedContributionAndProof", [
+        ("message", t.ContributionAndProof),
+        ("signature", BLSSignature),
+    ])
+    t.SyncAggregatorSelectionData = _C("SyncAggregatorSelectionData", [
+        ("slot", Slot),
+        ("subcommittee_index", uint64),
+    ])
+
+    EpochParticipation = ListType(uint8, p.VALIDATOR_REGISTRY_LIMIT)
+    InactivityScores = ListType(uint64, p.VALIDATOR_REGISTRY_LIMIT)
+
+    altair.BeaconBlockBody = _C("BeaconBlockBodyAltair", [
+        *phase0.BeaconBlockBody.fields,
+        ("sync_aggregate", t.SyncAggregate),
+    ])
+    altair.BeaconBlock = _C("BeaconBlockAltair", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", altair.BeaconBlockBody),
+    ])
+    altair.SignedBeaconBlock = _C("SignedBeaconBlockAltair", [
+        ("message", altair.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    altair.BeaconState = _C("BeaconStateAltair", [
+        ("genesis_time", uint64),
+        ("genesis_validators_root", Root),
+        ("slot", Slot),
+        ("fork", t.Fork),
+        ("latest_block_header", t.BeaconBlockHeader),
+        ("block_roots", BlockRoots),
+        ("state_roots", StateRoots),
+        ("historical_roots", HistoricalRoots),
+        ("eth1_data", t.Eth1Data),
+        ("eth1_data_votes", Eth1DataVotes),
+        ("eth1_deposit_index", uint64),
+        ("validators", Validators),
+        ("balances", Balances),
+        ("randao_mixes", RandaoMixes),
+        ("slashings", Slashings),
+        ("previous_epoch_participation", EpochParticipation),
+        ("current_epoch_participation", EpochParticipation),
+        ("justification_bits", JustificationBits),
+        ("previous_justified_checkpoint", t.Checkpoint),
+        ("current_justified_checkpoint", t.Checkpoint),
+        ("finalized_checkpoint", t.Checkpoint),
+        ("inactivity_scores", InactivityScores),
+        ("current_sync_committee", t.SyncCommittee),
+        ("next_sync_committee", t.SyncCommittee),
+    ])
+    t.altair = altair
+
+    # == bellatrix ==========================================================
+    bellatrix = SimpleNamespace()
+    Transaction = ByteListType(p.MAX_BYTES_PER_TRANSACTION)
+    Transactions = ListType(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD)
+    LogsBloom = ByteVectorType(p.BYTES_PER_LOGS_BLOOM)
+    ExtraData = ByteListType(p.MAX_EXTRA_DATA_BYTES)
+
+    _payload_head = [
+        ("parent_hash", Bytes32),
+        ("fee_recipient", ExecutionAddress),
+        ("state_root", Bytes32),
+        ("receipts_root", Bytes32),
+        ("logs_bloom", LogsBloom),
+        ("prev_randao", Bytes32),
+        ("block_number", uint64),
+        ("gas_limit", uint64),
+        ("gas_used", uint64),
+        ("timestamp", uint64),
+        ("extra_data", ExtraData),
+        ("base_fee_per_gas", uint256),
+        ("block_hash", Bytes32),
+    ]
+    bellatrix.ExecutionPayload = _C("ExecutionPayloadBellatrix", [
+        *_payload_head,
+        ("transactions", Transactions),
+    ])
+    bellatrix.ExecutionPayloadHeader = _C("ExecutionPayloadHeaderBellatrix", [
+        *_payload_head,
+        ("transactions_root", Root),
+    ])
+    t.PowBlock = _C("PowBlock", [
+        ("block_hash", Bytes32),
+        ("parent_hash", Bytes32),
+        ("total_difficulty", uint256),
+    ])
+    bellatrix.BeaconBlockBody = _C("BeaconBlockBodyBellatrix", [
+        *altair.BeaconBlockBody.fields,
+        ("execution_payload", bellatrix.ExecutionPayload),
+    ])
+    bellatrix.BeaconBlock = _C("BeaconBlockBellatrix", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", bellatrix.BeaconBlockBody),
+    ])
+    bellatrix.SignedBeaconBlock = _C("SignedBeaconBlockBellatrix", [
+        ("message", bellatrix.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    bellatrix.BeaconState = _C("BeaconStateBellatrix", [
+        *altair.BeaconState.fields,
+        ("latest_execution_payload_header", bellatrix.ExecutionPayloadHeader),
+    ])
+    t.bellatrix = bellatrix
+
+    # == capella ============================================================
+    capella = SimpleNamespace()
+    t.Withdrawal = _C("Withdrawal", [
+        ("index", uint64),
+        ("validator_index", ValidatorIndex),
+        ("address", ExecutionAddress),
+        ("amount", Gwei),
+    ])
+    t.BLSToExecutionChange = _C("BLSToExecutionChange", [
+        ("validator_index", ValidatorIndex),
+        ("from_bls_pubkey", BLSPubkey),
+        ("to_execution_address", ExecutionAddress),
+    ])
+    t.SignedBLSToExecutionChange = _C("SignedBLSToExecutionChange", [
+        ("message", t.BLSToExecutionChange),
+        ("signature", BLSSignature),
+    ])
+    t.HistoricalSummary = _C("HistoricalSummary", [
+        ("block_summary_root", Root),
+        ("state_summary_root", Root),
+    ])
+    Withdrawals = ListType(t.Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD)
+    capella.ExecutionPayload = _C("ExecutionPayloadCapella", [
+        *_payload_head,
+        ("transactions", Transactions),
+        ("withdrawals", Withdrawals),
+    ])
+    capella.ExecutionPayloadHeader = _C("ExecutionPayloadHeaderCapella", [
+        *_payload_head,
+        ("transactions_root", Root),
+        ("withdrawals_root", Root),
+    ])
+    capella.BeaconBlockBody = _C("BeaconBlockBodyCapella", [
+        *altair.BeaconBlockBody.fields,
+        ("execution_payload", capella.ExecutionPayload),
+        ("bls_to_execution_changes", ListType(t.SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)),
+    ])
+    capella.BeaconBlock = _C("BeaconBlockCapella", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", capella.BeaconBlockBody),
+    ])
+    capella.SignedBeaconBlock = _C("SignedBeaconBlockCapella", [
+        ("message", capella.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    capella.BeaconState = _C("BeaconStateCapella", [
+        *altair.BeaconState.fields,
+        ("latest_execution_payload_header", capella.ExecutionPayloadHeader),
+        ("next_withdrawal_index", uint64),
+        ("next_withdrawal_validator_index", ValidatorIndex),
+        ("historical_summaries", ListType(t.HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)),
+    ])
+    t.capella = capella
+
+    # == deneb ==============================================================
+    deneb = SimpleNamespace()
+    deneb.ExecutionPayload = _C("ExecutionPayloadDeneb", [
+        *_payload_head,
+        ("transactions", Transactions),
+        ("withdrawals", Withdrawals),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
+    ])
+    deneb.ExecutionPayloadHeader = _C("ExecutionPayloadHeaderDeneb", [
+        *_payload_head,
+        ("transactions_root", Root),
+        ("withdrawals_root", Root),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
+    ])
+    KZGCommitment = ByteVectorType(48)
+    KZGProof = ByteVectorType(48)
+    t.KZGCommitment = KZGCommitment
+    BlobKzgCommitments = ListType(KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+    deneb.BeaconBlockBody = _C("BeaconBlockBodyDeneb", [
+        *altair.BeaconBlockBody.fields,
+        ("execution_payload", deneb.ExecutionPayload),
+        ("bls_to_execution_changes", ListType(t.SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)),
+        ("blob_kzg_commitments", BlobKzgCommitments),
+    ])
+    deneb.BeaconBlock = _C("BeaconBlockDeneb", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", deneb.BeaconBlockBody),
+    ])
+    deneb.SignedBeaconBlock = _C("SignedBeaconBlockDeneb", [
+        ("message", deneb.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    deneb.BeaconState = _C("BeaconStateDeneb", [
+        (n, deneb.ExecutionPayloadHeader if n == "latest_execution_payload_header" else ty)
+        for n, ty in capella.BeaconState.fields
+    ])
+    Blob = ByteVectorType(32 * p.FIELD_ELEMENTS_PER_BLOB)
+    t.Blob = Blob
+    deneb.BlobSidecar = _C("BlobSidecar", [
+        ("index", uint64),
+        ("blob", Blob),
+        ("kzg_commitment", KZGCommitment),
+        ("kzg_proof", KZGProof),
+        ("signed_block_header", t.SignedBeaconBlockHeader),
+        ("kzg_commitment_inclusion_proof", VectorType(Bytes32, p.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)),
+    ])
+    deneb.BlobIdentifier = _C("BlobIdentifier", [
+        ("block_root", Root),
+        ("index", uint64),
+    ])
+    t.deneb = deneb
+
+    # == electra ============================================================
+    electra = SimpleNamespace()
+    agg_bits_limit = p.MAX_VALIDATORS_PER_COMMITTEE * p.MAX_COMMITTEES_PER_SLOT
+    electra.Attestation = _C("AttestationElectra", [
+        ("aggregation_bits", BitlistType(agg_bits_limit)),
+        ("data", t.AttestationData),
+        ("signature", BLSSignature),
+        ("committee_bits", BitvectorType(p.MAX_COMMITTEES_PER_SLOT)),
+    ])
+    electra.IndexedAttestation = _C("IndexedAttestationElectra", [
+        ("attesting_indices", ListType(ValidatorIndex, agg_bits_limit)),
+        ("data", t.AttestationData),
+        ("signature", BLSSignature),
+    ])
+    electra.AttesterSlashing = _C("AttesterSlashingElectra", [
+        ("attestation_1", electra.IndexedAttestation),
+        ("attestation_2", electra.IndexedAttestation),
+    ])
+    electra.AggregateAndProof = _C("AggregateAndProofElectra", [
+        ("aggregator_index", ValidatorIndex),
+        ("aggregate", electra.Attestation),
+        ("selection_proof", BLSSignature),
+    ])
+    electra.SignedAggregateAndProof = _C("SignedAggregateAndProofElectra", [
+        ("message", electra.AggregateAndProof),
+        ("signature", BLSSignature),
+    ])
+    electra.SingleAttestation = _C("SingleAttestation", [
+        ("committee_index", CommitteeIndex),
+        ("attester_index", ValidatorIndex),
+        ("data", t.AttestationData),
+        ("signature", BLSSignature),
+    ])
+    t.DepositRequest = _C("DepositRequest", [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+        ("signature", BLSSignature),
+        ("index", uint64),
+    ])
+    t.WithdrawalRequest = _C("WithdrawalRequest", [
+        ("source_address", ExecutionAddress),
+        ("validator_pubkey", BLSPubkey),
+        ("amount", Gwei),
+    ])
+    t.ConsolidationRequest = _C("ConsolidationRequest", [
+        ("source_address", ExecutionAddress),
+        ("source_pubkey", BLSPubkey),
+        ("target_pubkey", BLSPubkey),
+    ])
+    t.ExecutionRequests = _C("ExecutionRequests", [
+        ("deposits", ListType(t.DepositRequest, p.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD)),
+        ("withdrawals", ListType(t.WithdrawalRequest, p.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD)),
+        ("consolidations", ListType(t.ConsolidationRequest, p.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD)),
+    ])
+    t.PendingDeposit = _C("PendingDeposit", [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+        ("signature", BLSSignature),
+        ("slot", Slot),
+    ])
+    t.PendingPartialWithdrawal = _C("PendingPartialWithdrawal", [
+        ("validator_index", ValidatorIndex),
+        ("amount", Gwei),
+        ("withdrawable_epoch", Epoch),
+    ])
+    t.PendingConsolidation = _C("PendingConsolidation", [
+        ("source_index", ValidatorIndex),
+        ("target_index", ValidatorIndex),
+    ])
+    _electra_body_subs = {
+        "attester_slashings": ListType(electra.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS_ELECTRA),
+        "attestations": ListType(electra.Attestation, p.MAX_ATTESTATIONS_ELECTRA),
+    }
+    electra.BeaconBlockBody = _C("BeaconBlockBodyElectra", [
+        *[(n, _electra_body_subs.get(n, ty)) for n, ty in deneb.BeaconBlockBody.fields],
+        ("execution_requests", t.ExecutionRequests),
+    ])
+    electra.BeaconBlock = _C("BeaconBlockElectra", [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body", electra.BeaconBlockBody),
+    ])
+    electra.SignedBeaconBlock = _C("SignedBeaconBlockElectra", [
+        ("message", electra.BeaconBlock),
+        ("signature", BLSSignature),
+    ])
+    electra.BeaconState = _C("BeaconStateElectra", [
+        *deneb.BeaconState.fields,
+        ("deposit_requests_start_index", uint64),
+        ("deposit_balance_to_consume", Gwei),
+        ("exit_balance_to_consume", Gwei),
+        ("earliest_exit_epoch", Epoch),
+        ("consolidation_balance_to_consume", Gwei),
+        ("earliest_consolidation_epoch", Epoch),
+        ("pending_deposits", ListType(t.PendingDeposit, p.PENDING_DEPOSITS_LIMIT)),
+        ("pending_partial_withdrawals", ListType(t.PendingPartialWithdrawal, p.PENDING_PARTIAL_WITHDRAWALS_LIMIT)),
+        ("pending_consolidations", ListType(t.PendingConsolidation, p.PENDING_CONSOLIDATIONS_LIMIT)),
+    ])
+    t.electra = electra
+
+    # -- light client (altair+, capella header form kept simple for now) ----
+    lc = SimpleNamespace()
+    lc.LightClientHeader = _C("LightClientHeader", [
+        ("beacon", t.BeaconBlockHeader),
+    ])
+    SyncCommitteeBranch = VectorType(Bytes32, 5)
+    FinalityBranch = VectorType(Bytes32, 6)
+    lc.LightClientBootstrap = _C("LightClientBootstrap", [
+        ("header", lc.LightClientHeader),
+        ("current_sync_committee", t.SyncCommittee),
+        ("current_sync_committee_branch", SyncCommitteeBranch),
+    ])
+    lc.LightClientUpdate = _C("LightClientUpdate", [
+        ("attested_header", lc.LightClientHeader),
+        ("next_sync_committee", t.SyncCommittee),
+        ("next_sync_committee_branch", SyncCommitteeBranch),
+        ("finalized_header", lc.LightClientHeader),
+        ("finality_branch", FinalityBranch),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+    lc.LightClientFinalityUpdate = _C("LightClientFinalityUpdate", [
+        ("attested_header", lc.LightClientHeader),
+        ("finalized_header", lc.LightClientHeader),
+        ("finality_branch", FinalityBranch),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+    lc.LightClientOptimisticUpdate = _C("LightClientOptimisticUpdate", [
+        ("attested_header", lc.LightClientHeader),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+    t.lightclient = lc
+
+    # fork name -> namespace
+    t.by_fork = {
+        "phase0": phase0,
+        "altair": altair,
+        "bellatrix": bellatrix,
+        "capella": capella,
+        "deneb": deneb,
+        "electra": electra,
+    }
+    return t
+
+
+_cached: SszTypes | None = None
+
+
+def ssz_types() -> SszTypes:
+    """Types for the active preset (cached)."""
+    global _cached
+    if _cached is None:
+        _cached = create_ssz_types(active_preset())
+    return _cached

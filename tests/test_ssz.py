@@ -1,0 +1,300 @@
+"""SSZ serialization + merkleization tests.
+
+Known-answer anchors:
+- mainnet fork digests (ForkData container root) — externally known values
+- empty deposit tree root (List[DepositData, 2**32] analog via zero hashes),
+  the famous constant baked into the eth2 deposit contract
+- spec examples for bitlist encoding
+"""
+
+from hashlib import sha256
+
+import pytest
+
+from lodestar_tpu import ssz
+from lodestar_tpu.ssz import (
+    BitlistType,
+    BitvectorType,
+    ByteListType,
+    ByteVectorType,
+    ContainerType,
+    ListType,
+    VectorType,
+    boolean,
+    merkleize,
+    mix_in_length,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint256,
+    zero_hash,
+)
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+
+def test_uint_serialize_roundtrip():
+    assert uint64.serialize(0x0102030405060708) == bytes.fromhex("0807060504030201")
+    assert uint64.deserialize(bytes.fromhex("0807060504030201")) == 0x0102030405060708
+    assert uint16.serialize(0xABCD) == bytes.fromhex("cdab")
+    assert uint8.serialize(255) == b"\xff"
+    with pytest.raises(ValueError):
+        uint8.serialize(256)
+    with pytest.raises(ValueError):
+        uint64.serialize(-1)
+
+
+def test_uint_root_is_padded_chunk():
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+    assert uint256.hash_tree_root(1) == (1).to_bytes(32, "little")
+
+
+def test_boolean():
+    assert boolean.serialize(True) == b"\x01"
+    assert boolean.deserialize(b"\x00") is False
+    with pytest.raises(ValueError):
+        boolean.deserialize(b"\x02")
+
+
+# ---------------------------------------------------------------------------
+# Merkleize primitives
+# ---------------------------------------------------------------------------
+
+
+def test_merkleize_single_chunk_identity():
+    c = b"\x42" * 32
+    assert merkleize([c]) == c
+
+
+def test_merkleize_two_chunks():
+    a, b = b"\x01" * 32, b"\x02" * 32
+    assert merkleize([a, b]) == sha256(a + b).digest()
+
+
+def test_merkleize_padding_with_zero_subtrees():
+    a = b"\x01" * 32
+    # 1 chunk with limit 4: h(h(a,z0), z1)
+    expected = sha256(sha256(a + zero_hash(0)).digest() + zero_hash(1)).digest()
+    assert merkleize([a], limit=4) == expected
+
+
+def test_merkleize_empty_with_limit():
+    assert merkleize([], limit=4) == zero_hash(2)
+    assert merkleize([], limit=1) == zero_hash(0)
+
+
+def test_merkleize_rejects_overflow():
+    with pytest.raises(ValueError):
+        merkleize([b"\x00" * 32] * 3, limit=2)
+
+
+def test_empty_deposit_tree_root():
+    # The eth2 deposit contract's initial deposit root:
+    # mix_in_length(zero_hash(32), 0). Constant hardcoded in the deployed
+    # contract — external anchor for the zero-hash cascade + length mix-in.
+    root = mix_in_length(zero_hash(32), 0)
+    assert root.hex() == "d70a234731285c6804c2a4f56711ddb8c82c99740f207854891028af34e27e5e"
+
+
+# ---------------------------------------------------------------------------
+# ForkData container — anchored to known mainnet fork digests
+# ---------------------------------------------------------------------------
+
+MAINNET_GVR = bytes.fromhex(
+    "4b363db94e286120d76eb905340fdd4e54bfe9f06bf33ff6cf5ad27f511bfe95"
+)
+
+
+def test_fork_data_root_matches_mainnet_digests():
+    ForkData = ContainerType(
+        "ForkData",
+        [("current_version", ssz.Bytes4), ("genesis_validators_root", ssz.Root)],
+    )
+    for version, digest in [
+        ("00000000", "b5303f2a"),
+        ("01000000", "afcaaba0"),
+        ("02000000", "4a26c58b"),
+        ("03000000", "bba4da96"),
+        ("04000000", "6a95a1a9"),
+    ]:
+        v = ForkData(
+            current_version=bytes.fromhex(version),
+            genesis_validators_root=MAINNET_GVR,
+        )
+        assert ForkData.hash_tree_root(v)[:4].hex() == digest
+
+
+# ---------------------------------------------------------------------------
+# Byte vectors / lists
+# ---------------------------------------------------------------------------
+
+
+def test_bytevector():
+    t = ByteVectorType(48)
+    v = bytes(range(48))
+    assert t.serialize(v) == v
+    assert t.deserialize(v) == v
+    # 48 bytes -> 2 chunks
+    assert t.hash_tree_root(v) == sha256(v[:32] + v[32:] + b"\x00" * 16).digest()
+    with pytest.raises(ValueError):
+        t.serialize(b"\x00" * 47)
+
+
+def test_bytelist_root():
+    t = ByteListType(64)
+    v = b"\xaa" * 10
+    chunks_root = sha256((v + b"\x00" * 22) + b"\x00" * 32).digest()
+    assert t.hash_tree_root(v) == mix_in_length(chunks_root, 10)
+    assert t.hash_tree_root(b"") == mix_in_length(zero_hash(1), 0)
+
+
+# ---------------------------------------------------------------------------
+# Bitfields
+# ---------------------------------------------------------------------------
+
+
+def test_bitvector_serialize():
+    t = BitvectorType(10)
+    bits = [True, False, True, False, False, False, False, False, True, True]
+    # bits 0,2 set in byte0 -> 0x05 ; bits 8,9 -> 0x03
+    assert t.serialize(bits) == bytes([0x05, 0x03])
+    assert t.deserialize(bytes([0x05, 0x03])) == bits
+    with pytest.raises(ValueError):
+        t.deserialize(bytes([0x05, 0x07]))  # padding bit set
+
+
+def test_bitlist_serialize_spec_example():
+    t = BitlistType(8)
+    # [1,0,1] -> bits + delimiter at index 3 -> 0b00001101
+    assert t.serialize([True, False, True]) == bytes([0x0D])
+    assert t.deserialize(bytes([0x0D])) == [True, False, True]
+    # empty bitlist -> just delimiter
+    assert t.serialize([]) == bytes([0x01])
+    assert t.deserialize(bytes([0x01])) == []
+    with pytest.raises(ValueError):
+        t.deserialize(b"")
+    with pytest.raises(ValueError):
+        t.deserialize(bytes([0x00]))  # no delimiter
+
+
+def test_bitlist_root_excludes_delimiter():
+    t = BitlistType(2048)
+    bits = [True] * 5
+    packed = bytes([0b00011111]) + b"\x00" * 31
+    # 2048 bits -> 8 chunks
+    chunks_root = merkleize([packed], limit=8)
+    assert t.hash_tree_root(bits) == mix_in_length(chunks_root, 5)
+
+
+def test_bitlist_limit_enforced():
+    t = BitlistType(4)
+    with pytest.raises(ValueError):
+        t.serialize([True] * 5)
+    with pytest.raises(ValueError):
+        t.deserialize(bytes([0b00111111]))  # 5 bits + delimiter
+
+
+# ---------------------------------------------------------------------------
+# Vector / List
+# ---------------------------------------------------------------------------
+
+
+def test_vector_uint_pack():
+    t = VectorType(uint64, 4)
+    v = [1, 2, 3, 4]
+    ser = t.serialize(v)
+    assert len(ser) == 32
+    assert t.deserialize(ser) == v
+    assert t.hash_tree_root(v) == ser  # exactly one chunk
+
+
+def test_list_uint_root():
+    t = ListType(uint64, 8)  # 8*8=64 bytes -> 2 chunks
+    v = [7, 8, 9]
+    data = b"".join(x.to_bytes(8, "little") for x in v)
+    chunks_root = merkleize([data + b"\x00" * 8, b"\x00" * 32], limit=2)
+    assert t.hash_tree_root(v) == mix_in_length(chunks_root, 3)
+    assert t.deserialize(t.serialize(v)) == v
+
+
+def test_list_of_composite_roundtrip():
+    inner = ContainerType("Inner", [("a", uint64), ("b", ssz.Bytes32)])
+    t = ListType(inner, 10)
+    vals = [inner(a=i, b=bytes([i]) * 32) for i in range(3)]
+    assert t.deserialize(t.serialize(vals)) == vals
+    # root = merkleize of element roots, limit 10 -> depth 4
+    roots = [inner.hash_tree_root(v) for v in vals]
+    assert t.hash_tree_root(vals) == mix_in_length(merkleize(roots, limit=10), 3)
+
+
+def test_list_of_variable_size_elements():
+    inner = ListType(uint16, 32)
+    t = ListType(inner, 4)
+    vals = [[1, 2, 3], [], [65535]]
+    ser = t.serialize(vals)
+    assert t.deserialize(ser) == vals
+    # empty outer list
+    assert t.serialize([]) == b""
+    assert t.deserialize(b"") == []
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+
+def test_container_fixed_roundtrip():
+    C = ContainerType("Check", [("slot", uint64), ("root", ssz.Root)])
+    v = C(slot=42, root=b"\x11" * 32)
+    ser = C.serialize(v)
+    assert len(ser) == 40
+    assert C.deserialize(ser) == v
+    assert C.hash_tree_root(v) == sha256(
+        (42).to_bytes(8, "little") + b"\x00" * 24 + b"\x11" * 32
+    ).digest()
+
+
+def test_container_variable_offsets():
+    C = ContainerType(
+        "Var",
+        [("a", uint32), ("body", ByteListType(100)), ("c", uint32), ("tail", ByteListType(100))],
+    )
+    v = C(a=1, body=b"hello", c=2, tail=b"world!")
+    ser = C.serialize(v)
+    # fixed segment: 4 + 4(off) + 4 + 4(off) = 16; body at 16, tail at 21
+    assert ser[4:8] == (16).to_bytes(4, "little")
+    assert ser[12:16] == (21).to_bytes(4, "little")
+    assert C.deserialize(ser) == v
+
+
+def test_container_rejects_bad_offsets():
+    C = ContainerType("V", [("a", uint32), ("b", ByteListType(10))])
+    good = C.serialize(C(a=5, b=b"xy"))
+    bad = good[:4] + (9).to_bytes(4, "little") + good[8:]  # first offset != 8
+    with pytest.raises(ValueError):
+        C.deserialize(bad)
+
+
+def test_container_defaults_and_copy():
+    C = ContainerType("D", [("a", uint64), ("bits", BitlistType(16))])
+    d = C.default()
+    assert d.a == 0 and d.bits == []
+    d2 = d.copy()
+    d2.a = 7
+    assert d.a == 0
+    with pytest.raises(TypeError):
+        C(nope=1)
+
+
+def test_nested_container_root_stability():
+    Inner = ContainerType("I", [("x", uint64)])
+    Outer = ContainerType("O", [("i", Inner), ("y", uint64)])
+    v = Outer(i=Inner(x=3), y=4)
+    expected = sha256(
+        Inner.hash_tree_root(Inner(x=3)) + (4).to_bytes(8, "little") + b"\x00" * 24
+    ).digest()
+    assert Outer.hash_tree_root(v) == expected

@@ -1,0 +1,108 @@
+"""Beacon type registry tests: structure, round-trips, fork lineage."""
+
+import pytest
+
+from lodestar_tpu.params import MAINNET_PRESET, MINIMAL_PRESET
+from lodestar_tpu.types import create_ssz_types
+
+
+@pytest.fixture(scope="module")
+def t():
+    return create_ssz_types(MAINNET_PRESET)
+
+
+def test_all_forks_present(t):
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb", "electra"):
+        ns = t.by_fork[fork]
+        assert ns.BeaconState is not None
+        assert ns.SignedBeaconBlock is not None
+
+
+def test_state_field_counts(t):
+    # spec field counts per fork
+    assert len(t.phase0.BeaconState.fields) == 21
+    assert len(t.altair.BeaconState.fields) == 24
+    assert len(t.bellatrix.BeaconState.fields) == 25
+    assert len(t.capella.BeaconState.fields) == 28
+    assert len(t.deneb.BeaconState.fields) == 28
+    assert len(t.electra.BeaconState.fields) == 37
+
+
+def test_deneb_state_payload_header_upgraded(t):
+    d = dict(t.deneb.BeaconState.fields)
+    assert d["latest_execution_payload_header"] is t.deneb.ExecutionPayloadHeader
+    # order preserved from capella
+    assert [n for n, _ in t.deneb.BeaconState.fields] == [
+        n for n, _ in t.capella.BeaconState.fields
+    ]
+
+
+def test_validator_fixed_size(t):
+    # Validator: 48+32+8+1+8+8+8+8 = 121 bytes
+    assert t.Validator.is_fixed_size()
+    assert t.Validator.fixed_size() == 121
+
+
+def test_attestation_data_root_and_roundtrip(t):
+    ad = t.AttestationData(
+        slot=5,
+        index=2,
+        beacon_block_root=b"\x01" * 32,
+        source=t.Checkpoint(epoch=0, root=b"\x02" * 32),
+        target=t.Checkpoint(epoch=1, root=b"\x03" * 32),
+    )
+    ser = t.AttestationData.serialize(ad)
+    assert len(ser) == 8 + 8 + 32 + 40 + 40
+    assert t.AttestationData.deserialize(ser) == ad
+    assert len(t.AttestationData.hash_tree_root(ad)) == 32
+
+
+def test_signed_block_roundtrip_phase0(t):
+    block = t.phase0.BeaconBlock.default()
+    block.slot = 9
+    block.body.graffiti = b"g" * 32
+    signed = t.phase0.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+    ser = t.phase0.SignedBeaconBlock.serialize(signed)
+    out = t.phase0.SignedBeaconBlock.deserialize(ser)
+    assert out.message.slot == 9
+    assert out.message.body.graffiti == b"g" * 32
+    assert t.phase0.SignedBeaconBlock.hash_tree_root(out) == t.phase0.SignedBeaconBlock.hash_tree_root(signed)
+
+
+def test_default_state_roots_stable(t):
+    s = t.phase0.BeaconState.default()
+    r1 = t.phase0.BeaconState.hash_tree_root(s)
+    r2 = t.phase0.BeaconState.hash_tree_root(t.phase0.BeaconState.default())
+    assert r1 == r2
+    # state round-trip
+    ser = t.phase0.BeaconState.serialize(s)
+    assert t.phase0.BeaconState.hash_tree_root(t.phase0.BeaconState.deserialize(ser)) == r1
+
+
+def test_electra_attestation_shapes(t):
+    att = t.electra.Attestation.default()
+    att.aggregation_bits = [True] * 10
+    att.committee_bits = [False] * 63 + [True]
+    ser = t.electra.Attestation.serialize(att)
+    out = t.electra.Attestation.deserialize(ser)
+    assert out.committee_bits[-1] is True
+    assert len(out.aggregation_bits) == 10
+
+
+def test_minimal_preset_sizes():
+    tm = create_ssz_types(MINIMAL_PRESET)
+    sc = tm.SyncCommittee.default()
+    assert len(sc.pubkeys) == 32
+    assert dict(tm.altair.BeaconState.fields)["block_roots"].length == 64
+
+
+def test_execution_payload_roundtrip(t):
+    ep = t.deneb.ExecutionPayload.default()
+    ep.transactions = [b"\x01\x02", b""]
+    ep.withdrawals = [t.Withdrawal(index=1, validator_index=2, address=b"\xaa" * 20, amount=3)]
+    ep.base_fee_per_gas = 2**130
+    ser = t.deneb.ExecutionPayload.serialize(ep)
+    out = t.deneb.ExecutionPayload.deserialize(ser)
+    assert out.transactions == [b"\x01\x02", b""]
+    assert out.base_fee_per_gas == 2**130
+    assert out.withdrawals[0].amount == 3
