@@ -1,0 +1,5 @@
+"""Host-side cryptography: BLS12-381 oracle, hashing utilities.
+
+Reference analog: the native L0 crypto deps (@chainsafe/blst, c-kzg,
+@chainsafe/as-sha256 — SURVEY.md §2.1).
+"""

@@ -1,0 +1,34 @@
+"""Pure-Python BLS12-381 oracle: fields, curves, pairing, hash-to-curve,
+signatures (Ethereum ciphersuite).
+
+Validated against: the reference's interop deposit KAT
+(beacon-node/test/e2e/interop/genesisState.test.ts — byte-exact signature
+match with @chainsafe/blst), RFC 9380 expand_message_xmd vectors, known
+generator encodings, and algebraic pairing laws. Serves as the correctness
+oracle for the TPU kernels in lodestar_tpu/ops.
+"""
+
+from . import curve, fields, hash_to_curve, pairing, signature
+from .signature import (
+    BlsError,
+    aggregate_pubkeys,
+    aggregate_signatures,
+    aggregate_verify,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    keygen,
+    sign,
+    sk_from_bytes,
+    sk_to_bytes,
+    sk_to_pk,
+    verify,
+    verify_multiple_aggregate_signatures,
+)
+
+__all__ = [
+    "curve", "fields", "hash_to_curve", "pairing", "signature",
+    "BlsError", "aggregate_pubkeys", "aggregate_signatures",
+    "aggregate_verify", "eth_fast_aggregate_verify", "fast_aggregate_verify",
+    "keygen", "sign", "sk_from_bytes", "sk_to_bytes", "sk_to_pk", "verify",
+    "verify_multiple_aggregate_signatures",
+]

@@ -1,0 +1,273 @@
+"""BLS12-381 curve groups G1 (over Fq) and G2 (over Fq2).
+
+E1:  y^2 = x^3 + 4
+E2:  y^2 = x^3 + 4(1+u)   (M-twist)
+
+Points are affine tuples (x, y) with None as infinity. Scalar muls go
+through Jacobian coordinates. Serialization follows the ZCash/blst format
+used by Ethereum (compressed, flag bits in the MSBs of the first byte).
+"""
+
+from __future__ import annotations
+
+from . import fields as F
+from .fields import P, R
+
+# Generators (standard, from the BLS12-381 spec)
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+H1 = 0x396C8C005555E1568C00AAAB0000AAAB  # G1 cofactor
+
+_B1 = 4
+_B2 = (4, 4)  # 4(1+u)
+
+
+class _FqOps:
+    add = staticmethod(lambda a, b: (a + b) % P)
+    sub = staticmethod(lambda a, b: (a - b) % P)
+    neg = staticmethod(lambda a: -a % P)
+    mul = staticmethod(lambda a, b: a * b % P)
+    sqr = staticmethod(lambda a: a * a % P)
+    inv = staticmethod(F.fq_inv)
+    mul_int = staticmethod(lambda a, k: a * k % P)
+    zero = 0
+    one = 1
+
+
+class _Fq2Ops:
+    add = staticmethod(F.fq2_add)
+    sub = staticmethod(F.fq2_sub)
+    neg = staticmethod(F.fq2_neg)
+    mul = staticmethod(F.fq2_mul)
+    sqr = staticmethod(F.fq2_sqr)
+    inv = staticmethod(F.fq2_inv)
+    mul_int = staticmethod(F.fq2_mul_fq)
+    zero = F.FQ2_ZERO
+    one = F.FQ2_ONE
+
+
+def _on_curve(ops, pt, b) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return ops.sqr(y) == ops.add(ops.mul(ops.sqr(x), x), b)
+
+
+def _neg(ops, pt):
+    if pt is None:
+        return None
+    return (pt[0], ops.neg(pt[1]))
+
+
+def _add(ops, p1, p2):
+    """Affine addition (oracle simplicity over speed)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == ops.neg(y2):
+            return None
+        # doubling
+        m = ops.mul(ops.mul_int(ops.sqr(x1), 3), ops.inv(ops.mul_int(y1, 2)))
+    else:
+        m = ops.mul(ops.sub(y2, y1), ops.inv(ops.sub(x2, x1)))
+    x3 = ops.sub(ops.sub(ops.sqr(m), x1), x2)
+    y3 = ops.sub(ops.mul(m, ops.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _mul(ops, pt, k: int):
+    if k < 0:
+        return _mul(ops, _neg(ops, pt), -k)
+    result = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = _add(ops, result, addend)
+        addend = _add(ops, addend, addend)
+        k >>= 1
+    return result
+
+
+# -- public G1 ---------------------------------------------------------------
+
+
+def g1_add(p1, p2):
+    return _add(_FqOps, p1, p2)
+
+
+def g1_neg(p):
+    return _neg(_FqOps, p)
+
+
+def g1_mul(p, k: int):
+    return _mul(_FqOps, p, k % R if p is not None and k >= 0 else k)
+
+
+def g1_is_on_curve(p) -> bool:
+    return _on_curve(_FqOps, p, _B1)
+
+
+def g1_in_subgroup(p) -> bool:
+    return g1_is_on_curve(p) and _mul(_FqOps, p, R) is None
+
+
+# -- public G2 ---------------------------------------------------------------
+
+
+def g2_add(p1, p2):
+    return _add(_Fq2Ops, p1, p2)
+
+
+def g2_neg(p):
+    return _neg(_Fq2Ops, p)
+
+
+def g2_mul(p, k: int):
+    return _mul(_Fq2Ops, p, k % R if p is not None and k >= 0 else k)
+
+
+def g2_is_on_curve(p) -> bool:
+    return _on_curve(_Fq2Ops, p, _B2)
+
+
+def g2_in_subgroup(p) -> bool:
+    return g2_is_on_curve(p) and _mul(_Fq2Ops, p, R) is None
+
+
+# ---------------------------------------------------------------------------
+# ψ endomorphism on E2 (untwist-Frobenius-twist) — used for fast cofactor
+# clearing (Budroni–Pintore) in hash-to-curve.
+# Constants derived at import: psi_x = 1/XI^((p-1)/3), psi_y = 1/XI^((p-1)/2)
+# ---------------------------------------------------------------------------
+
+_PSI_X = F.fq2_inv(F.fq2_pow(F.XI, (P - 1) // 3))
+_PSI_Y = F.fq2_inv(F.fq2_pow(F.XI, (P - 1) // 2))
+
+
+def g2_psi(p):
+    if p is None:
+        return None
+    x, y = p
+    return (
+        F.fq2_mul(F.fq2_conj(x), _PSI_X),
+        F.fq2_mul(F.fq2_conj(y), _PSI_Y),
+    )
+
+
+def g2_clear_cofactor(p):
+    """Budroni–Pintore fast cofactor clearing:
+    h_eff * P = [x^2 - x - 1]P + [x - 1]ψ(P) + ψ^2([2]P),  x = BLS parameter.
+    """
+    x = F.X
+    t1 = _mul(_Fq2Ops, p, x * x - x - 1)
+    t2 = _mul(_Fq2Ops, g2_psi(p), x - 1)
+    t3 = g2_psi(g2_psi(_add(_Fq2Ops, p, p)))
+    return _add(_Fq2Ops, _add(_Fq2Ops, t1, t2), t3)
+
+
+# ---------------------------------------------------------------------------
+# Serialization (ZCash format, as used by blst / Ethereum)
+# ---------------------------------------------------------------------------
+
+_C_FLAG = 0x80  # compressed
+_I_FLAG = 0x40  # infinity
+_S_FLAG = 0x20  # y is the lexicographically larger root
+
+
+def g1_to_bytes(p) -> bytes:
+    if p is None:
+        out = bytearray(48)
+        out[0] = _C_FLAG | _I_FLAG
+        return bytes(out)
+    x, y = p
+    out = bytearray(x.to_bytes(48, "big"))
+    out[0] |= _C_FLAG
+    if y > (P - 1) // 2:
+        out[0] |= _S_FLAG
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes):
+    """Decompress + validate (on-curve and subgroup)."""
+    if len(data) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("uncompressed G1 not supported")
+    if flags & _I_FLAG:
+        if any(data[1:]) or flags & _S_FLAG or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("invalid infinity encoding")
+        return None
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("G1 x out of range")
+    y2 = (x * x * x + _B1) % P
+    y = F.fq_sqrt(y2)
+    if y is None:
+        raise ValueError("G1 x not on curve")
+    if bool(flags & _S_FLAG) != (y > (P - 1) // 2):
+        y = -y % P
+    pt = (x, y)
+    if not g1_in_subgroup(pt):
+        raise ValueError("G1 point not in subgroup")
+    return pt
+
+
+def g2_to_bytes(p) -> bytes:
+    if p is None:
+        out = bytearray(96)
+        out[0] = _C_FLAG | _I_FLAG
+        return bytes(out)
+    (x0, x1), (y0, y1) = p
+    out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    out[0] |= _C_FLAG
+    # sign from y1 unless zero, else y0 (lexicographic on (y1, y0))
+    if y1 > (P - 1) // 2 or (y1 == 0 and y0 > (P - 1) // 2):
+        out[0] |= _S_FLAG
+    return bytes(out)
+
+
+def g2_from_bytes(data: bytes):
+    if len(data) != 96:
+        raise ValueError("G2 compressed point must be 96 bytes")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("uncompressed G2 not supported")
+    if flags & _I_FLAG:
+        if any(data[1:]) or flags & _S_FLAG or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("invalid infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x out of range")
+    x = (x0, x1)
+    y2 = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), _B2)
+    y = F.fq2_sqrt(y2)
+    if y is None:
+        raise ValueError("G2 x not on curve")
+    y0, y1 = y
+    big = y1 > (P - 1) // 2 or (y1 == 0 and y0 > (P - 1) // 2)
+    if bool(flags & _S_FLAG) != big:
+        y = F.fq2_neg(y)
+    pt = (x, y)
+    if not g2_in_subgroup(pt):
+        raise ValueError("G2 point not in subgroup")
+    return pt

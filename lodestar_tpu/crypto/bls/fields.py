@@ -1,0 +1,303 @@
+"""BLS12-381 field tower: Fq, Fq2, Fq6, Fq12 (pure-Python oracle).
+
+Reference analog: the blst C library's field arithmetic (@chainsafe/blst —
+SURVEY.md §2.1). This oracle anchors correctness for the TPU kernels in
+lodestar_tpu/ops/.
+
+Representation (performance-minded plain data, no classes):
+  Fq   = int in [0, P)
+  Fq2  = (c0, c1)            # c0 + c1*u,  u^2 = -1
+  Fq6  = (a0, a1, a2)        # over Fq2,   v^3 = XI = 1 + u
+  Fq12 = (b0, b1)            # over Fq6,   w^2 = v
+"""
+
+from __future__ import annotations
+
+# field modulus
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# subgroup order
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+# BLS parameter x (negative)
+X = -0xD201000000010000
+
+FQ2_ONE = (1, 0)
+FQ2_ZERO = (0, 0)
+XI = (1, 1)  # 1 + u, the Fq6 non-residue
+
+# ---------------------------------------------------------------------------
+# Fq
+# ---------------------------------------------------------------------------
+
+
+def fq_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("Fq inverse of 0")
+    return pow(a, P - 2, P)
+
+
+def fq_sqrt(a: int) -> int | None:
+    """sqrt in Fq (P ≡ 3 mod 4); None if non-square."""
+    c = pow(a, (P + 1) // 4, P)
+    return c if c * c % P == a else None
+
+
+# ---------------------------------------------------------------------------
+# Fq2 = Fq[u]/(u^2+1)
+# ---------------------------------------------------------------------------
+
+
+def fq2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fq2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fq2_neg(a):
+    return (-a[0] % P, -a[1] % P)
+
+
+def fq2_conj(a):
+    return (a[0], -a[1] % P)
+
+
+def fq2_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = a0 * b0
+    t1 = a1 * b1
+    # Karatsuba: (a0+a1)(b0+b1) - t0 - t1
+    t2 = (a0 + a1) * (b0 + b1) - t0 - t1
+    return ((t0 - t1) % P, t2 % P)
+
+
+def fq2_sqr(a):
+    a0, a1 = a
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    return ((a0 + a1) * (a0 - a1) % P, 2 * a0 * a1 % P)
+
+
+def fq2_mul_fq(a, k: int):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fq2_inv(a):
+    a0, a1 = a
+    d = fq_inv((a0 * a0 + a1 * a1) % P)
+    return (a0 * d % P, -a1 * d % P)
+
+
+def fq2_pow(a, e: int):
+    if e < 0:
+        return fq2_pow(fq2_inv(a), -e)
+    result = FQ2_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fq2_mul(result, base)
+        base = fq2_sqr(base)
+        e >>= 1
+    return result
+
+
+def fq2_sgn0(a) -> int:
+    """RFC 9380 sgn0 for m=2 (lexicographic)."""
+    s0 = a[0] & 1
+    z0 = a[0] == 0
+    s1 = a[1] & 1
+    return s0 | (z0 & s1)
+
+
+def fq2_sqrt(a):
+    """sqrt in Fq2; None if non-square (algorithm for p ≡ 3 mod 4)."""
+    if a == FQ2_ZERO:
+        return FQ2_ZERO
+    c1 = (P - 3) // 4
+    a1 = fq2_pow(a, c1)
+    alpha = fq2_mul(fq2_sqr(a1), a)
+    x0 = fq2_mul(a1, a)
+    if alpha == (P - 1, 0):  # alpha == -1
+        cand = (-x0[1] % P, x0[0])  # u * x0
+    else:
+        b = fq2_pow(fq2_add(FQ2_ONE, alpha), (P - 1) // 2)
+        cand = fq2_mul(b, x0)
+    return cand if fq2_sqr(cand) == a else None
+
+
+# ---------------------------------------------------------------------------
+# Fq6 = Fq2[v]/(v^3 - XI)
+# ---------------------------------------------------------------------------
+
+
+def _mul_by_xi(a):
+    # (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1)u
+    return ((a[0] - a[1]) % P, (a[0] + a[1]) % P)
+
+
+FQ6_ZERO = (FQ2_ZERO, FQ2_ZERO, FQ2_ZERO)
+FQ6_ONE = (FQ2_ONE, FQ2_ZERO, FQ2_ZERO)
+
+
+def fq6_add(a, b):
+    return (fq2_add(a[0], b[0]), fq2_add(a[1], b[1]), fq2_add(a[2], b[2]))
+
+
+def fq6_sub(a, b):
+    return (fq2_sub(a[0], b[0]), fq2_sub(a[1], b[1]), fq2_sub(a[2], b[2]))
+
+
+def fq6_neg(a):
+    return (fq2_neg(a[0]), fq2_neg(a[1]), fq2_neg(a[2]))
+
+
+def fq6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    # c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    c0 = fq2_add(
+        t0,
+        _mul_by_xi(
+            fq2_sub(
+                fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), t1), t2
+            )
+        ),
+    )
+    # c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    c1 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), t0), t1),
+        _mul_by_xi(t2),
+    )
+    # c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    c2 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), t0), t2), t1
+    )
+    return (c0, c1, c2)
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_by_v(a):
+    # v * (a0 + a1 v + a2 v^2) = xi*a2 + a0 v + a1 v^2
+    return (_mul_by_xi(a[2]), a[0], a[1])
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    c0 = fq2_sub(fq2_sqr(a0), _mul_by_xi(fq2_mul(a1, a2)))
+    c1 = fq2_sub(_mul_by_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    c2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    t = fq2_add(
+        fq2_add(fq2_mul(a0, c0), _mul_by_xi(fq2_mul(a2, c1))),
+        _mul_by_xi(fq2_mul(a1, c2)),
+    )
+    ti = fq2_inv(t)
+    return (fq2_mul(c0, ti), fq2_mul(c1, ti), fq2_mul(c2, ti))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 = Fq6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+FQ12_ONE = (FQ6_ONE, FQ6_ZERO)
+FQ12_ZERO = (FQ6_ZERO, FQ6_ZERO)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_sub(a, b):
+    return (fq6_sub(a[0], b[0]), fq6_sub(a[1], b[1]))
+
+
+def fq12_neg(a):
+    return (fq6_neg(a[0]), fq6_neg(a[1]))
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    t = fq6_inv(fq6_sub(fq6_sqr(a0), fq6_mul_by_v(fq6_sqr(a1))))
+    return (fq6_mul(a0, t), fq6_neg(fq6_mul(a1, t)))
+
+
+def fq12_conj(a):
+    """Conjugation a0 - a1 w (the q^6 Frobenius); inverse on the cyclotomic
+    subgroup."""
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_pow(a, e: int):
+    if e < 0:
+        return fq12_pow(fq12_inv(a), -e)
+    result = FQ12_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fq12_mul(result, base)
+        base = fq12_sqr(base)
+        e >>= 1
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Frobenius: x -> x^p, computed via coefficient conjugation + constants.
+# Constants derived at import time (no hardcoded tables to mis-remember).
+# ---------------------------------------------------------------------------
+
+# gamma_1[i] = XI^(i*(p-1)/6) in Fq2, i = 0..5
+_G1 = [fq2_pow(XI, i * (P - 1) // 6) for i in range(6)]
+
+
+def fq6_frobenius(a):
+    # (a0 + a1 v + a2 v^2)^p = a0~ + a1~ g2 v + a2~ g4 v^2
+    return (
+        fq2_conj(a[0]),
+        fq2_mul(fq2_conj(a[1]), _G1[2]),
+        fq2_mul(fq2_conj(a[2]), _G1[4]),
+    )
+
+
+def fq12_frobenius(a):
+    a0, a1 = a
+    f0 = fq6_frobenius(a0)
+    # (a1 w)^p = a1^p * w^(p-1) * w, and w^(p-1) = XI^((p-1)/6) in Fq2,
+    # so the whole w-part is scaled by gamma_1[1] (fq6_frobenius already
+    # applied the per-coefficient v^j gammas).
+    f1 = fq6_frobenius(a1)
+    f1 = (
+        fq2_mul(f1[0], _G1[1]),
+        fq2_mul(f1[1], _G1[1]),
+        fq2_mul(f1[2], _G1[1]),
+    )
+    return (f0, f1)
+
+
+def fq12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fq12_frobenius(a)
+    return a
+
+
+# Cyclotomic squaring (Granger–Scott) is a future optimization for the
+# final-exponentiation hard part; the oracle favors obviously-correct code.
+fq12_cyclotomic_sqr = fq12_sqr

@@ -1,0 +1,205 @@
+"""Hash-to-curve for G2: BLS12381G2_XMD:SHA-256_SSWU_RO_ (RFC 9380).
+
+Pipeline: expand_message_xmd(SHA-256) -> hash_to_field(Fq2, m=2, L=64) ->
+simplified SWU on the 3-isogenous curve E2' -> 3-isogeny map to E2 ->
+cofactor clearing (Budroni–Pintore via the psi endomorphism, curve.py).
+
+Constants validated structurally in tests (isogeny output must satisfy the
+E2 curve equation; SSWU output the E2' equation) and end-to-end by the
+interop DepositData signature KAT from the reference repo
+(beacon-node/test/e2e/interop/genesisState.test.ts).
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+from . import fields as F
+from .fields import P
+from .curve import g2_clear_cofactor, g2_add
+
+# SSWU curve E2': y^2 = x^3 + A'x + B'
+A_PRIME = (0, 240)  # 240 * u
+B_PRIME = (1012, 1012)  # 1012 * (1 + u)
+Z_SSWU = (-2 % P, -1 % P)  # -(2 + u)
+
+L_FIELD = 64  # bytes per field element draw (ceil((381 + 128)/8))
+
+
+# ---------------------------------------------------------------------------
+# expand_message_xmd (RFC 9380 §5.3.1) with SHA-256
+# ---------------------------------------------------------------------------
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    b_in_bytes = 32  # SHA-256 output
+    r_in_bytes = 64  # SHA-256 block size
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    if ell > 255 or len_in_bytes > 65535 or len(dst) > 255:
+        raise ValueError("expand_message_xmd parameter overflow")
+    dst_prime = dst + len(dst).to_bytes(1, "big")
+    z_pad = b"\x00" * r_in_bytes
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b1 = sha256(b0 + b"\x01" + dst_prime).digest()
+    blocks = [b1]
+    for i in range(2, ell + 1):
+        prev = blocks[-1]
+        xored = bytes(a ^ b for a, b in zip(b0, prev))
+        blocks.append(sha256(xored + i.to_bytes(1, "big") + dst_prime).digest())
+    return b"".join(blocks)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, dst: bytes, count: int) -> list:
+    """hash_to_field with m=2, L=64 (RFC 9380 §5.2)."""
+    len_in_bytes = count * 2 * L_FIELD
+    uniform = expand_message_xmd(msg, dst, len_in_bytes)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(2):
+            offset = L_FIELD * (j + i * 2)
+            tv = uniform[offset : offset + L_FIELD]
+            coords.append(int.from_bytes(tv, "big") % P)
+        out.append((coords[0], coords[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Simplified SWU map on E2' (RFC 9380 §6.6.2, straightforward variant)
+# ---------------------------------------------------------------------------
+
+
+def map_to_curve_sswu(u):
+    """u in Fq2 -> point on E2' (affine)."""
+    # tv1 = 1 / (Z^2 u^4 + Z u^2), with the tv1 == 0 exception
+    u2 = F.fq2_sqr(u)
+    z_u2 = F.fq2_mul(Z_SSWU, u2)
+    tv = F.fq2_add(F.fq2_sqr(z_u2), z_u2)
+    if tv == F.FQ2_ZERO:
+        # exceptional case: x1 = B / (Z * A)
+        x1 = F.fq2_mul(B_PRIME, F.fq2_inv(F.fq2_mul(Z_SSWU, A_PRIME)))
+    else:
+        tv1 = F.fq2_inv(tv)
+        # x1 = (-B/A) * (1 + tv1)
+        x1 = F.fq2_mul(
+            F.fq2_mul(F.fq2_neg(B_PRIME), F.fq2_inv(A_PRIME)),
+            F.fq2_add(F.FQ2_ONE, tv1),
+        )
+    def g(x):
+        return F.fq2_add(F.fq2_mul(F.fq2_add(F.fq2_sqr(x), A_PRIME), x), B_PRIME)
+
+    gx1 = g(x1)
+    y1 = F.fq2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = F.fq2_mul(z_u2, x1)
+        gx2 = g(x2)
+        y2 = F.fq2_sqrt(gx2)
+        if y2 is None:
+            raise AssertionError("SSWU: neither gx1 nor gx2 square (impossible)")
+        x, y = x2, y2
+    if F.fq2_sgn0(u) != F.fq2_sgn0(y):
+        y = F.fq2_neg(y)
+    return (x, y)
+
+
+# ---------------------------------------------------------------------------
+# 3-isogeny E2' -> E2 (RFC 9380 Appendix E.3)
+# ---------------------------------------------------------------------------
+
+_K1 = [
+    (
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    (
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    (
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+]
+_K2 = [
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    (
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    (1, 0),  # monic x^2 term
+]
+_K3 = [
+    (
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    (
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    (
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+]
+_K4 = [
+    (
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    (
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    (1, 0),  # monic x^3 term
+]
+
+
+def _horner(coeffs, x):
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = F.fq2_add(F.fq2_mul(acc, x), c)
+    return acc
+
+
+def iso_map_g2(pt):
+    """Apply the 3-isogeny E2' -> E2."""
+    x, y = pt
+    x_num = _horner(_K1, x)
+    x_den = _horner(_K2, x)
+    y_num = _horner(_K3, x)
+    y_den = _horner(_K4, x)
+    xo = F.fq2_mul(x_num, F.fq2_inv(x_den))
+    yo = F.fq2_mul(y, F.fq2_mul(y_num, F.fq2_inv(y_den)))
+    return (xo, yo)
+
+
+# ---------------------------------------------------------------------------
+# hash_to_curve
+# ---------------------------------------------------------------------------
+
+
+def hash_to_g2(msg: bytes, dst: bytes):
+    """Full hash_to_curve: returns a point in G2 (r-torsion)."""
+    u0, u1 = hash_to_field_fq2(msg, dst, 2)
+    q0 = iso_map_g2(map_to_curve_sswu(u0))
+    q1 = iso_map_g2(map_to_curve_sswu(u1))
+    return g2_clear_cofactor(g2_add(q0, q1))

@@ -1,0 +1,131 @@
+"""BLS12-381 optimal ate pairing (pure-Python oracle).
+
+Strategy: correctness-first. G2 points are untwisted into E(Fq12) and the
+Miller loop uses generic affine line functions over Fq12 (slope via field
+division), so the code mirrors the textbook definition. The TPU kernels in
+lodestar_tpu/ops use the fast projective formulas and are differential-
+tested against this oracle.
+
+Untwist for the M-twist E': y^2 = x^3 + 4*XI with Fq12 = Fq6[w]/(w^2 - v),
+Fq6 = Fq2[v]/(v^3 - XI):  (x', y') -> (x'/w^2, y'/w^3), which lands on
+E: y^2 = x^3 + 4 over Fq12.
+"""
+
+from __future__ import annotations
+
+from . import fields as F
+from .fields import P, R, X, FQ12_ONE
+
+# w^2 = v  as an Fq12 element: (0 + 1*v + 0*v^2, 0)
+_W2 = ((F.FQ2_ZERO, F.FQ2_ONE, F.FQ2_ZERO), F.FQ6_ZERO)
+# w^3 = v*w: (0, 0 + 1*v + 0*v^2)
+_W3 = (F.FQ6_ZERO, (F.FQ2_ZERO, F.FQ2_ONE, F.FQ2_ZERO))
+_W2_INV = F.fq12_inv(_W2)
+_W3_INV = F.fq12_inv(_W3)
+
+
+def _fq_to_fq12(a: int):
+    return (((a, 0), F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+def _fq2_to_fq12(a):
+    return ((a, F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+def untwist(q):
+    """Map a point on the twist E'(Fq2) to E(Fq12)."""
+    if q is None:
+        return None
+    x, y = q
+    return (
+        F.fq12_mul(_fq2_to_fq12(x), _W2_INV),
+        F.fq12_mul(_fq2_to_fq12(y), _W3_INV),
+    )
+
+
+def embed_g1(p):
+    if p is None:
+        return None
+    return (_fq_to_fq12(p[0]), _fq_to_fq12(p[1]))
+
+
+def _line(p1, p2, t):
+    """Evaluate the line through p1,p2 (E(Fq12) affine) at point t."""
+    x1, y1 = p1
+    x2, y2 = p2
+    xt, yt = t
+    if x1 != x2:
+        m = F.fq12_mul(F.fq12_sub(y2, y1), F.fq12_inv(F.fq12_sub(x2, x1)))
+    elif y1 == y2:
+        three_x1_sq = F.fq12_mul(_fq_to_fq12(3), F.fq12_sqr(x1))
+        m = F.fq12_mul(three_x1_sq, F.fq12_inv(F.fq12_mul(_fq_to_fq12(2), y1)))
+    else:
+        # vertical line
+        return F.fq12_sub(xt, x1)
+    return F.fq12_sub(
+        F.fq12_mul(m, F.fq12_sub(xt, x1)), F.fq12_sub(yt, y1)
+    )
+
+
+def _add_fq12(p1, p2):
+    """Affine addition on E(Fq12)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 != y2:
+            return None
+        three_x1_sq = F.fq12_mul(_fq_to_fq12(3), F.fq12_sqr(x1))
+        m = F.fq12_mul(three_x1_sq, F.fq12_inv(F.fq12_mul(_fq_to_fq12(2), y1)))
+    else:
+        m = F.fq12_mul(F.fq12_sub(y2, y1), F.fq12_inv(F.fq12_sub(x2, x1)))
+    x3 = F.fq12_sub(F.fq12_sub(F.fq12_sqr(m), x1), x2)
+    y3 = F.fq12_sub(F.fq12_mul(m, F.fq12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def miller_loop(p, q):
+    """f_{|X|,Q}(P) with the BLS12 sign fix (X < 0 -> invert)."""
+    if p is None or q is None:
+        return FQ12_ONE
+    pe = embed_g1(p)
+    qe = untwist(q)
+    f = FQ12_ONE
+    r_pt = qe
+    n = -X  # |x|, positive
+    for bit in bin(n)[3:]:  # MSB already consumed (r_pt = qe)
+        f = F.fq12_mul(F.fq12_sqr(f), _line(r_pt, r_pt, pe))
+        r_pt = _add_fq12(r_pt, r_pt)
+        if bit == "1":
+            f = F.fq12_mul(f, _line(r_pt, qe, pe))
+            r_pt = _add_fq12(r_pt, qe)
+    # X < 0: f_{-n} = 1/f_n (up to vertical lines killed by final exp)
+    return F.fq12_inv(f)
+
+
+def final_exponentiation(f):
+    """f^((p^12-1)/r): easy part via Frobenius/conjugation, hard part as a
+    plain square-and-multiply (oracle simplicity; the exponent is public)."""
+    # easy: f^(p^6-1) = conj(f) * f^-1 ; then ^(p^2+1)
+    t = F.fq12_mul(F.fq12_conj(f), F.fq12_inv(f))
+    t = F.fq12_mul(F.fq12_frobenius_n(t, 2), t)
+    # hard: t^((p^4 - p^2 + 1) // r)
+    return F.fq12_pow(t, (P**4 - P**2 + 1) // R)
+
+
+def pairing(p, q):
+    """e(P, Q) for P in G1, Q in G2 (affine tuples)."""
+    return final_exponentiation(miller_loop(p, q))
+
+
+def pairing_product_is_one(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1, with one shared final exponentiation."""
+    f = FQ12_ONE
+    for p, q in pairs:
+        if p is None or q is None:
+            continue
+        f = F.fq12_mul(f, miller_loop(p, q))
+    return final_exponentiation(f) == FQ12_ONE

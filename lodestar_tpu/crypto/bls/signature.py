@@ -1,0 +1,171 @@
+"""BLS signatures over BLS12-381 (minimal-pubkey-size, Ethereum ciphersuite
+BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_).
+
+Reference analog: @chainsafe/blst's SecretKey/PublicKey/Signature API surface
+(used at chain/bls/maybeBatch.ts:1, chain/bls/multithread/jobItem.ts:1) and
+IETF draft-irtf-cfrg-bls-signature. This is the host-side oracle; batched
+verification on TPU lives in lodestar_tpu/ops with identical semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...params import BLS_DST_SIG
+from . import curve as C
+from . import pairing as PR
+from .fields import R
+from .hash_to_curve import hash_to_g2
+
+
+class BlsError(ValueError):
+    pass
+
+
+def sk_from_bytes(data: bytes) -> int:
+    """32-byte big-endian scalar; must be in [1, r)."""
+    if len(data) != 32:
+        raise BlsError("secret key must be 32 bytes")
+    sk = int.from_bytes(data, "big")
+    if not 0 < sk < R:
+        raise BlsError("secret key out of range")
+    return sk
+
+
+def sk_to_bytes(sk: int) -> bytes:
+    return sk.to_bytes(32, "big")
+
+
+def keygen(ikm: bytes | None = None) -> int:
+    """Random secret key in [1, r). Deterministic derivation from IKM
+    (EIP-2333 HKDF) lives in the keystore layer; passing ikm here is an
+    error rather than a silent ignore. A 48-byte draw mod r keeps the
+    distribution uniform to ~2^-125."""
+    if ikm is not None:
+        raise BlsError("deterministic keygen not supported here; use the keystore layer")
+    while True:
+        candidate = int.from_bytes(os.urandom(48), "big") % R
+        if candidate:
+            return candidate
+
+
+def sk_to_pk(sk: int) -> bytes:
+    return C.g1_to_bytes(C.g1_mul(C.G1_GEN, sk))
+
+
+def sign(sk: int, msg: bytes, dst: bytes = BLS_DST_SIG) -> bytes:
+    h = hash_to_g2(msg, dst)
+    return C.g2_to_bytes(C.g2_mul(h, sk))
+
+
+def _pk_point(pk: bytes):
+    pt = C.g1_from_bytes(pk)
+    if pt is None:
+        raise BlsError("public key is the identity")
+    return pt
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes, dst: bytes = BLS_DST_SIG) -> bool:
+    """Core verify. Malformed inputs return False (blst-compatible at the
+    IBlsVerifier seam — chain/bls/maybeBatch.ts:17-44 catches and rejects)."""
+    try:
+        pk_pt = _pk_point(pk)
+        sig_pt = C.g2_from_bytes(sig)
+    except (BlsError, ValueError):
+        return False
+    if sig_pt is None:
+        return False
+    h = hash_to_g2(msg, dst)
+    # e(pk, H(m)) == e(g1, sig)  <=>  e(-g1, sig) * e(pk, H(m)) == 1
+    return PR.pairing_product_is_one(
+        [(C.g1_neg(C.G1_GEN), sig_pt), (pk_pt, h)]
+    )
+
+
+def aggregate_signatures(sigs: list[bytes]) -> bytes:
+    if not sigs:
+        raise BlsError("cannot aggregate empty signature list")
+    acc = None
+    for s in sigs:
+        pt = C.g2_from_bytes(s)
+        acc = C.g2_add(acc, pt)
+    return C.g2_to_bytes(acc)
+
+
+def aggregate_pubkeys(pks: list[bytes]) -> bytes:
+    if not pks:
+        raise BlsError("cannot aggregate empty pubkey list")
+    acc = None
+    for pk in pks:
+        acc = C.g1_add(acc, _pk_point(pk))
+    return C.g1_to_bytes(acc)
+
+
+def fast_aggregate_verify(
+    pks: list[bytes], msg: bytes, sig: bytes, dst: bytes = BLS_DST_SIG
+) -> bool:
+    """All signers signed the same message (aggregate pubkeys first)."""
+    if not pks:
+        return False
+    try:
+        agg = aggregate_pubkeys(pks)
+    except (BlsError, ValueError):
+        return False
+    return verify(agg, msg, sig, dst)
+
+
+def aggregate_verify(
+    pks: list[bytes], msgs: list[bytes], sig: bytes, dst: bytes = BLS_DST_SIG
+) -> bool:
+    """Distinct messages: prod e(pk_i, H(m_i)) == e(g1, sig)."""
+    if not pks or len(pks) != len(msgs):
+        return False
+    try:
+        sig_pt = C.g2_from_bytes(sig)
+        if sig_pt is None:
+            return False
+        pairs = [(C.g1_neg(C.G1_GEN), sig_pt)]
+        for pk, msg in zip(pks, msgs):
+            pairs.append((_pk_point(pk), hash_to_g2(msg, dst)))
+    except (BlsError, ValueError):
+        return False
+    return PR.pairing_product_is_one(pairs)
+
+
+def verify_multiple_aggregate_signatures(
+    sets: list[tuple[bytes, bytes, bytes]], dst: bytes = BLS_DST_SIG
+) -> bool:
+    """Batch verify [(pk, msg, sig)] with a random linear combination —
+    blst verifyMultipleAggregateSignatures semantics (the reference's
+    batchable path, chain/bls/maybeBatch.ts:29-38).
+
+    prod_i e(r_i * pk_i, H(m_i)) * e(-g1, sum_i r_i * sig_i) == 1
+    """
+    if not sets:
+        return True
+    try:
+        pairs = []
+        sig_acc = None
+        for pk, msg, sig in sets:
+            r = int.from_bytes(os.urandom(8), "big") | 1  # nonzero 64-bit
+            pk_pt = _pk_point(pk)
+            sig_pt = C.g2_from_bytes(sig)
+            if sig_pt is None:
+                return False
+            pairs.append((C.g1_mul(pk_pt, r), hash_to_g2(msg, dst)))
+            sig_acc = C.g2_add(sig_acc, C.g2_mul(sig_pt, r))
+        pairs.append((C.g1_neg(C.G1_GEN), sig_acc))
+    except (BlsError, ValueError):
+        return False
+    return PR.pairing_product_is_one(pairs)
+
+
+def eth_fast_aggregate_verify(
+    pks: list[bytes], msg: bytes, sig: bytes, dst: bytes = BLS_DST_SIG
+) -> bool:
+    """Spec eth_fast_aggregate_verify: empty pubkeys + infinity sig -> True
+    (sync committee edge case)."""
+    G2_INFINITY = b"\xc0" + b"\x00" * 95
+    if not pks and sig == G2_INFINITY:
+        return True
+    return fast_aggregate_verify(pks, msg, sig, dst)

@@ -1,0 +1,236 @@
+"""BLS12-381 oracle tests.
+
+Crown-jewel KAT: the reference repo's interop deposit
+(beacon-node/test/e2e/interop/genesisState.test.ts) — validator 0's pubkey
+and DepositData signature must match @chainsafe/blst byte-for-byte.
+"""
+
+from hashlib import sha256
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config.beacon_config import (
+    compute_domain,
+    compute_signing_root_from_roots,
+)
+from lodestar_tpu.crypto.bls import (
+    curve as C,
+    fields as F,
+    pairing as PR,
+    signature as S,
+)
+from lodestar_tpu.crypto.bls.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_g2,
+    iso_map_g2,
+    map_to_curve_sswu,
+    hash_to_field_fq2,
+)
+from lodestar_tpu.types import ssz_types
+
+
+def interop_sk(i: int) -> int:
+    h = sha256(i.to_bytes(32, "little")).digest()
+    return int.from_bytes(h, "little") % F.R
+
+
+SK0 = interop_sk(0)
+PK0 = S.sk_to_pk(SK0)
+
+INTEROP_PK0_HEX = (
+    "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4"
+    "bf2d153f649f7b53359fe8b94a38e44c"
+)
+INTEROP_DEPOSIT_SIG_HEX = (
+    "a95af8ff0f8c06af4d29aef05ce865f85f82df42b606008ec5b1bcb42b17ae47"
+    "f4b78cdce1db31ce32d18f42a6b296b4014a2164981780e56b5a40d7723c27b8"
+    "423173e58fa36f075078b177634f66351412b867c103f532aedd50bcd9b98446"
+)
+
+
+# ---------------------------------------------------------------------------
+# Known-answer tests
+# ---------------------------------------------------------------------------
+
+
+def test_interop_sk0_value():
+    assert SK0.to_bytes(32, "big").hex() == (
+        "25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866"
+    )
+
+
+def test_interop_pk0():
+    assert PK0.hex() == INTEROP_PK0_HEX
+
+
+def test_interop_deposit_signature_kat():
+    """Byte-exact blst compatibility through SSZ + domain + hash-to-curve +
+    sign (reference fixture uses the minimal-config GENESIS_FORK_VERSION)."""
+    t = ssz_types()
+    wc = b"\x00" + sha256(PK0).digest()[1:]
+    dm = t.DepositMessage(
+        pubkey=PK0, withdrawal_credentials=wc, amount=32_000_000_000
+    )
+    domain = compute_domain(
+        params.DOMAIN_DEPOSIT, bytes.fromhex("00000001"), bytes(32)
+    )
+    root = compute_signing_root_from_roots(
+        t.DepositMessage.hash_tree_root(dm), domain
+    )
+    sig = S.sign(SK0, root)
+    assert sig.hex() == INTEROP_DEPOSIT_SIG_HEX
+    assert S.verify(PK0, root, sig)
+
+
+def test_expand_message_xmd_rfc_vectors():
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert expand_message_xmd(b"", dst, 0x20).hex() == (
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+    assert expand_message_xmd(b"abc", dst, 0x20).hex() == (
+        "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+    )
+
+
+def test_generator_compressed_encodings():
+    assert C.g1_to_bytes(C.G1_GEN).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    assert C.g2_to_bytes(C.G2_GEN).hex() == (
+        "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+        "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+        "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Algebraic laws
+# ---------------------------------------------------------------------------
+
+
+def test_pairing_laws():
+    e = PR.pairing(C.G1_GEN, C.G2_GEN)
+    assert e != F.FQ12_ONE
+    assert F.fq12_pow(e, F.R) == F.FQ12_ONE
+    a, b = 11, 19
+    assert PR.pairing(C.g1_mul(C.G1_GEN, a), C.g2_mul(C.G2_GEN, b)) == F.fq12_pow(e, a * b)
+    assert PR.pairing_product_is_one(
+        [(C.G1_GEN, C.G2_GEN), (C.g1_neg(C.G1_GEN), C.G2_GEN)]
+    )
+
+
+def test_frobenius_is_p_power():
+    a = (
+        ((123456789, 987654321), (5, 7), (11, 13)),
+        ((17, 19), (23, 29), (31, 37)),
+    )
+    assert F.fq12_frobenius(a) == F.fq12_pow(a, F.P)
+
+
+def test_fq2_sqrt_roundtrip():
+    for seed in range(4):
+        x = (seed * 7919 + 1, seed * 104729 + 3)
+        sq = F.fq2_sqr(x)
+        root = F.fq2_sqrt(sq)
+        assert root is not None
+        assert F.fq2_sqr(root) == sq
+
+
+def test_sswu_iso_map_on_curve():
+    us = hash_to_field_fq2(b"structural-check", b"TEST_DST", 2)
+    for u in us:
+        pt = map_to_curve_sswu(u)
+        img = iso_map_g2(pt)
+        assert C.g2_is_on_curve(img)
+    full = hash_to_g2(b"structural-check", b"TEST_DST")
+    assert C.g2_in_subgroup(full)
+
+
+# ---------------------------------------------------------------------------
+# Signature scheme behavior
+# ---------------------------------------------------------------------------
+
+
+def test_verify_rejects_wrong_message_and_key():
+    msg = b"m" * 32
+    sig = S.sign(SK0, msg)
+    assert S.verify(PK0, msg, sig)
+    assert not S.verify(PK0, b"x" * 32, sig)
+    sk1 = interop_sk(1)
+    assert not S.verify(S.sk_to_pk(sk1), msg, sig)
+
+
+def test_verify_malformed_inputs_return_false():
+    msg = b"m" * 32
+    sig = S.sign(SK0, msg)
+    assert not S.verify(b"\x00" * 48, msg, sig)  # invalid pk encoding
+    assert not S.verify(PK0, msg, b"\x01" * 96)  # invalid sig encoding
+    # infinity pubkey rejected
+    inf_pk = b"\xc0" + b"\x00" * 47
+    assert not S.verify(inf_pk, msg, sig)
+
+
+def test_fast_aggregate_verify():
+    msg = b"same-message" * 2
+    sks = [interop_sk(i) for i in range(3)]
+    pks = [S.sk_to_pk(sk) for sk in sks]
+    agg = S.aggregate_signatures([S.sign(sk, msg) for sk in sks])
+    assert S.fast_aggregate_verify(pks, msg, agg)
+    assert not S.fast_aggregate_verify(pks[:2], msg, agg)
+    assert not S.fast_aggregate_verify([], msg, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [interop_sk(i) for i in range(2)]
+    pks = [S.sk_to_pk(sk) for sk in sks]
+    msgs = [b"msg-zero" * 4, b"msg-one!" * 4]
+    agg = S.aggregate_signatures([S.sign(sk, m) for sk, m in zip(sks, msgs)])
+    assert S.aggregate_verify(pks, msgs, agg)
+    assert not S.aggregate_verify(pks, msgs[::-1], agg)
+
+
+def test_batch_verify_random_lincomb():
+    sets = []
+    for i in range(3):
+        sk = interop_sk(i)
+        msg = bytes([i]) * 32
+        sets.append((S.sk_to_pk(sk), msg, S.sign(sk, msg)))
+    assert S.verify_multiple_aggregate_signatures(sets)
+    # corrupt one signature -> whole batch fails
+    bad = list(sets)
+    bad[1] = (bad[1][0], bad[1][1], sets[2][2])
+    assert not S.verify_multiple_aggregate_signatures(bad)
+    assert S.verify_multiple_aggregate_signatures([])
+
+
+def test_eth_fast_aggregate_verify_infinity():
+    inf_sig = b"\xc0" + b"\x00" * 95
+    assert S.eth_fast_aggregate_verify([], b"anything", inf_sig)
+    assert not S.fast_aggregate_verify([], b"anything", inf_sig)
+
+
+def test_g1_decompress_rejects_non_subgroup():
+    # find an on-curve x whose point is NOT in the r-subgroup (cofactor > 1)
+    x = 0
+    found = None
+    while found is None:
+        x += 1
+        y = F.fq_sqrt((x * x * x + 4) % F.P)
+        if y is not None and not C.g1_in_subgroup((x, y)):
+            found = (x, y)
+    raw = bytearray(found[0].to_bytes(48, "big"))
+    raw[0] |= 0x80
+    if found[1] > (F.P - 1) // 2:
+        raw[0] |= 0x20
+    with pytest.raises(ValueError):
+        C.g1_from_bytes(bytes(raw))
+
+
+def test_sk_range_checks():
+    with pytest.raises(S.BlsError):
+        S.sk_from_bytes(b"\x00" * 32)
+    with pytest.raises(S.BlsError):
+        S.sk_from_bytes(F.R.to_bytes(32, "big"))
+    assert S.sk_from_bytes((1).to_bytes(32, "big")) == 1
