@@ -1,0 +1,344 @@
+"""Multi-precision Fq arithmetic primitives for BLS12-381 on TPU.
+
+Reference analog: the blst C library's 384-bit field arithmetic
+(@chainsafe/blst, SURVEY.md §2.1). blst uses 6x64-bit limbs with carry
+chains and Montgomery multiplication — a serial-CPU design. TPUs have no
+64-bit scalar units, no carry flags, and want wide, branch-free, static-
+shape vector code. This module therefore uses a *redundant signed limb*
+representation designed for the TPU VPU:
+
+  - An Fq element is 40 int32 limbs in radix 2^10 (39 limbs cover 390
+    bits >= 382; limb 39 is a small redundant carry limb), batched over
+    arbitrary leading dims.
+  - Multiplication is a plain schoolbook convolution: products of 10-bit
+    limbs and their 40-term column sums stay far below 2^31, so no carry
+    propagation is needed *inside* the product loop (carry-free MAC).
+  - Reduction mod P is a linear fold: 2^(10k) mod P for every overflow
+    limb index k is a precomputed constant row; folding high limbs is a
+    small constant matrix-multiply that XLA maps onto fused multiply-adds
+    (and later, Pallas can put an int8-decomposed version on the MXU).
+  - Carry normalization is a handful of data-parallel shift/subtract
+    passes (no sequential ripple), correct for signed limbs because the
+    int32 right shift is arithmetic.
+
+Overflow safety is *proved at trace time*: every value carries an exact
+per-limb interval, and every op propagates intervals with exact interval
+arithmetic, auto-normalizing operands when a column sum could leave
+int32. Intervals are static Python data (pytree aux), so this costs
+nothing at runtime, and `normalize()` lands on a fixed canonical profile
+so `lax.scan` carries typecheck.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls.fields import P
+
+BITS = 10
+B = 1 << BITS  # limb radix
+NLIMB = 39  # 390 bits >= 382 > log2(P)
+NCANON = NLIMB + 1  # canonical length incl. redundant carry limb
+INT32_MAX = 2**31 - 1
+
+# Canonical interval profile: non-negative limbs in [0, B+1] plus a
+# small redundant carry limb. Keeping the canonical domain non-negative
+# makes the trace-time interval analysis tight (signed hulls are sticky
+# at [-1, B] and would cycle); negative values are shifted into the
+# non-negative cone by adding a limb-wise multiple-of-P offset first.
+CANON_LO = tuple([0] * NCANON)
+CANON_HI = tuple([B + 1] * NLIMB + [2])
+
+
+def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
+    """Canonical non-negative base-2^BITS limbs of x (< 2^(BITS*n))."""
+    assert 0 <= x < (1 << (BITS * n))
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = x & (B - 1)
+        x >>= BITS
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side exact value of a limb vector (any bounds, signed)."""
+    return sum(int(v) << (BITS * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_row(k: int) -> tuple:
+    """Canonical limbs of 2^(BITS*k) mod P."""
+    return tuple(int(v) for v in int_to_limbs(pow(2, BITS * k, P)))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Lv:
+    """A limbed value: jnp int32 array (..., n) + exact static bounds."""
+
+    v: jax.Array
+    lo: tuple  # per-limb lower bounds (python ints)
+    hi: tuple  # per-limb upper bounds
+
+    def tree_flatten(self):
+        return (self.v,), (self.lo, self.hi)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0], aux[1])
+
+    @property
+    def n(self) -> int:
+        return len(self.lo)
+
+    def widen(self, lo, hi) -> "Lv":
+        """Declare looser bounds (sound; needed for scan fixed points)."""
+        assert all(a <= b for a, b in zip(lo, self.lo)) and all(
+            a <= b for a, b in zip(self.hi, hi)
+        ), "widen() must enclose the current interval"
+        return Lv(self.v, tuple(lo), tuple(hi))
+
+
+def const(x: int, batch_shape=()) -> Lv:
+    """Canonical constant (value reduced mod P), broadcastable."""
+    limbs = int_to_limbs(x % P)
+    arr = jnp.broadcast_to(
+        jnp.asarray(np.concatenate([limbs, [0]]), jnp.int32),
+        tuple(batch_shape) + (NCANON,),
+    )
+    bounds = tuple(int(v) for v in limbs) + (0,)
+    return Lv(arr, bounds, bounds)
+
+
+def from_ints(xs) -> Lv:
+    """Batch of canonical field elements from python ints; shape (len(xs),)."""
+    mat = np.stack([np.concatenate([int_to_limbs(x % P), [0]]) for x in xs])
+    lo = tuple([0] * NCANON)
+    hi = tuple([B - 1] * NLIMB + [0])
+    return Lv(jnp.asarray(mat, jnp.int32), lo, hi)
+
+
+def to_ints(x: Lv) -> np.ndarray:
+    """Host: exact canonical ints mod P from a device value (any bounds)."""
+    arr = np.asarray(jax.device_get(x.v))
+    flat = arr.reshape(-1, x.n)
+    vals = [limbs_to_int(r) % P for r in flat]
+    return np.array(vals, dtype=object).reshape(arr.shape[:-1])
+
+
+def _overflows(lo, hi) -> bool:
+    return min(lo) < -INT32_MAX or max(hi) > INT32_MAX
+
+
+# ---------------------------------------------------------------------------
+# Raw ops (interval-tracked; auto-normalize operands on potential overflow)
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(x: Lv, n: int) -> Lv:
+    if x.n == n:
+        return x
+    assert x.n < n
+    pad = [(0, 0)] * (x.v.ndim - 1) + [(0, n - x.n)]
+    z = (0,) * (n - x.n)
+    return Lv(jnp.pad(x.v, pad), x.lo + z, x.hi + z)
+
+
+def add(a: Lv, b: Lv) -> Lv:
+    n = max(a.n, b.n)
+    a, b = _pad_to(a, n), _pad_to(b, n)
+    lo = tuple(x + y for x, y in zip(a.lo, b.lo))
+    hi = tuple(x + y for x, y in zip(a.hi, b.hi))
+    if _overflows(lo, hi):
+        return add(normalize(a), normalize(b))
+    return Lv(a.v + b.v, lo, hi)
+
+
+def sub(a: Lv, b: Lv) -> Lv:
+    n = max(a.n, b.n)
+    a, b = _pad_to(a, n), _pad_to(b, n)
+    lo = tuple(x - y for x, y in zip(a.lo, b.hi))
+    hi = tuple(x - y for x, y in zip(a.hi, b.lo))
+    if _overflows(lo, hi):
+        return sub(normalize(a), normalize(b))
+    return Lv(a.v - b.v, lo, hi)
+
+
+def neg(a: Lv) -> Lv:
+    return Lv(-a.v, tuple(-h for h in a.hi), tuple(-l for l in a.lo))
+
+
+def mul_small(a: Lv, k: int) -> Lv:
+    """Multiply by a small python int (e.g. curve constants)."""
+    lo = tuple(min(k * x, k * y) for x, y in zip(a.lo, a.hi))
+    hi = tuple(max(k * x, k * y) for x, y in zip(a.lo, a.hi))
+    if _overflows(lo, hi):
+        return mul_small(normalize(a), k)
+    return Lv(a.v * k, lo, hi)
+
+
+@functools.lru_cache(maxsize=65536)
+def _conv_bounds(alo, ahi, blo, bhi):
+    na, nb = len(alo), len(blo)
+    lo = [0] * (na + nb - 1)
+    hi = [0] * (na + nb - 1)
+    for i in range(na):
+        for j in range(nb):
+            cands = (
+                alo[i] * blo[j],
+                alo[i] * bhi[j],
+                ahi[i] * blo[j],
+                ahi[i] * bhi[j],
+            )
+            lo[i + j] += min(cands)
+            hi[i + j] += max(cands)
+    return tuple(lo), tuple(hi)
+
+
+def conv(a: Lv, b: Lv) -> Lv:
+    """Schoolbook product (length na+nb-1), carry-free accumulation."""
+    lo, hi = _conv_bounds(a.lo, a.hi, b.lo, b.hi)
+    if _overflows(lo, hi):
+        a2, b2 = normalize(a), normalize(b)
+        if (a2.lo, a2.hi, b2.lo, b2.hi) == (a.lo, a.hi, b.lo, b.hi):
+            raise OverflowError("conv overflows even on canonical inputs")
+        return conv(a2, b2)
+    na, nb = a.n, b.n
+    out_shape = jnp.broadcast_shapes(a.v.shape[:-1], b.v.shape[:-1]) + (
+        na + nb - 1,
+    )
+    out = jnp.zeros(out_shape, jnp.int32)
+    for i in range(na):
+        if a.lo[i] == 0 and a.hi[i] == 0:
+            continue
+        out = out.at[..., i : i + nb].add(a.v[..., i : i + 1] * b.v)
+    return Lv(out, lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# Carry + fold normalization
+# ---------------------------------------------------------------------------
+
+
+def _carry_pass(x: Lv) -> Lv:
+    """One data-parallel signed carry pass; extends length by 1."""
+    x = _pad_to(x, x.n + 1)
+    hi = x.v >> BITS  # arithmetic shift: floor division, signed-correct
+    lo_v = x.v - (hi << BITS)  # in [0, B)
+    zero = jnp.zeros(x.v.shape[:-1] + (1,), jnp.int32)
+    shifted = jnp.concatenate([zero, hi[..., :-1]], axis=-1)
+    hlo = [l >> BITS for l in x.lo]
+    hhi = [h >> BITS for h in x.hi]
+    new_lo, new_hi = [], []
+    for i in range(x.n):
+        c_lo, c_hi = (hlo[i - 1], hhi[i - 1]) if i > 0 else (0, 0)
+        if hlo[i] == 0 and hhi[i] == 0:  # limb unsplit: hi==0, lo==value
+            new_lo.append(x.lo[i] + c_lo)
+            new_hi.append(x.hi[i] + c_hi)
+        else:
+            new_lo.append(0 + c_lo)
+            new_hi.append(B - 1 + c_hi)
+    return Lv(lo_v + shifted, tuple(new_lo), tuple(new_hi))
+
+
+def _needs_carry(x: Lv) -> bool:
+    return any(h > B + 1 for h in x.hi)
+
+
+@functools.lru_cache(maxsize=65536)
+def _offset_limbs(lo_bounds: tuple) -> tuple:
+    """A limb vector o with o[i] >= -lo[i], value(o) = 0 mod P: adding it
+    moves any value with these lower bounds into the non-negative cone
+    without changing it mod P."""
+    g = [max(0, -l) for l in lo_bounds]
+    n = max(len(g), NLIMB)
+    g += [0] * (n - len(g))
+    G = sum(gi << (BITS * i) for i, gi in enumerate(g))
+    if G == 0:
+        return None
+    K = -(-G // P)
+    m = int_to_limbs(K * P - G)  # in [0, P)
+    return tuple(g[i] + (int(m[i]) if i < NLIMB else 0) for i in range(n))
+
+
+def _make_nonneg(x: Lv) -> Lv:
+    """Shift into the non-negative cone (value preserved mod P)."""
+    # shrink huge magnitudes first so the offset add cannot overflow
+    while min(x.lo) < -(2**28) or max(x.hi) > 2**28:
+        x = _carry_pass(x)
+    off = _offset_limbs(x.lo)
+    if off is None:
+        return x
+    x = _pad_to(x, len(off))
+    arr = jnp.asarray(off, jnp.int32)
+    lo = tuple(l + o for l, o in zip(x.lo, off))
+    hi = tuple(h + o for h, o in zip(x.hi, off))
+    if _overflows(lo, hi):
+        raise OverflowError("offset overflow — magnitudes too large")
+    return Lv(x.v + arr, lo, hi)
+
+
+def _fold_overflow(x: Lv) -> Lv:
+    """Fold limbs at index >= NLIMB back below P's bit range via the
+    precomputed 2^(10k) mod P rows, except a small interval at the
+    canonical carry slot (index NLIMB), which stays in place."""
+    keep = x.v[..., :NLIMB]
+    lo = list(x.lo[:NLIMB]) + [0]
+    hi = list(x.hi[:NLIMB]) + [0]
+    out = jnp.pad(keep, [(0, 0)] * (keep.ndim - 1) + [(0, 1)])
+    for k in range(NLIMB, x.n):
+        if x.lo[k] == 0 and x.hi[k] == 0:
+            continue
+        if k == NLIMB and 0 <= x.lo[k] and x.hi[k] <= 2:
+            out = out.at[..., NLIMB].add(x.v[..., k])
+            lo[NLIMB] += x.lo[k]
+            hi[NLIMB] += x.hi[k]
+            continue
+        row = _fold_row(k)
+        contrib = x.v[..., k : k + 1] * jnp.asarray(row, jnp.int32)
+        out = out.at[..., :NLIMB].add(contrib)
+        for j in range(NLIMB):
+            lo[j] += min(x.lo[k] * row[j], x.hi[k] * row[j])
+            hi[j] += max(x.lo[k] * row[j], x.hi[k] * row[j])
+    if _overflows(tuple(lo), tuple(hi)):
+        raise OverflowError("fold overflow — carry before folding")
+    return Lv(out, tuple(lo), tuple(hi))
+
+
+def normalize(x: Lv) -> Lv:
+    """Reduce to the canonical 40-limb profile (value preserved mod P).
+
+    Trace-time-terminating loop: carry passes shrink limb magnitudes
+    geometrically; folds remove high limbs. Exact intervals drive the
+    loop, so the emitted op sequence is static per input profile.
+    """
+    if is_canonical_profile(x):
+        return x.widen(CANON_LO, CANON_HI)
+    x = _make_nonneg(x)
+    for _ in range(64):
+        if _needs_carry(x):
+            x = _carry_pass(x)
+            continue
+        if x.n > NCANON or (
+            x.n == NCANON and not (0 <= x.lo[-1] and x.hi[-1] <= 2)
+        ):
+            x = _fold_overflow(x)
+            continue
+        break
+    else:
+        raise RuntimeError("normalize() failed to converge — bounds bug")
+    x = _pad_to(x, NCANON)
+    return x.widen(CANON_LO, CANON_HI)
+
+
+def is_canonical_profile(x: Lv) -> bool:
+    return (
+        x.n == NCANON
+        and all(l >= c for l, c in zip(x.lo, CANON_LO))
+        and all(h <= c for h, c in zip(x.hi, CANON_HI))
+    )

@@ -1,0 +1,146 @@
+"""Differential tests: TPU limb/Fq kernels vs the pure-Python oracle.
+
+Mirrors the reference's approach of validating its BLS backend against
+spec vectors before performance work (SURVEY.md §4): here the oracle
+(crypto/bls/fields.py, itself blst-KAT-validated) anchors the vectorized
+limb arithmetic.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lodestar_tpu.crypto.bls.fields import P
+from lodestar_tpu.ops import fq
+from lodestar_tpu.ops import limbs as L
+
+rng = random.Random(0xB15)
+
+
+def rand_ints(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def test_codec_roundtrip():
+    xs = [0, 1, P - 1, P // 2] + rand_ints(12)
+    lv = L.from_ints(xs)
+    back = fq.to_int(lv)
+    assert [int(b) for b in back] == xs
+
+
+def test_const_broadcast():
+    c = L.const(12345, (3,))
+    assert c.v.shape == (3, L.NCANON)
+    assert all(int(x) == 12345 for x in fq.to_int(c))
+
+
+def test_add_sub_neg():
+    a_i, b_i = rand_ints(16), rand_ints(16)
+    a, b = L.from_ints(a_i), L.from_ints(b_i)
+    assert [int(x) for x in fq.to_int(L.add(a, b))] == [
+        (x + y) % P for x, y in zip(a_i, b_i)
+    ]
+    assert [int(x) for x in fq.to_int(L.sub(a, b))] == [
+        (x - y) % P for x, y in zip(a_i, b_i)
+    ]
+    assert [int(x) for x in fq.to_int(L.neg(a))] == [-x % P for x in a_i]
+
+
+def test_mul_matches_oracle():
+    a_i, b_i = rand_ints(32), rand_ints(32)
+    a, b = L.from_ints(a_i), L.from_ints(b_i)
+    got = fq.to_int(fq.mul(a, b))
+    assert [int(x) for x in got] == [x * y % P for x, y in zip(a_i, b_i)]
+
+
+def test_mul_edge_values():
+    xs = [0, 1, 2, P - 1, P - 2, (P + 1) // 2, 2**380, 2**389 % P]
+    a = L.from_ints(xs)
+    got = fq.to_int(fq.mul(a, a))
+    assert [int(x) for x in got] == [x * x % P for x in xs]
+
+
+def test_lazy_chain_bounds():
+    """Long unnormalized add/sub chains stay exact (auto-normalization)."""
+    a_i, b_i = rand_ints(8), rand_ints(8)
+    a, b = L.from_ints(a_i), L.from_ints(b_i)
+    acc, ref = a, list(a_i)
+    for k in range(50):
+        if k % 3 == 2:
+            acc = L.sub(acc, b)
+            ref = [(x - y) % P for x, y in zip(ref, b_i)]
+        else:
+            acc = L.add(acc, a)
+            ref = [(x + y) % P for x, y in zip(ref, a_i)]
+    acc = fq.mul(acc, b)
+    ref = [x * y % P for x, y in zip(ref, b_i)]
+    assert [int(x) for x in fq.to_int(acc)] == ref
+
+
+def test_mul_small():
+    a_i = rand_ints(8)
+    a = L.from_ints(a_i)
+    for k in (2, 3, 8, 12):
+        got = fq.to_int(L.normalize(L.mul_small(a, k)))
+        assert [int(x) for x in got] == [x * k % P for x in a_i]
+
+
+def test_normalize_worst_case_limbs():
+    """Adversarial: all limbs at the canonical extremes."""
+    for fill in (L.B + 1, L.B - 1, 1):
+        v = jnp.full((4, L.NCANON), fill, jnp.int32).at[..., -1].set(2)
+        lv = L.Lv(v, L.CANON_LO, L.CANON_HI)
+        val = L.limbs_to_int(np.asarray(lv.v[0]))
+        out = L.normalize(L.conv(lv, lv))
+        assert int(fq.to_int(out)[0]) == val * val % P
+
+
+def test_pow_inv_sqrt():
+    a_i = rand_ints(6)
+    a = L.from_ints(a_i)
+    inv = fq.to_int(fq.inv(a))
+    assert [int(x) for x in inv] == [pow(x, P - 2, P) for x in a_i]
+    sq = [x * x % P for x in a_i]
+    cand = fq.to_int(fq.sqrt_candidate(L.from_ints(sq)))
+    for c, s in zip(cand, sq):
+        assert int(c) * int(c) % P == s
+
+
+def test_eq_is_zero():
+    a_i = rand_ints(6)
+    a = L.from_ints(a_i)
+    b = L.from_ints(a_i)
+    c = L.from_ints([(x + 1) % P for x in a_i])
+    assert bool(jnp.all(fq.eq(a, b)))
+    assert not bool(jnp.any(fq.eq(a, c)))
+    z = L.sub(a, b)
+    assert bool(jnp.all(fq.is_zero(z)))
+    assert bool(jnp.all(fq.is_zero(L.const(0, (4,)))))
+    assert not bool(jnp.any(fq.is_zero(L.const(1, (4,)))))
+    # deep redundancy: many P-multiples folded in
+    deep = L.normalize(L.conv(L.from_ints([P - 1] * 4), L.from_ints([P - 1] * 4)))
+    one = L.const(1, (4,))
+    assert bool(jnp.all(fq.eq(deep, one)))
+
+
+def test_jit_and_vmap():
+    a_i, b_i = rand_ints(8), rand_ints(8)
+    a, b = L.from_ints(a_i), L.from_ints(b_i)
+    f = jax.jit(fq.mul)
+    got = fq.to_int(f(a, b))
+    assert [int(x) for x in got] == [x * y % P for x, y in zip(a_i, b_i)]
+    # second call hits the cache (same bounds profile)
+    got2 = fq.to_int(f(b, a))
+    assert [int(x) for x in got2] == [x * y % P for x, y in zip(a_i, b_i)]
+
+
+def test_scan_canonical_fixed_point():
+    """normalize() output profile must be a scan fixed point."""
+    a = L.from_ints(rand_ints(4))
+    out = L.normalize(L.conv(a, a))
+    assert L.is_canonical_profile(out)
+    out2 = L.normalize(L.conv(out, out))
+    assert (out2.lo, out2.hi) == (out.lo, out.hi)
