@@ -1,0 +1,318 @@
+"""Vectorized BLS12-381 extension-field towers: Fq2, Fq6, Fq12.
+
+Representation: plain tuples of Lv values (pytree-native, vmap/scan
+friendly), mirroring the oracle's layout (crypto/bls/fields.py):
+
+  Fq2  = (c0, c1)                 c0 + c1*u,  u^2 = -1
+  Fq6  = (a0, a1, a2)  over Fq2,  v^3 = XI = 1 + u
+  Fq12 = (b0, b1)      over Fq6,  w^2 = v
+
+Karatsuba multiplication with lazy (raw-space) addition; reduction
+happens once per output coefficient inside fq.mul's normalize. Frobenius
+constants are derived from the oracle at import time — no hand-copied
+tables. Correctness oracle: crypto/bls/fields.py (blst-KAT-validated).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..crypto.bls import fields as F
+from . import fq
+from . import limbs as L
+from .limbs import Lv
+
+# ---------------------------------------------------------------------------
+# Fq2
+# ---------------------------------------------------------------------------
+
+FQ2 = tuple  # (Lv, Lv)
+
+
+def fq2_const(x, batch_shape=()) -> FQ2:
+    return (L.const(x[0], batch_shape), L.const(x[1], batch_shape))
+
+
+def fq2_from_ints(xs) -> FQ2:
+    """Batch from list of (c0, c1) int pairs."""
+    return (L.from_ints([x[0] for x in xs]), L.from_ints([x[1] for x in xs]))
+
+
+def fq2_to_ints(a: FQ2):
+    return list(zip(fq.to_int(a[0]).tolist(), fq.to_int(a[1]).tolist()))
+
+
+def fq2_add(a, b):
+    return (L.add(a[0], b[0]), L.add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (L.sub(a[0], b[0]), L.sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (L.neg(a[0]), L.neg(a[1]))
+
+
+def fq2_conj(a):
+    return (a[0], L.neg(a[1]))
+
+
+def fq2_norm(a):
+    return (L.normalize(a[0]), L.normalize(a[1]))
+
+
+def fq2_mul(a, b):
+    t0 = L.conv(a[0], b[0])
+    t1 = L.conv(a[1], b[1])
+    t2 = L.conv(L.add(a[0], a[1]), L.add(b[0], b[1]))
+    c0 = L.normalize(L.sub(t0, t1))
+    c1 = L.normalize(L.sub(L.sub(t2, t0), t1))
+    return (c0, c1)
+
+
+def fq2_sqr(a):
+    c0 = L.normalize(L.conv(L.add(a[0], a[1]), L.sub(a[0], a[1])))
+    c1 = L.normalize(L.mul_small(L.conv(a[0], a[1]), 2))
+    return (c0, c1)
+
+
+def fq2_mul_fq(a, k: Lv):
+    return (fq.mul(a[0], k), fq.mul(a[1], k))
+
+
+def fq2_mul_small(a, k: int):
+    return (L.mul_small(a[0], k), L.mul_small(a[1], k))
+
+
+def fq2_mul_by_xi(a):
+    """(c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1)u."""
+    return (L.sub(a[0], a[1]), L.add(a[0], a[1]))
+
+
+def fq2_inv(a):
+    d = fq.inv(L.normalize(L.add(L.conv(a[0], a[0]), L.conv(a[1], a[1]))))
+    return (fq.mul(a[0], d), fq.mul(L.neg(a[1]), d))
+
+
+def fq2_select(mask, a, b):
+    return (fq.select(mask, a[0], b[0]), fq.select(mask, a[1], b[1]))
+
+
+def fq2_is_zero(a):
+    return fq.is_zero(a[0]) & fq.is_zero(a[1])
+
+
+def fq2_eq(a, b):
+    return fq.eq(a[0], b[0]) & fq.eq(a[1], b[1])
+
+
+# ---------------------------------------------------------------------------
+# Fq6 = Fq2[v]/(v^3 - XI)
+# ---------------------------------------------------------------------------
+
+
+def fq6_const(x, batch_shape=()):
+    return tuple(fq2_const(c, batch_shape) for c in x)
+
+
+def fq6_add(a, b):
+    return tuple(fq2_add(x, y) for x, y in zip(a, b))
+
+
+def fq6_sub(a, b):
+    return tuple(fq2_sub(x, y) for x, y in zip(a, b))
+
+
+def fq6_neg(a):
+    return tuple(fq2_neg(x) for x in a)
+
+
+def fq6_norm(a):
+    return tuple(fq2_norm(x) for x in a)
+
+
+def fq6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    c0 = fq2_add(
+        t0,
+        fq2_mul_by_xi(
+            fq2_sub(
+                fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), t1), t2
+            )
+        ),
+    )
+    c1 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), t0), t1),
+        fq2_mul_by_xi(t2),
+    )
+    c2 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), t0), t2),
+        t1,
+    )
+    return (c0, c1, c2)
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_by_v(a):
+    return (fq2_mul_by_xi(a[2]), a[0], a[1])
+
+
+def fq6_mul_fq2(a, k):
+    return tuple(fq2_mul(x, k) for x in a)
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    c0 = fq2_sub(fq2_sqr(a0), fq2_mul_by_xi(fq2_mul(a1, a2)))
+    c1 = fq2_sub(fq2_mul_by_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    c2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    t = fq2_add(
+        fq2_add(fq2_mul(a0, c0), fq2_mul_by_xi(fq2_mul(a2, c1))),
+        fq2_mul_by_xi(fq2_mul(a1, c2)),
+    )
+    ti = fq2_inv(t)
+    return (fq2_mul(c0, ti), fq2_mul(c1, ti), fq2_mul(c2, ti))
+
+
+def fq6_select(mask, a, b):
+    return tuple(fq2_select(mask, x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 = Fq6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+
+def fq12_const(x, batch_shape=()):
+    return tuple(fq6_const(c, batch_shape) for c in x)
+
+
+def fq12_one(batch_shape=()):
+    return fq12_const(F.FQ12_ONE, batch_shape)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_norm(a):
+    return (fq6_norm(a[0]), fq6_norm(a[1]))
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    a0, a1 = a
+    t1 = fq6_mul(a0, a1)
+    # (a0 + a1 w)^2 = (a0 + a1)(a0 + v a1) - t1 - v t1 + 2 t1 w
+    t = fq6_mul(fq6_add(a0, a1), fq6_add(a0, fq6_mul_by_v(a1)))
+    c0 = fq6_sub(fq6_sub(t, t1), fq6_mul_by_v(t1))
+    c1 = fq2_tuple_double(t1)
+    return (c0, c1)
+
+
+def fq2_tuple_double(a):
+    return tuple((L.mul_small(c[0], 2), L.mul_small(c[1], 2)) for c in a)
+
+
+def fq12_conj(a):
+    """f^(p^6): inverse on the cyclotomic subgroup (unitary elements)."""
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    t = fq6_inv(fq6_sub(fq6_sqr(a0), fq6_mul_by_v(fq6_sqr(a1))))
+    return (fq6_mul(a0, t), fq6_neg(fq6_mul(a1, t)))
+
+
+def fq12_select(mask, a, b):
+    return tuple(fq6_select(mask, x, y) for x, y in zip(a, b))
+
+
+def fq12_to_oracle(a):
+    """Host: convert a batch-shaped Fq12 to a list of oracle tuples."""
+    leaves = [
+        fq.to_int(lv)
+        for c6 in a
+        for c2 in c6
+        for lv in c2
+    ]
+    flat0 = leaves[0]
+    n = flat0.size if hasattr(flat0, "size") else 1
+    out = []
+    for i in range(n):
+        vals = [int(x.flat[i]) if hasattr(x, "flat") else int(x) for x in leaves]
+        f0 = (
+            (vals[0], vals[1]),
+            (vals[2], vals[3]),
+            (vals[4], vals[5]),
+        )
+        f1 = (
+            (vals[6], vals[7]),
+            (vals[8], vals[9]),
+            (vals[10], vals[11]),
+        )
+        out.append((f0, f1))
+    return out
+
+
+def fq12_from_oracle(fs):
+    """Batch an iterable of oracle Fq12 tuples onto the device."""
+    comps = [[] for _ in range(12)]
+    for f in fs:
+        i = 0
+        for c6 in f:
+            for c2 in c6:
+                comps[i].append(c2[0])
+                comps[i + 1].append(c2[1])
+                i += 2
+    lvs = [L.from_ints(c) for c in comps]
+    f0 = ((lvs[0], lvs[1]), (lvs[2], lvs[3]), (lvs[4], lvs[5]))
+    f1 = ((lvs[6], lvs[7]), (lvs[8], lvs[9]), (lvs[10], lvs[11]))
+    return (f0, f1)
+
+
+# ---------------------------------------------------------------------------
+# Frobenius (x -> x^p) — constants derived from the oracle at import
+# ---------------------------------------------------------------------------
+
+_G1 = F._G1  # gamma_1[i] = XI^(i*(p-1)/6) as oracle Fq2 tuples
+
+
+def fq6_frobenius(a):
+    return (
+        fq2_conj(a[0]),
+        fq2_mul(fq2_conj(a[1]), fq2_const(_G1[2])),
+        fq2_mul(fq2_conj(a[2]), fq2_const(_G1[4])),
+    )
+
+
+def fq12_frobenius(a):
+    f0 = fq6_frobenius(a[0])
+    f1 = fq6_frobenius(a[1])
+    g = fq2_const(_G1[1])
+    f1 = tuple(fq2_mul(c, g) for c in f1)
+    return (f0, f1)
+
+
+def fq12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fq12_frobenius(a)
+    return a

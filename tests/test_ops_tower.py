@@ -1,0 +1,97 @@
+"""Differential tests: vectorized Fq2/Fq6/Fq12 towers vs the oracle."""
+
+import random
+
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.crypto.bls.fields import P
+from lodestar_tpu.ops import fq
+from lodestar_tpu.ops import limbs as L
+from lodestar_tpu.ops import tower as T
+
+rng = random.Random(0x712)
+NB = 4
+
+
+def rand_fq2s():
+    return [(rng.randrange(P), rng.randrange(P)) for _ in range(NB)]
+
+
+def rand_fq12s():
+    def f6():
+        return tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3))
+
+    return [(f6(), f6()) for _ in range(NB)]
+
+
+def fq12_batch(fs):
+    return T.fq12_from_oracle(fs)
+
+
+def test_fq2_ops():
+    a_i, b_i = rand_fq2s(), rand_fq2s()
+    a, b = T.fq2_from_ints(a_i), T.fq2_from_ints(b_i)
+    assert T.fq2_to_ints(T.fq2_mul(a, b)) == [
+        F.fq2_mul(x, y) for x, y in zip(a_i, b_i)
+    ]
+    assert T.fq2_to_ints(T.fq2_sqr(a)) == [F.fq2_sqr(x) for x in a_i]
+    assert T.fq2_to_ints(T.fq2_norm(T.fq2_add(a, b))) == [
+        F.fq2_add(x, y) for x, y in zip(a_i, b_i)
+    ]
+    assert T.fq2_to_ints(T.fq2_norm(T.fq2_mul_by_xi(a))) == [
+        F._mul_by_xi(x) for x in a_i
+    ]
+    assert T.fq2_to_ints(T.fq2_inv(a)) == [F.fq2_inv(x) for x in a_i]
+
+
+def test_fq6_ops():
+    a_i = [tuple(rand_fq2s()[0] for _ in range(3)) for _ in range(NB)]
+    b_i = [tuple(rand_fq2s()[0] for _ in range(3)) for _ in range(NB)]
+
+    def batch6(xs):
+        return tuple(
+            T.fq2_from_ints([x[j] for x in xs]) for j in range(3)
+        )
+
+    def host6(x6):
+        return tuple(
+            tuple(T.fq2_to_ints(T.fq2_norm(c))[i] for c in x6)
+            for i in range(NB)
+        )
+
+    a, b = batch6(a_i), batch6(b_i)
+    assert host6(T.fq6_mul(a, b)) == tuple(
+        F.fq6_mul(x, y) for x, y in zip(a_i, b_i)
+    )
+    assert host6(T.fq6_mul_by_v(a)) == tuple(F.fq6_mul_by_v(x) for x in a_i)
+    assert host6(T.fq6_inv(a)) == tuple(F.fq6_inv(x) for x in a_i)
+
+
+def test_fq12_mul_sqr_inv():
+    a_i, b_i = rand_fq12s(), rand_fq12s()
+    a, b = fq12_batch(a_i), fq12_batch(b_i)
+    assert T.fq12_to_oracle(T.fq12_mul(a, b)) == [
+        F.fq12_mul(x, y) for x, y in zip(a_i, b_i)
+    ]
+    assert T.fq12_to_oracle(T.fq12_sqr(a)) == [F.fq12_sqr(x) for x in a_i]
+    assert T.fq12_to_oracle(T.fq12_conj(a)) == [F.fq12_conj(x) for x in a_i]
+    assert T.fq12_to_oracle(T.fq12_inv(a)) == [F.fq12_inv(x) for x in a_i]
+
+
+def test_fq12_frobenius():
+    a_i = rand_fq12s()
+    a = fq12_batch(a_i)
+    for n in (1, 2, 3):
+        got = T.fq12_to_oracle(T.fq12_frobenius_n(a, n))
+        want = [F.fq12_frobenius_n(x, n) for x in a_i]
+        assert got == want, f"frobenius^{n} mismatch"
+
+
+def test_fq12_select():
+    a_i, b_i = rand_fq12s(), rand_fq12s()
+    a, b = fq12_batch(a_i), fq12_batch(b_i)
+    mask = jnp.asarray([True, False, True, False])
+    got = T.fq12_to_oracle(T.fq12_select(mask, a, b))
+    want = [x if m else y for m, x, y in zip([1, 0, 1, 0], a_i, b_i)]
+    assert got == want
