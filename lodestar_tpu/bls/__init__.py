@@ -1,0 +1,13 @@
+"""BLS verification subsystem: signature-set model, TPU batch kernels,
+and the IBlsVerifier-compatible service (reference: chain/bls/,
+SURVEY.md §2.3 — the designated TPU-acceleration target)."""
+
+from .api import SameMessageSet, SignatureSet
+from .verifier import OracleBlsVerifier, TpuBlsVerifier
+
+__all__ = [
+    "SameMessageSet",
+    "SignatureSet",
+    "OracleBlsVerifier",
+    "TpuBlsVerifier",
+]

@@ -1,0 +1,93 @@
+"""Signature-set model for the verifier service.
+
+Reference analog: `ISignatureSet` in
+state-transition/src/signatureSets/types.ts and the serialized
+`SerializedSet {message, publicKey, signature}` the BLS pool ships to
+workers (chain/bls/multithread/types.ts). A set is one independently
+verifiable (aggregate-pubkey, message, signature) triple; same-message
+batches carry k (pubkey, signature) pairs over one message
+(chain/bls/multithread/jobItem.ts:50-92).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+from ..crypto.bls import curve as oc
+from ..crypto.bls import hash_to_curve as h2c
+from ..crypto.bls.signature import BLS_DST_SIG
+
+
+@dataclass(frozen=True)
+class SignatureSet:
+    """One verification unit: aggregate pubkey point, 32-byte signing
+    root, 96-byte compressed signature."""
+
+    pubkey: bytes  # 48-byte compressed G1 (possibly pre-aggregated)
+    message: bytes  # signing root
+    signature: bytes  # 96-byte compressed G2
+
+
+@dataclass(frozen=True)
+class SameMessageSet:
+    """One (pubkey, signature) pair of a same-message batch."""
+
+    pubkey: bytes
+    signature: bytes
+
+
+class InvalidPointError(ValueError):
+    pass
+
+
+@lru_cache(maxsize=65536)
+def decompress_pubkey(pk: bytes):
+    """48B compressed -> affine ints; rejects infinity (spec
+    KeyValidate) and off-curve/subgroup points. Cached: validator
+    pubkeys recur constantly (reference pubkey-index-map, SURVEY.md
+    §2.1)."""
+    try:
+        p = oc.g1_from_bytes(pk)
+    except Exception as e:  # malformed encoding
+        raise InvalidPointError(str(e)) from e
+    if p is None:
+        raise InvalidPointError("pubkey is the identity")
+    if not oc.g1_in_subgroup(p):
+        raise InvalidPointError("pubkey not in G1 subgroup")
+    return p
+
+
+@lru_cache(maxsize=16384)
+def decompress_signature(sig: bytes):
+    """96B compressed -> affine ints on the twist; identity -> None
+    (an identity signature can only verify for identity pubkeys, which
+    KeyValidate already rejects — callers treat None as invalid)."""
+    try:
+        q = oc.g2_from_bytes(sig)
+    except Exception as e:
+        raise InvalidPointError(str(e)) from e
+    if q is None:
+        return None
+    if not oc.g2_in_subgroup(q):
+        raise InvalidPointError("signature not in G2 subgroup")
+    return q
+
+
+@lru_cache(maxsize=8192)
+def message_to_g2(message: bytes, dst: bytes = BLS_DST_SIG):
+    """Hash a signing root to G2 (host SHA-256 path). Cached because the
+    gossip batch path groups many sets on one attestation data
+    (IndexedGossipQueueMinSize, SURVEY.md §2.2)."""
+    return h2c.hash_to_g2(message, dst)
+
+
+def aggregate_pubkeys_points(pks) -> tuple:
+    """Sum decompressed pubkey points (for aggregate sets — spec
+    fastAggregateVerify's pubkey aggregation)."""
+    acc = None
+    for p in pks:
+        acc = oc.g1_add(acc, p)
+    if acc is None:
+        raise InvalidPointError("aggregate pubkey is the identity")
+    return acc

@@ -1,0 +1,181 @@
+"""TPU batch-verification kernels for BLS signatures.
+
+Reference analog: the blst entry points Lodestar's BLS pool calls
+(SURVEY.md §2.3): `verifyMultipleAggregateSignatures` (random
+linear-combination batch verify, chain/bls/maybeBatch.ts:17) and
+`aggregateWithRandomness` (same-message aggregation,
+chain/bls/multithread/jobItem.ts:73 — the measured main-thread
+bottleneck, ~2 min/epoch on CPU). Both become staged device programs:
+64-bit random-weighted scalar ladders, a log-depth aggregate tree, a
+batched Miller loop, and one shared final exponentiation.
+
+The pipeline is jitted in stages rather than as one program: XLA's
+compile time punishes one giant graph superlinearly, the final-exp
+stage has batch-independent shape () so it compiles exactly once, and
+`jax.jit` caches each stage per input shape. Callers pad to a bucket
+size and pass a mask (SURVEY.md §7 hard part 2: padded static shapes
+avoid recompiles); the persistent disk cache (utils/jaxcache.py) makes
+later processes start warm. All stages broadcast over a leading batch
+axis that lodestar_tpu/parallel shards across chips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls import curve as oc
+from ..ops import curve as C
+from ..ops import fq, pairing, tower
+from ..ops import limbs as L
+from ..utils import jaxcache
+
+RAND_BITS = 64  # blst's randomness width for batch verify
+
+
+def _g1_neg_gen(batch=()):
+    """-G1 generator as canonical device coords."""
+    x, y = oc.g1_neg(oc.G1_GEN)
+    return (
+        L.normalize(L.const(x, batch)),
+        L.normalize(L.const(y, batch)),
+    )
+
+
+def _to_affine(ops, p: C.JacPoint):
+    """Jacobian -> affine on device via one batched Fermat inversion.
+    Infinity slots yield garbage coords — callers mask them."""
+    if ops is C.FQ_OPS:
+        zinv = fq.inv(p.z)
+        zinv2 = fq.sqr(zinv)
+        return fq.mul(p.x, zinv2), fq.mul(p.y, fq.mul(zinv2, zinv))
+    zinv = tower.fq2_inv(p.z)
+    zinv2 = tower.fq2_sqr(zinv)
+    x = tower.fq2_mul(p.x, zinv2)
+    y = tower.fq2_mul(p.y, tower.fq2_mul(zinv2, zinv))
+    return C.FQ2_OPS.norm(x), C.FQ2_OPS.norm(y)
+
+
+# --- jitted stages (cached per input shape) --------------------------------
+
+
+@jax.jit
+def _stage_ladder_g1(x, y, inf, bits):
+    return C.scalar_mul(C.FQ_OPS, x, y, bits, inf)
+
+
+@jax.jit
+def _stage_ladder_g2(x, y, inf, bits):
+    return C.scalar_mul(C.FQ2_OPS, x, y, bits, inf)
+
+
+@jax.jit
+def _stage_affine_g1(p: C.JacPoint):
+    return _to_affine(C.FQ_OPS, p)
+
+
+@jax.jit
+def _stage_sum_affine_g1(p: C.JacPoint, mask):
+    p = C.jac_select(
+        C.FQ_OPS, mask, p, C.jac_infinity(C.FQ_OPS, mask.shape)
+    )
+    s = C.jac_sum(C.FQ_OPS, p)
+    return _to_affine(C.FQ_OPS, s)
+
+
+@jax.jit
+def _stage_sum_affine_g2(p: C.JacPoint, mask):
+    p = C.jac_select(
+        C.FQ2_OPS, mask, p, C.jac_infinity(C.FQ2_OPS, mask.shape)
+    )
+    s = C.jac_sum(C.FQ2_OPS, p)
+    return _to_affine(C.FQ2_OPS, s)
+
+
+@jax.jit
+def _stage_miller_product(px, py, qx, qy, mask):
+    f = pairing.miller_loop(px, py, qx, qy)
+    return pairing._fq12_masked_product(f, mask)
+
+
+@jax.jit
+def _stage_final_is_one(f):
+    return pairing.fq12_is_one(pairing.final_exponentiation(f))
+
+
+# --- host-orchestrated kernels --------------------------------------------
+
+
+def run_verify_batch(pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask) -> bool:
+    """Random-linear-combination batch verify of n (pk, msg, sig) sets:
+
+      prod_i e(r_i*pk_i, H_i) * e(-g1, sum_i r_i*sig_i) == 1
+
+    pk: G1 affine batch (n,); h: (hx, hy) G2 Fq2 batches (n,);
+    sig: G2 affine batch (n,). rand_bits: (n, RAND_BITS) bool MSB-first,
+    r_i != 0. mask: (n,) bool — False slots are padding. Reference:
+    blst verifyMultipleAggregateSignatures (maybeBatch.ts:17-44); a
+    batch failure means callers retry per set (index.ts:552-563).
+    """
+    jaxcache.enable()
+    if not bool(jnp.any(mask)):
+        return True  # all-padding call is vacuously true
+    rpk = _stage_ladder_g1(pk.x, pk.y, pk.inf, rand_bits)
+    rsig = _stage_ladder_g2(sig.x, sig.y, sig.inf, rand_bits)
+    s_aff = _stage_sum_affine_g2(rsig, mask)  # batch (1,)
+    rpk_aff = _stage_affine_g1(rpk)
+    ngx, ngy = _g1_neg_gen((1,))
+    px = _cat_fq(rpk_aff[0], ngx)
+    py = _cat_fq(rpk_aff[1], ngy)
+    qx = _cat_fq2(h[0], s_aff[0])
+    qy = _cat_fq2(h[1], s_aff[1])
+    full_mask = jnp.concatenate([mask, jnp.asarray([True])])
+    prod = _stage_miller_product(px, py, qx, qy, full_mask)
+    return bool(_stage_final_is_one(prod))
+
+
+def run_verify_same_message(pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask) -> bool:
+    """Same-message batch verify: n (pk_i, sig_i) on ONE message H:
+
+      e(sum r_i*pk_i, H) * e(-g1, sum r_i*sig_i) == 1
+
+    `aggregateWithRandomness` + one pairing check fused on device — the
+    reference computes the MSMs on the main thread (jobItem.ts:60-75),
+    its documented scaling limit. h: (hx, hy) with batch shape (1,).
+    """
+    jaxcache.enable()
+    if not bool(jnp.any(mask)):
+        return True
+    rpk = _stage_ladder_g1(pk.x, pk.y, pk.inf, rand_bits)
+    rsig = _stage_ladder_g2(sig.x, sig.y, sig.inf, rand_bits)
+    apk_aff = _stage_sum_affine_g1(rpk, mask)
+    asig_aff = _stage_sum_affine_g2(rsig, mask)
+    ngx, ngy = _g1_neg_gen((1,))
+    px = _cat_fq(apk_aff[0], ngx)
+    py = _cat_fq(apk_aff[1], ngy)
+    qx = _cat_fq2(h[0], asig_aff[0])
+    qy = _cat_fq2(h[1], asig_aff[1])
+    pair_mask = jnp.asarray([True, True])
+    prod = _stage_miller_product(px, py, qx, qy, pair_mask)
+    return bool(_stage_final_is_one(prod))
+
+
+# --- small helpers ---------------------------------------------------------
+
+
+def _cat_fq(a: L.Lv, b: L.Lv) -> L.Lv:
+    a, b = L.normalize(a), L.normalize(b)
+    return L.Lv(jnp.concatenate([a.v, b.v], 0), a.lo, a.hi)
+
+
+def _cat_fq2(a, b):
+    return (_cat_fq(a[0], b[0]), _cat_fq(a[1], b[1]))
+
+
+def bucket_size(n: int, buckets=(4, 8, 16, 32, 64, 128)) -> int:
+    """Smallest bucket >= n (reference chunks at <=128 sets/job,
+    chain/bls/multithread/index.ts:48-56)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]

@@ -1,0 +1,412 @@
+"""TPU-backed BLS verifier service — the reference's north-star seam.
+
+Reference analog: `IBlsVerifier` + `BlsMultiThreadWorkerPool`
+(chain/bls/interface.ts:25-68, chain/bls/multithread/index.ts:113,
+SURVEY.md §2.3). The pool's contract is kept exactly:
+
+  - `verify_signature_sets(sets, batchable, priority)` — batchable sets
+    are buffered up to MAX_BUFFER_WAIT_MS / MAX_BUFFERED_SIGS and merged
+    with other callers' work (index.ts:59-74, 320-339); jobs are packed
+    to <= MAX_SIGNATURE_SETS_PER_JOB sets (index.ts:48-56, 519-534);
+    a failed batch is re-verified set-by-set so one bad signature only
+    fails its own caller (interface.ts:4-12, worker.ts:88-103).
+  - `verify_signature_sets_same_message(sets, message)` — random-
+    weighted aggregation + one pairing check; on failure, per-signature
+    retry fan-out (jobItem.ts:96-125, index.ts:552-563).
+  - `can_accept_work()` — backpressure for the gossip processor
+    (index.ts:149-155, network/processor/index.ts).
+
+What changes vs the reference: the N-1 worker threads and their 5 ms
+postMessage round-trip are replaced by one async dispatch queue in
+front of jitted TPU kernels (bls/kernels.py); `aggregateWithRandomness`
+— the reference's measured main-thread bottleneck (jobItem.ts:60-70) —
+runs inside the device program instead of on the host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..crypto.bls import curve as oc
+from ..ops import curve as C
+from . import api, kernels
+
+MAX_BUFFER_WAIT_MS = 100  # index.ts:74
+MAX_BUFFERED_SIGS = 32  # index.ts:65
+MAX_SIGNATURE_SETS_PER_JOB = 128  # index.ts:56
+QUEUE_MAX_LENGTH = 512  # canAcceptWork threshold, index.ts:149-155
+
+
+def _rand_scalars(n: int):
+    """Nonzero 64-bit blinding scalars (blst batch-verify width)."""
+    return [secrets.randbits(kernels.RAND_BITS) | 1 for _ in range(n)]
+
+
+@dataclass
+class _PreparedSet:
+    pk: tuple  # affine G1 ints
+    h: tuple  # affine G2 ints (hashed message)
+    sig: tuple | None  # affine G2 ints, None = invalid/identity
+
+
+@dataclass
+class _Job:
+    sets: list
+    future: asyncio.Future
+    batchable: bool
+    enqueued_at: float = 0.0
+
+
+class BlsVerifierMetrics:
+    """Counter names mirror lodestar_bls_thread_pool_* so the reference
+    Grafana dashboard maps 1:1 (metrics/metrics/lodestar.ts:403-506)."""
+
+    def __init__(self):
+        self.job_groups_started = 0
+        self.jobs_started = 0
+        self.sig_sets_started = 0
+        self.batch_retries = 0
+        self.batch_sigs_success = 0
+        self.same_message_retries = 0
+        self.queue_length = 0
+        self.total_job_wait_s = 0.0
+        self.total_device_time_s = 0.0
+
+
+class TpuBlsVerifier:
+    """`IBlsVerifier` over TPU pairing kernels."""
+
+    def __init__(
+        self,
+        max_buffer_wait_ms: int = MAX_BUFFER_WAIT_MS,
+        max_buffered_sigs: int = MAX_BUFFERED_SIGS,
+        queue_max: int = QUEUE_MAX_LENGTH,
+    ):
+        self.metrics = BlsVerifierMetrics()
+        self._max_wait = max_buffer_wait_ms / 1000.0
+        self._max_buffered = max_buffered_sigs
+        self._max_sets_per_job = MAX_SIGNATURE_SETS_PER_JOB
+        self._queue_max = queue_max
+        self._buffer: list[_Job] = []
+        self._buffer_task: asyncio.Task | None = None
+        # priority queue: (priority_class, seq) keeps FIFO within class;
+        # priority jobs jump the queue (reference jobs.unshift,
+        # chain/bls/interface.ts:19-22)
+        self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
+        self._seq = 0
+        self._runner: asyncio.Task | None = None
+        self._closed = False
+
+    # -- IBlsVerifier surface ------------------------------------------
+
+    def can_accept_work(self) -> bool:
+        return (
+            not self._closed
+            and self._queue.qsize() + len(self._buffer) < self._queue_max
+        )
+
+    async def verify_signature_sets(
+        self,
+        sets: list[api.SignatureSet],
+        batchable: bool = False,
+        priority: bool = False,
+    ) -> bool:
+        """True iff every set verifies. Malformed points -> False
+        (maybeBatch.ts:17-44 semantics)."""
+        self._ensure_runner()
+        try:
+            prepared = [self._prepare(s) for s in sets]
+        except api.InvalidPointError:
+            return False
+        if any(p.sig is None for p in prepared):
+            return False
+        fut = asyncio.get_event_loop().create_future()
+        job = _Job(prepared, fut, batchable)
+        self.metrics.sig_sets_started += len(prepared)
+        if batchable and len(prepared) < self._max_buffered:
+            self._buffer.append(job)
+            buffered = sum(len(j.sets) for j in self._buffer)
+            if buffered >= self._max_buffered:
+                self._flush_buffer()
+            elif self._buffer_task is None:
+                self._buffer_task = asyncio.ensure_future(
+                    self._flush_after_wait()
+                )
+        else:
+            self._enqueue([job], priority)
+        return await fut
+
+    async def verify_signature_sets_same_message(
+        self, sets: list[api.SameMessageSet], message: bytes
+    ) -> list[bool]:
+        """Per-set verdicts for k (pubkey, signature) pairs on one
+        message (jobItem.ts:50-92)."""
+        self._ensure_runner()
+        h = api.message_to_g2(message)
+        prepared = []
+        valid = []
+        for s in sets:
+            try:
+                pk = api.decompress_pubkey(s.pubkey)
+                sig = api.decompress_signature(s.signature)
+            except api.InvalidPointError:
+                pk, sig = None, None
+            prepared.append((pk, sig))
+            valid.append(pk is not None and sig is not None)
+        live = [i for i, v in enumerate(valid) if v]
+        if not live:
+            return [False] * len(sets)
+        results = [False] * len(sets)
+        ok = await self._run_same_message(
+            [prepared[i] for i in live], h
+        )
+        if ok:
+            for i in live:
+                results[i] = True
+            return results
+        # batch failed: per-signature retry fan-out (index.ts:552-563)
+        self.metrics.same_message_retries += 1
+        singles = await asyncio.gather(
+            *(
+                self._run_batch(
+                    [_PreparedSet(prepared[i][0], h, prepared[i][1])]
+                )
+                for i in live
+            )
+        )
+        for i, r in zip(live, singles):
+            results[i] = r
+        return results
+
+    async def close(self):
+        """Reject all pending work (the reference rejects queued jobs on
+        worker termination, index.ts:311-318) and stop the runner."""
+        self._closed = True
+        if self._buffer_task:
+            self._buffer_task.cancel()
+            self._buffer_task = None
+        err = RuntimeError("BLS verifier closed")
+        for j in self._buffer:
+            if not j.future.done():
+                j.future.set_exception(err)
+        self._buffer = []
+        while not self._queue.empty():
+            _, _, jobs = self._queue.get_nowait()
+            for j in jobs:
+                if not j.future.done():
+                    j.future.set_exception(err)
+        if self._runner:
+            self._runner.cancel()
+            self._runner = None
+
+    # -- internals ------------------------------------------------------
+
+    def _prepare(self, s: api.SignatureSet) -> _PreparedSet:
+        pk = api.decompress_pubkey(s.pubkey)
+        h = api.message_to_g2(s.message)
+        sig = api.decompress_signature(s.signature)
+        return _PreparedSet(pk, h, sig)
+
+    def _ensure_runner(self):
+        if self._runner is None or self._runner.done():
+            self._runner = asyncio.ensure_future(self._run_loop())
+
+    def _enqueue(self, jobs: list[_Job], priority: bool = False):
+        self.metrics.job_groups_started += 1
+        now = time.monotonic()
+        for j in jobs:
+            j.enqueued_at = now
+        self._seq += 1
+        self._queue.put_nowait((0 if priority else 1, self._seq, jobs))
+        self.metrics.queue_length = self._queue.qsize()
+
+    def _flush_buffer(self):
+        if self._buffer_task:
+            self._buffer_task.cancel()
+            self._buffer_task = None
+        jobs, self._buffer = self._buffer, []
+        if jobs:
+            self._enqueue(jobs)
+
+    async def _flush_after_wait(self):
+        try:
+            await asyncio.sleep(self._max_wait)
+        except asyncio.CancelledError:
+            return
+        self._buffer_task = None
+        self._flush_buffer()
+
+    async def _run_loop(self):
+        while not self._closed:
+            _, _, jobs = await self._queue.get()
+            self.metrics.queue_length = self._queue.qsize()
+            t0 = time.monotonic()
+            for j in jobs:
+                self.metrics.total_job_wait_s += t0 - j.enqueued_at
+            try:
+                await self._execute_job_group(jobs)
+            except asyncio.CancelledError:
+                err = RuntimeError("BLS verifier closed")
+                for j in jobs:
+                    if not j.future.done():
+                        j.future.set_exception(err)
+                raise
+            except Exception as e:  # defensive: fail the waiters
+                for j in jobs:
+                    if not j.future.done():
+                        j.future.set_exception(e)
+            self.metrics.total_device_time_s += time.monotonic() - t0
+
+    async def _execute_job_group(self, jobs: list[_Job]):
+        """Pack jobs into <=128-set chunks; verify each chunk as one
+        random-lincomb batch; failed chunks retry per set
+        (prepareWork/runJob, index.ts:357-534)."""
+        # greedy packing preserving job boundaries
+        chunks: list[list[_Job]] = []
+        cur: list[_Job] = []
+        cur_n = 0
+        for j in jobs:
+            n = len(j.sets)
+            if cur and cur_n + n > self._max_sets_per_job:
+                chunks.append(cur)
+                cur, cur_n = [], 0
+            cur.append(j)
+            cur_n += n
+        if cur:
+            chunks.append(cur)
+        for chunk in chunks:
+            self.metrics.jobs_started += 1
+            all_sets = [s for j in chunk for s in j.sets]
+            ok = await self._run_batch(all_sets)
+            if ok:
+                self.metrics.batch_sigs_success += len(all_sets)
+                for j in chunk:
+                    if not j.future.done():
+                        j.future.set_result(True)
+                continue
+            if len(chunk) == 1 and len(all_sets) == 1:
+                if not chunk[0].future.done():
+                    chunk[0].future.set_result(False)
+                continue
+            # batch failed: isolate per job, then per set (worker.ts:88-103)
+            self.metrics.batch_retries += 1
+            for j in chunk:
+                verdicts = await asyncio.gather(
+                    *(self._run_batch([s]) for s in j.sets)
+                )
+                if not j.future.done():
+                    j.future.set_result(all(verdicts))
+
+    async def _run_batch(self, sets: list[_PreparedSet]) -> bool:
+        """Verify a list of sets as random-lincomb batches. Lists larger
+        than one device bucket are split and AND-ed — a single job may
+        legitimately exceed the per-call cap (e.g. a 64-block sync batch
+        carries ~8,000 sets, index.ts:51)."""
+        cap = self._max_sets_per_job
+        if len(sets) > cap:
+            parts = [
+                sets[i : i + cap] for i in range(0, len(sets), cap)
+            ]
+            verdicts = await asyncio.gather(
+                *(self._run_batch(p) for p in parts)
+            )
+            return all(verdicts)
+        n = len(sets)
+        b = kernels.bucket_size(n)
+        pad = b - n
+        pks = [s.pk for s in sets] + [oc.G1_GEN] * pad
+        hs = [s.h for s in sets] + [oc.G2_GEN] * pad
+        sigs = [s.sig for s in sets] + [oc.G2_GEN] * pad
+        rand = _rand_scalars(b)
+        pk_dev = C.g1_batch_from_ints(pks)
+        h_dev = C.g2_batch_from_ints(hs)
+        sig_dev = C.g2_batch_from_ints(sigs)
+        bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
+        mask = jnp.asarray([True] * n + [False] * pad)
+        ok = await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: kernels.run_verify_batch(
+                pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
+            ),
+        )
+        return ok
+
+    async def _run_same_message(self, pairs, h) -> bool:
+        n = len(pairs)
+        b = kernels.bucket_size(n)
+        pad = b - n
+        pks = [p for p, _ in pairs] + [oc.G1_GEN] * pad
+        sigs = [s for _, s in pairs] + [oc.G2_GEN] * pad
+        rand = _rand_scalars(b)
+        pk_dev = C.g1_batch_from_ints(pks)
+        sig_dev = C.g2_batch_from_ints(sigs)
+        h_dev = C.g2_batch_from_ints([h])  # batch (1,)
+        bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
+        mask = jnp.asarray([True] * n + [False] * pad)
+        ok = await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: kernels.run_verify_same_message(
+                pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
+            ),
+        )
+        return ok
+
+
+class OracleBlsVerifier:
+    """Single-threaded oracle-backed verifier — same interface, used in
+    tests and as the differential reference (reference analog:
+    BlsSingleThreadVerifier, chain/bls/singleThread.ts:8)."""
+
+    def can_accept_work(self) -> bool:
+        return True
+
+    async def verify_signature_sets(
+        self, sets, batchable=False, priority=False
+    ) -> bool:
+        from ..crypto.bls import pairing as op
+
+        try:
+            for s in sets:
+                pk = api.decompress_pubkey(s.pubkey)
+                h = api.message_to_g2(s.message)
+                sig = api.decompress_signature(s.signature)
+                if sig is None:
+                    return False
+                ok = op.pairing_product_is_one(
+                    [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+                )
+                if not ok:
+                    return False
+            return True
+        except api.InvalidPointError:
+            return False
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        from ..crypto.bls import pairing as op
+
+        h = api.message_to_g2(message)
+        out = []
+        for s in sets:
+            try:
+                pk = api.decompress_pubkey(s.pubkey)
+                sig = api.decompress_signature(s.signature)
+            except api.InvalidPointError:
+                out.append(False)
+                continue
+            if sig is None:
+                out.append(False)
+                continue
+            out.append(
+                op.pairing_product_is_one(
+                    [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+                )
+            )
+        return out
+
+    async def close(self):
+        pass

@@ -1,0 +1,352 @@
+"""Vectorized BLS12-381 G1/G2 point arithmetic on TPU limb values.
+
+Reference analog: blst's point ops behind @chainsafe/blst (SURVEY.md
+§2.1) — serial Jacobian ladders in C. Here the same Jacobian formulas
+are expressed as branch-free jnp ops over batched limb tensors so vmap /
+pjit can spread point batches across TPU lanes and chips:
+
+  - Points are (X, Y, Z) Jacobian triples plus an explicit `inf` boolean
+    (no Z==0 probing: field equality needs full canonicalization, a bool
+    select is ~free).
+  - Doubling is unconditional: on prime-order subgroups no point has
+    Y == 0, and infinity propagates through the flag.
+  - Mixed add assumes T != +-Q, which scalar ladders guarantee for
+    scalars k with partial prefixes never congruent to +-1 mod r (true
+    for any k < 2^255 fed MSB-first after the explicit-infinity start);
+    the T == infinity case is handled by the flag select.
+  - Scalar multiplication is an MSB-first double-and-add `lax.scan` over
+    the (secret-independent-shape) bit tensor; per-element bits select
+    between T and T+Q, so one compiled ladder serves the whole batch.
+
+The generic `_Ops` indirection instantiates the same formulas for G1
+(coords in Fq) and G2 (coords in Fq2 on the twist). Correctness oracle:
+lodestar_tpu/crypto/bls/curve.py (blst-KAT-validated).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls.fields import P
+from . import fq, tower
+from . import limbs as L
+
+
+@dataclass(frozen=True)
+class _Ops:
+    """Field-op table: same Jacobian formulas for Fq (G1) and Fq2 (G2)."""
+
+    add: Callable
+    sub: Callable
+    neg: Callable
+    mul: Callable
+    sqr: Callable
+    mul_small: Callable
+    norm: Callable  # reduce to canonical profile (scan-carry stable)
+    select: Callable
+    const: Callable  # (int-or-pair, batch_shape) -> element
+    eq: Callable
+    is_zero: Callable
+
+
+def _fq_norm(a):
+    return L.normalize(a)
+
+
+def _fq2_norm(a):
+    return (L.normalize(a[0]), L.normalize(a[1]))
+
+
+FQ_OPS = _Ops(
+    add=L.add,
+    sub=L.sub,
+    neg=L.neg,
+    mul=fq.mul,
+    sqr=fq.sqr,
+    mul_small=L.mul_small,
+    norm=_fq_norm,
+    select=fq.select,
+    const=lambda x, batch=(): L.const(x, batch),
+    eq=fq.eq,
+    is_zero=fq.is_zero,
+)
+
+FQ2_OPS = _Ops(
+    add=tower.fq2_add,
+    sub=tower.fq2_sub,
+    neg=tower.fq2_neg,
+    mul=tower.fq2_mul,
+    sqr=tower.fq2_sqr,
+    mul_small=lambda a, k: tower.fq2_mul_small(a, k),
+    norm=_fq2_norm,
+    select=tower.fq2_select,
+    const=lambda x, batch=(): tower.fq2_const(x, batch),
+    eq=tower.fq2_eq,
+    is_zero=tower.fq2_is_zero,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class JacPoint:
+    """Batched Jacobian point: coords of one field, inf flag per element."""
+
+    x: Any
+    y: Any
+    z: Any
+    inf: jax.Array
+
+    def tree_flatten(self):
+        return (self.x, self.y, self.z, self.inf), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def jac_normalize(ops: _Ops, p: JacPoint) -> JacPoint:
+    """Canonical limb profile on all coords (stable scan carry type)."""
+    return JacPoint(ops.norm(p.x), ops.norm(p.y), ops.norm(p.z), p.inf)
+
+
+def jac_select(ops: _Ops, mask, a: JacPoint, b: JacPoint) -> JacPoint:
+    return JacPoint(
+        ops.select(mask, a.x, b.x),
+        ops.select(mask, a.y, b.y),
+        ops.select(mask, a.z, b.z),
+        jnp.where(mask, a.inf, b.inf),
+    )
+
+
+def jac_infinity(ops: _Ops, batch_shape=()) -> JacPoint:
+    one = ops.norm(ops.const(_one_of(ops), batch_shape))
+    return JacPoint(
+        one, one, one, jnp.ones(batch_shape, jnp.bool_)
+    )
+
+
+def _one_of(ops: _Ops):
+    return 1 if ops is FQ_OPS else (1, 0)
+
+
+def jac_from_affine(ops: _Ops, x, y, inf=None) -> JacPoint:
+    batch = jnp.shape(inf) if inf is not None else _batch_shape(ops, x)
+    one = ops.norm(ops.const(_one_of(ops), batch))
+    if inf is None:
+        inf = jnp.zeros(batch, jnp.bool_)
+    return JacPoint(ops.norm(x), ops.norm(y), one, inf)
+
+
+def _batch_shape(ops: _Ops, x):
+    v = x.v if ops is FQ_OPS else x[0].v
+    return v.shape[:-1]
+
+
+def jac_double(ops: _Ops, p: JacPoint) -> JacPoint:
+    """dbl-2009-l (a = 0). Unconditional: Y == 0 never occurs on the
+    prime-order subgroup; infinity rides the flag."""
+    A = ops.sqr(p.x)
+    Bv = ops.sqr(p.y)
+    C = ops.sqr(Bv)
+    t = ops.sqr(ops.add(p.x, Bv))
+    D = ops.mul_small(ops.norm(ops.sub(ops.sub(t, A), C)), 2)
+    E = ops.mul_small(A, 3)
+    F = ops.sqr(E)
+    x3 = ops.norm(ops.sub(F, ops.mul_small(D, 2)))
+    y3 = ops.norm(
+        ops.sub(ops.mul(E, ops.norm(ops.sub(D, x3))), ops.mul_small(C, 8))
+    )
+    z3 = ops.norm(ops.mul_small(ops.mul(p.y, p.z), 2))
+    return JacPoint(x3, y3, z3, p.inf)
+
+
+def jac_mixed_add(ops: _Ops, p: JacPoint, qx, qy, q_inf=None) -> JacPoint:
+    """p + (qx, qy) with q affine. Requires p != +-q (see module doc);
+    p == infinity and q == infinity handled via flags."""
+    z2 = ops.sqr(p.z)
+    z3 = ops.mul(z2, p.z)
+    mu = ops.norm(ops.sub(ops.mul(qx, z2), p.x))  # x_q*Z^2 - X
+    th = ops.norm(ops.sub(ops.mul(qy, z3), p.y))  # y_q*Z^3 - Y
+    mu2 = ops.sqr(mu)
+    mu3 = ops.mul(mu2, mu)
+    xmu2 = ops.mul(p.x, mu2)
+    x3 = ops.norm(
+        ops.sub(ops.sub(ops.sqr(th), mu3), ops.mul_small(xmu2, 2))
+    )
+    y3 = ops.norm(
+        ops.sub(
+            ops.mul(th, ops.norm(ops.sub(xmu2, x3))), ops.mul(p.y, mu3)
+        )
+    )
+    z3v = ops.norm(ops.mul(p.z, mu))
+    out = JacPoint(x3, y3, z3v, jnp.zeros_like(p.inf))
+    # p at infinity -> q
+    q_as_jac = jac_from_affine(ops, qx, qy)
+    out = jac_select(ops, p.inf, JacPoint(q_as_jac.x, q_as_jac.y, q_as_jac.z, jnp.zeros_like(p.inf)), out)
+    if q_inf is not None:
+        out = jac_select(ops, q_inf, p, out)
+    return out
+
+
+def jac_add(ops: _Ops, p: JacPoint, q: JacPoint) -> JacPoint:
+    """Complete Jacobian+Jacobian addition (add-2007-bl shape) with
+    select fallbacks for p == q (double) and p == -q (infinity). Used in
+    MSM reduction trees where operand equality is data-dependent."""
+    z1z1 = ops.sqr(p.z)
+    z2z2 = ops.sqr(q.z)
+    u1 = ops.mul(p.x, z2z2)
+    u2 = ops.mul(q.x, z1z1)
+    s1 = ops.mul(ops.mul(p.y, q.z), z2z2)
+    s2 = ops.mul(ops.mul(q.y, p.z), z1z1)
+    h = ops.norm(ops.sub(u2, u1))
+    r = ops.norm(ops.sub(s2, s1))
+    h_zero = ops.is_zero(h)
+    r_zero = ops.is_zero(r)
+    h2 = ops.sqr(h)
+    h3 = ops.mul(h2, h)
+    u1h2 = ops.mul(u1, h2)
+    x3 = ops.norm(
+        ops.sub(ops.sub(ops.sqr(r), h3), ops.mul_small(u1h2, 2))
+    )
+    y3 = ops.norm(
+        ops.sub(ops.mul(r, ops.norm(ops.sub(u1h2, x3))), ops.mul(s1, h3))
+    )
+    z3 = ops.norm(ops.mul(ops.mul(p.z, q.z), h))
+    generic = JacPoint(x3, y3, z3, p.inf | q.inf)
+    doubled = jac_double(ops, p)
+    out = jac_select(ops, h_zero & r_zero & ~p.inf & ~q.inf, doubled, generic)
+    # p == -q -> infinity
+    both = ~p.inf & ~q.inf
+    out_inf = jnp.where(both & h_zero & ~r_zero, True, out.inf)
+    out = JacPoint(out.x, out.y, out.z, out_inf)
+    out = jac_select(ops, p.inf, q, out)
+    out = jac_select(ops, q.inf, p, out)
+    return out
+
+
+def scalar_mul(ops: _Ops, qx, qy, bits: jax.Array, q_inf=None) -> JacPoint:
+    """[k]Q for per-element scalars given as a bit tensor.
+
+    bits: (..., nbits) bool, MSB first, broadcast-compatible with the
+    point batch. One `lax.scan` over the bit axis; per element the add is
+    applied under a select. Reference analog: blst scalar mult used by
+    aggregateWithRandomness (SURVEY.md §2.2 same-message aggregation).
+    """
+    qx, qy = ops.norm(qx), ops.norm(qy)
+    batch = jnp.broadcast_shapes(
+        _batch_shape(ops, qx), bits.shape[:-1]
+    )
+    acc0 = jac_infinity(ops, batch)
+    bits_t = jnp.moveaxis(
+        jnp.broadcast_to(bits, batch + (bits.shape[-1],)), -1, 0
+    )
+
+    def body(acc, bit):
+        acc = jac_double(ops, acc)
+        added = jac_mixed_add(ops, acc, qx, qy, q_inf)
+        acc = jac_select(ops, bit, added, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc0, bits_t)
+    return acc
+
+
+def scalars_to_bits(ks, nbits: int) -> jax.Array:
+    """Host: python ints -> (len(ks), nbits) bool tensor, MSB first."""
+    out = np.zeros((len(ks), nbits), np.bool_)
+    for i, k in enumerate(ks):
+        assert 0 <= k < (1 << nbits)
+        for j in range(nbits):
+            out[i, nbits - 1 - j] = (k >> j) & 1
+    return jnp.asarray(out)
+
+
+def jac_sum(ops: _Ops, p: JacPoint) -> JacPoint:
+    """Reduce a batch of points (leading axis) to one by a log-depth tree
+    of complete adds — the device-side analog of blst aggregate()."""
+    n = _batch_shape(ops, p.x)[0]
+    while n > 1:
+        half = (n + 1) // 2
+        top = jax.tree.map(lambda t: t[half : half + (n - half)], p)
+        bot = jax.tree.map(lambda t: t[:half], p)
+        if n - half < half:  # odd: pad top with infinity
+            # canonical profiles on both sides -> identical treedefs
+            pad_inf = jac_infinity(
+                ops, (half - (n - half),) + _batch_shape(ops, p.x)[1:]
+            )
+            top = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], 0), top, pad_inf
+            )
+        p = jac_add(ops, bot, top)
+        n = half
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Host conversions (affine ints <-> device Jacobian batches)
+# ---------------------------------------------------------------------------
+
+
+def g1_batch_from_ints(pts) -> JacPoint:
+    """[(x, y) | None]  ->  batched G1 JacPoint (None = infinity)."""
+    xs = [p[0] if p else 0 for p in pts]
+    ys = [p[1] if p else 1 for p in pts]
+    inf = jnp.asarray([p is None for p in pts])
+    return jac_from_affine(FQ_OPS, L.from_ints(xs), L.from_ints(ys), inf)
+
+
+def g2_batch_from_ints(pts) -> JacPoint:
+    """[((x0,x1), (y0,y1)) | None] -> batched G2 JacPoint on the twist."""
+    xs = tower.fq2_from_ints([p[0] if p else (0, 0) for p in pts])
+    ys = tower.fq2_from_ints([p[1] if p else (1, 0) for p in pts])
+    inf = jnp.asarray([p is None for p in pts])
+    return jac_from_affine(FQ2_OPS, xs, ys, inf)
+
+
+def _to_affine_ints_one(ops, x, y, z, inf):
+    if inf:
+        return None
+    if ops is FQ_OPS:
+        zi = F_inv_int(z)
+        return (x * zi * zi % P, y * zi * zi * zi % P)
+    from ..crypto.bls import fields as OF
+
+    zi = OF.fq2_inv(z)
+    zi2 = OF.fq2_sqr(zi)
+    zi3 = OF.fq2_mul(zi2, zi)
+    return (OF.fq2_mul(x, zi2), OF.fq2_mul(y, zi3))
+
+
+def F_inv_int(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+def jac_to_affine_ints(ops: _Ops, p: JacPoint):
+    """Host: batched device point -> list of affine int tuples (None=inf)."""
+    inf = np.asarray(jax.device_get(p.inf)).reshape(-1)
+    if ops is FQ_OPS:
+        xs = fq.to_int(p.x).reshape(-1)
+        ys = fq.to_int(p.y).reshape(-1)
+        zs = fq.to_int(p.z).reshape(-1)
+        return [
+            _to_affine_ints_one(ops, int(x), int(y), int(z), i)
+            for x, y, z, i in zip(xs, ys, zs, inf)
+        ]
+    xs = tower.fq2_to_ints(p.x)
+    ys = tower.fq2_to_ints(p.y)
+    zs = tower.fq2_to_ints(p.z)
+    return [
+        _to_affine_ints_one(
+            ops,
+            tuple(int(v) for v in x),
+            tuple(int(v) for v in y),
+            tuple(int(v) for v in z),
+            i,
+        )
+        for x, y, z, i in zip(xs, ys, zs, inf)
+    ]

@@ -13,6 +13,8 @@ Reference analog: blst's fp arithmetic (@chainsafe/blst, SURVEY.md
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -99,19 +101,30 @@ def _digits_of(m: int, n: int = _NDIG) -> np.ndarray:
     return out
 
 
-_LADDER = [jnp.asarray(_digits_of((1 << k) * P), jnp.int32) for k in range(12)]
+@functools.lru_cache(maxsize=None)
+def _ladder(k: int) -> jax.Array:
+    """Device constant (1 << k) * P as strict digits. Lazy so importing
+    this module does not initialize a JAX backend (the ambient env may
+    pin JAX_PLATFORMS to a remote TPU that is slow to dial)."""
+    return jnp.asarray(_digits_of((1 << k) * P), jnp.int32)
 
 
 def _strict_carry(v: jax.Array) -> jax.Array:
     """Sequential signed carry leaving exact digits in [0, B). The value
-    must be non-negative and < 2^(10*ndigits). Unrolled: 41 cheap steps."""
-    out = []
-    carry = jnp.zeros(v.shape[:-1], jnp.int32)
-    for i in range(v.shape[-1]):
-        t = v[..., i] + carry
-        carry = t >> L.BITS
-        out.append(t - (carry << L.BITS))
-    return jnp.stack(out, axis=-1)
+    must be non-negative and < 2^(10*ndigits). One lax.scan over the
+    limb axis (a Python loop here would add ~160 ops per call site —
+    canon_digits runs 12 of these)."""
+
+    def body(carry, x):
+        t = x + carry
+        c = t >> L.BITS
+        return c, t - (c << L.BITS)
+
+    vt = jnp.moveaxis(v, -1, 0)
+    _, out = jax.lax.scan(
+        body, jnp.zeros(v.shape[:-1], jnp.int32), vt
+    )
+    return jnp.moveaxis(out, 0, -1)
 
 
 def canon_digits(a: Lv) -> jax.Array:
@@ -120,7 +133,7 @@ def canon_digits(a: Lv) -> jax.Array:
     v = jnp.pad(x.v, [(0, 0)] * (x.v.ndim - 1) + [(0, _NDIG - x.n)])
     v = _strict_carry(v)  # value in [0, 1037*P) < 2^12 * P
     for k in reversed(range(12)):
-        m = _LADDER[k]
+        m = _ladder(k)
         d = v - m
         nz = d != 0
         idx = (_NDIG - 1) - jnp.argmax(nz[..., ::-1], axis=-1)

@@ -184,9 +184,14 @@ def mul_small(a: Lv, k: int) -> Lv:
 
 @functools.lru_cache(maxsize=65536)
 def _conv_bounds(alo, ahi, blo, bhi):
+    """Exact per-column interval bounds of the convolution, plus an
+    order-independent partial-sum bound (sum of |max product| per column):
+    XLA may accumulate dot products in any order, so intermediate sums are
+    only bounded by the absolute-value column sum, not the final interval."""
     na, nb = len(alo), len(blo)
     lo = [0] * (na + nb - 1)
     hi = [0] * (na + nb - 1)
+    ab = [0] * (na + nb - 1)
     for i in range(na):
         for j in range(nb):
             cands = (
@@ -197,26 +202,38 @@ def _conv_bounds(alo, ahi, blo, bhi):
             )
             lo[i + j] += min(cands)
             hi[i + j] += max(cands)
-    return tuple(lo), tuple(hi)
+            ab[i + j] += max(abs(c) for c in cands)
+    return tuple(lo), tuple(hi), max(ab)
+
+
+@functools.lru_cache(maxsize=None)
+def _band_index(na: int, nb: int):
+    """Static gather index + mask building the banded matrix of b:
+    Bm[i, k] = b[k - i] for 0 <= k-i < nb, else 0."""
+    nout = na + nb - 1
+    idx = np.arange(nout)[None, :] - np.arange(na)[:, None]
+    valid = (idx >= 0) & (idx < nb)
+    return np.clip(idx, 0, nb - 1), valid.astype(np.int32)
 
 
 def conv(a: Lv, b: Lv) -> Lv:
-    """Schoolbook product (length na+nb-1), carry-free accumulation."""
-    lo, hi = _conv_bounds(a.lo, a.hi, b.lo, b.hi)
-    if _overflows(lo, hi):
+    """Schoolbook product (length na+nb-1), carry-free accumulation.
+
+    Emitted as one batched int32 matvec against a banded gather of b's
+    limbs (3 XLA ops) rather than na slice-adds, keeping scan bodies that
+    chain hundreds of field muls small enough to compile."""
+    lo, hi, absmax = _conv_bounds(a.lo, a.hi, b.lo, b.hi)
+    if _overflows(lo, hi) or absmax > INT32_MAX:
         a2, b2 = normalize(a), normalize(b)
         if (a2.lo, a2.hi, b2.lo, b2.hi) == (a.lo, a.hi, b.lo, b.hi):
             raise OverflowError("conv overflows even on canonical inputs")
         return conv(a2, b2)
     na, nb = a.n, b.n
-    out_shape = jnp.broadcast_shapes(a.v.shape[:-1], b.v.shape[:-1]) + (
-        na + nb - 1,
+    idx, valid = _band_index(na, nb)
+    band = b.v[..., idx] * jnp.asarray(valid)  # (..., na, nout)
+    out = jnp.einsum(
+        "...i,...ik->...k", a.v, band, preferred_element_type=jnp.int32
     )
-    out = jnp.zeros(out_shape, jnp.int32)
-    for i in range(na):
-        if a.lo[i] == 0 and a.hi[i] == 0:
-            continue
-        out = out.at[..., i : i + nb].add(a.v[..., i : i + 1] * b.v)
     return Lv(out, lo, hi)
 
 
@@ -283,31 +300,52 @@ def _make_nonneg(x: Lv) -> Lv:
     return Lv(x.v + arr, lo, hi)
 
 
-def _fold_overflow(x: Lv) -> Lv:
-    """Fold limbs at index >= NLIMB back below P's bit range via the
-    precomputed 2^(10k) mod P rows, except a small interval at the
-    canonical carry slot (index NLIMB), which stays in place."""
-    keep = x.v[..., :NLIMB]
-    lo = list(x.lo[:NLIMB]) + [0]
-    hi = list(x.hi[:NLIMB]) + [0]
-    out = jnp.pad(keep, [(0, 0)] * (keep.ndim - 1) + [(0, 1)])
-    for k in range(NLIMB, x.n):
-        if x.lo[k] == 0 and x.hi[k] == 0:
+@functools.lru_cache(maxsize=None)
+def _fold_plan(n: int, lo: tuple, hi: tuple):
+    """Static fold matrix (n-NLIMB, NLIMB+1) and output bounds for folding
+    high limbs of a value with the given interval profile. Column NLIMB is
+    the canonical carry slot: the k==NLIMB limb passes through unchanged
+    when its interval is already small."""
+    mat = np.zeros((n - NLIMB, NLIMB + 1), np.int64)
+    olo = [0] * (NLIMB + 1)
+    ohi = [0] * (NLIMB + 1)
+    oabs = [0] * (NLIMB + 1)
+    for k in range(NLIMB, n):
+        if lo[k] == 0 and hi[k] == 0:
             continue
-        if k == NLIMB and 0 <= x.lo[k] and x.hi[k] <= 2:
-            out = out.at[..., NLIMB].add(x.v[..., k])
-            lo[NLIMB] += x.lo[k]
-            hi[NLIMB] += x.hi[k]
+        if k == NLIMB and 0 <= lo[k] and hi[k] <= 2:
+            mat[0, NLIMB] = 1
+            olo[NLIMB] += lo[k]
+            ohi[NLIMB] += hi[k]
+            oabs[NLIMB] += hi[k]
             continue
         row = _fold_row(k)
-        contrib = x.v[..., k : k + 1] * jnp.asarray(row, jnp.int32)
-        out = out.at[..., :NLIMB].add(contrib)
         for j in range(NLIMB):
-            lo[j] += min(x.lo[k] * row[j], x.hi[k] * row[j])
-            hi[j] += max(x.lo[k] * row[j], x.hi[k] * row[j])
-    if _overflows(tuple(lo), tuple(hi)):
+            mat[k - NLIMB, j] = row[j]
+            olo[j] += min(lo[k] * row[j], hi[k] * row[j])
+            ohi[j] += max(lo[k] * row[j], hi[k] * row[j])
+            oabs[j] += max(abs(lo[k]), abs(hi[k])) * row[j]
+    return mat, tuple(olo), tuple(ohi), max(oabs)
+
+
+def _fold_overflow(x: Lv) -> Lv:
+    """Fold limbs at index >= NLIMB back below P's bit range via the
+    precomputed 2^(10k) mod P rows (one static int32 matmul), except a
+    small interval at the canonical carry slot (index NLIMB), which stays
+    in place."""
+    mat, flo, fhi, fabs = _fold_plan(x.n, x.lo, x.hi)
+    lo = tuple(a + b for a, b in zip(x.lo[:NLIMB] + (0,), flo))
+    hi = tuple(a + b for a, b in zip(x.hi[:NLIMB] + (0,), fhi))
+    if _overflows(lo, hi) or fabs > INT32_MAX:
         raise OverflowError("fold overflow — carry before folding")
-    return Lv(out, tuple(lo), tuple(hi))
+    keep = jnp.pad(x.v[..., :NLIMB], [(0, 0)] * (x.v.ndim - 1) + [(0, 1)])
+    folded = jnp.einsum(
+        "...k,kj->...j",
+        x.v[..., NLIMB:],
+        jnp.asarray(mat, jnp.int32),
+        preferred_element_type=jnp.int32,
+    )
+    return Lv(keep + folded, lo, hi)
 
 
 def normalize(x: Lv) -> Lv:

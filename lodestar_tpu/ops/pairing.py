@@ -1,0 +1,252 @@
+"""Vectorized BLS12-381 optimal ate pairing on TPU.
+
+Reference analog: blst's pairing core used by every Lodestar signature
+check (@chainsafe/blst, SURVEY.md §2.1, §2.3). blst runs one serial
+Miller loop per pairing on a CPU worker; here the Miller loop is a
+single `lax.scan` over the 63 post-MSB bits of |x| whose body operates
+on an arbitrary leading batch of (G1, G2) pairs, so one compiled kernel
+evaluates the whole pairing-product batch and the scan body's cost is
+amortized across TPU vector lanes (and across chips under pjit).
+
+Math notes (derived for the M-twist with untwist (x', y') ->
+(x'/w^2, y'/w^3), matching crypto/bls/pairing.py):
+
+  - Lines are evaluated on the twist and scaled by Fq2 factors and
+    powers of w. Any such factor g satisfies g^((q^6-1)(q^2+1)) = 1
+    (for w^j: (w^j)^(q^6-1) = (-1)^j and q^2+1 is even), so it is
+    annihilated by the final exponentiation — the standard
+    denominator-elimination argument, applied slot-wise.
+  - The scaled line through T (Jacobian (X,Y,Z) on the twist) evaluated
+    at P = (x_P, y_P) in G1 is sparse in Fq12 slots {w^0, w^2, w^3}:
+      double:  (3X^3 - 2Y^2,  -3X^2 Z^2 * x_P,  2YZ^3 * y_P)
+      add(Q):  (th*x_Q - Z*mu*y_Q,  -th * x_P,  Z*mu * y_P)
+    with mu = x_Q Z^2 - X, th = y_Q Z^3 - Y.
+  - x < 0: the Miller result is conjugated (unitary inverse) instead of
+    inverted — the difference f*conj(f) lies in Fq6 and dies in the
+    final exponentiation.
+  - The hard part uses the BLS12 decomposition
+    (x-1)^2 (x+q) (x^2+q^2-1) + 3 = 3*(q^4-q^2+1)/r,
+    i.e. this computes FE(f)^3 — equivalent for every product-==-1
+    check since gcd(3, r) = 1. `final_exponentiation` therefore matches
+    the oracle's FE only up to a cube; tests compare accordingly.
+
+Correctness oracle: lodestar_tpu/crypto/bls/pairing.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls.fields import X as BLS_X
+from . import fq, tower
+from . import limbs as L
+from .curve import FQ2_OPS, JacPoint, jac_from_affine, jac_select
+
+_U = -BLS_X  # positive |x|, low hamming weight
+_UBITS_AFTER_MSB = np.array(
+    [b == "1" for b in bin(_U)[3:]], np.bool_
+)  # 63 entries, MSB-first after the consumed top bit
+
+
+def _sparse_line(l0, l2, l3, batch):
+    """Assemble (l0 + l2*w^2 + l3*w^3) as a full Fq12 element: slots
+    w^0 -> b0.c0, w^2 = v -> b0.c1, w^3 = v*w -> b1.c1."""
+    z2 = tower.fq2_const((0, 0), batch)
+    return ((l0, l2, z2), (z2, l3, z2))
+
+
+def _dbl_step(T: JacPoint, px, py):
+    """Double T and return the tangent-line slots evaluated at (px, py).
+    Shares intermediates between the line and dbl-2009-l."""
+    o = FQ2_OPS
+    Xc, Yc, Zc = T.x, T.y, T.z
+    A = o.sqr(Xc)
+    Bv = o.sqr(Yc)
+    C = o.sqr(Bv)
+    Z2 = o.sqr(Zc)
+    XA = o.mul(Xc, A)  # X^3
+    YZ = o.mul(Yc, Zc)
+    l0 = o.norm(o.sub(o.mul_small(XA, 3), o.mul_small(Bv, 2)))
+    l2c = o.mul_small(o.mul(A, Z2), -3)
+    l3c = o.mul_small(o.mul(YZ, Z2), 2)
+    l2 = tower.fq2_mul_fq(l2c, px)
+    l3 = tower.fq2_mul_fq(l3c, py)
+    t = o.sqr(o.add(Xc, Bv))
+    D = o.mul_small(o.norm(o.sub(o.sub(t, A), C)), 2)
+    E = o.mul_small(A, 3)
+    F = o.sqr(E)
+    x3 = o.norm(o.sub(F, o.mul_small(D, 2)))
+    y3 = o.norm(o.sub(o.mul(E, o.norm(o.sub(D, x3))), o.mul_small(C, 8)))
+    z3 = o.norm(o.mul_small(YZ, 2))
+    return JacPoint(x3, y3, z3, T.inf), (l0, l2, l3)
+
+
+def _add_step(T: JacPoint, qx, qy, px, py):
+    """Mixed-add Q into T and return the chord-line slots at (px, py).
+    Requires T != +-Q — guaranteed in the ate ladder for prime-order Q
+    (partial multiples [k]Q, 2 <= k < r, never hit +-Q)."""
+    o = FQ2_OPS
+    Xc, Yc, Zc = T.x, T.y, T.z
+    Z2 = o.sqr(Zc)
+    Z3c = o.mul(Z2, Zc)
+    mu = o.norm(o.sub(o.mul(qx, Z2), Xc))
+    th = o.norm(o.sub(o.mul(qy, Z3c), Yc))
+    Zmu = o.norm(o.mul(Zc, mu))
+    l0 = o.norm(o.sub(o.mul(th, qx), o.mul(Zmu, qy)))
+    l2 = tower.fq2_mul_fq(o.norm(o.neg(th)), px)
+    l3 = tower.fq2_mul_fq(Zmu, py)
+    mu2 = o.sqr(mu)
+    mu3 = o.mul(mu2, mu)
+    xmu2 = o.mul(Xc, mu2)
+    x3 = o.norm(o.sub(o.sub(o.sqr(th), mu3), o.mul_small(xmu2, 2)))
+    y3 = o.norm(
+        o.sub(o.mul(th, o.norm(o.sub(xmu2, x3))), o.mul(Yc, mu3))
+    )
+    return JacPoint(x3, y3, Zmu, T.inf), (l0, l2, l3)
+
+
+def _norm12(f):
+    return tower.fq12_norm(f)
+
+
+def miller_loop(px, py, qx, qy):
+    """f_{|x|,Q}(P) conjugated (x < 0), batched over leading dims.
+
+    px, py: G1 affine coords (Lv batches); qx, qy: G2 affine coords on
+    the twist (Fq2 batches). Infinity inputs are NOT handled here — mask
+    them out at the product stage (reference rejects identity points at
+    validation time, chain/validation/*).
+    """
+    px, py = L.normalize(px), L.normalize(py)
+    qx = FQ2_OPS.norm(qx)
+    qy = FQ2_OPS.norm(qy)
+    batch = jnp.broadcast_shapes(
+        px.v.shape[:-1], qx[0].v.shape[:-1]
+    )
+    T = jac_from_affine(FQ2_OPS, qx, qy)
+    f = _norm12(tower.fq12_one(batch))
+    bits = jnp.asarray(_UBITS_AFTER_MSB)
+
+    def body(carry, bit):
+        T, f = carry
+        T2, (d0, d2, d3) = _dbl_step(T, px, py)
+        f2 = tower.fq12_mul(
+            tower.fq12_sqr(f), _sparse_line(d0, d2, d3, batch)
+        )
+        T3, (a0, a2, a3) = _add_step(T2, qx, qy, px, py)
+        f3 = tower.fq12_mul(f2, _sparse_line(a0, a2, a3, batch))
+        bitb = jnp.broadcast_to(bit, batch)
+        T_next = jac_select(FQ2_OPS, bitb, T3, T2)
+        f_next = _norm12(tower.fq12_select(bitb, f3, f2))
+        return (T_next, f_next), None
+
+    (_, f), _ = jax.lax.scan(body, (T, f), bits)
+    return tower.fq12_conj(f)
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation
+# ---------------------------------------------------------------------------
+
+
+def _pow_u(f):
+    """f^|x| on the cyclotomic subgroup via a 64-bit LSB-first scan."""
+    nbits = _U.bit_length()
+    bits = jnp.asarray(
+        np.array([(_U >> i) & 1 for i in range(nbits)], np.bool_)
+    )
+    f = _norm12(f)
+    batch = f[0][0][0].v.shape[:-1]
+    one = _norm12(tower.fq12_one(batch))
+
+    def body(carry, bit):
+        result, base = carry
+        nxt = tower.fq12_mul(result, base)
+        bitb = jnp.broadcast_to(bit, batch)
+        result = _norm12(tower.fq12_select(bitb, nxt, result))
+        base = _norm12(tower.fq12_sqr(base))
+        return (result, base), None
+
+    (result, _), _ = jax.lax.scan(body, (one, f), bits)
+    return result
+
+
+def _pow_x(f):
+    """f^x = conj(f^|x|) — valid for unitary f (conj == inverse)."""
+    return tower.fq12_conj(_pow_u(f))
+
+
+def _pow_x_minus_1(f):
+    """f^(x-1) = conj(f^(|x|+1)) for unitary f."""
+    return tower.fq12_conj(_norm12(tower.fq12_mul(_pow_u(f), f)))
+
+
+def final_exponentiation(f):
+    """f^(3 * (q^12-1)/r) — the cube of the spec map; exponent-equivalent
+    for membership/product checks (3 coprime to r). Easy part by
+    Frobenius/conjugation, hard part by the (x-1)^2 (x+q) (x^2+q^2-1)+3
+    chain (5 exponentiations by |x|)."""
+    f = _norm12(f)
+    # easy: f^((q^6-1)(q^2+1)) — lands in the cyclotomic subgroup
+    t = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
+    t = _norm12(t)
+    t = _norm12(tower.fq12_mul(tower.fq12_frobenius_n(t, 2), t))
+    # hard
+    a = _pow_x_minus_1(_pow_x_minus_1(t))  # t^((x-1)^2)
+    b = _norm12(tower.fq12_mul(_pow_x(a), tower.fq12_frobenius(a)))
+    c = _norm12(
+        tower.fq12_mul(
+            tower.fq12_mul(_pow_u(_pow_u(b)), tower.fq12_frobenius_n(b, 2)),
+            tower.fq12_conj(b),
+        )
+    )  # b^(x^2 + q^2 - 1)  (x^2 = |x|^2)
+    out = tower.fq12_mul(tower.fq12_mul(c, tower.fq12_sqr(t)), t)
+    return _norm12(out)
+
+
+def fq12_is_one(f) -> jax.Array:
+    """Batched equality with 1 (exact, via canonical digits)."""
+    one = tower.fq12_one(f[0][0][0].v.shape[:-1])
+    flags = []
+    for c6f, c6o in zip(f, one):
+        for c2f, c2o in zip(c6f, c6o):
+            flags.append(fq.eq(c2f[0], c2o[0]))
+            flags.append(fq.eq(c2f[1], c2o[1]))
+    out = flags[0]
+    for fl in flags[1:]:
+        out = out & fl
+    return out
+
+
+def _fq12_masked_product(f, mask):
+    """Tree-reduce prod_i f_i over axis 0, taking 1 where mask is False."""
+    batch = f[0][0][0].v.shape[:-1]
+    one = _norm12(tower.fq12_one(batch))
+    f = _norm12(tower.fq12_select(mask, f, one))
+    n = batch[0]
+    while n > 1:
+        half = (n + 1) // 2
+        bot = jax.tree.map(lambda t: t[:half], f)
+        top = jax.tree.map(lambda t: t[half:], f)
+        if n - half < half:
+            pad = _norm12(
+                tower.fq12_one((half - (n - half),) + batch[1:])
+            )
+            top = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], 0), top, pad
+            )
+        f = _norm12(tower.fq12_mul(bot, top))
+        n = half
+    return jax.tree.map(lambda t: t[0], f)
+
+
+def pairing_product_is_one(px, py, qx, qy, mask) -> jax.Array:
+    """prod_i e(P_i, Q_i)^(mask_i) == 1 with one shared final
+    exponentiation — the TPU analog of blst's
+    verifyMultipleAggregateSignatures core check (SURVEY.md §2.3,
+    maybeBatch.ts:17)."""
+    f = miller_loop(px, py, qx, qy)
+    prod = _fq12_masked_product(f, mask)
+    return fq12_is_one(final_exponentiation(prod))

@@ -1,0 +1,52 @@
+"""Multi-chip sharding for the verification batch dimension.
+
+Reference analog (SURVEY.md §2.2): the blst pool fans signature chunks
+out to N-1 CPU worker threads round-robin
+(chain/bls/multithread/index.ts:183-199). The TPU design replaces the
+worker fan-out with SPMD: every batch-shaped crypto kernel in this
+package broadcasts over a leading axis, so distributing work across
+chips is a matter of placing that axis on a `Mesh` axis and letting
+XLA insert the collectives (the log-depth aggregate/product reduction
+trees in ops/curve.jac_sum and ops/pairing._fq12_masked_product become
+ICI all-reduces). There is no NCCL/MPI analog to port — the "comm
+backend" is jax.sharding over ICI/DCN (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D device mesh over the verify batch axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (BATCH_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis; replicate limb axes."""
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree):
+    """Place every array leaf of a batched pytree (JacPoint / Lv / Fq2
+    tuples / bool masks) with its leading axis split over the mesh."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(mesh: Mesh, tree):
+    r = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, r), tree)

@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache.
+
+The crypto kernels compile large scan-heavy programs (Miller loop,
+final exponentiation); on CPU XLA that is tens of seconds per shape.
+A persistent on-disk cache makes every process after the first start
+warm — the analog of the reference paying its worker-spawn cost once
+at startup (chain/bls/multithread/index.ts:130-146).
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+
+def enable(cache_dir: str | None = None) -> None:
+    global _enabled
+    if _enabled:
+        return
+    import jax
+
+    d = cache_dir or os.environ.get(
+        "LODESTAR_TPU_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"),
+    )
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # cache is an optimization only
+    _enabled = True

@@ -1,0 +1,158 @@
+"""Tests for the TPU BLS verifier service (reference semantics:
+chain/bls/multithread/index.ts — buffering, chunking, retry fan-out).
+
+Differential reference: OracleBlsVerifier (BlsSingleThreadVerifier
+analog, chain/bls/singleThread.ts:8).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.bls import (
+    OracleBlsVerifier,
+    SameMessageSet,
+    SignatureSet,
+    TpuBlsVerifier,
+)
+from lodestar_tpu.crypto.bls import signature as sig
+
+
+def _mk_sets(n, msg_prefix=b"msg", good=True):
+    out = []
+    for i in range(n):
+        sk = 1000 + i
+        msg = msg_prefix + bytes([i]) + b"\x00" * (32 - len(msg_prefix) - 1)
+        s = sig.sign(sk, msg)
+        if not good and i == n - 1:
+            b = bytearray(s)
+            b[20] ^= 0xFF
+            s = bytes(b)
+        out.append(SignatureSet(sig.sk_to_pk(sk), msg, s))
+    return out
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+class TestTpuVerifier:
+    def test_good_batch_and_oracle_agree(self):
+        sets = _mk_sets(3)
+
+        async def go():
+            tpu, orc = TpuBlsVerifier(), OracleBlsVerifier()
+            a = await tpu.verify_signature_sets(sets)
+            b = await orc.verify_signature_sets(sets)
+            await tpu.close()
+            return a, b
+
+        a, b = _run(go())
+        assert a is True and b is True
+
+    def test_tampered_batch_rejected(self):
+        sets = _mk_sets(3, good=False)
+
+        async def go():
+            tpu, orc = TpuBlsVerifier(), OracleBlsVerifier()
+            a = await tpu.verify_signature_sets(sets)
+            b = await orc.verify_signature_sets(sets)
+            await tpu.close()
+            return a, b
+
+        a, b = _run(go())
+        assert a is False and b is False
+
+    def test_oversized_job_is_chunked(self):
+        # 5 sets with a 2-set cap -> 3 device chunks, all must pass
+        sets = _mk_sets(5)
+
+        async def go():
+            v = TpuBlsVerifier()
+            v._max_sets_per_job = 2
+            ok = await v.verify_signature_sets(sets)
+            await v.close()
+            return ok
+
+        assert _run(go()) is True
+
+    def test_oversized_job_with_one_bad_set(self):
+        sets = _mk_sets(5, good=False)
+
+        async def go():
+            v = TpuBlsVerifier()
+            v._max_sets_per_job = 2
+            ok = await v.verify_signature_sets(sets)
+            await v.close()
+            return ok
+
+        assert _run(go()) is False
+
+    def test_malformed_signature_returns_false(self):
+        s = _mk_sets(1)[0]
+        bad = SignatureSet(s.pubkey, s.message, b"\x00" * 96)
+
+        async def go():
+            v = TpuBlsVerifier()
+            ok = await v.verify_signature_sets([bad])
+            await v.close()
+            return ok
+
+        assert _run(go()) is False
+
+    def test_same_message_verdicts_match_oracle(self):
+        msg = b"a" * 32
+        pairs = []
+        for i in range(3):
+            sk = 2000 + i
+            s = sig.sign(sk, msg)
+            if i == 1:  # tamper the middle one
+                b = bytearray(s)
+                b[10] ^= 0xFF
+                s = bytes(b)
+            pairs.append(SameMessageSet(sig.sk_to_pk(sk), s))
+
+        async def go():
+            tpu, orc = TpuBlsVerifier(), OracleBlsVerifier()
+            a = await tpu.verify_signature_sets_same_message(pairs, msg)
+            b = await orc.verify_signature_sets_same_message(pairs, msg)
+            await tpu.close()
+            return a, b
+
+        a, b = _run(go())
+        assert a == b == [True, False, True]
+
+    def test_batchable_jobs_merge_and_settle(self):
+        sets = _mk_sets(4)
+
+        async def go():
+            v = TpuBlsVerifier(max_buffer_wait_ms=30)
+            results = await asyncio.gather(
+                *(
+                    v.verify_signature_sets([s], batchable=True)
+                    for s in sets
+                )
+            )
+            m = v.metrics
+            await v.close()
+            return results, m
+
+        results, m = _run(go())
+        assert results == [True] * 4
+        # buffering merged multiple 1-set jobs into fewer device groups
+        assert m.job_groups_started < 4
+
+    def test_close_rejects_pending(self):
+        sets = _mk_sets(1)
+
+        async def go():
+            v = TpuBlsVerifier(max_buffer_wait_ms=10_000)
+            fut = asyncio.ensure_future(
+                v.verify_signature_sets(sets, batchable=True)
+            )
+            await asyncio.sleep(0.05)  # job sits in the buffer
+            await v.close()
+            with pytest.raises(RuntimeError):
+                await fut
+
+        _run(go())

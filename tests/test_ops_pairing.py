@@ -1,0 +1,87 @@
+"""Differential tests: TPU Miller loop / final exp vs the oracle pairing.
+
+The TPU final exponentiation computes FE(f)^3 (see ops/pairing.py module
+doc), so raw-value comparisons cube the oracle side; product-is-one
+checks are exponent-equivalent.
+"""
+
+import random
+
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import curve as oc
+from lodestar_tpu.crypto.bls import fields as OF
+from lodestar_tpu.crypto.bls import pairing as op
+from lodestar_tpu.ops import curve as tc
+from lodestar_tpu.ops import pairing as tp
+from lodestar_tpu.ops import tower
+
+random.seed(0xBEEF)
+
+
+def _dev_pairs(g1s, g2s):
+    d1 = tc.g1_batch_from_ints(g1s)
+    d2 = tc.g2_batch_from_ints(g2s)
+    return d1.x, d1.y, d2.x, d2.y
+
+
+class TestMillerLoop:
+    def test_single_pair_matches_oracle_after_fe(self):
+        p = oc.g1_mul(oc.G1_GEN, random.getrandbits(100) + 2)
+        q = oc.g2_mul(oc.G2_GEN, random.getrandbits(100) + 2)
+        px, py, qx, qy = _dev_pairs([p], [q])
+        f = tp.miller_loop(px, py, qx, qy)
+        fe = tp.final_exponentiation(f)
+        got = tower.fq12_to_oracle(fe)[0]
+        want = OF.fq12_pow(op.pairing(p, q), 3)
+        assert got == want
+
+    def test_batch_is_elementwise(self):
+        g1s = [oc.g1_mul(oc.G1_GEN, k) for k in (2, 3)]
+        g2s = [oc.g2_mul(oc.G2_GEN, k) for k in (5, 7)]
+        px, py, qx, qy = _dev_pairs(g1s, g2s)
+        fe = tp.final_exponentiation(tp.miller_loop(px, py, qx, qy))
+        got = tower.fq12_to_oracle(fe)
+        want = [
+            OF.fq12_pow(op.pairing(p, q), 3) for p, q in zip(g1s, g2s)
+        ]
+        assert got == want
+
+
+class TestPairingProduct:
+    def test_signature_relation_holds(self):
+        # e(pk, H) * e(-g1, sig) == 1  for  pk = sk*g1, sig = sk*H
+        sk = random.getrandbits(254) + 1
+        h = oc.g2_mul(oc.G2_GEN, random.getrandbits(150) + 1)
+        pk = oc.g1_mul(oc.G1_GEN, sk)
+        sig = oc.g2_mul(h, sk)
+        g1s = [pk, oc.g1_neg(oc.G1_GEN)]
+        g2s = [h, sig]
+        px, py, qx, qy = _dev_pairs(g1s, g2s)
+        mask = jnp.asarray([True, True])
+        assert bool(tp.pairing_product_is_one(px, py, qx, qy, mask))
+
+    def test_bad_signature_rejected(self):
+        sk = random.getrandbits(254) + 1
+        h = oc.g2_mul(oc.G2_GEN, random.getrandbits(150) + 1)
+        pk = oc.g1_mul(oc.G1_GEN, sk)
+        bad_sig = oc.g2_mul(h, sk + 1)
+        g1s = [pk, oc.g1_neg(oc.G1_GEN)]
+        g2s = [h, bad_sig]
+        px, py, qx, qy = _dev_pairs(g1s, g2s)
+        mask = jnp.asarray([True, True])
+        assert not bool(tp.pairing_product_is_one(px, py, qx, qy, mask))
+
+    def test_mask_skips_padding_slots(self):
+        # one real relation + one garbage pad slot masked off
+        sk = random.getrandbits(254) + 1
+        h = oc.g2_mul(oc.G2_GEN, random.getrandbits(150) + 1)
+        pk = oc.g1_mul(oc.G1_GEN, sk)
+        sig = oc.g2_mul(h, sk)
+        g1s = [pk, oc.g1_neg(oc.G1_GEN), oc.G1_GEN]
+        g2s = [h, sig, oc.G2_GEN]
+        px, py, qx, qy = _dev_pairs(g1s, g2s)
+        mask = jnp.asarray([True, True, False])
+        assert bool(tp.pairing_product_is_one(px, py, qx, qy, mask))
+        mask_all = jnp.asarray([True, True, True])
+        assert not bool(tp.pairing_product_is_one(px, py, qx, qy, mask_all))
