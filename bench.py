@@ -1,0 +1,83 @@
+"""Headline benchmark: BLS signature-set batch verification throughput.
+
+Measures the device pipeline behind `IBlsVerifier.verify_signature_sets`
+(BASELINE.json config #2: batch-verify 128 attestation SignatureSets) —
+random-weighted scalar ladders, masked aggregation, batched Miller loop,
+one shared final exponentiation — end-to-end on the default JAX platform
+(the real TPU under the driver; CPU elsewhere).
+
+Baseline: the reference verifies ~100 signature sets in ~45 ms on its CPU
+blst worker pool (chain/blocks/verifyBlocksSignatures.ts:45; BASELINE.md)
+= ~2,222 sets/sec. vs_baseline = our sets/sec / 2222.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_SETS = 128
+WARMUP = 1
+ITERS = 3
+BASELINE_SETS_PER_SEC = 100 / 0.045  # reference: ~100 sigs / 45 ms
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from lodestar_tpu.bls import kernels
+    from lodestar_tpu.bls.verifier import _rand_scalars
+    from lodestar_tpu.crypto.bls import curve as oc
+    from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lodestar_tpu.ops import curve as C
+    from lodestar_tpu.params import BLS_DST_SIG
+
+    print(f"# platform: {jax.default_backend()}, devices: {len(jax.devices())}",
+          file=sys.stderr)
+
+    # Build N_SETS valid (pk, H(msg), sig) sets with the pure-Python oracle.
+    pks, hs, sigs = [], [], []
+    for i in range(N_SETS):
+        sk = 10_000 + i
+        msg = i.to_bytes(32, "little")
+        h = hash_to_g2(msg, BLS_DST_SIG)
+        pks.append(oc.g1_mul(oc.G1_GEN, sk))
+        hs.append(h)
+        sigs.append(oc.g2_mul(h, sk))
+
+    pk_dev = C.g1_batch_from_ints(pks)
+    h_dev = C.g2_batch_from_ints(hs)
+    sig_dev = C.g2_batch_from_ints(sigs)
+    mask = jnp.ones(N_SETS, dtype=bool)
+
+    def run_once():
+        bits = C.scalars_to_bits(_rand_scalars(N_SETS), kernels.RAND_BITS)
+        ok = kernels.run_verify_batch(
+            pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
+        )
+        if not ok:
+            raise RuntimeError("batch verify returned False on valid sets")
+
+    for _ in range(WARMUP):
+        run_once()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        run_once()
+    dt = time.perf_counter() - t0
+
+    sets_per_sec = N_SETS * ITERS / dt
+    print(json.dumps({
+        "metric": "bls_batch_verify_sets_per_sec",
+        "value": round(sets_per_sec, 2),
+        "unit": "sets/sec (128-set random-lincomb batch)",
+        "vs_baseline": round(sets_per_sec / BASELINE_SETS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,12 +82,3 @@ def message_to_g2(message: bytes, dst: bytes = BLS_DST_SIG):
     return h2c.hash_to_g2(message, dst)
 
 
-def aggregate_pubkeys_points(pks) -> tuple:
-    """Sum decompressed pubkey points (for aggregate sets — spec
-    fastAggregateVerify's pubkey aggregation)."""
-    acc = None
-    for p in pks:
-        acc = oc.g1_add(acc, p)
-    if acc is None:
-        raise InvalidPointError("aggregate pubkey is the identity")
-    return acc

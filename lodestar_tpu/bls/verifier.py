@@ -213,6 +213,9 @@ class TpuBlsVerifier:
         return _PreparedSet(pk, h, sig)
 
     def _ensure_runner(self):
+        if self._closed:
+            # the reference rejects work after termination (index.ts:311-318)
+            raise RuntimeError("BLS verifier closed")
         if self._runner is None or self._runner.done():
             self._runner = asyncio.ensure_future(self._run_loop())
 
@@ -337,6 +340,17 @@ class TpuBlsVerifier:
         return ok
 
     async def _run_same_message(self, pairs, h) -> bool:
+        """One fused aggregate+pairing check; splits above the device
+        cap and ANDs (random weights keep each part sound)."""
+        cap = self._max_sets_per_job
+        if len(pairs) > cap:
+            parts = [
+                pairs[i : i + cap] for i in range(0, len(pairs), cap)
+            ]
+            verdicts = await asyncio.gather(
+                *(self._run_same_message(p, h) for p in parts)
+            )
+            return all(verdicts)
         n = len(pairs)
         b = kernels.bucket_size(n)
         pad = b - n

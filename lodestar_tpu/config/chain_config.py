@@ -74,6 +74,10 @@ class ChainConfig:
     MAX_REQUEST_BLOB_SIDECARS: int = 768
     MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS: int = 4096
     BLOB_SIDECAR_SUBNET_COUNT: int = 6
+    # Electra (EIP-7691 raised the blob cap; a config value since electra)
+    MAX_BLOBS_PER_BLOCK_ELECTRA: int = 9
+    MAX_REQUEST_BLOB_SIDECARS_ELECTRA: int = 1152
+    BLOB_SIDECAR_SUBNET_COUNT_ELECTRA: int = 9
 
     def with_overrides(self, **kwargs) -> "ChainConfig":
         return replace(self, **kwargs)

@@ -102,11 +102,12 @@ def _digits_of(m: int, n: int = _NDIG) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _ladder(k: int) -> jax.Array:
-    """Device constant (1 << k) * P as strict digits. Lazy so importing
-    this module does not initialize a JAX backend (the ambient env may
-    pin JAX_PLATFORMS to a remote TPU that is slow to dial)."""
-    return jnp.asarray(_digits_of((1 << k) * P), jnp.int32)
+def _ladder(k: int) -> np.ndarray:
+    """Constant (1 << k) * P as strict digits. Cached as a NUMPY array:
+    caching a jnp array created during a jit trace would capture that
+    trace's tracer and leak it into later traces; jnp ops convert the
+    numpy constant per-trace."""
+    return _digits_of((1 << k) * P)
 
 
 def _strict_carry(v: jax.Array) -> jax.Array:

@@ -1,0 +1,49 @@
+"""Consensus core: the pure (no-I/O) beacon state transition.
+
+Reference analog: packages/state-transition (SURVEY.md §2.5) —
+stateTransition/processSlots/processBlock/processEpoch over cached
+beacon states, per-fork upgrades, and spec helpers. Per-validator work
+is numpy-vectorized (registry as struct-of-arrays), the layout that
+later moves onto the TPU.
+"""
+
+from .block import BlockProcessError, process_block
+from .epoch import process_epoch
+from .genesis import (
+    create_interop_genesis_state,
+    interop_pubkeys,
+    interop_secret_key,
+)
+from .slot import (
+    BeaconStateView,
+    fork_at_epoch,
+    process_slots,
+    state_transition,
+    upgrade_to_altair,
+    upgrade_to_bellatrix,
+    upgrade_to_capella,
+    upgrade_to_deneb,
+    upgrade_to_electra,
+    verify_block_signature,
+)
+from . import util
+
+__all__ = [
+    "BeaconStateView",
+    "BlockProcessError",
+    "create_interop_genesis_state",
+    "fork_at_epoch",
+    "interop_pubkeys",
+    "interop_secret_key",
+    "process_block",
+    "process_epoch",
+    "process_slots",
+    "state_transition",
+    "upgrade_to_altair",
+    "upgrade_to_bellatrix",
+    "upgrade_to_capella",
+    "upgrade_to_deneb",
+    "upgrade_to_electra",
+    "util",
+    "verify_block_signature",
+]

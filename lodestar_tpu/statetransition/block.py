@@ -1,0 +1,1350 @@
+"""Block processing for every fork (phase0 → electra).
+
+Reference analog: packages/state-transition/src/block/index.ts:31 and
+its 22 operation processors (src/block/process*.ts), following
+ethereum/consensus-specs beacon-chain.md per fork. Signature
+verification is gated by ``verify_signatures`` — production block
+import extracts signature sets instead (signature_sets.py) and batches
+them through the TPU verifier, mirroring the reference's split between
+stateTransition({verifySignatures:false}) and the BLS pool
+(chain/blocks/verifyBlock.ts:38-100).
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+import numpy as np
+
+from ..config.beacon_config import compute_domain, compute_signing_root_from_roots
+from ..params import (
+    BLS_WITHDRAWAL_PREFIX,
+    COMPOUNDING_WITHDRAWAL_PREFIX,
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    DOMAIN_DEPOSIT,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_VOLUNTARY_EXIT,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    GENESIS_SLOT,
+    ForkSeq,
+    preset,
+)
+from ..ssz import uint64 as ssz_uint64
+from . import util
+from .util import (
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    EpochShuffling,
+    compute_epoch_at_slot,
+    decrease_balance,
+    get_block_root,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_previous_epoch,
+    get_randao_mix,
+    get_total_active_balance,
+    increase_balance,
+    integer_squareroot,
+)
+
+FULL_EXIT_REQUEST_AMOUNT = 0
+UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95
+
+
+class BlockProcessError(AssertionError):
+    pass
+
+
+def _req(cond, msg: str) -> None:
+    if not cond:
+        raise BlockProcessError(msg)
+
+
+# ---------------------------------------------------------------------------
+# Domains / signing roots
+# ---------------------------------------------------------------------------
+
+
+def get_domain(cfg, state, domain_type: bytes, epoch: int | None = None) -> bytes:
+    """Spec get_domain over the state's fork schedule."""
+    if epoch is None:
+        epoch = get_current_epoch(state)
+    fork = state.fork
+    version = (
+        fork.previous_version if epoch < fork.epoch else fork.current_version
+    )
+    return compute_domain(domain_type, version, state.genesis_validators_root)
+
+
+def compute_signing_root(ssz_type, value, domain: bytes) -> bytes:
+    return compute_signing_root_from_roots(
+        ssz_type.hash_tree_root(value), domain
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-block context (memoized proposer / shufflings / base rewards)
+# ---------------------------------------------------------------------------
+
+
+class BlockCtx:
+    """Caches recomputed-per-operation quantities for one block.
+    Reference analog: EpochCache on CachedBeaconState
+    (state-transition/src/cache/epochCache.ts:111)."""
+
+    def __init__(self, cfg, state, types, fork_seq, verify_signatures):
+        self.cfg = cfg
+        self.state = state
+        self.types = types
+        self.fork_seq = fork_seq
+        self.verify = verify_signatures
+        self._shufflings: dict[int, EpochShuffling] = {}
+        self._proposer: int | None = None
+        self._total_active: int | None = None
+        self._pubkey2index: dict[bytes, int] | None = None
+
+    def pubkey2index(self) -> dict[bytes, int]:
+        """Registry pubkey -> index map, built once per block and kept
+        current across in-block registry appends (reference:
+        pubkey-index-map / Index2PubkeyCache, pubkeyCache.ts:2)."""
+        vals = self.state.validators
+        if self._pubkey2index is None:
+            self._pubkey2index = {
+                bytes(v.pubkey): i for i, v in enumerate(vals)
+            }
+            self._pubkey2index_len = len(vals)
+        elif self._pubkey2index_len != len(vals):
+            for i in range(self._pubkey2index_len, len(vals)):
+                self._pubkey2index[bytes(vals[i].pubkey)] = i
+            self._pubkey2index_len = len(vals)
+        return self._pubkey2index
+
+    def shuffling(self, epoch: int) -> EpochShuffling:
+        if epoch not in self._shufflings:
+            self._shufflings[epoch] = EpochShuffling(self.state, epoch)
+        return self._shufflings[epoch]
+
+    def proposer_index(self) -> int:
+        if self._proposer is None:
+            self._proposer = util.get_beacon_proposer_index(
+                self.state, electra=self.fork_seq >= ForkSeq.electra
+            )
+        return self._proposer
+
+    def total_active_balance(self) -> int:
+        if self._total_active is None:
+            self._total_active = get_total_active_balance(self.state)
+        return self._total_active
+
+    def base_reward(self, index: int) -> int:
+        p = preset()
+        increments = (
+            self.state.validators[index].effective_balance
+            // p.EFFECTIVE_BALANCE_INCREMENT
+        )
+        return increments * self.base_reward_per_increment()
+
+    def base_reward_per_increment(self) -> int:
+        p = preset()
+        return (
+            p.EFFECTIVE_BALANCE_INCREMENT
+            * p.BASE_REWARD_FACTOR
+            // integer_squareroot(self.total_active_balance())
+        )
+
+
+# ---------------------------------------------------------------------------
+# Header / randao / eth1 data
+# ---------------------------------------------------------------------------
+
+
+def process_block_header(ctx, block) -> None:
+    state, types = ctx.state, ctx.types
+    _req(block.slot == state.slot, "block slot != state slot")
+    _req(
+        block.slot > state.latest_block_header.slot,
+        "block not newer than latest header",
+    )
+    _req(
+        block.proposer_index == ctx.proposer_index(),
+        "wrong proposer index",
+    )
+    _req(
+        bytes(block.parent_root)
+        == types.BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        "parent root mismatch",
+    )
+    header = types.BeaconBlockHeader.default()
+    header.slot = block.slot
+    header.proposer_index = block.proposer_index
+    header.parent_root = block.parent_root
+    header.state_root = b"\x00" * 32
+    body_type = types.by_fork[_fork_name(ctx.fork_seq)].BeaconBlockBody
+    header.body_root = body_type.hash_tree_root(block.body)
+    state.latest_block_header = header
+    _req(
+        not state.validators[block.proposer_index].slashed,
+        "proposer slashed",
+    )
+
+
+def _fork_name(fork_seq: int) -> str:
+    from ..params import FORK_ORDER
+
+    return FORK_ORDER[fork_seq]
+
+
+def process_randao(ctx, body) -> None:
+    state = ctx.state
+    p = preset()
+    epoch = get_current_epoch(state)
+    if ctx.verify:
+        from ..crypto.bls.signature import verify as bls_verify
+
+        proposer = state.validators[ctx.proposer_index()]
+        domain = get_domain(ctx.cfg, state, DOMAIN_RANDAO)
+        root = compute_signing_root(ssz_uint64, epoch, domain)
+        _req(
+            bls_verify(bytes(proposer.pubkey), root, bytes(body.randao_reveal)),
+            "invalid randao reveal",
+        )
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            get_randao_mix(state, epoch), sha256(bytes(body.randao_reveal)).digest()
+        )
+    )
+    state.randao_mixes[epoch % p.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+
+def process_eth1_data(ctx, body) -> None:
+    state, types = ctx.state, ctx.types
+    p = preset()
+    state.eth1_data_votes.append(body.eth1_data)
+    target = types.Eth1Data.serialize(body.eth1_data)
+    count = sum(
+        1
+        for v in state.eth1_data_votes
+        if types.Eth1Data.serialize(v) == target
+    )
+    if count * 2 > p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH:
+        state.eth1_data = body.eth1_data
+
+
+# ---------------------------------------------------------------------------
+# Attestations
+# ---------------------------------------------------------------------------
+
+
+def _checkpoint_eq(types, a, b) -> bool:
+    return types.Checkpoint.serialize(a) == types.Checkpoint.serialize(b)
+
+
+def _validate_attestation_data(ctx, data) -> None:
+    state = ctx.state
+    p = preset()
+    prev, cur = get_previous_epoch(state), get_current_epoch(state)
+    _req(data.target.epoch in (prev, cur), "target epoch not prev/cur")
+    _req(
+        data.target.epoch == compute_epoch_at_slot(data.slot),
+        "target epoch != slot epoch",
+    )
+    _req(
+        data.slot + p.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot,
+        "attestation too fresh",
+    )
+    if ctx.fork_seq < ForkSeq.deneb:  # EIP-7045 removed the upper bound
+        _req(
+            state.slot <= data.slot + p.SLOTS_PER_EPOCH,
+            "attestation too old",
+        )
+
+
+def get_attesting_indices(ctx, attestation) -> list[int]:
+    """Validator indices attested to, per fork encoding (phase0 single
+    committee bitlist; electra committee_bits + concatenated bits)."""
+    data = attestation.data
+    shuffling = ctx.shuffling(data.target.epoch)
+    if ctx.fork_seq >= ForkSeq.electra:
+        out = []
+        offset = 0
+        bits = list(attestation.aggregation_bits)
+        for ci, has in enumerate(attestation.committee_bits):
+            if not has:
+                continue
+            committee = shuffling.committee(data.slot, ci)
+            members = [
+                int(v)
+                for i, v in enumerate(committee)
+                if bits[offset + i]
+            ]
+            out.extend(members)
+            offset += len(committee)
+        return out
+    committee = shuffling.committee(data.slot, data.index)
+    bits = list(attestation.aggregation_bits)
+    return [int(v) for i, v in enumerate(committee) if bits[i]]
+
+
+def is_valid_indexed_attestation(ctx, indexed) -> bool:
+    indices = [int(i) for i in indexed.attesting_indices]
+    if len(indices) == 0 or indices != sorted(set(indices)):
+        return False
+    if indices[-1] >= len(ctx.state.validators):
+        return False  # unknown validator: invalid, not a crash
+    if not ctx.verify:
+        return True
+    from ..crypto.bls.signature import fast_aggregate_verify
+
+    state = ctx.state
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    domain = get_domain(
+        ctx.cfg, state, DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch
+    )
+    root = compute_signing_root(
+        ctx.types.AttestationData, indexed.data, domain
+    )
+    return fast_aggregate_verify(pubkeys, root, bytes(indexed.signature))
+
+
+def _indexed_from_attestation(ctx, attestation):
+    t = (
+        ctx.types.electra.IndexedAttestation
+        if ctx.fork_seq >= ForkSeq.electra
+        else ctx.types.IndexedAttestation
+    )
+    out = t.default()
+    out.attesting_indices = sorted(get_attesting_indices(ctx, attestation))
+    out.data = attestation.data
+    out.signature = attestation.signature
+    return out
+
+
+def get_attestation_participation_flag_indices(
+    ctx, data, inclusion_delay: int
+) -> list[int]:
+    state = ctx.state
+    p = preset()
+    justified = (
+        state.current_justified_checkpoint
+        if data.target.epoch == get_current_epoch(state)
+        else state.previous_justified_checkpoint
+    )
+    is_matching_source = _checkpoint_eq(ctx.types, data.source, justified)
+    _req(is_matching_source, "attestation source != justified checkpoint")
+    is_matching_target = is_matching_source and bytes(
+        data.target.root
+    ) == get_block_root(state, data.target.epoch)
+    is_matching_head = False
+    if is_matching_target:
+        try:
+            is_matching_head = bytes(
+                data.beacon_block_root
+            ) == get_block_root_at_slot(state, data.slot)
+        except ValueError:
+            is_matching_head = False
+    flags = []
+    if is_matching_source and inclusion_delay <= integer_squareroot(
+        p.SLOTS_PER_EPOCH
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if ctx.fork_seq >= ForkSeq.deneb:
+        if is_matching_target:  # EIP-7045: no delay bound
+            flags.append(TIMELY_TARGET_FLAG_INDEX)
+    elif is_matching_target and inclusion_delay <= p.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if (
+        is_matching_head
+        and inclusion_delay == p.MIN_ATTESTATION_INCLUSION_DELAY
+    ):
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def process_attestation(ctx, attestation) -> None:
+    state = ctx.state
+    p = preset()
+    data = attestation.data
+    _validate_attestation_data(ctx, data)
+
+    if ctx.fork_seq >= ForkSeq.electra:
+        _req(data.index == 0, "electra attestation data.index != 0")
+        shuffling = ctx.shuffling(data.target.epoch)
+        bits = list(attestation.aggregation_bits)
+        selected = [
+            ci
+            for ci, has in enumerate(attestation.committee_bits)
+            if has
+        ]
+        _req(len(selected) > 0, "no committee bits set")
+        committees = []
+        total = 0
+        for ci in selected:
+            _req(
+                ci < shuffling.committees_per_slot,
+                "committee index out of range",
+            )
+            committee = shuffling.committee(data.slot, ci)
+            committees.append(committee)
+            total += len(committee)
+        _req(len(bits) == total, "aggregation bits length mismatch")
+        offset = 0
+        for committee in committees:
+            members = [i for i in range(len(committee)) if bits[offset + i]]
+            _req(len(members) > 0, "empty committee participation")
+            offset += len(committee)
+    else:
+        shuffling = ctx.shuffling(data.target.epoch)
+        _req(
+            data.index < shuffling.committees_per_slot,
+            "committee index out of range",
+        )
+        committee = shuffling.committee(data.slot, data.index)
+        _req(
+            len(attestation.aggregation_bits) == len(committee),
+            "aggregation bits length mismatch",
+        )
+
+    indexed = _indexed_from_attestation(ctx, attestation)
+    _req(
+        is_valid_indexed_attestation(ctx, indexed),
+        "invalid indexed attestation",
+    )
+
+    if ctx.fork_seq >= ForkSeq.altair:
+        inclusion_delay = state.slot - data.slot
+        flag_indices = get_attestation_participation_flag_indices(
+            ctx, data, inclusion_delay
+        )
+        epoch_participation = (
+            state.current_epoch_participation
+            if data.target.epoch == get_current_epoch(state)
+            else state.previous_epoch_participation
+        )
+        proposer_reward_numerator = 0
+        for index in indexed.attesting_indices:
+            for flag_index, weight in enumerate(
+                util.PARTICIPATION_FLAG_WEIGHTS
+            ):
+                if flag_index in flag_indices and not util.has_flag(
+                    epoch_participation[index], flag_index
+                ):
+                    epoch_participation[index] = util.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+                    proposer_reward_numerator += (
+                        ctx.base_reward(index) * weight
+                    )
+        denominator = (
+            (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+            * WEIGHT_DENOMINATOR
+            // PROPOSER_WEIGHT
+        )
+        increase_balance(
+            state, ctx.proposer_index(), proposer_reward_numerator // denominator
+        )
+    else:
+        pending = ctx.types.PendingAttestation.default()
+        pending.aggregation_bits = list(attestation.aggregation_bits)
+        pending.data = data
+        pending.inclusion_delay = state.slot - data.slot
+        pending.proposer_index = ctx.proposer_index()
+        if data.target.epoch == get_current_epoch(state):
+            _req(
+                _checkpoint_eq(
+                    ctx.types, data.source, state.current_justified_checkpoint
+                ),
+                "source != current justified",
+            )
+            state.current_epoch_attestations.append(pending)
+        else:
+            _req(
+                _checkpoint_eq(
+                    ctx.types, data.source, state.previous_justified_checkpoint
+                ),
+                "source != previous justified",
+            )
+            state.previous_epoch_attestations.append(pending)
+
+
+# ---------------------------------------------------------------------------
+# Slashings
+# ---------------------------------------------------------------------------
+
+
+def is_slashable_attestation_data(types, data_1, data_2) -> bool:
+    double = (
+        types.AttestationData.serialize(data_1)
+        != types.AttestationData.serialize(data_2)
+        and data_1.target.epoch == data_2.target.epoch
+    )
+    surround = (
+        data_1.source.epoch < data_2.source.epoch
+        and data_2.target.epoch < data_1.target.epoch
+    )
+    return double or surround
+
+
+def process_proposer_slashing(ctx, proposer_slashing) -> None:
+    state, types = ctx.state, ctx.types
+    h1 = proposer_slashing.signed_header_1.message
+    h2 = proposer_slashing.signed_header_2.message
+    _req(h1.slot == h2.slot, "slots differ")
+    _req(h1.proposer_index == h2.proposer_index, "proposer differs")
+    _req(
+        types.BeaconBlockHeader.serialize(h1)
+        != types.BeaconBlockHeader.serialize(h2),
+        "identical headers",
+    )
+    proposer = state.validators[h1.proposer_index]
+    _req(
+        util.is_slashable_validator(proposer, get_current_epoch(state)),
+        "proposer not slashable",
+    )
+    if ctx.verify:
+        from ..crypto.bls.signature import verify as bls_verify
+
+        for signed in (
+            proposer_slashing.signed_header_1,
+            proposer_slashing.signed_header_2,
+        ):
+            domain = get_domain(
+                ctx.cfg,
+                state,
+                DOMAIN_BEACON_PROPOSER,
+                compute_epoch_at_slot(signed.message.slot),
+            )
+            root = compute_signing_root(
+                types.BeaconBlockHeader, signed.message, domain
+            )
+            _req(
+                bls_verify(
+                    bytes(proposer.pubkey), root, bytes(signed.signature)
+                ),
+                "bad proposer slashing signature",
+            )
+    util.slash_validator(
+        ctx.cfg, state, int(h1.proposer_index), ctx.fork_seq
+    )
+
+
+def process_attester_slashing(ctx, attester_slashing) -> None:
+    state = ctx.state
+    att1 = attester_slashing.attestation_1
+    att2 = attester_slashing.attestation_2
+    _req(
+        is_slashable_attestation_data(ctx.types, att1.data, att2.data),
+        "attestation data not slashable",
+    )
+    _req(is_valid_indexed_attestation(ctx, att1), "invalid attestation 1")
+    _req(is_valid_indexed_attestation(ctx, att2), "invalid attestation 2")
+    slashed_any = False
+    common = set(int(i) for i in att1.attesting_indices) & set(
+        int(i) for i in att2.attesting_indices
+    )
+    for index in sorted(common):
+        if util.is_slashable_validator(
+            state.validators[index], get_current_epoch(state)
+        ):
+            util.slash_validator(ctx.cfg, state, index, ctx.fork_seq)
+            slashed_any = True
+    _req(slashed_any, "no validator slashed")
+
+
+# ---------------------------------------------------------------------------
+# Deposits
+# ---------------------------------------------------------------------------
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch, depth: int, index: int, root: bytes
+) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = sha256(bytes(branch[i]) + value).digest()
+        else:
+            value = sha256(value + bytes(branch[i])).digest()
+    return value == bytes(root)
+
+
+def is_valid_deposit_signature(
+    cfg, pubkey, withdrawal_credentials, amount, signature, types
+) -> bool:
+    from ..crypto.bls.signature import verify as bls_verify
+
+    msg = types.DepositMessage.default()
+    msg.pubkey = pubkey
+    msg.withdrawal_credentials = withdrawal_credentials
+    msg.amount = amount
+    domain = compute_domain(DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, b"\x00" * 32)
+    root = compute_signing_root(types.DepositMessage, msg, domain)
+    try:
+        return bls_verify(bytes(pubkey), root, bytes(signature))
+    except Exception:
+        return False
+
+
+def has_eth1_withdrawal_credential(wc: bytes) -> bool:
+    return bytes(wc[:1]) == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def has_compounding_withdrawal_credential(wc: bytes) -> bool:
+    return bytes(wc[:1]) == COMPOUNDING_WITHDRAWAL_PREFIX
+
+
+def has_execution_withdrawal_credential(wc: bytes) -> bool:
+    return has_eth1_withdrawal_credential(wc) or has_compounding_withdrawal_credential(wc)
+
+
+def get_max_effective_balance(wc: bytes) -> int:
+    p = preset()
+    if has_compounding_withdrawal_credential(wc):
+        return p.MAX_EFFECTIVE_BALANCE_ELECTRA
+    return p.MIN_ACTIVATION_BALANCE
+
+
+def add_validator_to_registry(
+    cfg, state, pubkey, withdrawal_credentials, amount, types, fork_seq
+) -> None:
+    p = preset()
+    v = types.Validator.default()
+    v.pubkey = bytes(pubkey)
+    v.withdrawal_credentials = bytes(withdrawal_credentials)
+    v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+    v.activation_epoch = FAR_FUTURE_EPOCH
+    v.exit_epoch = FAR_FUTURE_EPOCH
+    v.withdrawable_epoch = FAR_FUTURE_EPOCH
+    v.slashed = False
+    if fork_seq >= ForkSeq.electra:
+        max_eb = get_max_effective_balance(bytes(withdrawal_credentials))
+    else:
+        max_eb = p.MAX_EFFECTIVE_BALANCE
+    v.effective_balance = min(
+        amount - amount % p.EFFECTIVE_BALANCE_INCREMENT, max_eb
+    )
+    state.validators.append(v)
+    state.balances.append(int(amount))
+    if hasattr(state, "previous_epoch_participation"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+
+def apply_deposit(
+    ctx, pubkey, withdrawal_credentials, amount, signature
+) -> None:
+    state, types, cfg = ctx.state, ctx.types, ctx.cfg
+    index = ctx.pubkey2index().get(bytes(pubkey))
+    if ctx.fork_seq >= ForkSeq.electra:
+        if index is None:
+            if is_valid_deposit_signature(
+                cfg, pubkey, withdrawal_credentials, amount, signature, types
+            ):
+                add_validator_to_registry(
+                    cfg,
+                    state,
+                    pubkey,
+                    withdrawal_credentials,
+                    0,
+                    types,
+                    ctx.fork_seq,
+                )
+            else:
+                return
+        pd = types.PendingDeposit.default()
+        pd.pubkey = bytes(pubkey)
+        pd.withdrawal_credentials = bytes(withdrawal_credentials)
+        pd.amount = amount
+        pd.signature = bytes(signature)
+        pd.slot = GENESIS_SLOT
+        state.pending_deposits.append(pd)
+        return
+    if index is None:
+        if is_valid_deposit_signature(
+            cfg, pubkey, withdrawal_credentials, amount, signature, types
+        ):
+            add_validator_to_registry(
+                cfg,
+                state,
+                pubkey,
+                withdrawal_credentials,
+                amount,
+                types,
+                ctx.fork_seq,
+            )
+    else:
+        increase_balance(state, index, amount)
+
+
+def process_deposit(ctx, deposit) -> None:
+    from ..params import DEPOSIT_CONTRACT_TREE_DEPTH
+
+    state, types = ctx.state, ctx.types
+    leaf = types.DepositData.hash_tree_root(deposit.data)
+    _req(
+        is_valid_merkle_branch(
+            leaf,
+            deposit.proof,
+            DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            state.eth1_deposit_index,
+            state.eth1_data.deposit_root,
+        ),
+        "invalid deposit proof",
+    )
+    state.eth1_deposit_index += 1
+    apply_deposit(
+        ctx,
+        deposit.data.pubkey,
+        deposit.data.withdrawal_credentials,
+        deposit.data.amount,
+        deposit.data.signature,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Voluntary exits
+# ---------------------------------------------------------------------------
+
+
+def get_pending_balance_to_withdraw(state, index: int) -> int:
+    return sum(
+        w.amount
+        for w in state.pending_partial_withdrawals
+        if w.validator_index == index
+    )
+
+
+def process_voluntary_exit(ctx, signed_exit) -> None:
+    state, cfg = ctx.state, ctx.cfg
+    exit_msg = signed_exit.message
+    index = int(exit_msg.validator_index)
+    validator = state.validators[index]
+    cur = get_current_epoch(state)
+    _req(util.is_active_validator(validator, cur), "not active")
+    _req(validator.exit_epoch == FAR_FUTURE_EPOCH, "already exiting")
+    _req(cur >= exit_msg.epoch, "exit epoch in future")
+    _req(
+        cur >= validator.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD,
+        "too young to exit",
+    )
+    if ctx.fork_seq >= ForkSeq.electra:
+        _req(
+            get_pending_balance_to_withdraw(state, index) == 0,
+            "pending partial withdrawals exist",
+        )
+    if ctx.verify:
+        from ..crypto.bls.signature import verify as bls_verify
+
+        if ctx.fork_seq >= ForkSeq.deneb:
+            # EIP-7044: locked to capella fork domain
+            domain = compute_domain(
+                DOMAIN_VOLUNTARY_EXIT,
+                cfg.CAPELLA_FORK_VERSION,
+                state.genesis_validators_root,
+            )
+        else:
+            domain = get_domain(
+                cfg, state, DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch
+            )
+        root = compute_signing_root(ctx.types.VoluntaryExit, exit_msg, domain)
+        _req(
+            bls_verify(
+                bytes(validator.pubkey), root, bytes(signed_exit.signature)
+            ),
+            "bad voluntary exit signature",
+        )
+    if ctx.fork_seq >= ForkSeq.electra:
+        util.initiate_validator_exit_electra(cfg, state, index)
+    else:
+        util.initiate_validator_exit(cfg, state, index)
+
+
+# ---------------------------------------------------------------------------
+# Sync aggregate (altair+)
+# ---------------------------------------------------------------------------
+
+
+def process_sync_aggregate(ctx, sync_aggregate) -> None:
+    state, cfg = ctx.state, ctx.cfg
+    p = preset()
+    bits = list(sync_aggregate.sync_committee_bits)
+    previous_slot = max(state.slot, 1) - 1
+    if ctx.verify:
+        from ..crypto.bls.signature import eth_fast_aggregate_verify
+
+        committee_pubkeys = [
+            bytes(pk) for pk in state.current_sync_committee.pubkeys
+        ]
+        participants = [pk for pk, b in zip(committee_pubkeys, bits) if b]
+        domain = get_domain(
+            cfg,
+            state,
+            DOMAIN_SYNC_COMMITTEE,
+            compute_epoch_at_slot(previous_slot),
+        )
+        root = compute_signing_root_from_roots(
+            get_block_root_at_slot(state, previous_slot), domain
+        )
+        _req(
+            eth_fast_aggregate_verify(
+                participants, root, bytes(sync_aggregate.sync_committee_signature)
+            ),
+            "bad sync aggregate signature",
+        )
+    total_active_increments = (
+        ctx.total_active_balance() // p.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        ctx.base_reward_per_increment() * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // p.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // p.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward
+        * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    pubkey2index = ctx.pubkey2index()
+    proposer = ctx.proposer_index()
+    for pk, bit in zip(state.current_sync_committee.pubkeys, bits):
+        participant = pubkey2index[bytes(pk)]
+        if bit:
+            increase_balance(state, participant, participant_reward)
+            increase_balance(state, proposer, proposer_reward)
+        else:
+            decrease_balance(state, participant, participant_reward)
+
+
+# ---------------------------------------------------------------------------
+# Execution payload + withdrawals (bellatrix+/capella+)
+# ---------------------------------------------------------------------------
+
+
+def is_merge_transition_complete(ctx) -> bool:
+    header_t = ctx.types.by_fork[
+        _fork_name(ctx.fork_seq)
+    ].ExecutionPayloadHeader
+    default = header_t.serialize(header_t.default())
+    return (
+        header_t.serialize(ctx.state.latest_execution_payload_header)
+        != default
+    )
+
+
+def compute_timestamp_at_slot(cfg, state, slot: int) -> int:
+    return state.genesis_time + slot * cfg.SECONDS_PER_SLOT
+
+
+def process_execution_payload(ctx, body, execution_engine=None) -> None:
+    state, cfg, types = ctx.state, ctx.cfg, ctx.types
+    p = preset()
+    payload = body.execution_payload
+    if ctx.fork_seq >= ForkSeq.capella or is_merge_transition_complete(ctx):
+        _req(
+            bytes(payload.parent_hash)
+            == bytes(state.latest_execution_payload_header.block_hash),
+            "payload parent hash mismatch",
+        )
+    _req(
+        bytes(payload.prev_randao)
+        == get_randao_mix(state, get_current_epoch(state)),
+        "payload prev_randao mismatch",
+    )
+    _req(
+        payload.timestamp == compute_timestamp_at_slot(cfg, state, state.slot),
+        "payload timestamp mismatch",
+    )
+    if ctx.fork_seq >= ForkSeq.deneb:
+        max_blobs = (
+            cfg.MAX_BLOBS_PER_BLOCK_ELECTRA
+            if ctx.fork_seq >= ForkSeq.electra
+            else p.MAX_BLOBS_PER_BLOCK
+        )
+        _req(
+            len(body.blob_kzg_commitments) <= max_blobs,
+            "too many blobs",
+        )
+    if execution_engine is not None:
+        _req(
+            execution_engine.notify_new_payload(payload),
+            "execution engine rejected payload",
+        )
+    ns = types.by_fork[_fork_name(ctx.fork_seq)]
+    header = ns.ExecutionPayloadHeader.default()
+    for name, _ in ns.ExecutionPayloadHeader.fields:
+        if name == "transactions_root":
+            tx_t = ns.ExecutionPayload.field_types["transactions"]
+            header.transactions_root = tx_t.hash_tree_root(
+                payload.transactions
+            )
+        elif name == "withdrawals_root":
+            w_t = ns.ExecutionPayload.field_types["withdrawals"]
+            header.withdrawals_root = w_t.hash_tree_root(payload.withdrawals)
+        else:
+            setattr(header, name, getattr(payload, name))
+    state.latest_execution_payload_header = header
+
+
+def is_fully_withdrawable_validator(
+    fork_seq, v, balance: int, epoch: int
+) -> bool:
+    wc = bytes(v.withdrawal_credentials)
+    if fork_seq >= ForkSeq.electra:
+        has_cred = has_execution_withdrawal_credential(wc)
+    else:
+        has_cred = has_eth1_withdrawal_credential(wc)
+    return has_cred and v.withdrawable_epoch <= epoch and balance > 0
+
+
+def is_partially_withdrawable_validator(fork_seq, v, balance: int) -> bool:
+    p = preset()
+    wc = bytes(v.withdrawal_credentials)
+    if fork_seq >= ForkSeq.electra:
+        if not has_execution_withdrawal_credential(wc):
+            return False
+        max_eb = get_max_effective_balance(wc)
+        return v.effective_balance == max_eb and balance > max_eb
+    return (
+        has_eth1_withdrawal_credential(wc)
+        and v.effective_balance == p.MAX_EFFECTIVE_BALANCE
+        and balance > p.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def get_expected_withdrawals(ctx):
+    """Returns (withdrawals, partial_withdrawals_count)."""
+    state, types = ctx.state, ctx.types
+    p = preset()
+    epoch = get_current_epoch(state)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    withdrawals = []
+    partial_count = 0
+
+    if ctx.fork_seq >= ForkSeq.electra:
+        for w in state.pending_partial_withdrawals:
+            if (
+                w.withdrawable_epoch > epoch
+                or len(withdrawals)
+                == p.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP
+            ):
+                break
+            v = state.validators[w.validator_index]
+            has_sufficient = (
+                v.effective_balance >= p.MIN_ACTIVATION_BALANCE
+            )
+            has_excess = (
+                state.balances[w.validator_index] > p.MIN_ACTIVATION_BALANCE
+            )
+            if (
+                v.exit_epoch == FAR_FUTURE_EPOCH
+                and has_sufficient
+                and has_excess
+            ):
+                amount = min(
+                    state.balances[w.validator_index]
+                    - p.MIN_ACTIVATION_BALANCE,
+                    w.amount,
+                )
+                wd = types.Withdrawal.default()
+                wd.index = withdrawal_index
+                wd.validator_index = w.validator_index
+                wd.address = bytes(v.withdrawal_credentials)[12:]
+                wd.amount = amount
+                withdrawals.append(wd)
+                withdrawal_index += 1
+            partial_count += 1
+
+    bound = min(len(state.validators), p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    for _ in range(bound):
+        v = state.validators[validator_index]
+        balance = state.balances[validator_index] - sum(
+            w.amount
+            for w in withdrawals
+            if w.validator_index == validator_index
+        )
+        if is_fully_withdrawable_validator(ctx.fork_seq, v, balance, epoch):
+            wd = types.Withdrawal.default()
+            wd.index = withdrawal_index
+            wd.validator_index = validator_index
+            wd.address = bytes(v.withdrawal_credentials)[12:]
+            wd.amount = balance
+            withdrawals.append(wd)
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(ctx.fork_seq, v, balance):
+            if ctx.fork_seq >= ForkSeq.electra:
+                max_eb = get_max_effective_balance(
+                    bytes(v.withdrawal_credentials)
+                )
+            else:
+                max_eb = p.MAX_EFFECTIVE_BALANCE
+            wd = types.Withdrawal.default()
+            wd.index = withdrawal_index
+            wd.validator_index = validator_index
+            wd.address = bytes(v.withdrawal_credentials)[12:]
+            wd.amount = balance - max_eb
+            withdrawals.append(wd)
+            withdrawal_index += 1
+        if len(withdrawals) == p.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % len(state.validators)
+    return withdrawals, partial_count
+
+
+def process_withdrawals(ctx, payload) -> None:
+    state, types = ctx.state, ctx.types
+    p = preset()
+    expected, partial_count = get_expected_withdrawals(ctx)
+    got = list(payload.withdrawals)
+    _req(len(got) == len(expected), "withdrawals count mismatch")
+    for a, b in zip(got, expected):
+        _req(
+            types.Withdrawal.serialize(a) == types.Withdrawal.serialize(b),
+            "withdrawal mismatch",
+        )
+    for w in expected:
+        decrease_balance(state, int(w.validator_index), int(w.amount))
+    if ctx.fork_seq >= ForkSeq.electra and partial_count:
+        state.pending_partial_withdrawals = list(
+            state.pending_partial_withdrawals[partial_count:]
+        )
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    if len(expected) == p.MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % len(state.validators)
+    else:
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % len(state.validators)
+
+
+def process_bls_to_execution_change(ctx, signed_change) -> None:
+    state, cfg, types = ctx.state, ctx.cfg, ctx.types
+    change = signed_change.message
+    v = state.validators[change.validator_index]
+    wc = bytes(v.withdrawal_credentials)
+    _req(wc[:1] == BLS_WITHDRAWAL_PREFIX, "not a BLS credential")
+    _req(
+        wc[1:] == sha256(bytes(change.from_bls_pubkey)).digest()[1:],
+        "from_bls_pubkey mismatch",
+    )
+    if ctx.verify:
+        from ..crypto.bls.signature import verify as bls_verify
+
+        domain = compute_domain(
+            DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            cfg.GENESIS_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+        root = compute_signing_root(
+            types.BLSToExecutionChange, change, domain
+        )
+        _req(
+            bls_verify(
+                bytes(change.from_bls_pubkey),
+                root,
+                bytes(signed_change.signature),
+            ),
+            "bad bls-to-execution-change signature",
+        )
+    v.withdrawal_credentials = (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        + b"\x00" * 11
+        + bytes(change.to_execution_address)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Electra execution requests
+# ---------------------------------------------------------------------------
+
+
+def process_deposit_request(ctx, request) -> None:
+    state, types = ctx.state, ctx.types
+    if state.deposit_requests_start_index == UNSET_DEPOSIT_REQUESTS_START_INDEX:
+        state.deposit_requests_start_index = request.index
+    pd = types.PendingDeposit.default()
+    pd.pubkey = bytes(request.pubkey)
+    pd.withdrawal_credentials = bytes(request.withdrawal_credentials)
+    pd.amount = request.amount
+    pd.signature = bytes(request.signature)
+    pd.slot = state.slot
+    state.pending_deposits.append(pd)
+
+
+def process_withdrawal_request(ctx, request) -> None:
+    state, cfg, types = ctx.state, ctx.cfg, ctx.types
+    p = preset()
+    amount = request.amount
+    is_full_exit = amount == FULL_EXIT_REQUEST_AMOUNT
+    if (
+        len(state.pending_partial_withdrawals)
+        == p.PENDING_PARTIAL_WITHDRAWALS_LIMIT
+        and not is_full_exit
+    ):
+        return
+    index = ctx.pubkey2index().get(bytes(request.validator_pubkey))
+    if index is None:
+        return
+    v = state.validators[index]
+    wc = bytes(v.withdrawal_credentials)
+    if not (
+        has_execution_withdrawal_credential(wc)
+        and wc[12:] == bytes(request.source_address)
+    ):
+        return
+    cur = get_current_epoch(state)
+    if not util.is_active_validator(v, cur):
+        return
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    if cur < v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD:
+        return
+    pending = get_pending_balance_to_withdraw(state, index)
+    if is_full_exit:
+        if pending == 0:
+            util.initiate_validator_exit_electra(cfg, state, index)
+        return
+    has_sufficient = v.effective_balance >= p.MIN_ACTIVATION_BALANCE
+    has_excess = state.balances[index] > p.MIN_ACTIVATION_BALANCE + pending
+    if (
+        has_compounding_withdrawal_credential(wc)
+        and has_sufficient
+        and has_excess
+    ):
+        to_withdraw = min(
+            state.balances[index] - p.MIN_ACTIVATION_BALANCE - pending,
+            amount,
+        )
+        exit_queue_epoch = util.compute_exit_epoch_and_update_churn(
+            cfg, state, to_withdraw
+        )
+        ppw = types.PendingPartialWithdrawal.default()
+        ppw.validator_index = index
+        ppw.amount = to_withdraw
+        ppw.withdrawable_epoch = (
+            exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        )
+        state.pending_partial_withdrawals.append(ppw)
+
+
+def compute_consolidation_epoch_and_update_churn(
+    cfg, state, consolidation_balance: int
+) -> int:
+    from .util import (
+        compute_activation_exit_epoch,
+        get_consolidation_churn_limit,
+    )
+
+    earliest = max(
+        state.earliest_consolidation_epoch,
+        compute_activation_exit_epoch(get_current_epoch(state)),
+    )
+    per_epoch = get_consolidation_churn_limit(cfg, state)
+    if state.earliest_consolidation_epoch < earliest:
+        balance_to_consume = per_epoch
+    else:
+        balance_to_consume = state.consolidation_balance_to_consume
+    if consolidation_balance > balance_to_consume:
+        to_process = consolidation_balance - balance_to_consume
+        additional_epochs = (to_process - 1) // per_epoch + 1
+        earliest += additional_epochs
+        balance_to_consume += additional_epochs * per_epoch
+    state.consolidation_balance_to_consume = (
+        balance_to_consume - consolidation_balance
+    )
+    state.earliest_consolidation_epoch = earliest
+    return earliest
+
+
+def switch_to_compounding_validator(ctx, index: int) -> None:
+    state, types = ctx.state, ctx.types
+    p = preset()
+    v = state.validators[index]
+    v.withdrawal_credentials = (
+        COMPOUNDING_WITHDRAWAL_PREFIX + bytes(v.withdrawal_credentials)[1:]
+    )
+    balance = state.balances[index]
+    if balance > p.MIN_ACTIVATION_BALANCE:
+        excess = balance - p.MIN_ACTIVATION_BALANCE
+        state.balances[index] = p.MIN_ACTIVATION_BALANCE
+        pd = types.PendingDeposit.default()
+        pd.pubkey = bytes(v.pubkey)
+        pd.withdrawal_credentials = bytes(v.withdrawal_credentials)
+        pd.amount = excess
+        pd.signature = G2_POINT_AT_INFINITY
+        pd.slot = GENESIS_SLOT
+        state.pending_deposits.append(pd)
+
+
+def process_consolidation_request(ctx, request) -> None:
+    state, cfg, types = ctx.state, ctx.cfg, ctx.types
+    p = preset()
+    pubkey2index = ctx.pubkey2index()
+    source_pk = bytes(request.source_pubkey)
+    target_pk = bytes(request.target_pubkey)
+    cur = get_current_epoch(state)
+
+    # switch-to-compounding self-request
+    if source_pk == target_pk:
+        index = pubkey2index.get(source_pk)
+        if index is None:
+            return
+        v = state.validators[index]
+        wc = bytes(v.withdrawal_credentials)
+        if (
+            has_eth1_withdrawal_credential(wc)
+            and wc[12:] == bytes(request.source_address)
+            and util.is_active_validator(v, cur)
+            and v.exit_epoch == FAR_FUTURE_EPOCH
+        ):
+            switch_to_compounding_validator(ctx, index)
+        return
+
+    if len(state.pending_consolidations) == p.PENDING_CONSOLIDATIONS_LIMIT:
+        return
+    if util.get_consolidation_churn_limit(cfg, state) <= p.MIN_ACTIVATION_BALANCE:
+        return
+    source_index = pubkey2index.get(source_pk)
+    target_index = pubkey2index.get(target_pk)
+    if source_index is None or target_index is None:
+        return
+    source = state.validators[source_index]
+    target = state.validators[target_index]
+    swc = bytes(source.withdrawal_credentials)
+    twc = bytes(target.withdrawal_credentials)
+    if not (
+        has_execution_withdrawal_credential(swc)
+        and swc[12:] == bytes(request.source_address)
+    ):
+        return
+    if not has_compounding_withdrawal_credential(twc):
+        return
+    if not (
+        util.is_active_validator(source, cur)
+        and util.is_active_validator(target, cur)
+    ):
+        return
+    if (
+        source.exit_epoch != FAR_FUTURE_EPOCH
+        or target.exit_epoch != FAR_FUTURE_EPOCH
+    ):
+        return
+    if cur < source.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD:
+        return
+    if get_pending_balance_to_withdraw(state, source_index) > 0:
+        return
+    source.exit_epoch = compute_consolidation_epoch_and_update_churn(
+        cfg, state, source.effective_balance
+    )
+    source.withdrawable_epoch = (
+        source.exit_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+    pc = types.PendingConsolidation.default()
+    pc.source_index = source_index
+    pc.target_index = target_index
+    state.pending_consolidations.append(pc)
+
+
+# ---------------------------------------------------------------------------
+# Operations driver + block entry
+# ---------------------------------------------------------------------------
+
+
+def process_operations(ctx, body) -> None:
+    state = ctx.state
+    p = preset()
+    if ctx.fork_seq >= ForkSeq.electra:
+        limit = min(
+            state.eth1_data.deposit_count, state.deposit_requests_start_index
+        )
+        if state.eth1_deposit_index < limit:
+            _req(
+                len(body.deposits)
+                == min(p.MAX_DEPOSITS, limit - state.eth1_deposit_index),
+                "wrong deposit count",
+            )
+        else:
+            _req(len(body.deposits) == 0, "deposits after transition")
+    else:
+        _req(
+            len(body.deposits)
+            == min(
+                p.MAX_DEPOSITS,
+                state.eth1_data.deposit_count - state.eth1_deposit_index,
+            ),
+            "wrong deposit count",
+        )
+    for op in body.proposer_slashings:
+        process_proposer_slashing(ctx, op)
+    for op in body.attester_slashings:
+        process_attester_slashing(ctx, op)
+    for op in body.attestations:
+        process_attestation(ctx, op)
+    for op in body.deposits:
+        process_deposit(ctx, op)
+    for op in body.voluntary_exits:
+        process_voluntary_exit(ctx, op)
+    if ctx.fork_seq >= ForkSeq.capella:
+        for op in body.bls_to_execution_changes:
+            process_bls_to_execution_change(ctx, op)
+    if ctx.fork_seq >= ForkSeq.electra:
+        for op in body.execution_requests.deposits:
+            process_deposit_request(ctx, op)
+        for op in body.execution_requests.withdrawals:
+            process_withdrawal_request(ctx, op)
+        for op in body.execution_requests.consolidations:
+            process_consolidation_request(ctx, op)
+
+
+def process_block(
+    cfg,
+    state,
+    block,
+    types,
+    fork_seq: int,
+    verify_signatures: bool = True,
+    execution_engine=None,
+) -> None:
+    """Spec process_block for the given fork."""
+    ctx = BlockCtx(cfg, state, types, fork_seq, verify_signatures)
+    process_block_header(ctx, block)
+    if fork_seq >= ForkSeq.capella:
+        process_withdrawals(ctx, block.body.execution_payload)
+    if fork_seq >= ForkSeq.bellatrix and (
+        fork_seq >= ForkSeq.capella or is_merge_transition_complete(ctx)
+        or _has_execution_payload(ctx, block.body)
+    ):
+        process_execution_payload(ctx, block.body, execution_engine)
+    process_randao(ctx, block.body)
+    process_eth1_data(ctx, block.body)
+    process_operations(ctx, block.body)
+    if fork_seq >= ForkSeq.altair:
+        process_sync_aggregate(ctx, block.body.sync_aggregate)
+
+
+def _has_execution_payload(ctx, body) -> bool:
+    """bellatrix is_execution_enabled: payload present (non-default) or
+    merge already complete."""
+    ns = ctx.types.by_fork[_fork_name(ctx.fork_seq)]
+    t = ns.ExecutionPayload
+    return t.serialize(body.execution_payload) != t.serialize(t.default())

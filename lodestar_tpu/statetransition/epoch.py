@@ -1,0 +1,730 @@
+"""Epoch transition — numpy-vectorized per-validator processing.
+
+Reference analog: packages/state-transition/src/epoch/index.ts:77 and
+its 17 process* steps, plus the EpochTransitionCache precomputation
+(src/cache/epochTransitionCache.ts). The reference already keeps
+per-validator data as flat typed arrays for speed; here every step is
+an array op over the registry (the tensor layout that later moves to
+device, SURVEY.md §7 step 3). Follows ethereum/consensus-specs
+{phase0,altair,capella,electra}/beacon-chain.md epoch processing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..params import (
+    BASE_REWARDS_PER_EPOCH,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    GENESIS_SLOT,
+    JUSTIFICATION_BITS_LENGTH,
+    ForkSeq,
+    preset,
+)
+from . import util
+from .util import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    EpochShuffling,
+    compute_activation_exit_epoch,
+    compute_start_slot_at_epoch,
+    get_block_root,
+    get_current_epoch,
+    get_previous_epoch,
+    get_randao_mix,
+    get_total_active_balance,
+    increase_balance,
+    initiate_validator_exit,
+    initiate_validator_exit_electra,
+    integer_squareroot,
+)
+
+
+class EpochTransitionCache:
+    """Flat arrays shared by all steps of one epoch transition
+    (reference: EpochTransitionCache, epochTransitionCache.ts)."""
+
+    def __init__(self, cfg, state, fork_seq: int):
+        self.cfg = cfg
+        self.fork_seq = fork_seq
+        p = preset()
+        n = len(state.validators)
+        self.n = n
+        self.current_epoch = get_current_epoch(state)
+        self.previous_epoch = get_previous_epoch(state)
+        self.reg = util.RegistryArrays(state)
+        self.balances = np.fromiter(state.balances, np.int64, n)
+        self.active_prev = self.reg.is_active(self.previous_epoch)
+        self.active_cur = self.reg.is_active(self.current_epoch)
+        self.total_active_balance = max(
+            p.EFFECTIVE_BALANCE_INCREMENT,
+            int(self.reg.effective_balance[self.active_cur].sum()),
+        )
+        # eligible = active_prev | (slashed & prev+1 < withdrawable)
+        self.eligible = self.active_prev | (
+            self.reg.slashed
+            & (self.previous_epoch + 1 < self.reg.withdrawable_epoch)
+        )
+        self.finality_delay = (
+            self.previous_epoch - state.finalized_checkpoint.epoch
+        )
+        self.is_in_inactivity_leak = (
+            self.finality_delay > p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+        )
+
+    def write_balances(self, state) -> None:
+        state.balances[:] = [int(b) for b in self.balances]
+
+
+# ---------------------------------------------------------------------------
+# Participation extraction
+# ---------------------------------------------------------------------------
+
+
+def _participation_arrays(state):
+    prev = np.fromiter(
+        state.previous_epoch_participation, np.uint8, len(state.validators)
+    )
+    cur = np.fromiter(
+        state.current_epoch_participation, np.uint8, len(state.validators)
+    )
+    return prev, cur
+
+
+def _unslashed_participating(cache, participation, flag_index):
+    return (
+        cache.active_prev
+        & ~cache.reg.slashed
+        & ((participation >> flag_index) & 1).astype(bool)
+    )
+
+
+def _phase0_attesting_masks(cache, state):
+    """Boolean masks over validators for phase0 matching source/target/
+    head of the PREVIOUS epoch, plus per-validator best inclusion
+    (delay, proposer) for the inclusion-delay reward. Memoized on the
+    cache — both justification and rewards need it."""
+    if hasattr(cache, "_phase0_masks"):
+        return cache._phase0_masks
+    n = cache.n
+    src = np.zeros(n, bool)
+    tgt = np.zeros(n, bool)
+    head = np.zeros(n, bool)
+    best_delay = np.full(n, np.iinfo(np.int64).max, np.int64)
+    best_proposer = np.full(n, -1, np.int64)
+
+    shuffling = EpochShuffling(state, cache.previous_epoch)
+    target_root = get_block_root(state, cache.previous_epoch)
+    for att in state.previous_epoch_attestations:
+        data = att.data
+        committee = shuffling.committee(data.slot, data.index)
+        bits = np.asarray(att.aggregation_bits, bool)
+        attesters = committee[bits[: len(committee)]]
+        src[attesters] = True
+        better = att.inclusion_delay < best_delay[attesters]
+        upd = attesters[better]
+        best_delay[upd] = att.inclusion_delay
+        best_proposer[upd] = att.proposer_index
+        if data.target.root == target_root:
+            tgt[attesters] = True
+            try:
+                head_root = util.get_block_root_at_slot(state, data.slot)
+            except ValueError:
+                head_root = None
+            if head_root is not None and data.beacon_block_root == head_root:
+                head[attesters] = True
+    cache._phase0_masks = (src, tgt, head, best_delay, best_proposer)
+    return cache._phase0_masks
+
+
+# ---------------------------------------------------------------------------
+# Justification & finalization
+# ---------------------------------------------------------------------------
+
+
+def _weigh_justification_and_finalization(
+    state, total_active, prev_target, cur_target, types
+):
+    previous_epoch = get_previous_epoch(state)
+    current_epoch = get_current_epoch(state)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+    Checkpoint = types.Checkpoint
+
+    state.previous_justified_checkpoint = old_cur_justified
+    bits = list(state.justification_bits)
+    bits = [False] + bits[: JUSTIFICATION_BITS_LENGTH - 1]
+    if prev_target * 3 >= total_active * 2:
+        cp = Checkpoint.default()
+        cp.epoch = previous_epoch
+        cp.root = get_block_root(state, previous_epoch)
+        state.current_justified_checkpoint = cp
+        bits[1] = True
+    if cur_target * 3 >= total_active * 2:
+        cp = Checkpoint.default()
+        cp.epoch = current_epoch
+        cp.root = get_block_root(state, current_epoch)
+        state.current_justified_checkpoint = cp
+        bits[0] = True
+    state.justification_bits = bits
+
+    # finalization rules
+    if all(bits[1:4]) and old_prev_justified.epoch + 3 == current_epoch:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[1:3]) and old_prev_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[0:3]) and old_cur_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_cur_justified
+    if all(bits[0:2]) and old_cur_justified.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_cur_justified
+
+
+def process_justification_and_finalization(cache, state, types) -> None:
+    if cache.current_epoch <= GENESIS_EPOCH + 1:
+        return
+    eb = cache.reg.effective_balance
+    p = preset()
+    if cache.fork_seq >= ForkSeq.altair:
+        prev_part, cur_part = _participation_arrays(state)
+        prev_mask = _unslashed_participating(
+            cache, prev_part, TIMELY_TARGET_FLAG_INDEX
+        )
+        cur_mask = (
+            cache.active_cur
+            & ~cache.reg.slashed
+            & ((cur_part >> TIMELY_TARGET_FLAG_INDEX) & 1).astype(bool)
+        )
+        prev_target = max(
+            p.EFFECTIVE_BALANCE_INCREMENT, int(eb[prev_mask].sum())
+        )
+        cur_target = max(
+            p.EFFECTIVE_BALANCE_INCREMENT, int(eb[cur_mask].sum())
+        )
+    else:
+        src, tgt, head, _, _ = _phase0_attesting_masks(cache, state)
+        prev_target = max(
+            p.EFFECTIVE_BALANCE_INCREMENT,
+            int(eb[tgt & ~cache.reg.slashed].sum()),
+        )
+        # current-epoch target attesters
+        cur_tgt = np.zeros(cache.n, bool)
+        shuffling = EpochShuffling(state, cache.current_epoch)
+        cur_target_root = get_block_root(state, cache.current_epoch)
+        for att in state.current_epoch_attestations:
+            if att.data.target.root != cur_target_root:
+                continue
+            committee = shuffling.committee(att.data.slot, att.data.index)
+            bits = np.asarray(att.aggregation_bits, bool)
+            cur_tgt[committee[bits[: len(committee)]]] = True
+        cur_target = max(
+            p.EFFECTIVE_BALANCE_INCREMENT,
+            int(eb[cur_tgt & ~cache.reg.slashed].sum()),
+        )
+    _weigh_justification_and_finalization(
+        state, cache.total_active_balance, prev_target, cur_target, types
+    )
+
+
+# ---------------------------------------------------------------------------
+# Inactivity scores (altair+)
+# ---------------------------------------------------------------------------
+
+
+def process_inactivity_updates(cache, state) -> None:
+    if cache.current_epoch == GENESIS_EPOCH:
+        return
+    cfg = cache.cfg
+    n = cache.n
+    scores = np.fromiter(state.inactivity_scores, np.int64, n)
+    prev_part, _ = _participation_arrays(state)
+    target_mask = _unslashed_participating(
+        cache, prev_part, TIMELY_TARGET_FLAG_INDEX
+    )
+    el = cache.eligible
+    scores = np.where(
+        el & target_mask, scores - np.minimum(1, scores), scores
+    )
+    scores = np.where(
+        el & ~target_mask, scores + cfg.INACTIVITY_SCORE_BIAS, scores
+    )
+    if not cache.is_in_inactivity_leak:
+        scores = np.where(
+            el,
+            scores - np.minimum(cfg.INACTIVITY_SCORE_RECOVERY_RATE, scores),
+            scores,
+        )
+    state.inactivity_scores[:] = [int(s) for s in scores]
+
+
+# ---------------------------------------------------------------------------
+# Rewards & penalties
+# ---------------------------------------------------------------------------
+
+
+def _inactivity_penalty_quotient(fork_seq: int) -> int:
+    p = preset()
+    if fork_seq >= ForkSeq.bellatrix:
+        return p.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    if fork_seq >= ForkSeq.altair:
+        return p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    return p.INACTIVITY_PENALTY_QUOTIENT
+
+
+def process_rewards_and_penalties(cache, state) -> None:
+    if cache.current_epoch == GENESIS_EPOCH:
+        return
+    if cache.fork_seq >= ForkSeq.altair:
+        rewards, penalties = _altair_deltas(cache, state)
+    else:
+        rewards, penalties = _phase0_deltas(cache, state)
+    cache.balances = np.maximum(0, cache.balances + rewards - penalties)
+    cache.write_balances(state)
+
+
+def _altair_deltas(cache, state):
+    p = preset()
+    n = cache.n
+    eb = cache.reg.effective_balance
+    increments = eb // p.EFFECTIVE_BALANCE_INCREMENT
+    base_reward_per_increment = (
+        p.EFFECTIVE_BALANCE_INCREMENT
+        * p.BASE_REWARD_FACTOR
+        // integer_squareroot(cache.total_active_balance)
+    )
+    base_reward = increments * base_reward_per_increment
+    active_increments = (
+        cache.total_active_balance // p.EFFECTIVE_BALANCE_INCREMENT
+    )
+    prev_part, _ = _participation_arrays(state)
+    rewards = np.zeros(n, np.int64)
+    penalties = np.zeros(n, np.int64)
+    el = cache.eligible
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        mask = _unslashed_participating(cache, prev_part, flag_index)
+        participating_increments = int(increments[mask].sum())
+        if not cache.is_in_inactivity_leak:
+            reward = (
+                base_reward * weight * participating_increments
+                // (active_increments * WEIGHT_DENOMINATOR)
+            )
+            rewards += np.where(el & mask, reward, 0)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties += np.where(
+                el & ~mask, base_reward * weight // WEIGHT_DENOMINATOR, 0
+            )
+    # inactivity penalties
+    target_mask = _unslashed_participating(
+        cache, prev_part, TIMELY_TARGET_FLAG_INDEX
+    )
+    scores = np.fromiter(state.inactivity_scores, np.int64, n)
+    quotient = (
+        cache.cfg.INACTIVITY_SCORE_BIAS
+        * _inactivity_penalty_quotient(cache.fork_seq)
+    )
+    penalties += np.where(el & ~target_mask, eb * scores // quotient, 0)
+    return rewards, penalties
+
+
+def _phase0_deltas(cache, state):
+    p = preset()
+    n = cache.n
+    eb = cache.reg.effective_balance
+    total = cache.total_active_balance
+    sqrt_total = integer_squareroot(total)
+    base_reward = (
+        eb * p.BASE_REWARD_FACTOR // sqrt_total // BASE_REWARDS_PER_EPOCH
+    )
+    proposer_reward = base_reward // p.PROPOSER_REWARD_QUOTIENT
+
+    src, tgt, head, best_delay, best_proposer = _phase0_attesting_masks(
+        cache, state
+    )
+    unsl = ~cache.reg.slashed
+    src, tgt, head = src & unsl, tgt & unsl, head & unsl
+    el = cache.eligible
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    total_increments = total // increment
+
+    rewards = np.zeros(n, np.int64)
+    penalties = np.zeros(n, np.int64)
+    for mask in (src, tgt, head):
+        attesting_balance = max(increment, int(eb[mask].sum()))
+        attesting_increments = attesting_balance // increment
+        if cache.is_in_inactivity_leak:
+            rewards += np.where(el & mask, base_reward, 0)
+        else:
+            rewards += np.where(
+                el & mask,
+                base_reward * attesting_increments // total_increments,
+                0,
+            )
+        penalties += np.where(el & ~mask, base_reward, 0)
+
+    # inclusion-delay rewards (proposer + attester), source attesters only
+    max_attester_reward = base_reward - proposer_reward
+    for i in np.nonzero(src)[0]:
+        d = int(best_delay[i])
+        if d == np.iinfo(np.int64).max:
+            continue
+        rewards[int(best_proposer[i])] += int(proposer_reward[i])
+        rewards[i] += int(max_attester_reward[i]) // d
+
+    # inactivity leak quadratic penalties
+    if cache.is_in_inactivity_leak:
+        penalties += np.where(
+            el, BASE_REWARDS_PER_EPOCH * base_reward - proposer_reward, 0
+        )
+        penalties += np.where(
+            el & ~tgt,
+            eb * cache.finality_delay // p.INACTIVITY_PENALTY_QUOTIENT,
+            0,
+        )
+    return rewards, penalties
+
+
+# ---------------------------------------------------------------------------
+# Registry updates
+# ---------------------------------------------------------------------------
+
+
+def process_registry_updates(cache, state) -> None:
+    cfg = cache.cfg
+    p = preset()
+    current_epoch = cache.current_epoch
+    electra = cache.fork_seq >= ForkSeq.electra
+    activation_epoch = compute_activation_exit_epoch(current_epoch)
+
+    for index, v in enumerate(state.validators):
+        if util.is_eligible_for_activation_queue(v, cache.fork_seq):
+            v.activation_eligibility_epoch = current_epoch + 1
+        elif (
+            util.is_active_validator(v, current_epoch)
+            and v.effective_balance <= cfg.EJECTION_BALANCE
+        ):
+            if electra:
+                initiate_validator_exit_electra(cfg, state, index)
+            else:
+                initiate_validator_exit(cfg, state, index)
+        if electra and util.is_eligible_for_activation(state, v):
+            v.activation_epoch = activation_epoch
+
+    if not electra:
+        queue = sorted(
+            (
+                i
+                for i, v in enumerate(state.validators)
+                if util.is_eligible_for_activation(state, v)
+            ),
+            key=lambda i: (
+                state.validators[i].activation_eligibility_epoch,
+                i,
+            ),
+        )
+        if cache.fork_seq >= ForkSeq.deneb:
+            churn = util.get_validator_activation_churn_limit(cfg, state)
+        else:
+            churn = util.get_validator_churn_limit(cfg, state)
+        for i in queue[:churn]:
+            state.validators[i].activation_epoch = activation_epoch
+
+
+# ---------------------------------------------------------------------------
+# Slashings
+# ---------------------------------------------------------------------------
+
+
+def process_slashings(cache, state) -> None:
+    p = preset()
+    epoch = cache.current_epoch
+    total = cache.total_active_balance
+    if cache.fork_seq >= ForkSeq.bellatrix:
+        multiplier = p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    elif cache.fork_seq >= ForkSeq.altair:
+        multiplier = p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    else:
+        multiplier = p.PROPORTIONAL_SLASHING_MULTIPLIER
+    adjusted = min(sum(state.slashings) * multiplier, total)
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+
+    target_epoch = epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    mask = cache.reg.slashed & (cache.reg.withdrawable_epoch == target_epoch)
+    idxs = np.nonzero(mask)[0]
+    if cache.fork_seq >= ForkSeq.electra:
+        penalty_per_increment = adjusted // (total // increment)
+        for i in idxs:
+            eff_increments = int(cache.reg.effective_balance[i]) // increment
+            penalty = eff_increments * penalty_per_increment
+            util.decrease_balance(state, int(i), penalty)
+    else:
+        for i in idxs:
+            numerator = (
+                int(cache.reg.effective_balance[i]) // increment * adjusted
+            )
+            penalty = numerator // total * increment
+            util.decrease_balance(state, int(i), penalty)
+    if len(idxs):
+        cache.balances = np.fromiter(state.balances, np.int64, cache.n)
+
+
+# ---------------------------------------------------------------------------
+# Electra: pending deposits / consolidations
+# ---------------------------------------------------------------------------
+
+
+def process_pending_deposits(cache, state, types) -> None:
+    from .block import add_validator_to_registry, is_valid_deposit_signature
+
+    cfg = cache.cfg
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    available = state.deposit_balance_to_consume + util.get_activation_exit_churn_limit(
+        cfg, state
+    )
+    processed_amount = 0
+    next_deposit_index = 0
+    postponed = []
+    churn_reached = False
+    finalized_slot = compute_start_slot_at_epoch(
+        state.finalized_checkpoint.epoch
+    )
+    pubkey2index = {
+        bytes(v.pubkey): i for i, v in enumerate(state.validators)
+    }
+
+    for dep in state.pending_deposits:
+        if (
+            dep.slot > GENESIS_SLOT
+            and state.eth1_deposit_index < state.deposit_requests_start_index
+        ):
+            break
+        if dep.slot > finalized_slot:
+            break
+        if next_deposit_index >= p.MAX_PENDING_DEPOSITS_PER_EPOCH:
+            break
+        idx = pubkey2index.get(bytes(dep.pubkey))
+        is_exited = False
+        is_withdrawn = False
+        if idx is not None:
+            v = state.validators[idx]
+            is_exited = v.exit_epoch < FAR_FUTURE_EPOCH
+            is_withdrawn = v.withdrawable_epoch < next_epoch
+        if is_withdrawn:
+            _apply_pending_deposit(cfg, state, dep, pubkey2index, types)
+        elif is_exited:
+            postponed.append(dep)
+        else:
+            churn_reached = processed_amount + dep.amount > available
+            if churn_reached:
+                break
+            processed_amount += dep.amount
+            _apply_pending_deposit(cfg, state, dep, pubkey2index, types)
+        next_deposit_index += 1
+
+    state.pending_deposits = (
+        list(state.pending_deposits[next_deposit_index:]) + postponed
+    )
+    state.deposit_balance_to_consume = (
+        available - processed_amount if churn_reached else 0
+    )
+
+
+def _apply_pending_deposit(cfg, state, dep, pubkey2index, types) -> None:
+    from .block import add_validator_to_registry, is_valid_deposit_signature
+
+    idx = pubkey2index.get(bytes(dep.pubkey))
+    if idx is None:
+        if is_valid_deposit_signature(
+            cfg,
+            dep.pubkey,
+            dep.withdrawal_credentials,
+            dep.amount,
+            dep.signature,
+            types,
+        ):
+            add_validator_to_registry(
+                cfg,
+                state,
+                dep.pubkey,
+                dep.withdrawal_credentials,
+                dep.amount,
+                types,
+                fork_seq=ForkSeq.electra,
+            )
+            pubkey2index[bytes(dep.pubkey)] = len(state.validators) - 1
+    else:
+        increase_balance(state, idx, dep.amount)
+
+
+def process_pending_consolidations(cache, state) -> None:
+    next_epoch = cache.current_epoch + 1
+    done = 0
+    for pc in state.pending_consolidations:
+        source = state.validators[pc.source_index]
+        if source.slashed:
+            done += 1
+            continue
+        if source.withdrawable_epoch > next_epoch:
+            break
+        amount = min(
+            state.balances[pc.source_index], source.effective_balance
+        )
+        util.decrease_balance(state, pc.source_index, amount)
+        increase_balance(state, pc.target_index, amount)
+        done += 1
+    state.pending_consolidations = list(state.pending_consolidations[done:])
+
+
+# ---------------------------------------------------------------------------
+# Final housekeeping steps
+# ---------------------------------------------------------------------------
+
+
+def process_eth1_data_reset(cache, state) -> None:
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    if next_epoch % p.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(cache, state) -> None:
+    from .block import has_compounding_withdrawal_credential
+
+    p = preset()
+    hysteresis_increment = (
+        p.EFFECTIVE_BALANCE_INCREMENT // p.HYSTERESIS_QUOTIENT
+    )
+    down = hysteresis_increment * p.HYSTERESIS_DOWNWARD_MULTIPLIER
+    up = hysteresis_increment * p.HYSTERESIS_UPWARD_MULTIPLIER
+    electra = cache.fork_seq >= ForkSeq.electra
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        if electra:
+            max_eb = (
+                p.MAX_EFFECTIVE_BALANCE_ELECTRA
+                if has_compounding_withdrawal_credential(
+                    v.withdrawal_credentials
+                )
+                else p.MIN_ACTIVATION_BALANCE
+            )
+        else:
+            max_eb = p.MAX_EFFECTIVE_BALANCE
+        if (
+            balance + down < v.effective_balance
+            or v.effective_balance + up < balance
+        ):
+            v.effective_balance = min(
+                balance - balance % p.EFFECTIVE_BALANCE_INCREMENT, max_eb
+            )
+
+
+def process_slashings_reset(cache, state) -> None:
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    state.slashings[next_epoch % p.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(cache, state) -> None:
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    state.randao_mixes[next_epoch % p.EPOCHS_PER_HISTORICAL_VECTOR] = (
+        get_randao_mix(state, cache.current_epoch)
+    )
+
+
+def process_historical_roots_update(cache, state, types) -> None:
+    """phase0..bellatrix: append HistoricalBatch root."""
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    if next_epoch % (p.SLOTS_PER_HISTORICAL_ROOT // p.SLOTS_PER_EPOCH) == 0:
+        batch = types.HistoricalBatch.default()
+        batch.block_roots = list(state.block_roots)
+        batch.state_roots = list(state.state_roots)
+        state.historical_roots.append(
+            types.HistoricalBatch.hash_tree_root(batch)
+        )
+
+
+def process_historical_summaries_update(cache, state, types) -> None:
+    """capella+: append HistoricalSummary (detached roots, EIP-4895)."""
+    from ..ssz import VectorType, Root
+
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    if next_epoch % (p.SLOTS_PER_HISTORICAL_ROOT // p.SLOTS_PER_EPOCH) == 0:
+        roots_t = VectorType(Root, p.SLOTS_PER_HISTORICAL_ROOT)
+        summary = types.HistoricalSummary.default()
+        summary.block_summary_root = roots_t.hash_tree_root(
+            list(state.block_roots)
+        )
+        summary.state_summary_root = roots_t.hash_tree_root(
+            list(state.state_roots)
+        )
+        state.historical_summaries.append(summary)
+
+
+def process_participation_record_updates(cache, state) -> None:
+    state.previous_epoch_attestations = list(
+        state.current_epoch_attestations
+    )
+    state.current_epoch_attestations = []
+
+
+def process_participation_flag_updates(cache, state) -> None:
+    state.previous_epoch_participation = list(
+        state.current_epoch_participation
+    )
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+def process_sync_committee_updates(cache, state, types) -> None:
+    from ..crypto.bls.signature import aggregate_pubkeys
+
+    p = preset()
+    next_epoch = cache.current_epoch + 1
+    if next_epoch % p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD != 0:
+        return
+    state.current_sync_committee = state.next_sync_committee
+    indices = util.get_next_sync_committee_indices(
+        state, electra=cache.fork_seq >= ForkSeq.electra
+    )
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    sc = types.SyncCommittee.default()
+    sc.pubkeys = pubkeys
+    sc.aggregate_pubkey = aggregate_pubkeys(pubkeys)
+    state.next_sync_committee = sc
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+
+def process_epoch(cfg, state, types, fork_seq: int) -> None:
+    """Run the full epoch transition for the given fork's state."""
+    cache = EpochTransitionCache(cfg, state, fork_seq)
+    process_justification_and_finalization(cache, state, types)
+    if fork_seq >= ForkSeq.altair:
+        process_inactivity_updates(cache, state)
+    process_rewards_and_penalties(cache, state)
+    process_registry_updates(cache, state)
+    process_slashings(cache, state)
+    process_eth1_data_reset(cache, state)
+    if fork_seq >= ForkSeq.electra:
+        process_pending_deposits(cache, state, types)
+        process_pending_consolidations(cache, state)
+    process_effective_balance_updates(cache, state)
+    process_slashings_reset(cache, state)
+    process_randao_mixes_reset(cache, state)
+    if fork_seq >= ForkSeq.capella:
+        process_historical_summaries_update(cache, state, types)
+    else:
+        process_historical_roots_update(cache, state, types)
+    if fork_seq >= ForkSeq.altair:
+        process_participation_flag_updates(cache, state)
+        process_sync_committee_updates(cache, state, types)
+    else:
+        process_participation_record_updates(cache, state)

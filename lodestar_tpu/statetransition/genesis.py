@@ -1,0 +1,159 @@
+"""Genesis state construction: spec eth1-deposit genesis + interop.
+
+Reference analog: GenesisBuilder (beacon-node/src/chain/genesis/
+genesis.ts:40) for the deposit path, and the interop/dev genesis used
+by `lodestar dev` (cli/src/cmds/dev/, beacon-node interop state
+utilities). Interop keys follow the EF interop spec: sk_i =
+int(sha256(uint256_le(i))) mod r.
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+from ..crypto.bls.fields import R as CURVE_ORDER
+from ..crypto.bls.signature import sk_to_pk
+from ..params import (
+    BLS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    ForkSeq,
+    preset,
+)
+from .slot import BeaconStateView, fork_at_epoch
+from .util import get_next_sync_committee_indices
+
+
+def interop_secret_key(index: int) -> int:
+    h = sha256(index.to_bytes(32, "little")).digest()
+    return int.from_bytes(h, "little") % CURVE_ORDER
+
+
+def interop_pubkeys(n: int) -> list[bytes]:
+    return [sk_to_pk(interop_secret_key(i)) for i in range(n)]
+
+
+def bls_withdrawal_credentials(pubkey: bytes) -> bytes:
+    return BLS_WITHDRAWAL_PREFIX + sha256(pubkey).digest()[1:]
+
+
+def create_interop_genesis_state(
+    cfg,
+    types,
+    n_validators: int,
+    genesis_time: int = 0,
+    eth1_block_hash: bytes = b"\x42" * 32,
+    fork: str | None = None,
+    pubkeys: list[bytes] | None = None,
+):
+    """Deterministic pre-activated genesis state at the configured
+    genesis fork (or an explicit one), for dev chains and tests."""
+    p = preset()
+    if fork is None:
+        fork = fork_at_epoch(cfg, GENESIS_EPOCH)
+    fork_seq = int(ForkSeq[fork])
+    ns = types.by_fork[fork]
+    state = ns.BeaconState.default()
+
+    state.genesis_time = genesis_time
+    f = types.Fork.default()
+    # genesis states start at the genesis fork's version pair
+    versions = {
+        "phase0": (cfg.GENESIS_FORK_VERSION, cfg.GENESIS_FORK_VERSION),
+        "altair": (cfg.GENESIS_FORK_VERSION, cfg.ALTAIR_FORK_VERSION),
+        "bellatrix": (cfg.ALTAIR_FORK_VERSION, cfg.BELLATRIX_FORK_VERSION),
+        "capella": (cfg.BELLATRIX_FORK_VERSION, cfg.CAPELLA_FORK_VERSION),
+        "deneb": (cfg.CAPELLA_FORK_VERSION, cfg.DENEB_FORK_VERSION),
+        "electra": (cfg.DENEB_FORK_VERSION, cfg.ELECTRA_FORK_VERSION),
+    }
+    f.previous_version, f.current_version = versions[fork]
+    f.epoch = GENESIS_EPOCH
+    state.fork = f
+
+    if pubkeys is None:
+        pubkeys = interop_pubkeys(n_validators)
+    for pk in pubkeys:
+        v = types.Validator.default()
+        v.pubkey = pk
+        v.withdrawal_credentials = bls_withdrawal_credentials(pk)
+        v.effective_balance = p.MAX_EFFECTIVE_BALANCE
+        v.slashed = False
+        v.activation_eligibility_epoch = GENESIS_EPOCH
+        v.activation_epoch = GENESIS_EPOCH
+        v.exit_epoch = FAR_FUTURE_EPOCH
+        v.withdrawable_epoch = FAR_FUTURE_EPOCH
+        state.validators.append(v)
+        state.balances.append(p.MAX_EFFECTIVE_BALANCE)
+
+    state.randao_mixes = [eth1_block_hash] * p.EPOCHS_PER_HISTORICAL_VECTOR
+    eth1 = types.Eth1Data.default()
+    eth1.block_hash = eth1_block_hash
+    eth1.deposit_count = len(pubkeys)
+    state.eth1_data = eth1
+    state.eth1_deposit_index = len(pubkeys)
+
+    header = types.BeaconBlockHeader.default()
+    header.body_root = ns.BeaconBlockBody.hash_tree_root(
+        ns.BeaconBlockBody.default()
+    )
+    state.latest_block_header = header
+
+    from ..ssz import ListType
+
+    validators_t = ListType(types.Validator, p.VALIDATOR_REGISTRY_LIMIT)
+    state.genesis_validators_root = validators_t.hash_tree_root(
+        list(state.validators)
+    )
+
+    if fork_seq >= ForkSeq.altair:
+        n = len(pubkeys)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+        _set_genesis_sync_committees(state, types, fork_seq)
+    if fork_seq >= ForkSeq.bellatrix:
+        # latest_execution_payload_header: pretend-merged genesis with
+        # the eth1 block as terminal block (dev-chain convention)
+        hdr = ns.ExecutionPayloadHeader.default()
+        hdr.block_hash = eth1_block_hash
+        state.latest_execution_payload_header = hdr
+    if fork_seq >= ForkSeq.electra:
+        from .block import UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+        state.deposit_requests_start_index = (
+            UNSET_DEPOSIT_REQUESTS_START_INDEX
+        )
+        state.earliest_exit_epoch = GENESIS_EPOCH + 1
+        from .util import (
+            compute_activation_exit_epoch,
+            get_activation_exit_churn_limit,
+            get_consolidation_churn_limit,
+        )
+
+        state.exit_balance_to_consume = get_activation_exit_churn_limit(
+            cfg, state
+        )
+        state.consolidation_balance_to_consume = (
+            get_consolidation_churn_limit(cfg, state)
+        )
+        state.earliest_consolidation_epoch = compute_activation_exit_epoch(
+            GENESIS_EPOCH
+        )
+    return BeaconStateView(state=state, fork=fork)
+
+
+def _set_genesis_sync_committees(state, types, fork_seq) -> None:
+    from ..crypto.bls.signature import aggregate_pubkeys
+
+    indices = get_next_sync_committee_indices(
+        state, electra=fork_seq >= ForkSeq.electra
+    )
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    sc = types.SyncCommittee.default()
+    sc.pubkeys = pubkeys
+    sc.aggregate_pubkey = aggregate_pubkeys(pubkeys)
+    state.current_sync_committee = sc
+    sc2 = types.SyncCommittee.default()
+    sc2.pubkeys = list(pubkeys)
+    sc2.aggregate_pubkey = aggregate_pubkeys(pubkeys)
+    state.next_sync_committee = sc2

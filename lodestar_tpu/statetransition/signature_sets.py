@@ -1,0 +1,228 @@
+"""Extract every signature set of a signed block for batch verification.
+
+Reference analog: getBlockSignatureSets
+(state-transition/src/signatureSets/index.ts:26) and its per-operation
+extractors (proposer, randao, attestations, slashings, exits, sync
+committee, blsToExecutionChange). Block import runs the state
+transition with signature checks off and ships these sets to the TPU
+verifier pool instead (chain/blocks/verifyBlocksSignatures.ts:18-77).
+"""
+
+from __future__ import annotations
+
+from ..bls.api import SignatureSet
+from ..config.beacon_config import compute_domain
+from ..crypto.bls.signature import aggregate_pubkeys
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_VOLUNTARY_EXIT,
+    ForkSeq,
+    preset,
+)
+from ..ssz import uint64 as ssz_uint64
+from .block import BlockCtx, compute_signing_root, get_domain
+from .util import compute_epoch_at_slot, get_block_root_at_slot, get_current_epoch
+
+
+def proposer_signature_set(cfg, view, signed_block, types) -> SignatureSet:
+    state = view.state
+    block = signed_block.message
+    proposer = state.validators[block.proposer_index]
+    domain = get_domain(cfg, state, DOMAIN_BEACON_PROPOSER)
+    block_t = types.by_fork[view.fork].BeaconBlock
+    root = compute_signing_root(block_t, block, domain)
+    return SignatureSet(
+        bytes(proposer.pubkey), root, bytes(signed_block.signature)
+    )
+
+
+def randao_signature_set(cfg, view, block, types) -> SignatureSet:
+    state = view.state
+    proposer = state.validators[block.proposer_index]
+    epoch = get_current_epoch(state)
+    domain = get_domain(cfg, state, DOMAIN_RANDAO)
+    root = compute_signing_root(ssz_uint64, epoch, domain)
+    return SignatureSet(
+        bytes(proposer.pubkey), root, bytes(block.body.randao_reveal)
+    )
+
+
+def attestation_signature_sets(cfg, view, block, types) -> list[SignatureSet]:
+    from .block import get_attesting_indices
+
+    state = view.state
+    ctx = BlockCtx(cfg, state, types, view.fork_seq, False)
+    out = []
+    for att in block.body.attestations:
+        indices = get_attesting_indices(ctx, att)
+        pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+        domain = get_domain(
+            cfg, state, DOMAIN_BEACON_ATTESTER, att.data.target.epoch
+        )
+        root = compute_signing_root(types.AttestationData, att.data, domain)
+        out.append(
+            SignatureSet(
+                aggregate_pubkeys(pubkeys), root, bytes(att.signature)
+            )
+        )
+    return out
+
+
+def proposer_slashing_signature_sets(cfg, view, block, types) -> list[SignatureSet]:
+    state = view.state
+    out = []
+    for ps in block.body.proposer_slashings:
+        proposer = state.validators[
+            ps.signed_header_1.message.proposer_index
+        ]
+        for signed in (ps.signed_header_1, ps.signed_header_2):
+            domain = get_domain(
+                cfg,
+                state,
+                DOMAIN_BEACON_PROPOSER,
+                compute_epoch_at_slot(signed.message.slot),
+            )
+            root = compute_signing_root(
+                types.BeaconBlockHeader, signed.message, domain
+            )
+            out.append(
+                SignatureSet(
+                    bytes(proposer.pubkey), root, bytes(signed.signature)
+                )
+            )
+    return out
+
+
+def attester_slashing_signature_sets(cfg, view, block, types) -> list[SignatureSet]:
+    state = view.state
+    out = []
+    for s in block.body.attester_slashings:
+        for indexed in (s.attestation_1, s.attestation_2):
+            pubkeys = [
+                bytes(state.validators[int(i)].pubkey)
+                for i in indexed.attesting_indices
+            ]
+            domain = get_domain(
+                cfg,
+                state,
+                DOMAIN_BEACON_ATTESTER,
+                indexed.data.target.epoch,
+            )
+            root = compute_signing_root(
+                types.AttestationData, indexed.data, domain
+            )
+            out.append(
+                SignatureSet(
+                    aggregate_pubkeys(pubkeys), root, bytes(indexed.signature)
+                )
+            )
+    return out
+
+
+def voluntary_exit_signature_sets(cfg, view, block, types) -> list[SignatureSet]:
+    state = view.state
+    out = []
+    for signed in block.body.voluntary_exits:
+        v = state.validators[signed.message.validator_index]
+        if view.fork_seq >= ForkSeq.deneb:  # EIP-7044
+            domain = compute_domain(
+                DOMAIN_VOLUNTARY_EXIT,
+                cfg.CAPELLA_FORK_VERSION,
+                state.genesis_validators_root,
+            )
+        else:
+            domain = get_domain(
+                cfg, state, DOMAIN_VOLUNTARY_EXIT, signed.message.epoch
+            )
+        root = compute_signing_root(
+            types.VoluntaryExit, signed.message, domain
+        )
+        out.append(
+            SignatureSet(bytes(v.pubkey), root, bytes(signed.signature))
+        )
+    return out
+
+
+def sync_aggregate_signature_set(cfg, view, block, types) -> SignatureSet | None:
+    from ..config.beacon_config import compute_signing_root_from_roots
+
+    state = view.state
+    sa = block.body.sync_aggregate
+    bits = list(sa.sync_committee_bits)
+    participants = [
+        bytes(pk)
+        for pk, b in zip(state.current_sync_committee.pubkeys, bits)
+        if b
+    ]
+    if not participants:
+        return None
+    previous_slot = max(block.slot, 1) - 1
+    domain = get_domain(
+        cfg, state, DOMAIN_SYNC_COMMITTEE, compute_epoch_at_slot(previous_slot)
+    )
+    root = compute_signing_root_from_roots(
+        get_block_root_at_slot(state, previous_slot), domain
+    )
+    return SignatureSet(
+        aggregate_pubkeys(participants),
+        root,
+        bytes(sa.sync_committee_signature),
+    )
+
+
+def bls_to_execution_change_signature_sets(
+    cfg, view, block, types
+) -> list[SignatureSet]:
+    state = view.state
+    out = []
+    for signed in block.body.bls_to_execution_changes:
+        domain = compute_domain(
+            DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            cfg.GENESIS_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+        root = compute_signing_root(
+            types.BLSToExecutionChange, signed.message, domain
+        )
+        out.append(
+            SignatureSet(
+                bytes(signed.message.from_bls_pubkey),
+                root,
+                bytes(signed.signature),
+            )
+        )
+    return out
+
+
+def get_block_signature_sets(
+    cfg,
+    view,
+    signed_block,
+    types,
+    include_proposer: bool = True,
+) -> list[SignatureSet]:
+    """All signature sets of one signed block, in the reference's order
+    (signatureSets/index.ts:26-60). The state must already be advanced
+    to the block's slot."""
+    block = signed_block.message
+    sets: list[SignatureSet] = []
+    if include_proposer:
+        sets.append(proposer_signature_set(cfg, view, signed_block, types))
+    sets.append(randao_signature_set(cfg, view, block, types))
+    sets.extend(proposer_slashing_signature_sets(cfg, view, block, types))
+    sets.extend(attester_slashing_signature_sets(cfg, view, block, types))
+    sets.extend(attestation_signature_sets(cfg, view, block, types))
+    sets.extend(voluntary_exit_signature_sets(cfg, view, block, types))
+    if view.fork_seq >= ForkSeq.altair:
+        sync_set = sync_aggregate_signature_set(cfg, view, block, types)
+        if sync_set is not None:
+            sets.append(sync_set)
+    if view.fork_seq >= ForkSeq.capella:
+        sets.extend(
+            bls_to_execution_change_signature_sets(cfg, view, block, types)
+        )
+    return sets

@@ -1,0 +1,369 @@
+"""Slot processing, fork upgrades, and the state_transition entry.
+
+Reference analog: packages/state-transition/src/stateTransition.ts:64
+(stateTransition/processSlots) and src/slot/upgradeStateTo*.ts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..config.beacon_config import compute_domain
+from ..params import (
+    DOMAIN_BEACON_PROPOSER,
+    FAR_FUTURE_EPOCH,
+    FORK_ORDER,
+    GENESIS_SLOT,
+    ForkSeq,
+    preset,
+)
+from . import block as blockproc
+from . import epoch as epochproc
+from . import util
+from .block import (
+    G2_POINT_AT_INFINITY,
+    UNSET_DEPOSIT_REQUESTS_START_INDEX,
+    BlockProcessError,
+    _req,
+    compute_signing_root,
+    get_domain,
+    has_compounding_withdrawal_credential,
+)
+
+
+@dataclass
+class BeaconStateView:
+    """A beacon state value + which fork's container type it is.
+
+    Reference analog: CachedBeaconState<F> — the fork is part of the
+    static type there (state-transition/src/cache/stateCache.ts);
+    here it's carried alongside the plain SSZ value.
+    """
+
+    state: object
+    fork: str  # ForkName
+
+    @property
+    def fork_seq(self) -> int:
+        return int(ForkSeq[self.fork])
+
+    def state_type(self, types):
+        return types.by_fork[self.fork].BeaconState
+
+    def hash_tree_root(self, types) -> bytes:
+        return self.state_type(types).hash_tree_root(self.state)
+
+
+def fork_at_epoch(cfg, epoch: int) -> str:
+    """Highest fork active at epoch (config fork schedule)."""
+    name = "phase0"
+    for fork, ep in (
+        ("altair", cfg.ALTAIR_FORK_EPOCH),
+        ("bellatrix", cfg.BELLATRIX_FORK_EPOCH),
+        ("capella", cfg.CAPELLA_FORK_EPOCH),
+        ("deneb", cfg.DENEB_FORK_EPOCH),
+        ("electra", cfg.ELECTRA_FORK_EPOCH),
+    ):
+        if epoch >= ep:
+            name = fork
+    return name
+
+
+def process_slot(cfg, view: BeaconStateView, types) -> None:
+    p = preset()
+    state = view.state
+    prev_state_root = view.hash_tree_root(types)
+    state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
+        prev_state_root
+    )
+    if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+        state.latest_block_header.state_root = prev_state_root
+    prev_block_root = types.BeaconBlockHeader.hash_tree_root(
+        state.latest_block_header
+    )
+    state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
+        prev_block_root
+    )
+
+
+def process_slots(cfg, view: BeaconStateView, slot: int, types) -> None:
+    p = preset()
+    state = view.state
+    if state.slot > slot:
+        raise BlockProcessError(
+            f"cannot rewind state from {state.slot} to {slot}"
+        )
+    while state.slot < slot:
+        process_slot(cfg, view, types)
+        if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
+            epochproc.process_epoch(cfg, state, types, view.fork_seq)
+        state.slot += 1
+        if state.slot % p.SLOTS_PER_EPOCH == 0:
+            epoch = state.slot // p.SLOTS_PER_EPOCH
+            _maybe_upgrade(cfg, view, epoch, types)
+            state = view.state  # upgrades replace the state object
+
+
+def _maybe_upgrade(cfg, view: BeaconStateView, epoch: int, types) -> None:
+    upgrades = {
+        "altair": (cfg.ALTAIR_FORK_EPOCH, upgrade_to_altair),
+        "bellatrix": (cfg.BELLATRIX_FORK_EPOCH, upgrade_to_bellatrix),
+        "capella": (cfg.CAPELLA_FORK_EPOCH, upgrade_to_capella),
+        "deneb": (cfg.DENEB_FORK_EPOCH, upgrade_to_deneb),
+        "electra": (cfg.ELECTRA_FORK_EPOCH, upgrade_to_electra),
+    }
+    for fork, (fork_epoch, fn) in upgrades.items():
+        if epoch == fork_epoch and FORK_ORDER.index(fork) == view.fork_seq + 1:
+            fn(cfg, view, types)
+
+
+# ---------------------------------------------------------------------------
+# Fork upgrades
+# ---------------------------------------------------------------------------
+
+
+def _copy_fields(old_state, new_state) -> None:
+    for name in type(new_state)._type.field_names:
+        if name in type(old_state)._type.field_names:
+            setattr(new_state, name, getattr(old_state, name))
+
+
+def _bump_fork(cfg, state, new_state, version: bytes, types) -> None:
+    f = types.Fork.default()
+    f.previous_version = bytes(state.fork.current_version)
+    f.current_version = version
+    f.epoch = util.get_current_epoch(state)
+    new_state.fork = f
+
+
+def upgrade_to_altair(cfg, view: BeaconStateView, types) -> None:
+    """Reference: state-transition/src/slot/upgradeStateToAltair.ts."""
+    from ..crypto.bls.signature import aggregate_pubkeys
+
+    pre = view.state
+    n = len(pre.validators)
+    post = types.altair.BeaconState.default()
+    _copy_fields(pre, post)
+    _bump_fork(cfg, pre, post, cfg.ALTAIR_FORK_VERSION, types)
+    post.previous_epoch_participation = [0] * n
+    post.current_epoch_participation = [0] * n
+    post.inactivity_scores = [0] * n
+    view.state = post
+    view.fork = "altair"
+
+    # translate_participation over pre.previous_epoch_attestations
+    ctx = blockproc.BlockCtx(cfg, post, types, ForkSeq.altair, False)
+    for att in pre.previous_epoch_attestations:
+        try:
+            flags = blockproc.get_attestation_participation_flag_indices(
+                ctx, att.data, att.inclusion_delay
+            )
+        except BlockProcessError:
+            continue
+        shuffling = ctx.shuffling(att.data.target.epoch)
+        committee = shuffling.committee(att.data.slot, att.data.index)
+        bits = list(att.aggregation_bits)
+        for i, v in enumerate(committee):
+            if bits[i]:
+                for flag in flags:
+                    post.previous_epoch_participation[int(v)] = util.add_flag(
+                        post.previous_epoch_participation[int(v)], flag
+                    )
+
+    indices = util.get_next_sync_committee_indices(post)
+    pubkeys = [bytes(post.validators[i].pubkey) for i in indices]
+    sc = types.SyncCommittee.default()
+    sc.pubkeys = pubkeys
+    sc.aggregate_pubkey = aggregate_pubkeys(pubkeys)
+    post.current_sync_committee = sc
+    indices = util.get_next_sync_committee_indices(post)
+    pubkeys = [bytes(post.validators[i].pubkey) for i in indices]
+    sc2 = types.SyncCommittee.default()
+    sc2.pubkeys = pubkeys
+    sc2.aggregate_pubkey = aggregate_pubkeys(pubkeys)
+    post.next_sync_committee = sc2
+
+
+def upgrade_to_bellatrix(cfg, view: BeaconStateView, types) -> None:
+    pre = view.state
+    post = types.bellatrix.BeaconState.default()
+    _copy_fields(pre, post)
+    _bump_fork(cfg, pre, post, cfg.BELLATRIX_FORK_VERSION, types)
+    post.latest_execution_payload_header = (
+        types.bellatrix.ExecutionPayloadHeader.default()
+    )
+    view.state = post
+    view.fork = "bellatrix"
+
+
+def upgrade_to_capella(cfg, view: BeaconStateView, types) -> None:
+    pre = view.state
+    post = types.capella.BeaconState.default()
+    _copy_fields(pre, post)
+    _bump_fork(cfg, pre, post, cfg.CAPELLA_FORK_VERSION, types)
+    old = pre.latest_execution_payload_header
+    hdr = types.capella.ExecutionPayloadHeader.default()
+    for name, _ in types.bellatrix.ExecutionPayloadHeader.fields:
+        setattr(hdr, name, getattr(old, name))
+    post.latest_execution_payload_header = hdr
+    post.next_withdrawal_index = 0
+    post.next_withdrawal_validator_index = 0
+    post.historical_summaries = []
+    view.state = post
+    view.fork = "capella"
+
+
+def upgrade_to_deneb(cfg, view: BeaconStateView, types) -> None:
+    pre = view.state
+    post = types.deneb.BeaconState.default()
+    _copy_fields(pre, post)
+    _bump_fork(cfg, pre, post, cfg.DENEB_FORK_VERSION, types)
+    old = pre.latest_execution_payload_header
+    hdr = types.deneb.ExecutionPayloadHeader.default()
+    for name, _ in types.capella.ExecutionPayloadHeader.fields:
+        setattr(hdr, name, getattr(old, name))
+    hdr.blob_gas_used = 0
+    hdr.excess_blob_gas = 0
+    post.latest_execution_payload_header = hdr
+    view.state = post
+    view.fork = "deneb"
+
+
+def upgrade_to_electra(cfg, view: BeaconStateView, types) -> None:
+    pre = view.state
+    post = types.electra.BeaconState.default()
+    _copy_fields(pre, post)
+    _bump_fork(cfg, pre, post, cfg.ELECTRA_FORK_VERSION, types)
+    cur = util.get_current_epoch(pre)
+    exit_epochs = [
+        v.exit_epoch
+        for v in post.validators
+        if v.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    post.earliest_exit_epoch = max(exit_epochs + [cur]) + 1
+    post.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
+    post.deposit_balance_to_consume = 0
+    post.exit_balance_to_consume = util.get_activation_exit_churn_limit(
+        cfg, post
+    )
+    post.consolidation_balance_to_consume = util.get_consolidation_churn_limit(
+        cfg, post
+    )
+    post.earliest_consolidation_epoch = util.compute_activation_exit_epoch(
+        cur
+    )
+    post.pending_deposits = []
+    post.pending_partial_withdrawals = []
+    post.pending_consolidations = []
+    view.state = post
+    view.fork = "electra"
+
+    pre_activation = sorted(
+        (
+            i
+            for i, v in enumerate(post.validators)
+            if v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (
+            post.validators[i].activation_eligibility_epoch,
+            i,
+        ),
+    )
+    for i in pre_activation:
+        _queue_entire_balance_and_reset_validator(post, i, types)
+    for i, v in enumerate(post.validators):
+        if has_compounding_withdrawal_credential(
+            bytes(v.withdrawal_credentials)
+        ):
+            _queue_excess_active_balance(post, i, types)
+
+
+def _queue_entire_balance_and_reset_validator(state, index: int, types) -> None:
+    v = state.validators[index]
+    balance = state.balances[index]
+    state.balances[index] = 0
+    v.effective_balance = 0
+    v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+    pd = types.PendingDeposit.default()
+    pd.pubkey = bytes(v.pubkey)
+    pd.withdrawal_credentials = bytes(v.withdrawal_credentials)
+    pd.amount = balance
+    pd.signature = G2_POINT_AT_INFINITY
+    pd.slot = GENESIS_SLOT
+    state.pending_deposits.append(pd)
+
+
+def _queue_excess_active_balance(state, index: int, types) -> None:
+    p = preset()
+    balance = state.balances[index]
+    if balance > p.MIN_ACTIVATION_BALANCE:
+        excess = balance - p.MIN_ACTIVATION_BALANCE
+        state.balances[index] = p.MIN_ACTIVATION_BALANCE
+        v = state.validators[index]
+        pd = types.PendingDeposit.default()
+        pd.pubkey = bytes(v.pubkey)
+        pd.withdrawal_credentials = bytes(v.withdrawal_credentials)
+        pd.amount = excess
+        pd.signature = G2_POINT_AT_INFINITY
+        pd.slot = GENESIS_SLOT
+        state.pending_deposits.append(pd)
+
+
+# ---------------------------------------------------------------------------
+# Full transition
+# ---------------------------------------------------------------------------
+
+
+def verify_block_signature(cfg, view: BeaconStateView, signed_block, types) -> bool:
+    from ..crypto.bls.signature import verify as bls_verify
+
+    state = view.state
+    block = signed_block.message
+    proposer = state.validators[block.proposer_index]
+    domain = get_domain(cfg, state, DOMAIN_BEACON_PROPOSER)
+    block_t = types.by_fork[view.fork].BeaconBlock
+    root = compute_signing_root(block_t, block, domain)
+    return bls_verify(
+        bytes(proposer.pubkey), root, bytes(signed_block.signature)
+    )
+
+
+def state_transition(
+    cfg,
+    view: BeaconStateView,
+    signed_block,
+    types,
+    verify_state_root: bool = True,
+    verify_proposer: bool = True,
+    verify_signatures: bool = True,
+    execution_engine=None,
+) -> BeaconStateView:
+    """Spec state_transition. Mutates and returns `view`.
+
+    Production block import calls this with all verify flags False and
+    batches the extracted signature sets through the TPU verifier
+    instead (reference: verifyBlocksStateTransitionOnly +
+    verifyBlocksSignatures in parallel, chain/blocks/verifyBlock.ts).
+    """
+    block = signed_block.message
+    process_slots(cfg, view, block.slot, types)
+    if verify_proposer:
+        _req(
+            verify_block_signature(cfg, view, signed_block, types),
+            "invalid block signature",
+        )
+    blockproc.process_block(
+        cfg,
+        view.state,
+        block,
+        types,
+        view.fork_seq,
+        verify_signatures=verify_signatures,
+        execution_engine=execution_engine,
+    )
+    if verify_state_root:
+        _req(
+            bytes(block.state_root) == view.hash_tree_root(types),
+            "state root mismatch",
+        )
+    return view

@@ -1,0 +1,582 @@
+"""Spec helper functions for the beacon state transition.
+
+Reference analog: packages/state-transition/src/util/ (epoch.ts,
+seed.ts, shuffle.ts, committee.ts, balance.ts, validator.ts,
+domain.ts, aggregator.ts) following ethereum/consensus-specs
+beacon-chain.md helpers. Per-validator loops are numpy-vectorized —
+the registry is tensor-shaped data (SURVEY.md §7 step 3), which is
+exactly what makes the epoch transition map onto the TPU later.
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+import numpy as np
+
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_SYNC_COMMITTEE,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    preset,
+)
+
+
+def hash32(data: bytes) -> bytes:
+    return sha256(data).digest()
+
+
+def integer_squareroot(n: int) -> int:
+    """Largest x with x*x <= n (spec integer_squareroot)."""
+    import math
+
+    return math.isqrt(n)
+
+
+def uint_to_bytes8(n: int) -> bytes:
+    return int(n).to_bytes(8, "little")
+
+
+# ---------------------------------------------------------------------------
+# Epoch / slot math
+# ---------------------------------------------------------------------------
+
+
+def compute_epoch_at_slot(slot: int) -> int:
+    return slot // preset().SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int) -> int:
+    return epoch * preset().SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int) -> int:
+    return epoch + 1 + preset().MAX_SEED_LOOKAHEAD
+
+
+def get_current_epoch(state) -> int:
+    return compute_epoch_at_slot(state.slot)
+
+
+def get_previous_epoch(state) -> int:
+    cur = get_current_epoch(state)
+    return GENESIS_EPOCH if cur == GENESIS_EPOCH else cur - 1
+
+
+def get_randao_mix(state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % preset().EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_block_root_at_slot(state, slot: int) -> bytes:
+    if not (slot < state.slot <= slot + preset().SLOTS_PER_HISTORICAL_ROOT):
+        raise ValueError(f"slot {slot} out of block_roots window at {state.slot}")
+    return state.block_roots[slot % preset().SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, epoch: int) -> bytes:
+    return get_block_root_at_slot(state, compute_start_slot_at_epoch(epoch))
+
+
+# ---------------------------------------------------------------------------
+# Validator predicates (scalar + vectorized)
+# ---------------------------------------------------------------------------
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_eligible_for_activation_queue(v, fork_seq: int = 0) -> bool:
+    from ..params import ForkSeq
+
+    p = preset()
+    if fork_seq >= ForkSeq.electra:
+        return (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= p.MIN_ACTIVATION_BALANCE
+        )
+    return (
+        v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        and v.effective_balance == p.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def is_eligible_for_activation(state, v) -> bool:
+    return (
+        v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+        and v.activation_epoch == FAR_FUTURE_EPOCH
+    )
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed) and v.activation_epoch <= epoch < v.withdrawable_epoch
+
+
+def get_active_validator_indices(state, epoch: int) -> list[int]:
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if v.activation_epoch <= epoch < v.exit_epoch
+    ]
+
+
+class RegistryArrays:
+    """Struct-of-arrays view of the validator registry — the tensor
+    layout every epoch-processing step operates on (reference keeps
+    effective balances as a flat Uint8Array for the same reason,
+    state-transition/src/cache/effectiveBalanceIncrements.ts)."""
+
+    def __init__(self, state):
+        vals = state.validators
+        n = len(vals)
+        self.n = n
+        self.effective_balance = np.fromiter(
+            (v.effective_balance for v in vals), np.int64, n
+        )
+        self.slashed = np.fromiter((v.slashed for v in vals), np.bool_, n)
+        self.activation_eligibility_epoch = np.fromiter(
+            (min(v.activation_eligibility_epoch, 2**63 - 1) for v in vals),
+            np.int64,
+            n,
+        )
+        self.activation_epoch = np.fromiter(
+            (min(v.activation_epoch, 2**63 - 1) for v in vals), np.int64, n
+        )
+        self.exit_epoch = np.fromiter(
+            (min(v.exit_epoch, 2**63 - 1) for v in vals), np.int64, n
+        )
+        self.withdrawable_epoch = np.fromiter(
+            (min(v.withdrawable_epoch, 2**63 - 1) for v in vals), np.int64, n
+        )
+
+    def is_active(self, epoch: int) -> np.ndarray:
+        return (self.activation_epoch <= epoch) & (epoch < self.exit_epoch)
+
+
+# ---------------------------------------------------------------------------
+# Seeds and shuffling
+# ---------------------------------------------------------------------------
+
+
+def get_seed(state, epoch: int, domain_type: bytes) -> bytes:
+    p = preset()
+    mix = get_randao_mix(
+        state, epoch + p.EPOCHS_PER_HISTORICAL_VECTOR - p.MIN_SEED_LOOKAHEAD - 1
+    )
+    return hash32(domain_type + uint_to_bytes8(epoch) + mix)
+
+
+def compute_shuffled_index(index: int, count: int, seed: bytes) -> int:
+    """Scalar spec swap-or-not (for spot checks); the batch path is
+    compute_shuffling()."""
+    assert index < count
+    p = preset()
+    for r in range(p.SHUFFLE_ROUND_COUNT):
+        pivot = (
+            int.from_bytes(hash32(seed + bytes([r]))[:8], "little") % count
+        )
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = hash32(
+            seed + bytes([r]) + (position // 256).to_bytes(4, "little")
+        )
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) % 2:
+            index = flip
+    return index
+
+
+def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
+    """Vectorized swap-or-not over all indices at once: shuffled[i] is
+    where index i lands (equals compute_shuffled_index(i) for all i).
+
+    Each of the SHUFFLE_ROUND_COUNT rounds is one numpy pass: pivot from
+    the round hash, per-position decision bytes from vectorized SHA-256
+    over the position blocks. Reference analog:
+    @chainsafe/swap-or-not-shuffle native addon (SURVEY.md §2.1) — here
+    the rounds are data-parallel array ops, the natural TPU layout.
+    """
+    if count == 0:
+        return np.zeros(0, np.int64)
+    p = preset()
+    idx = np.arange(count, dtype=np.int64)
+    n_blocks = (count + 255) // 256
+    for r in range(p.SHUFFLE_ROUND_COUNT):
+        rh = hash32(seed + bytes([r]))
+        pivot = int.from_bytes(rh[:8], "little") % count
+        flip = (pivot + count - idx) % count
+        position = np.maximum(idx, flip)
+        # decision bytes for every 256-position block of this round
+        blocks = np.stack(
+            [
+                np.frombuffer(
+                    hash32(seed + bytes([r]) + int(b).to_bytes(4, "little")),
+                    np.uint8,
+                )
+                for b in range(n_blocks)
+            ]
+        )  # (n_blocks, 32)
+        byte = blocks[position // 256, (position % 256) // 8]
+        bit = (byte >> (position % 8).astype(np.uint8)) & 1
+        idx = np.where(bit == 1, flip, idx)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Committees / proposers
+# ---------------------------------------------------------------------------
+
+
+def compute_committee_count_per_slot(active_count: int) -> int:
+    p = preset()
+    return max(
+        1,
+        min(
+            p.MAX_COMMITTEES_PER_SLOT,
+            active_count // p.SLOTS_PER_EPOCH // p.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+def get_committee_count_per_slot(state, epoch: int) -> int:
+    return compute_committee_count_per_slot(
+        len(get_active_validator_indices(state, epoch))
+    )
+
+
+class EpochShuffling:
+    """All committees of one epoch, computed in one shuffle pass.
+
+    Reference analog: EpochShuffling (state-transition/src/util/
+    epochShuffling.ts) cached per epoch in the EpochCache.
+    """
+
+    def __init__(self, state, epoch: int):
+        self.epoch = epoch
+        active = np.asarray(
+            get_active_validator_indices(state, epoch), np.int64
+        )
+        self.active_indices = active
+        seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER)
+        if len(active):
+            # spec compute_committee: position i holds
+            # indices[compute_shuffled_index(i)] — the forward map
+            self.shuffled = active[compute_shuffling(len(active), seed)]
+        else:
+            self.shuffled = active
+        self.committees_per_slot = compute_committee_count_per_slot(
+            len(active)
+        )
+
+    def committees_at_slot(self, slot: int) -> list[np.ndarray]:
+        p = preset()
+        n = len(self.shuffled)
+        per_slot = self.committees_per_slot
+        total = per_slot * p.SLOTS_PER_EPOCH
+        slot_in_epoch = slot % p.SLOTS_PER_EPOCH
+        out = []
+        for i in range(per_slot):
+            ci = slot_in_epoch * per_slot + i
+            start = n * ci // total
+            end = n * (ci + 1) // total
+            out.append(self.shuffled[start:end])
+        return out
+
+    def committee(self, slot: int, index: int) -> np.ndarray:
+        return self.committees_at_slot(slot)[index]
+
+
+def get_beacon_committee(state, slot: int, index: int) -> np.ndarray:
+    epoch = compute_epoch_at_slot(slot)
+    return EpochShuffling(state, epoch).committee(slot, index)
+
+
+MAX_RANDOM_BYTE = 2**8 - 1
+MAX_RANDOM_VALUE_ELECTRA = 2**16 - 1
+
+
+def compute_proposer_index(
+    state, indices, seed: bytes, electra: bool = False
+) -> int:
+    """Spec compute_proposer_index: rejection-sample by effective
+    balance. Pre-electra draws 1 random byte per candidate; electra
+    draws 2 (EIP-7251 raises max effective balance 64x)."""
+    assert len(indices) > 0
+    p = preset()
+    max_eb = (
+        p.MAX_EFFECTIVE_BALANCE_ELECTRA if electra else p.MAX_EFFECTIVE_BALANCE
+    )
+    max_rand = MAX_RANDOM_VALUE_ELECTRA if electra else MAX_RANDOM_BYTE
+    total = len(indices)
+    i = 0
+    while True:
+        candidate = indices[compute_shuffled_index(i % total, total, seed)]
+        pos = i % (16 if electra else 32)
+        source = hash32(seed + uint_to_bytes8(i // (16 if electra else 32)))
+        if electra:
+            rand = int.from_bytes(source[pos * 2 : pos * 2 + 2], "little")
+        else:
+            rand = source[pos]
+        eb = state.validators[int(candidate)].effective_balance
+        if eb * max_rand >= max_eb * rand:
+            return int(candidate)
+        i += 1
+
+
+def get_beacon_proposer_index(state, electra: bool = False) -> int:
+    epoch = get_current_epoch(state)
+    seed = hash32(
+        get_seed(state, epoch, DOMAIN_BEACON_PROPOSER)
+        + uint_to_bytes8(state.slot)
+    )
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed, electra=electra)
+
+
+# ---------------------------------------------------------------------------
+# Sync committee selection (altair)
+# ---------------------------------------------------------------------------
+
+
+def get_next_sync_committee_indices(state, electra: bool = False) -> list[int]:
+    """Spec get_next_sync_committee_indices: seeded rejection sampling
+    over the active set at epoch+1."""
+    p = preset()
+    epoch = get_current_epoch(state) + 1
+    active = get_active_validator_indices(state, epoch)
+    count = len(active)
+    seed = get_seed(state, epoch, DOMAIN_SYNC_COMMITTEE)
+    max_eb = (
+        p.MAX_EFFECTIVE_BALANCE_ELECTRA if electra else p.MAX_EFFECTIVE_BALANCE
+    )
+    max_rand = MAX_RANDOM_VALUE_ELECTRA if electra else MAX_RANDOM_BYTE
+    out: list[int] = []
+    i = 0
+    while len(out) < p.SYNC_COMMITTEE_SIZE:
+        shuffled = compute_shuffled_index(i % count, count, seed)
+        candidate = active[shuffled]
+        pos = i % (16 if electra else 32)
+        source = hash32(seed + uint_to_bytes8(i // (16 if electra else 32)))
+        if electra:
+            rand = int.from_bytes(source[pos * 2 : pos * 2 + 2], "little")
+        else:
+            rand = source[pos]
+        eb = state.validators[candidate].effective_balance
+        if eb * max_rand >= max_eb * rand:
+            out.append(candidate)
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Balances / churn
+# ---------------------------------------------------------------------------
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += int(delta)
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - int(delta))
+
+
+def get_total_balance(state, indices) -> int:
+    p = preset()
+    total = sum(state.validators[int(i)].effective_balance for i in indices)
+    return max(p.EFFECTIVE_BALANCE_INCREMENT, total)
+
+
+def get_total_active_balance(state) -> int:
+    return get_total_balance(
+        state, get_active_validator_indices(state, get_current_epoch(state))
+    )
+
+
+def get_validator_churn_limit(cfg, state) -> int:
+    active = len(get_active_validator_indices(state, get_current_epoch(state)))
+    return max(
+        cfg.MIN_PER_EPOCH_CHURN_LIMIT, active // cfg.CHURN_LIMIT_QUOTIENT
+    )
+
+
+def get_validator_activation_churn_limit(cfg, state) -> int:
+    """Deneb EIP-7514 cap on the activation churn."""
+    return min(
+        cfg.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT,
+        get_validator_churn_limit(cfg, state),
+    )
+
+
+# Electra (EIP-7251) balance-denominated churn
+def get_balance_churn_limit(cfg, state) -> int:
+    p = preset()
+    churn = max(
+        cfg.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA,
+        get_total_active_balance(state) // cfg.CHURN_LIMIT_QUOTIENT,
+    )
+    return churn - churn % p.EFFECTIVE_BALANCE_INCREMENT
+
+
+def get_activation_exit_churn_limit(cfg, state) -> int:
+    return min(
+        cfg.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT,
+        get_balance_churn_limit(cfg, state),
+    )
+
+
+def get_consolidation_churn_limit(cfg, state) -> int:
+    return get_balance_churn_limit(cfg, state) - get_activation_exit_churn_limit(
+        cfg, state
+    )
+
+
+# ---------------------------------------------------------------------------
+# Exits / slashing mechanics
+# ---------------------------------------------------------------------------
+
+
+def initiate_validator_exit(cfg, state, index: int) -> None:
+    """Pre-electra exit queue (count churn)."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        w.exit_epoch
+        for w in state.validators
+        if w.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state))]
+    )
+    exit_queue_churn = sum(
+        1 for w in state.validators if w.exit_epoch == exit_queue_epoch
+    )
+    if exit_queue_churn >= get_validator_churn_limit(cfg, state):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+def compute_exit_epoch_and_update_churn(cfg, state, exit_balance: int) -> int:
+    """Electra balance-churn exit scheduling (EIP-7251)."""
+    earliest = max(
+        state.earliest_exit_epoch,
+        compute_activation_exit_epoch(get_current_epoch(state)),
+    )
+    per_epoch_churn = get_activation_exit_churn_limit(cfg, state)
+    if state.earliest_exit_epoch < earliest:
+        exit_balance_to_consume = per_epoch_churn
+    else:
+        exit_balance_to_consume = state.exit_balance_to_consume
+    if exit_balance > exit_balance_to_consume:
+        balance_to_process = exit_balance - exit_balance_to_consume
+        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest += additional_epochs
+        exit_balance_to_consume += additional_epochs * per_epoch_churn
+    state.exit_balance_to_consume = exit_balance_to_consume - exit_balance
+    state.earliest_exit_epoch = earliest
+    return earliest
+
+
+def initiate_validator_exit_electra(cfg, state, index: int) -> None:
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_queue_epoch = compute_exit_epoch_and_update_churn(
+        cfg, state, v.effective_balance
+    )
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+def slash_validator(
+    cfg, state, slashed_index: int, fork_seq: int, whistleblower_index=None
+) -> None:
+    """Spec slash_validator with per-fork quotients."""
+    from ..params import ForkSeq
+
+    p = preset()
+    epoch = get_current_epoch(state)
+    if fork_seq >= ForkSeq.electra:
+        initiate_validator_exit_electra(cfg, state, slashed_index)
+    else:
+        initiate_validator_exit(cfg, state, slashed_index)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + p.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % p.EPOCHS_PER_SLASHINGS_VECTOR] += (
+        v.effective_balance
+    )
+    if fork_seq >= ForkSeq.electra:
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
+    elif fork_seq >= ForkSeq.bellatrix:
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    elif fork_seq >= ForkSeq.altair:
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    else:
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT
+    decrease_balance(state, slashed_index, v.effective_balance // quotient)
+
+    proposer_index = get_beacon_proposer_index(
+        state, electra=fork_seq >= ForkSeq.electra
+    )
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    if fork_seq >= ForkSeq.electra:
+        whistleblower_reward = (
+            v.effective_balance // p.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA
+        )
+    else:
+        whistleblower_reward = (
+            v.effective_balance // p.WHISTLEBLOWER_REWARD_QUOTIENT
+        )
+    if fork_seq >= ForkSeq.altair:
+        proposer_reward = (
+            whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+        )
+    else:
+        proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(
+        state, whistleblower_index, whistleblower_reward - proposer_reward
+    )
+
+
+# ---------------------------------------------------------------------------
+# Altair participation flags / weights
+# ---------------------------------------------------------------------------
+
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT,
+    TIMELY_TARGET_WEIGHT,
+    TIMELY_HEAD_WEIGHT,
+]
+
+
+def add_flag(flags: int, flag_index: int) -> int:
+    return flags | (1 << flag_index)
+
+
+def has_flag(flags: int, flag_index: int) -> bool:
+    return bool(flags & (1 << flag_index))

@@ -11,6 +11,10 @@ import os
 # tunneled TPU); unit tests must run hermetically on the virtual CPU
 # mesh regardless.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Minimal preset for consensus tests (reference: default minimal test
+# preset, beacon-node/test/setupPreset.ts) unless the runner pins one.
+os.environ.setdefault("LODESTAR_PRESET", "minimal")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,169 @@
+"""getBlockSignatureSets analog: extract a real block's signature sets
+and verify them through the oracle and TPU verifier services — the
+minimum end-to-end verify slice (SURVEY.md §7 step 4).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.bls import OracleBlsVerifier, TpuBlsVerifier
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.signature import aggregate_signatures, sign
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    preset,
+)
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.statetransition import (
+    BeaconStateView,
+    create_interop_genesis_state,
+    interop_secret_key,
+    process_slots,
+    state_transition,
+    util,
+)
+from lodestar_tpu.statetransition.block import compute_signing_root, get_domain
+from lodestar_tpu.statetransition.signature_sets import get_block_signature_sets
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 64
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+def _clone(view, types):
+    t = view.state_type(types)
+    return BeaconStateView(
+        state=t.deserialize(t.serialize(view.state)), fork=view.fork
+    )
+
+
+def _signed_block_with_attestations(cfg, types, slot=2):
+    """Genesis -> slot, with a fully signed block carrying signed
+    attestations for slot-1."""
+    view = create_interop_genesis_state(cfg, types, N, genesis_time=0)
+    process_slots(cfg, view, slot, types)
+    st = view.state
+    ns = types.by_fork[view.fork]
+
+    # signed attestations for the previous slot
+    s = slot - 1
+    epoch = util.compute_epoch_at_slot(s)
+    sh = util.EpochShuffling(st, epoch)
+    atts = []
+    for ci, committee in enumerate(sh.committees_at_slot(s)):
+        data = types.AttestationData.default()
+        data.slot = s
+        data.index = ci
+        data.beacon_block_root = util.get_block_root_at_slot(st, s)
+        data.source = st.current_justified_checkpoint
+        tgt = types.Checkpoint.default()
+        tgt.epoch = epoch
+        tgt.root = util.get_block_root(st, epoch)
+        data.target = tgt
+        domain = get_domain(cfg, st, DOMAIN_BEACON_ATTESTER, epoch)
+        root = compute_signing_root(types.AttestationData, data, domain)
+        sigs = [
+            sign(interop_secret_key(int(v)), root) for v in committee
+        ]
+        a = types.Attestation.default()
+        a.data = data
+        a.aggregation_bits = [True] * len(committee)
+        a.signature = aggregate_signatures(sigs)
+        atts.append(a)
+
+    proposer = util.get_beacon_proposer_index(st)
+    sk = interop_secret_key(proposer)
+    block = ns.BeaconBlock.default()
+    block.slot = slot
+    block.proposer_index = proposer
+    block.parent_root = types.BeaconBlockHeader.hash_tree_root(
+        st.latest_block_header
+    )
+    body = ns.BeaconBlockBody.default()
+    cur_epoch = util.get_current_epoch(st)
+    body.randao_reveal = sign(
+        sk,
+        compute_signing_root(
+            uint64, cur_epoch, get_domain(cfg, st, DOMAIN_RANDAO)
+        ),
+    )
+    body.eth1_data = st.eth1_data
+    body.attestations = atts
+    block.body = body
+
+    work = _clone(view, types)
+    signed0 = ns.SignedBeaconBlock.default()
+    signed0.message = block
+    state_transition(
+        cfg,
+        work,
+        signed0,
+        types,
+        verify_state_root=False,
+        verify_proposer=False,
+        verify_signatures=True,  # oracle-checks randao + attestations
+    )
+    block.state_root = work.hash_tree_root(types)
+
+    signed = ns.SignedBeaconBlock.default()
+    signed.message = block
+    signed.signature = sign(
+        sk,
+        compute_signing_root(
+            ns.BeaconBlock, block, get_domain(cfg, st, DOMAIN_BEACON_PROPOSER)
+        ),
+    )
+    return cfg, view, signed
+
+
+class TestBlockSignatureSets:
+    def test_extract_and_verify_all_sets(self, cfg, types):
+        cfg, view, signed = _signed_block_with_attestations(cfg, types)
+        sets = get_block_signature_sets(cfg, view, signed, types)
+        # proposer + randao + >=1 attestation
+        assert len(sets) >= 3
+
+        async def go():
+            orc = OracleBlsVerifier()
+            ok_oracle = await orc.verify_signature_sets(sets)
+            tpu = TpuBlsVerifier()
+            ok_tpu = await tpu.verify_signature_sets(sets)
+            await tpu.close()
+            return ok_oracle, ok_tpu
+
+        ok_oracle, ok_tpu = asyncio.run(go())
+        assert ok_oracle is True
+        assert ok_tpu is True
+
+    def test_tampered_proposer_sig_fails(self, cfg, types):
+        cfg, view, signed = _signed_block_with_attestations(cfg, types)
+        sig = bytearray(signed.signature)
+        sig[7] ^= 0xFF
+        signed.signature = bytes(sig)
+        sets = get_block_signature_sets(cfg, view, signed, types)
+
+        async def go():
+            orc = OracleBlsVerifier()
+            return await orc.verify_signature_sets(sets)
+
+        assert asyncio.run(go()) is False

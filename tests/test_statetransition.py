@@ -1,0 +1,284 @@
+"""State-transition tests: genesis, shuffling, empty-slot advance, and a
+full-participation dev chain reaching justification + finalization.
+
+Reference analogs: state-transition spec suites (sanity/slots,
+sanity/blocks, finality — SURVEY.md §4) run here as self-built
+scenarios on the minimal preset.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.params import GENESIS_EPOCH, preset
+from lodestar_tpu.statetransition import (
+    BeaconStateView,
+    create_interop_genesis_state,
+    process_slots,
+    state_transition,
+    util,
+)
+from lodestar_tpu.statetransition import block as blockproc
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N_VALIDATORS = 64
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+@pytest.fixture()
+def cfg():
+    # phase0-only dev chain
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=N_VALIDATORS,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+@pytest.fixture()
+def altair_cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=N_VALIDATORS,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+def _genesis(cfg, types, fork=None):
+    return create_interop_genesis_state(
+        cfg, types, N_VALIDATORS, genesis_time=0, fork=fork
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shuffling
+# ---------------------------------------------------------------------------
+
+
+class TestShuffling:
+    def test_vectorized_matches_scalar(self):
+        seed = bytes(range(32))
+        for count in (1, 5, 64, 257):
+            fwd = util.compute_shuffling(count, seed)
+            for i in range(count):
+                assert fwd[i] == util.compute_shuffled_index(i, count, seed)
+
+    def test_shuffling_is_permutation(self):
+        seed = b"\x07" * 32
+        fwd = util.compute_shuffling(100, seed)
+        assert sorted(fwd.tolist()) == list(range(100))
+
+
+# ---------------------------------------------------------------------------
+# Genesis
+# ---------------------------------------------------------------------------
+
+
+class TestGenesis:
+    def test_phase0_genesis(self, cfg, types):
+        view = _genesis(cfg, types)
+        st = view.state
+        assert view.fork == "phase0"
+        assert len(st.validators) == N_VALIDATORS
+        assert st.slot == 0
+        assert (
+            st.validators[0].effective_balance
+            == preset().MAX_EFFECTIVE_BALANCE
+        )
+        assert st.genesis_validators_root != b"\x00" * 32
+        root = view.hash_tree_root(types)
+        assert len(root) == 32
+
+    def test_altair_genesis_has_sync_committees(self, altair_cfg, types):
+        view = _genesis(altair_cfg, types)
+        st = view.state
+        assert view.fork == "altair"
+        assert len(st.current_sync_committee.pubkeys) == (
+            preset().SYNC_COMMITTEE_SIZE
+        )
+        assert len(st.previous_epoch_participation) == N_VALIDATORS
+
+    def test_committees_partition_active_set(self, cfg, types):
+        view = _genesis(cfg, types)
+        sh = util.EpochShuffling(view.state, GENESIS_EPOCH)
+        seen = []
+        p = preset()
+        for slot in range(p.SLOTS_PER_EPOCH):
+            for c in sh.committees_at_slot(slot):
+                seen.extend(int(x) for x in c)
+        assert sorted(seen) == list(range(N_VALIDATORS))
+
+
+# ---------------------------------------------------------------------------
+# Empty-slot advance
+# ---------------------------------------------------------------------------
+
+
+class TestProcessSlots:
+    def test_advance_through_epoch(self, cfg, types):
+        view = _genesis(cfg, types)
+        p = preset()
+        process_slots(cfg, view, p.SLOTS_PER_EPOCH + 1, types)
+        st = view.state
+        assert st.slot == p.SLOTS_PER_EPOCH + 1
+        # no attestations -> no justification
+        assert st.current_justified_checkpoint.epoch == 0
+        # randao mix rotated
+        assert st.block_roots[0] != b"\x00" * 32
+
+    def test_cannot_rewind(self, cfg, types):
+        view = _genesis(cfg, types)
+        process_slots(cfg, view, 3, types)
+        with pytest.raises(Exception):
+            process_slots(cfg, view, 2, types)
+
+    def test_fork_upgrade_mid_advance(self, types):
+        """Advancing across a fork boundary must upgrade the container
+        AND keep advancing the new state object (regression: stale
+        `state` binding froze view.state at the boundary)."""
+        cfg2 = ChainConfig(
+            ALTAIR_FORK_EPOCH=1,
+            BELLATRIX_FORK_EPOCH=FAR,
+            CAPELLA_FORK_EPOCH=FAR,
+            DENEB_FORK_EPOCH=FAR,
+            ELECTRA_FORK_EPOCH=FAR,
+            SHARD_COMMITTEE_PERIOD=0,
+        )
+        view = _genesis(cfg2, types)
+        p = preset()
+        target = p.SLOTS_PER_EPOCH + 3
+        process_slots(cfg2, view, target, types)
+        assert view.fork == "altair"
+        assert view.state.slot == target
+        assert len(view.state.current_sync_committee.pubkeys) == (
+            p.SYNC_COMMITTEE_SIZE
+        )
+
+
+# ---------------------------------------------------------------------------
+# Dev chain: produce + import full-participation blocks
+# ---------------------------------------------------------------------------
+
+
+def _clone_view(view, types):
+    t = view.state_type(types)
+    return BeaconStateView(
+        state=t.deserialize(t.serialize(view.state)), fork=view.fork
+    )
+
+
+def _full_attestations_for_prev_slot(cfg, view, types, fork_seq):
+    """Full-participation attestations for slot state.slot-1."""
+    st = view.state
+    s = st.slot - 1
+    if s < 0:
+        return []
+    epoch = util.compute_epoch_at_slot(s)
+    sh = util.EpochShuffling(st, epoch)
+    target_root = util.get_block_root(st, epoch)
+    if util.get_current_epoch(st) == epoch:
+        source = st.current_justified_checkpoint
+    else:
+        source = st.previous_justified_checkpoint
+    atts = []
+    for ci, committee in enumerate(sh.committees_at_slot(s)):
+        a = types.Attestation.default()
+        data = types.AttestationData.default()
+        data.slot = s
+        data.index = ci
+        data.beacon_block_root = util.get_block_root_at_slot(st, s)
+        data.source = source
+        tgt = types.Checkpoint.default()
+        tgt.epoch = epoch
+        tgt.root = target_root
+        data.target = tgt
+        a.data = data
+        a.aggregation_bits = [True] * len(committee)
+        a.signature = b"\x00" * 96  # sig verification off in this test
+        atts.append(a)
+    return atts
+
+
+def _produce_and_apply_block(cfg, view, types, slot):
+    """Advance to `slot`, build a block with full attestations for the
+    previous slot, apply it (computeNewStateRoot-style)."""
+    process_slots(cfg, view, slot, types)
+    st = view.state
+    ns = types.by_fork[view.fork]
+    proposer = util.get_beacon_proposer_index(st)
+
+    block = ns.BeaconBlock.default()
+    block.slot = slot
+    block.proposer_index = proposer
+    block.parent_root = types.BeaconBlockHeader.hash_tree_root(
+        st.latest_block_header
+    )
+    body = ns.BeaconBlockBody.default()
+    body.randao_reveal = os.urandom(96)
+    body.eth1_data = st.eth1_data
+    body.attestations = _full_attestations_for_prev_slot(
+        cfg, view, types, view.fork_seq
+    )
+    if view.fork != "phase0":
+        sa = types.SyncAggregate.default()
+        sa.sync_committee_bits = [False] * preset().SYNC_COMMITTEE_SIZE
+        sa.sync_committee_signature = b"\xc0" + b"\x00" * 95
+        body.sync_aggregate = sa
+    block.body = body
+
+    signed = ns.SignedBeaconBlock.default()
+    signed.message = block
+    signed.signature = b"\x00" * 96
+
+    work = _clone_view(view, types)
+    state_transition(
+        cfg,
+        work,
+        signed,
+        types,
+        verify_state_root=False,
+        verify_proposer=False,
+        verify_signatures=False,
+    )
+    block.state_root = work.hash_tree_root(types)
+    view.state = work.state
+    view.fork = work.fork
+    return view
+
+
+class TestDevChain:
+    def test_phase0_chain_finalizes(self, cfg, types):
+        view = _genesis(cfg, types)
+        p = preset()
+        # run 4 epochs of full-participation blocks
+        for slot in range(1, 4 * p.SLOTS_PER_EPOCH + 1):
+            _produce_and_apply_block(cfg, view, types, slot)
+        st = view.state
+        assert st.current_justified_checkpoint.epoch >= 2
+        assert st.finalized_checkpoint.epoch >= 1
+
+    def test_altair_chain_finalizes_and_rewards(self, altair_cfg, types):
+        view = _genesis(altair_cfg, types)
+        p = preset()
+        for slot in range(1, 4 * p.SLOTS_PER_EPOCH + 1):
+            _produce_and_apply_block(altair_cfg, view, types, slot)
+        st = view.state
+        assert st.current_justified_checkpoint.epoch >= 2
+        assert st.finalized_checkpoint.epoch >= 1
+        # attesters earned rewards above initial balance
+        assert max(st.balances) > preset().MAX_EFFECTIVE_BALANCE
