@@ -1,0 +1,197 @@
+/* Batched SHA-256 merkleization for SSZ hash_tree_root.
+ *
+ * Reference analog: @chainsafe/as-sha256 (WASM SIMD batch hasher under
+ * persistent-merkle-tree, SURVEY.md §2.1 L0). This is the native
+ * hot-loop behind lodestar_tpu.ssz merkleization: hash whole tree
+ * levels of 64-byte nodes per call instead of one Python hashlib call
+ * per node. Runtime-dispatches to x86 SHA-NI when available, portable
+ * C otherwise. Built by lodestar_tpu/crypto/sha256_batch.py (ctypes).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static const uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                               0xa54ff53a, 0x510e527f, 0x9b05688c,
+                               0x1f83d9ab, 0x5be0cd19};
+
+/* Padding block for a fixed 64-byte message: 0x80, zeros, bitlen 512 */
+static const uint8_t PAD64[64] = {
+    0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0x02, 0x00};
+
+/* ------------------------------------------------------------------ */
+/* Portable scalar compression                                         */
+/* ------------------------------------------------------------------ */
+
+#define ROR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void compress_scalar(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((uint32_t)block[i * 4] << 24) | ((uint32_t)block[i * 4 + 1] << 16) |
+           ((uint32_t)block[i * 4 + 2] << 8) | block[i * 4 + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = ROR(w[i - 15], 7) ^ ROR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = ROR(w[i - 2], 17) ^ ROR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = ROR(e, 6) ^ ROR(e, 11) ^ ROR(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = ROR(a, 2) ^ ROR(a, 13) ^ ROR(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+/* ------------------------------------------------------------------ */
+/* x86 SHA-NI compression                                              */
+/* ------------------------------------------------------------------ */
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+
+__attribute__((target("sha,sse4.1,ssse3"))) static void
+compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i TMP = _mm_loadu_si128((const __m128i *)&state[0]);
+  __m128i STATE1 = _mm_loadu_si128((const __m128i *)&state[4]);
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);          /* CDAB */
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);    /* EFGH */
+  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);    /* ABEF */
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);         /* CDGH */
+
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+
+  __m128i MSGV[4];
+  for (int i = 0; i < 4; i++)
+    MSGV[i] = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(block + 16 * i)), MASK);
+
+  for (int i = 0; i < 16; i++) {
+    __m128i msg;
+    if (i < 4) {
+      msg = MSGV[i];
+    } else {
+      __m128i t = _mm_alignr_epi8(MSGV[(i + 3) & 3], MSGV[(i + 2) & 3], 4);
+      __m128i m = _mm_sha256msg1_epu32(MSGV[i & 3], MSGV[(i + 1) & 3]);
+      m = _mm_add_epi32(m, t);
+      m = _mm_sha256msg2_epu32(m, MSGV[(i + 3) & 3]);
+      MSGV[i & 3] = m;
+      msg = m;
+    }
+    __m128i kw = _mm_add_epi32(msg, _mm_loadu_si128((const __m128i *)&K[i * 4]));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, kw);
+    kw = _mm_shuffle_epi32(kw, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, kw);
+  }
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);       /* FEBA */
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);    /* DCHG */
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0); /* DCBA */
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);    /* HGFE */
+  _mm_storeu_si128((__m128i *)&state[0], STATE0);
+  _mm_storeu_si128((__m128i *)&state[4], STATE1);
+}
+
+static int has_shani(void) {
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1") &&
+         __builtin_cpu_supports("ssse3");
+}
+#else
+static int has_shani(void) { return 0; }
+static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  compress_scalar(state, block);
+}
+#endif
+
+typedef void (*compress_fn)(uint32_t[8], const uint8_t *);
+static compress_fn COMPRESS = 0;
+
+static compress_fn get_compress(void) {
+  if (!COMPRESS)
+    COMPRESS = has_shani() ? compress_shani : compress_scalar;
+  return COMPRESS;
+}
+
+static void hash64(const uint8_t in[64], uint8_t out[32]) {
+  compress_fn f = get_compress();
+  uint32_t st[8];
+  memcpy(st, H0, sizeof(st));
+  f(st, in);
+  f(st, PAD64);
+  for (int i = 0; i < 8; i++) {
+    out[i * 4] = (uint8_t)(st[i] >> 24);
+    out[i * 4 + 1] = (uint8_t)(st[i] >> 16);
+    out[i * 4 + 2] = (uint8_t)(st[i] >> 8);
+    out[i * 4 + 3] = (uint8_t)st[i];
+  }
+}
+
+/* ------------------------------------------------------------------ */
+/* Public API (ctypes)                                                 */
+/* ------------------------------------------------------------------ */
+
+/* n independent 64-byte inputs -> n 32-byte digests. */
+void hash64_batch(const uint8_t *in, uint8_t *out, size_t n) {
+  for (size_t i = 0; i < n; i++)
+    hash64(in + i * 64, out + i * 32);
+}
+
+/* Full sub-tree merkleization: `count` 32-byte chunks, `depth` levels,
+ * virtual zero-subtree padding via zero_hashes (33*32 bytes,
+ * zero_hashes[i] = root of depth-i zero subtree). scratch needs
+ * (count+1)*32 bytes. Writes the 32-byte root to out. */
+void merkle_root(const uint8_t *chunks, size_t count, size_t depth,
+                 const uint8_t *zero_hashes, uint8_t *scratch, uint8_t *out) {
+  if (count == 0) {
+    memcpy(out, zero_hashes + depth * 32, 32);
+    return;
+  }
+  memcpy(scratch, chunks, count * 32);
+  size_t n = count;
+  for (size_t level = 0; level < depth; level++) {
+    if (n == 1) {
+      /* lone node: hash with the zero subtree of this level */
+      memcpy(scratch + 32, zero_hashes + level * 32, 32);
+      hash64(scratch, scratch);
+      continue;
+    }
+    if (n & 1) {
+      memcpy(scratch + n * 32, zero_hashes + level * 32, 32);
+      n++;
+    }
+    for (size_t i = 0; i < n / 2; i++)
+      hash64(scratch + i * 64, scratch + i * 32);
+    n /= 2;
+  }
+  memcpy(out, scratch, 32);
+}

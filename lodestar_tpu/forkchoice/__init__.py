@@ -1,0 +1,25 @@
+"""Fork choice: proto-array LMD-GHOST with proposer boost.
+
+Reference analog: packages/fork-choice (SURVEY.md §2.5) — ProtoArray
+(protoArray.ts:15), ForkChoice (forkChoice.ts:80), computeDeltas.
+"""
+
+from .fork_choice import Checkpoint, ForkChoice, ForkChoiceError, VoteTracker, compute_deltas
+from .proto_array import (
+    ExecutionStatus,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoNode,
+)
+
+__all__ = [
+    "Checkpoint",
+    "ExecutionStatus",
+    "ForkChoice",
+    "ForkChoiceError",
+    "ProtoArray",
+    "ProtoArrayError",
+    "ProtoNode",
+    "VoteTracker",
+    "compute_deltas",
+]

@@ -1,0 +1,250 @@
+"""ForkChoice: spec get_head over a ProtoArray, with LMD-GHOST votes,
+proposer boost, unrealized justification, and checkpoint management.
+
+Reference analog: packages/fork-choice/src/forkChoice/forkChoice.ts:80
+(onBlock/onAttestation/updateHead), store.ts:52, computeDeltas.ts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..params import GENESIS_EPOCH, preset
+
+
+@dataclass
+class Checkpoint:
+    epoch: int
+    root: bytes
+
+
+@dataclass
+class VoteTracker:
+    current_root: bytes | None = None
+    next_root: bytes | None = None
+    next_epoch: int = 0
+
+
+class ForkChoiceError(Exception):
+    pass
+
+
+def compute_deltas(
+    indices: dict[bytes, int],
+    n_nodes: int,
+    votes: dict[int, VoteTracker],
+    old_balances: list[int],
+    new_balances: list[int],
+    equivocating: set[int],
+) -> list[int]:
+    """Per-node weight changes from vote movement since last run
+    (fork-choice/src/protoArray/computeDeltas.ts)."""
+    deltas = [0] * n_nodes
+    for i, vote in votes.items():
+        if vote.current_root is None and vote.next_root is None:
+            continue
+        old_b = old_balances[i] if i < len(old_balances) else 0
+        new_b = new_balances[i] if i < len(new_balances) else 0
+        if i in equivocating:
+            new_b = 0
+            vote.next_root = None
+        if vote.current_root is not None:
+            idx = indices.get(vote.current_root)
+            if idx is not None:
+                deltas[idx] -= old_b
+        if vote.next_root is not None:
+            idx = indices.get(vote.next_root)
+            if idx is not None:
+                deltas[idx] += new_b
+        vote.current_root = vote.next_root
+    return deltas
+
+
+class ForkChoice:
+    """Host-side fork choice; pure bookkeeping, no crypto (signature
+    validity is the verifier pool's job upstream)."""
+
+    def __init__(
+        self,
+        cfg,
+        proto_array,
+        finalized_checkpoint: Checkpoint,
+        justified_checkpoint: Checkpoint,
+        justified_balances: list[int],
+        current_slot: int = 0,
+    ):
+        from .proto_array import ProtoArray
+
+        self.cfg = cfg
+        self.proto: ProtoArray = proto_array
+        self.finalized_checkpoint = finalized_checkpoint
+        self.justified_checkpoint = justified_checkpoint
+        self.unrealized_justified = justified_checkpoint
+        self.unrealized_finalized = finalized_checkpoint
+        self.justified_balances = list(justified_balances)
+        self._old_balances = list(justified_balances)
+        self.votes: dict[int, VoteTracker] = {}
+        self.equivocating: set[int] = set()
+        self.proposer_boost_root: bytes | None = None
+        self._applied_boost: tuple[bytes, int] | None = None
+        self.current_slot = current_slot
+        self.head: bytes | None = None
+
+    # -- time ----------------------------------------------------------
+
+    def on_tick(self, slot: int) -> None:
+        p = preset()
+        prev = self.current_slot
+        self.current_slot = slot
+        if slot > prev and slot // p.SLOTS_PER_EPOCH > prev // p.SLOTS_PER_EPOCH:
+            # crossed an epoch boundary (possibly several slots late):
+            # pull up unrealized checkpoints (spec on_tick_per_slot)
+            self._update_checkpoints(
+                self.unrealized_justified, self.unrealized_finalized
+            )
+        if slot > prev:
+            self.proposer_boost_root = None
+
+    def _update_checkpoints(
+        self, justified: Checkpoint, finalized: Checkpoint
+    ) -> None:
+        if justified.epoch > self.justified_checkpoint.epoch:
+            self.justified_checkpoint = justified
+        if finalized.epoch > self.finalized_checkpoint.epoch:
+            self.finalized_checkpoint = finalized
+
+    # -- block import ----------------------------------------------------
+
+    def on_block(
+        self,
+        *,
+        slot: int,
+        block_root: bytes,
+        parent_root: bytes,
+        state_root: bytes,
+        target_root: bytes,
+        justified_checkpoint: Checkpoint,
+        finalized_checkpoint: Checkpoint,
+        unrealized_justified: Checkpoint | None = None,
+        unrealized_finalized: Checkpoint | None = None,
+        execution_block_hash: bytes | None = None,
+        execution_status=None,
+        is_timely: bool = False,
+    ) -> None:
+        """Register an imported block (chain verified it already)."""
+        from .proto_array import ExecutionStatus, ProtoNode
+
+        uj = unrealized_justified or justified_checkpoint
+        uf = unrealized_finalized or finalized_checkpoint
+        if execution_status is None:
+            execution_status = (
+                ExecutionStatus.syncing
+                if execution_block_hash
+                else ExecutionStatus.pre_merge
+            )
+        self.proto.on_block(
+            ProtoNode(
+                slot=slot,
+                block_root=block_root,
+                parent_root=parent_root,
+                state_root=state_root,
+                target_root=target_root,
+                justified_epoch=justified_checkpoint.epoch,
+                finalized_epoch=finalized_checkpoint.epoch,
+                unrealized_justified_epoch=uj.epoch,
+                unrealized_finalized_epoch=uf.epoch,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+        )
+        # spec: current-epoch blocks update the store's checkpoints with
+        # their realized values; unrealized values pull up at the next
+        # epoch tick
+        self._update_checkpoints(justified_checkpoint, finalized_checkpoint)
+        if uj.epoch > self.unrealized_justified.epoch:
+            self.unrealized_justified = uj
+        if uf.epoch > self.unrealized_finalized.epoch:
+            self.unrealized_finalized = uf
+        # proposer boost for timely first block of the slot
+        if is_timely and self.proposer_boost_root is None:
+            self.proposer_boost_root = block_root
+
+    # -- attestations ----------------------------------------------------
+
+    def on_attestation(
+        self,
+        validator_indices,
+        beacon_block_root: bytes,
+        target_epoch: int,
+    ) -> None:
+        """Record LMD votes (already validated upstream: signature,
+        slot windows, known block)."""
+        for i in validator_indices:
+            i = int(i)
+            if i in self.equivocating:
+                continue
+            vote = self.votes.setdefault(i, VoteTracker())
+            if (
+                vote.next_root is None
+                or target_epoch > vote.next_epoch
+            ):
+                vote.next_root = beacon_block_root
+                vote.next_epoch = target_epoch
+
+    def on_attester_slashing(self, indices) -> None:
+        self.equivocating.update(int(i) for i in indices)
+
+    # -- balances --------------------------------------------------------
+
+    def set_justified_balances(self, balances: list[int]) -> None:
+        self.justified_balances = list(balances)
+
+    # -- head ------------------------------------------------------------
+
+    def update_head(self) -> bytes:
+        """Spec get_head via proto-array delta pass."""
+        p = preset()
+        deltas = compute_deltas(
+            self.proto.indices,
+            len(self.proto.nodes),
+            self.votes,
+            self._old_balances,
+            self.justified_balances,
+            self.equivocating,
+        )
+        # proposer boost: remove previous boost, add current
+        if self._applied_boost is not None:
+            root, amount = self._applied_boost
+            idx = self.proto.indices.get(root)
+            if idx is not None:
+                deltas[idx] -= amount
+            self._applied_boost = None
+        if self.proposer_boost_root is not None:
+            total = sum(self.justified_balances)
+            committee_weight = total // p.SLOTS_PER_EPOCH
+            boost = committee_weight * self.cfg.PROPOSER_SCORE_BOOST // 100
+            idx = self.proto.indices.get(self.proposer_boost_root)
+            if idx is not None:
+                deltas[idx] += boost
+                self._applied_boost = (self.proposer_boost_root, boost)
+        self._old_balances = list(self.justified_balances)
+        self.proto.apply_score_changes(
+            deltas,
+            self.justified_checkpoint.epoch,
+            self.finalized_checkpoint.epoch,
+        )
+        self.head = self.proto.find_head(self.justified_checkpoint.root)
+        return self.head
+
+    # -- queries ---------------------------------------------------------
+
+    def has_block(self, root: bytes) -> bool:
+        return root in self.proto.indices
+
+    def is_descendant_of_finalized(self, root: bytes) -> bool:
+        return self.proto.is_descendant(
+            self.finalized_checkpoint.root, root
+        )
+
+    def prune(self) -> list:
+        return self.proto.prune(self.finalized_checkpoint.root)

@@ -1,0 +1,310 @@
+"""ProtoArray: array-backed block DAG with best-descendant propagation.
+
+Reference analog: packages/fork-choice/src/protoArray/protoArray.ts:15
+and computeDeltas.ts — the proto-array fork-choice optimization: nodes
+stored parent-before-child in a flat list, weights aggregated in one
+backward pass, head lookup O(1) via bestDescendant pointers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class ExecutionStatus(str, Enum):
+    valid = "valid"
+    syncing = "syncing"  # optimistically imported
+    invalid = "invalid"
+    pre_merge = "pre_merge"
+
+
+DEFAULT_PRUNE_THRESHOLD = 256
+
+
+@dataclass
+class ProtoNode:
+    slot: int
+    block_root: bytes
+    parent_root: bytes | None
+    state_root: bytes
+    target_root: bytes
+    justified_epoch: int
+    finalized_epoch: int
+    unrealized_justified_epoch: int
+    unrealized_finalized_epoch: int
+    execution_status: ExecutionStatus = ExecutionStatus.pre_merge
+    execution_block_hash: bytes | None = None
+    parent: int | None = None  # index into nodes
+    weight: int = 0
+    best_child: int | None = None
+    best_descendant: int | None = None
+
+
+class ProtoArrayError(Exception):
+    pass
+
+
+class ProtoArray:
+    def __init__(
+        self,
+        justified_epoch: int,
+        finalized_epoch: int,
+        prune_threshold: int = DEFAULT_PRUNE_THRESHOLD,
+    ):
+        self.prune_threshold = prune_threshold
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.nodes: list[ProtoNode] = []
+        self.indices: dict[bytes, int] = {}
+
+    # -- insertion -----------------------------------------------------
+
+    def on_block(self, node: ProtoNode) -> None:
+        """Register a block. Parent must already be known (or None for
+        the anchor). protoArray.ts onBlock."""
+        if node.block_root in self.indices:
+            return
+        if node.parent_root is not None:
+            parent = self.indices.get(node.parent_root)
+            if parent is None:
+                raise ProtoArrayError(
+                    "unknown parent (blocks must be inserted in order)"
+                )
+            node.parent = parent
+        else:
+            node.parent = None
+        node_index = len(self.nodes)
+        self.indices[node.block_root] = node_index
+        self.nodes.append(node)
+        if node.parent is not None:
+            self._maybe_update_best_child_and_descendant(
+                node.parent, node_index
+            )
+
+    # -- scoring -------------------------------------------------------
+
+    def apply_score_changes(
+        self,
+        deltas: list[int],
+        justified_epoch: int,
+        finalized_epoch: int,
+    ) -> None:
+        """One backward pass: apply vote deltas, bubble weights to
+        parents, refresh best child/descendant (protoArray.ts
+        applyScoreChanges)."""
+        if len(deltas) != len(self.nodes):
+            raise ProtoArrayError("deltas length mismatch")
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            delta = deltas[i]
+            if delta:
+                node.weight += delta
+                if node.weight < 0:
+                    raise ProtoArrayError("negative node weight")
+                if node.parent is not None:
+                    deltas[node.parent] += delta
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(node.parent, i)
+
+    # -- head ----------------------------------------------------------
+
+    def find_head(self, justified_root: bytes) -> bytes:
+        idx = self.indices.get(justified_root)
+        if idx is None:
+            raise ProtoArrayError("unknown justified root")
+        node = self.nodes[idx]
+        best = (
+            self.nodes[node.best_descendant]
+            if node.best_descendant is not None
+            else node
+        )
+        if not self._node_is_viable_for_head(best):
+            raise ProtoArrayError(
+                "best node is not viable for head (justified/finalized "
+                "mismatch or invalid execution)"
+            )
+        return best.block_root
+
+    # -- execution status (engine verdicts) -----------------------------
+
+    def set_execution_valid(self, block_root: bytes) -> None:
+        """Mark a block and all ancestors valid (a valid payload
+        validates its ancestry)."""
+        idx = self.indices.get(block_root)
+        while idx is not None:
+            node = self.nodes[idx]
+            if node.execution_status == ExecutionStatus.invalid:
+                raise ProtoArrayError("valid block has invalid ancestor")
+            if node.execution_status != ExecutionStatus.syncing:
+                break
+            node.execution_status = ExecutionStatus.valid
+            idx = node.parent
+
+    def set_execution_invalid(self, block_root: bytes) -> None:
+        """Mark a block and all descendants invalid; zero their weights
+        (protoArray.ts invalidation on engine INVALID)."""
+        start = self.indices.get(block_root)
+        if start is None:
+            return
+        bad = {start}
+        self.nodes[start].execution_status = ExecutionStatus.invalid
+        self.nodes[start].weight = 0
+        for i in range(start + 1, len(self.nodes)):
+            node = self.nodes[i]
+            if node.parent in bad:
+                node.execution_status = ExecutionStatus.invalid
+                node.weight = 0
+                bad.add(i)
+        # recompute best pointers from scratch below the invalid set
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(node.parent, i)
+
+    # -- pruning -------------------------------------------------------
+
+    def prune(self, finalized_root: bytes) -> list[ProtoNode]:
+        """Drop everything before the finalized root once enough nodes
+        accumulated. Returns removed nodes."""
+        idx = self.indices.get(finalized_root)
+        if idx is None:
+            raise ProtoArrayError("unknown finalized root")
+        if idx < self.prune_threshold:
+            return []
+        removed = self.nodes[:idx]
+        kept_set = set()
+        keep = []
+        remap: dict[int, int] = {}
+        for i in range(idx, len(self.nodes)):
+            node = self.nodes[i]
+            if i == idx or node.parent in kept_set:
+                remap[i] = len(keep)
+                keep.append(node)
+                kept_set.add(i)
+            else:
+                removed.append(node)
+        for node in keep:
+            node.parent = (
+                remap.get(node.parent) if node.parent is not None else None
+            )
+            node.best_child = (
+                remap.get(node.best_child)
+                if node.best_child is not None
+                else None
+            )
+            node.best_descendant = (
+                remap.get(node.best_descendant)
+                if node.best_descendant is not None
+                else None
+            )
+        anchor = keep[0]
+        anchor.parent = None
+        self.nodes = keep
+        self.indices = {n.block_root: i for i, n in enumerate(self.nodes)}
+        return removed
+
+    # -- traversal helpers ---------------------------------------------
+
+    def get_node(self, block_root: bytes) -> ProtoNode | None:
+        idx = self.indices.get(block_root)
+        return self.nodes[idx] if idx is not None else None
+
+    def is_descendant(self, ancestor_root: bytes, root: bytes) -> bool:
+        a = self.indices.get(ancestor_root)
+        i = self.indices.get(root)
+        if a is None or i is None:
+            return False
+        while i is not None and i >= a:
+            if i == a:
+                return True
+            i = self.nodes[i].parent
+        return False
+
+    def ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:
+        idx = self.indices.get(root)
+        while idx is not None:
+            node = self.nodes[idx]
+            if node.slot <= slot:
+                return node.block_root
+            idx = node.parent
+        return None
+
+    def iter_chain(self, root: bytes):
+        idx = self.indices.get(root)
+        while idx is not None:
+            node = self.nodes[idx]
+            yield node
+            idx = node.parent
+
+    # -- internals -----------------------------------------------------
+
+    def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        if node.execution_status == ExecutionStatus.invalid:
+            return False
+        # spec filter_block_tree condition with unrealized justification
+        # (node counts as viable if its voting source matches the
+        # store's justified checkpoint, or it is ahead of it)
+        correct_justified = (
+            self.justified_epoch == 0
+            or node.justified_epoch == self.justified_epoch
+            or node.unrealized_justified_epoch >= self.justified_epoch
+        )
+        correct_finalized = (
+            self.finalized_epoch == 0
+            or node.finalized_epoch >= self.finalized_epoch
+            or node.unrealized_finalized_epoch >= self.finalized_epoch
+        )
+        return correct_justified and correct_finalized
+
+    def _leads_to_viable_head(self, node: ProtoNode) -> bool:
+        if node.best_descendant is not None:
+            return self._node_is_viable_for_head(
+                self.nodes[node.best_descendant]
+            )
+        return self._node_is_viable_for_head(node)
+
+    def _maybe_update_best_child_and_descendant(
+        self, parent_index: int, child_index: int
+    ) -> None:
+        parent = self.nodes[parent_index]
+        child = self.nodes[child_index]
+        child_leads = self._leads_to_viable_head(child)
+
+        child_best_descendant = (
+            child.best_descendant
+            if child.best_descendant is not None
+            else child_index
+        )
+
+        if parent.best_child == child_index:
+            if not child_leads:
+                parent.best_child = None
+                parent.best_descendant = None
+            else:
+                parent.best_descendant = child_best_descendant
+            return
+
+        if not child_leads:
+            return
+
+        if parent.best_child is None:
+            parent.best_child = child_index
+            parent.best_descendant = child_best_descendant
+            return
+
+        best = self.nodes[parent.best_child]
+        best_leads = self._leads_to_viable_head(best)
+        if not best_leads or (
+            child.weight > best.weight
+            or (
+                child.weight == best.weight
+                and child.block_root >= best.block_root
+            )
+        ):
+            parent.best_child = child_index
+            parent.best_descendant = child_best_descendant

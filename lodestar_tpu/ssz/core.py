@@ -37,10 +37,28 @@ def next_pow_of_two(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+_NATIVE_MIN_CHUNKS = 8
+_native = None  # lazy: None = untried, False = unavailable
+
+
+def _native_hasher():
+    global _native
+    if _native is None:
+        try:
+            from ..crypto import sha256_batch
+
+            _native = sha256_batch if sha256_batch.available() else False
+        except Exception:
+            _native = False
+    return _native
+
+
 def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
     """Merkleize 32-byte chunks, padding with zero subtrees to `limit` leaves.
 
-    limit=None pads to next_pow_of_two(len(chunks)).
+    limit=None pads to next_pow_of_two(len(chunks)). Large inputs go
+    through the native batched hasher (csrc/sha256_merkle.c, the
+    as-sha256 analog); small ones stay on hashlib.
     """
     count = len(chunks)
     if limit is None:
@@ -52,6 +70,10 @@ def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
     depth = (limit - 1).bit_length() if limit > 1 else 0
     if count == 0:
         return zero_hash(depth)
+    if count >= _NATIVE_MIN_CHUNKS:
+        native = _native_hasher()
+        if native:
+            return native.merkleize_packed(b"".join(chunks), count, depth)
     layer = list(chunks)
     for level in range(depth):
         if len(layer) % 2 == 1:

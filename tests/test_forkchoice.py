@@ -1,0 +1,185 @@
+"""ProtoArray + ForkChoice tests.
+
+Reference analogs: fork-choice package unit tests (protoArray,
+computeDeltas, forkChoice get_head scenarios — SURVEY.md §2.5/§4).
+Scenarios: linear chains, competing forks with vote weights, tie-break
+by root, proposer boost reorgs, justification viability filtering,
+execution invalidation, and pruning.
+"""
+
+import pytest
+
+from lodestar_tpu.forkchoice import (
+    Checkpoint,
+    ExecutionStatus,
+    ForkChoice,
+    ProtoArray,
+    ProtoNode,
+)
+from lodestar_tpu.config.chain_config import ChainConfig
+
+
+def _root(n: int) -> bytes:
+    return n.to_bytes(32, "big")
+
+
+def _node(slot, root, parent, je=0, fe=0):
+    return ProtoNode(
+        slot=slot,
+        block_root=_root(root),
+        parent_root=_root(parent) if parent is not None else None,
+        state_root=_root(root),
+        target_root=_root(root),
+        justified_epoch=je,
+        finalized_epoch=fe,
+        unrealized_justified_epoch=je,
+        unrealized_finalized_epoch=fe,
+    )
+
+
+def _fc(proto, n_validators=16, balance=32):
+    cfg = ChainConfig()
+    return ForkChoice(
+        cfg,
+        proto,
+        finalized_checkpoint=Checkpoint(0, _root(0)),
+        justified_checkpoint=Checkpoint(0, _root(0)),
+        justified_balances=[balance] * n_validators,
+    )
+
+
+class TestProtoArray:
+    def test_linear_chain_head(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        for i in range(1, 5):
+            pa.on_block(_node(i, i, i - 1))
+        pa.apply_score_changes([0] * 5, 0, 0)
+        assert pa.find_head(_root(0)) == _root(4)
+
+    def test_fork_resolved_by_weight(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        pa.on_block(_node(1, 1, 0))  # fork A
+        pa.on_block(_node(1, 2, 0))  # fork B
+        deltas = [0, 5, 10]
+        pa.apply_score_changes(deltas, 0, 0)
+        assert pa.find_head(_root(0)) == _root(2)
+        # votes move to A
+        deltas = [0, 10, -10]
+        pa.apply_score_changes(deltas, 0, 0)
+        assert pa.find_head(_root(0)) == _root(1)
+
+    def test_tie_breaks_by_root(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        pa.on_block(_node(1, 1, 0))
+        pa.on_block(_node(1, 2, 0))
+        pa.apply_score_changes([0, 0, 0], 0, 0)
+        # equal weight: higher root wins
+        assert pa.find_head(_root(0)) == _root(2)
+
+    def test_viability_filters_wrong_justification(self):
+        pa = ProtoArray(1, 0)
+        pa.on_block(_node(0, 0, None, je=1))
+        pa.on_block(_node(1, 1, 0, je=1))
+        pa.on_block(_node(2, 2, 1, je=0))  # stale justification
+        pa.apply_score_changes([0, 0, 100], 1, 0)
+        # node 2 has je=0 < store 1 and unrealized 0 -> not viable
+        assert pa.find_head(_root(0)) == _root(1)
+
+    def test_execution_invalidation_reorgs(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        a = _node(1, 1, 0)
+        a.execution_status = ExecutionStatus.syncing
+        pa.on_block(a)
+        b = _node(1, 2, 0)
+        b.execution_status = ExecutionStatus.syncing
+        pa.on_block(b)
+        pa.apply_score_changes([0, 100, 1], 0, 0)
+        assert pa.find_head(_root(0)) == _root(1)
+        pa.set_execution_invalid(_root(1))
+        pa.apply_score_changes([0, 0, 0], 0, 0)
+        assert pa.find_head(_root(0)) == _root(2)
+
+    def test_prune_keeps_descendants(self):
+        pa = ProtoArray(0, 0, prune_threshold=1)
+        pa.on_block(_node(0, 0, None))
+        for i in range(1, 6):
+            pa.on_block(_node(i, i, i - 1))
+        removed = pa.prune(_root(3))
+        assert [n.block_root for n in removed] == [_root(0), _root(1), _root(2)]
+        pa.apply_score_changes([0, 0, 0], 0, 0)
+        assert pa.find_head(_root(3)) == _root(5)
+        assert pa.get_node(_root(4)).parent == 0
+
+
+class TestForkChoice:
+    def test_votes_drive_head(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        fc = _fc(pa)
+        fc.on_block(**_blockargs(1, 1, 0))
+        fc.on_block(**_blockargs(1, 2, 0))
+        fc.on_attestation([0, 1, 2], _root(1), 0)
+        fc.on_attestation([3], _root(2), 0)
+        assert fc.update_head() == _root(1)
+        # votes migrate in a later epoch
+        fc.on_attestation([0, 1, 2, 3], _root(2), 1)
+        assert fc.update_head() == _root(2)
+
+    def test_equivocating_votes_removed(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        fc = _fc(pa)
+        fc.on_block(**_blockargs(1, 1, 0))
+        fc.on_block(**_blockargs(1, 2, 0))
+        fc.on_attestation([0, 1], _root(1), 0)
+        fc.on_attestation([2], _root(2), 0)
+        assert fc.update_head() == _root(1)
+        fc.on_attester_slashing([0, 1])
+        assert fc.update_head() == _root(2)
+
+    def test_proposer_boost_wins_tie(self):
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        # 64 validators so the boost (committee weight * 40%) outweighs
+        # one attestation
+        fc = _fc(pa, n_validators=64)
+        fc.on_block(**_blockargs(1, 1, 0))
+        fc.on_attestation([0], _root(1), 0)
+        assert fc.update_head() == _root(1)
+        # timely competing block at slot 2 with boost beats 1 stale vote
+        fc.on_tick(2)
+        fc.on_block(**_blockargs(2, 2, 0), is_timely=True)
+        assert fc.update_head() == _root(2)
+        # boost expires next slot; the vote still points at 1
+        fc.on_tick(3)
+        assert fc.update_head() == _root(1)
+
+    def test_checkpoint_pullup_on_epoch_tick(self):
+        from lodestar_tpu.params import preset
+
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        fc = _fc(pa)
+        fc.on_block(
+            **_blockargs(1, 1, 0),
+            unrealized_justified=Checkpoint(1, _root(1)),
+        )
+        assert fc.justified_checkpoint.epoch == 0
+        fc.on_tick(preset().SLOTS_PER_EPOCH)
+        assert fc.justified_checkpoint.epoch == 1
+
+
+def _blockargs(slot, root, parent, je=0, fe=0):
+    return dict(
+        slot=slot,
+        block_root=_root(root),
+        parent_root=_root(parent),
+        state_root=_root(root),
+        target_root=_root(root),
+        justified_checkpoint=Checkpoint(je, _root(parent)),
+        finalized_checkpoint=Checkpoint(fe, _root(parent)),
+    )

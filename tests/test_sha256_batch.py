@@ -1,0 +1,61 @@
+"""Native batched SHA-256 merkleizer vs hashlib reference.
+
+Regression coverage: deep zero-padded limits (SSZ registry lists have
+limit 2^40 — depth 40 exceeded the original 33-entry zero table and
+produced silently wrong roots).
+"""
+
+from hashlib import sha256
+
+import pytest
+
+from lodestar_tpu.crypto import sha256_batch as sb
+from lodestar_tpu.ssz.core import _hash_layer, next_pow_of_two, zero_hash
+
+
+def _py_merkleize(chunks, limit):
+    count = len(chunks)
+    limit = next_pow_of_two(limit)
+    depth = (limit - 1).bit_length() if limit > 1 else 0
+    if count == 0:
+        return zero_hash(depth)
+    layer = list(chunks)
+    for level in range(depth):
+        if len(layer) % 2 == 1:
+            layer.append(zero_hash(level))
+        layer = _hash_layer(layer)
+    return layer[0]
+
+
+pytestmark = pytest.mark.skipif(
+    not sb.available(), reason="native hasher unavailable"
+)
+
+
+class TestNativeHasher:
+    def test_hash64_batch_matches_hashlib(self):
+        data = bytes(range(256)) * 16  # 64 inputs of 64 bytes
+        got = sb.hash64_batch(data)
+        for i in range(len(data) // 64):
+            assert (
+                got[i * 32 : (i + 1) * 32]
+                == sha256(data[i * 64 : (i + 1) * 64]).digest()
+            )
+
+    @pytest.mark.parametrize("count", [1, 2, 3, 8, 11, 16, 100, 1000])
+    @pytest.mark.parametrize("limit_depth", [0, 4, 10, 40, 64])
+    def test_merkleize_matches_python_at_depth(self, count, limit_depth):
+        limit = 1 << limit_depth
+        if count > limit:
+            pytest.skip("count exceeds limit")
+        chunks = [bytes([i & 0xFF]) * 32 for i in range(count)]
+        expect = _py_merkleize(chunks, limit)
+        got = sb.merkleize_packed(b"".join(chunks), count, limit_depth)
+        assert got == expect
+
+    def test_registry_depth_regression(self):
+        """Depth 40 (VALIDATOR_REGISTRY_LIMIT) — zero-table overrun."""
+        chunks = [bytes([7]) * 32] * 16
+        expect = _py_merkleize(chunks, 1 << 40)
+        got = sb.merkleize_packed(b"".join(chunks), 16, 40)
+        assert got == expect
