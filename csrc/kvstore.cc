@@ -1,0 +1,240 @@
+// Embedded ordered KV store: the native persistence engine.
+//
+// Reference analog: classic-level / LevelDB under @lodestar/db
+// (SURVEY.md §2.1 L0, db/src/controller/level.ts:28). Design: an
+// in-memory ordered map (the working set of a beacon node's hot
+// buckets fits comfortably in RAM) + append-only WAL for durability +
+// snapshot compaction. Supports the operations the repository layer
+// needs: put/get/delete, batched writes, and ordered range scans in
+// both directions (block-archive-by-slot iteration).
+//
+// C ABI for ctypes (lodestar_tpu/db/native.py). All returned buffers
+// are malloc'd copies — free with kv_free.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> table;
+  std::string dir;
+  FILE *wal = nullptr;
+  std::mutex mu;
+  uint64_t wal_records = 0;
+};
+
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+
+std::string wal_path(const std::string &dir) { return dir + "/wal.log"; }
+std::string snap_path(const std::string &dir) { return dir + "/snapshot.db"; }
+
+bool read_exact(FILE *f, void *buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+// record: [op u8][klen u32][vlen u32][key][val]  (vlen=0 for DEL)
+bool read_record(FILE *f, uint8_t &op, std::string &k, std::string &v) {
+  uint8_t o;
+  uint32_t kl, vl;
+  if (!read_exact(f, &o, 1)) return false;
+  if (!read_exact(f, &kl, 4) || !read_exact(f, &vl, 4)) return false;
+  if (kl > (1u << 30) || vl > (1u << 30)) return false;
+  k.resize(kl);
+  v.resize(vl);
+  if (kl && !read_exact(f, &k[0], kl)) return false;
+  if (vl && !read_exact(f, &v[0], vl)) return false;
+  op = o;
+  return true;
+}
+
+void write_record(FILE *f, uint8_t op, const char *k, uint32_t kl,
+                  const char *v, uint32_t vl) {
+  fwrite(&op, 1, 1, f);
+  fwrite(&kl, 4, 1, f);
+  fwrite(&vl, 4, 1, f);
+  if (kl) fwrite(k, 1, kl, f);
+  if (vl) fwrite(v, 1, vl, f);
+}
+
+void load_file(Store *s, const std::string &path) {
+  FILE *f = fopen(path.c_str(), "rb");
+  if (!f) return;
+  uint8_t op;
+  std::string k, v;
+  while (read_record(f, op, k, v)) {
+    if (op == OP_PUT)
+      s->table[k] = v;
+    else
+      s->table.erase(k);
+  }
+  fclose(f);
+}
+
+}  // namespace
+
+extern "C" {
+
+void *kv_open(const char *dir) {
+  auto *s = new Store();
+  s->dir = dir;
+  load_file(s, snap_path(s->dir));
+  load_file(s, wal_path(s->dir));
+  s->wal = fopen(wal_path(s->dir).c_str(), "ab");
+  if (!s->wal) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int kv_put(void *h, const char *k, uint32_t kl, const char *v, uint32_t vl) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->table[std::string(k, kl)] = std::string(v, vl);
+  write_record(s->wal, OP_PUT, k, kl, v, vl);
+  s->wal_records++;
+  return 0;
+}
+
+int kv_delete(void *h, const char *k, uint32_t kl) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->table.erase(std::string(k, kl));
+  write_record(s->wal, OP_DEL, k, kl, nullptr, 0);
+  s->wal_records++;
+  return 0;
+}
+
+// Batch: packed records [op u8][klen u32][vlen u32][key][val]*
+int kv_batch(void *h, const char *buf, uint64_t len) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  uint64_t off = 0;
+  while (off < len) {
+    if (off + 9 > len) return -1;
+    uint8_t op = (uint8_t)buf[off];
+    uint32_t kl, vl;
+    memcpy(&kl, buf + off + 1, 4);
+    memcpy(&vl, buf + off + 5, 4);
+    off += 9;
+    if (off + kl + vl > len) return -1;
+    std::string k(buf + off, kl);
+    off += kl;
+    std::string v(buf + off, vl);
+    off += vl;
+    if (op == OP_PUT)
+      s->table[k] = v;
+    else
+      s->table.erase(k);
+    write_record(s->wal, op, k.data(), kl, v.data(), vl);
+    s->wal_records++;
+  }
+  return 0;
+}
+
+// Returns malloc'd value copy (caller kv_free) or NULL. *vl = length.
+char *kv_get(void *h, const char *k, uint32_t kl, uint32_t *vl) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->table.find(std::string(k, kl));
+  if (it == s->table.end()) return nullptr;
+  *vl = (uint32_t)it->second.size();
+  char *out = (char *)malloc(it->second.size() ? it->second.size() : 1);
+  memcpy(out, it->second.data(), it->second.size());
+  return out;
+}
+
+// Range scan [start, end) (end empty = unbounded), ascending or
+// reverse, up to `limit` entries (0 = unlimited). Returns a malloc'd
+// packed buffer [klen u32][vlen u32][key][val]* ; *out_len = bytes,
+// *out_count = entries.
+char *kv_range(void *h, const char *start, uint32_t sl, const char *end,
+               uint32_t el, int reverse, uint64_t limit, uint64_t *out_len,
+               uint64_t *out_count) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string lo(start, sl), hi(end, el);
+  auto it = s->table.lower_bound(lo);
+  auto stop = el ? s->table.lower_bound(hi) : s->table.end();
+  std::vector<std::pair<const std::string *, const std::string *>> hits;
+  for (; it != stop; ++it) hits.emplace_back(&it->first, &it->second);
+  if (reverse) {
+    std::reverse(hits.begin(), hits.end());
+  }
+  if (limit && hits.size() > limit) hits.resize(limit);
+  uint64_t total = 0;
+  for (auto &kv : hits) total += 8 + kv.first->size() + kv.second->size();
+  char *buf = (char *)malloc(total ? total : 1);
+  uint64_t off = 0;
+  for (auto &kv : hits) {
+    uint32_t kl2 = (uint32_t)kv.first->size();
+    uint32_t vl2 = (uint32_t)kv.second->size();
+    memcpy(buf + off, &kl2, 4);
+    memcpy(buf + off + 4, &vl2, 4);
+    off += 8;
+    memcpy(buf + off, kv.first->data(), kl2);
+    off += kl2;
+    memcpy(buf + off, kv.second->data(), vl2);
+    off += vl2;
+  }
+  *out_len = total;
+  *out_count = hits.size();
+  return buf;
+}
+
+uint64_t kv_count(void *h) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->table.size();
+}
+
+int kv_flush(void *h) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  fflush(s->wal);
+  return 0;
+}
+
+// Write a fresh snapshot and truncate the WAL.
+int kv_compact(void *h) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string tmp = snap_path(s->dir) + ".tmp";
+  FILE *f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  for (auto &kv : s->table)
+    write_record(f, OP_PUT, kv.first.data(), (uint32_t)kv.first.size(),
+                 kv.second.data(), (uint32_t)kv.second.size());
+  fflush(f);
+  fclose(f);
+  if (rename(tmp.c_str(), snap_path(s->dir).c_str()) != 0) return -1;
+  fclose(s->wal);
+  s->wal = fopen(wal_path(s->dir).c_str(), "wb");
+  s->wal_records = 0;
+  return s->wal ? 0 : -1;
+}
+
+void kv_close(void *h) {
+  auto *s = static_cast<Store *>(h);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->wal) {
+      fflush(s->wal);
+      fclose(s->wal);
+    }
+  }
+  delete s;
+}
+
+void kv_free(char *p) { free(p); }
+
+}  // extern "C"

@@ -1,0 +1,21 @@
+"""Chain orchestration: clock, op pools, block import/production, dev node.
+
+Reference analog: beacon-node/src/chain (SURVEY.md §2.4) — BeaconChain
+(chain.ts:112), block pipeline (chain/blocks/), op pools
+(chain/opPools/), clock (util/clock.ts:66), `lodestar dev`.
+"""
+
+from .chain import BeaconChain, ChainError
+from .clock import Clock
+from .devnode import DevNode
+from .oppools import AggregatedAttestationPool, AttestationPool, OpPool
+
+__all__ = [
+    "AggregatedAttestationPool",
+    "AttestationPool",
+    "BeaconChain",
+    "ChainError",
+    "Clock",
+    "DevNode",
+    "OpPool",
+]

@@ -1,0 +1,355 @@
+"""BeaconChain: block import/production orchestration.
+
+Reference analog: BeaconChain (beacon-node/src/chain/chain.ts:112) and
+the block pipeline (chain/blocks/: verifyBlock.ts:38-100 runs state
+transition and signature verification in parallel; importBlock.ts wires
+fork choice, head update, pools). Here the signature sets go to the
+TPU verifier service while the host runs the (signature-free) state
+transition — the same split, with the worker pool replaced by device
+batch dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..bls import OracleBlsVerifier
+from ..forkchoice import Checkpoint, ExecutionStatus, ForkChoice, ProtoArray, ProtoNode
+from ..params import GENESIS_EPOCH, ForkSeq, preset
+from ..statetransition import BeaconStateView, state_transition, util
+from ..statetransition.block import BlockProcessError
+from ..statetransition.epoch import compute_unrealized_checkpoints
+from ..statetransition.signature_sets import get_block_signature_sets
+from ..statetransition.slot import process_slots
+
+MAX_CACHED_STATES = 48  # FIFOBlockStateCache-ish bound
+
+
+class ChainError(Exception):
+    pass
+
+
+def _clone(view: BeaconStateView, types) -> BeaconStateView:
+    t = view.state_type(types)
+    return BeaconStateView(
+        state=t.deserialize(t.serialize(view.state)), fork=view.fork
+    )
+
+
+def _checkpoint(cp) -> Checkpoint:
+    return Checkpoint(int(cp.epoch), bytes(cp.root))
+
+
+class BeaconChain:
+    def __init__(self, cfg, types, anchor: BeaconStateView, verifier=None):
+        self.cfg = cfg
+        self.types = types
+        self.verifier = verifier or OracleBlsVerifier()
+
+        p = preset()
+        state = anchor.state
+        # anchor block root: latest header with state_root filled
+        header_t = types.BeaconBlockHeader
+        header = header_t.default()
+        src = state.latest_block_header
+        header.slot = src.slot
+        header.proposer_index = src.proposer_index
+        header.parent_root = src.parent_root
+        header.body_root = src.body_root
+        header.state_root = (
+            bytes(src.state_root)
+            if bytes(src.state_root) != b"\x00" * 32
+            else anchor.hash_tree_root(types)
+        )
+        self.genesis_root = header_t.hash_tree_root(header)
+        self.genesis_time = state.genesis_time
+
+        anchor_epoch = util.compute_epoch_at_slot(state.slot)
+        anchor_cp = Checkpoint(anchor_epoch, self.genesis_root)
+        justified = (
+            _checkpoint(state.current_justified_checkpoint)
+            if anchor_epoch > GENESIS_EPOCH
+            else anchor_cp
+        )
+        finalized = (
+            _checkpoint(state.finalized_checkpoint)
+            if anchor_epoch > GENESIS_EPOCH
+            else anchor_cp
+        )
+        proto = ProtoArray(justified.epoch, finalized.epoch)
+        proto.on_block(
+            ProtoNode(
+                slot=state.slot,
+                block_root=self.genesis_root,
+                parent_root=None,
+                state_root=header.state_root,
+                target_root=self.genesis_root,
+                justified_epoch=justified.epoch,
+                finalized_epoch=finalized.epoch,
+                unrealized_justified_epoch=justified.epoch,
+                unrealized_finalized_epoch=finalized.epoch,
+                execution_status=ExecutionStatus.pre_merge,
+            )
+        )
+        balances = [v.effective_balance for v in state.validators]
+        self.fork_choice = ForkChoice(
+            cfg, proto, finalized, justified, balances, state.slot
+        )
+        self.head_root: bytes = self.genesis_root
+        self._states: dict[bytes, BeaconStateView] = {
+            self.genesis_root: anchor
+        }
+        self._state_order: list[bytes] = [self.genesis_root]
+        self._justified_root_seen = justified.root
+
+    # -- state access -----------------------------------------------------
+
+    @property
+    def head_state(self) -> BeaconStateView:
+        return self._states[self.head_root]
+
+    def get_state(self, block_root: bytes) -> BeaconStateView | None:
+        return self._states.get(block_root)
+
+    def _store_state(self, root: bytes, view: BeaconStateView) -> None:
+        if root not in self._states:
+            self._state_order.append(root)
+        self._states[root] = view
+        while len(self._state_order) > MAX_CACHED_STATES:
+            old = self._state_order.pop(0)
+            if old != self.head_root and old != self.genesis_root:
+                self._states.pop(old, None)
+            else:
+                self._state_order.append(old)
+                if all(
+                    r in (self.head_root, self.genesis_root)
+                    for r in self._state_order
+                ):
+                    break
+
+    # -- block import ------------------------------------------------------
+
+    async def process_block(self, signed_block) -> bytes:
+        """Full import: state transition + TPU signature batch + fork
+        choice + head update. Returns the block root."""
+        types = self.types
+        block = signed_block.message
+        parent = self.get_state(bytes(block.parent_root))
+        if parent is None:
+            raise ChainError("unknown parent state (no regen yet)")
+
+        work = _clone(parent, types)
+        process_slots(self.cfg, work, block.slot, types)
+
+        # signature sets against the advanced pre-state
+        sets = get_block_signature_sets(
+            self.cfg, work, signed_block, types
+        )
+        verify_task = asyncio.ensure_future(
+            self.verifier.verify_signature_sets(sets)
+        )
+        try:
+            state_transition(
+                self.cfg,
+                work,
+                signed_block,
+                types,
+                verify_state_root=True,
+                verify_proposer=False,
+                verify_signatures=False,
+            )
+        except BlockProcessError:
+            verify_task.cancel()
+            raise
+        if not await verify_task:
+            raise ChainError("block signature verification failed")
+
+        block_t = types.by_fork[work.fork].BeaconBlock
+        block_root = block_t.hash_tree_root(block)
+        self._store_state(block_root, work)
+
+        state = work.state
+        epoch = util.compute_epoch_at_slot(block.slot)
+        if block.slot % preset().SLOTS_PER_EPOCH == 0:
+            target_root = block_root
+        else:
+            target_root = bytes(util.get_block_root(state, epoch))
+        uj, uf = compute_unrealized_checkpoints(
+            self.cfg, state, types, work.fork_seq
+        )
+        exec_hash = None
+        if work.fork_seq >= ForkSeq.bellatrix:
+            exec_hash = bytes(
+                state.latest_execution_payload_header.block_hash
+            )
+        self.fork_choice.on_tick(max(self.fork_choice.current_slot, block.slot))
+        self.fork_choice.on_block(
+            slot=block.slot,
+            block_root=block_root,
+            parent_root=bytes(block.parent_root),
+            state_root=bytes(block.state_root),
+            target_root=target_root,
+            justified_checkpoint=_checkpoint(
+                state.current_justified_checkpoint
+            ),
+            finalized_checkpoint=_checkpoint(state.finalized_checkpoint),
+            unrealized_justified=_checkpoint(uj),
+            unrealized_finalized=_checkpoint(uf),
+            execution_block_hash=exec_hash,
+            execution_status=(
+                ExecutionStatus.valid if exec_hash else None
+            ),
+            is_timely=True,
+        )
+        self._refresh_justified_balances()
+        self.head_root = self.fork_choice.update_head()
+        return block_root
+
+    def _refresh_justified_balances(self) -> None:
+        jr = self.fork_choice.justified_checkpoint.root
+        if jr == self._justified_root_seen:
+            return
+        jview = self._states.get(jr)
+        if jview is not None:
+            epoch = self.fork_choice.justified_checkpoint.epoch
+            reg = jview.state.validators
+            self.fork_choice.set_justified_balances(
+                [
+                    v.effective_balance
+                    if v.activation_epoch <= epoch < v.exit_epoch
+                    else 0
+                    for v in reg
+                ]
+            )
+            self._justified_root_seen = jr
+
+    # -- attestations ------------------------------------------------------
+
+    async def on_attestation(self, attestation, committee) -> bool:
+        """Validate an (already committee-resolved) attestation's vote
+        and feed fork choice. Signature verification happens upstream
+        (gossip batch path / block import)."""
+        data = attestation.data
+        if not self.fork_choice.has_block(bytes(data.beacon_block_root)):
+            return False
+        bits = list(attestation.aggregation_bits)
+        indices = [int(v) for i, v in enumerate(committee) if bits[i]]
+        self.fork_choice.on_attestation(
+            indices, bytes(data.beacon_block_root), int(data.target.epoch)
+        )
+        return True
+
+    # -- block production --------------------------------------------------
+
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        attestations=None,
+        graffiti: bytes = b"\x00" * 32,
+        sync_aggregate=None,
+        proposer_slashings=(),
+        attester_slashings=(),
+        voluntary_exits=(),
+        bls_to_execution_changes=(),
+    ):
+        """Assemble + run the unsigned block, returning (block, post_view).
+        Reference: produceBlockWrapper/produceBlockBody (chain.ts:648,
+        produceBlockBody.ts)."""
+        types = self.types
+        head = self.get_state(self.head_root)
+        work = _clone(head, types)
+        process_slots(self.cfg, work, slot, types)
+        st = work.state
+        ns = types.by_fork[work.fork]
+
+        block = ns.BeaconBlock.default()
+        block.slot = slot
+        block.proposer_index = util.get_beacon_proposer_index(
+            st, electra=work.fork_seq >= ForkSeq.electra
+        )
+        block.parent_root = types.BeaconBlockHeader.hash_tree_root(
+            st.latest_block_header
+        )
+        body = ns.BeaconBlockBody.default()
+        body.randao_reveal = randao_reveal
+        body.eth1_data = st.eth1_data
+        body.graffiti = graffiti
+        body.attestations = list(attestations or [])
+        body.proposer_slashings = list(proposer_slashings)
+        body.attester_slashings = list(attester_slashings)
+        body.voluntary_exits = list(voluntary_exits)
+        if work.fork_seq >= ForkSeq.altair:
+            if sync_aggregate is None:
+                sync_aggregate = types.SyncAggregate.default()
+                sync_aggregate.sync_committee_bits = [False] * preset().SYNC_COMMITTEE_SIZE
+                sync_aggregate.sync_committee_signature = (
+                    b"\xc0" + b"\x00" * 95
+                )
+            body.sync_aggregate = sync_aggregate
+        if work.fork_seq >= ForkSeq.capella:
+            body.bls_to_execution_changes = list(bls_to_execution_changes)
+        if work.fork_seq >= ForkSeq.bellatrix:
+            body.execution_payload = self._build_dev_payload(work, slot)
+        block.body = body
+
+        signed = ns.SignedBeaconBlock.default()
+        signed.message = block
+        state_transition(
+            self.cfg,
+            work,
+            signed,
+            types,
+            verify_state_root=False,
+            verify_proposer=False,
+            verify_signatures=False,
+        )
+        block.state_root = work.hash_tree_root(types)
+        return block, work
+
+    def _build_dev_payload(self, work: BeaconStateView, slot: int):
+        """Deterministic mock execution payload for dev chains
+        (reference: ExecutionEngineMockBackend, execution/engine/mock.ts).
+        Satisfies process_execution_payload's parent/randao/timestamp
+        checks; block_hash is a fake chained hash."""
+        from hashlib import sha256
+
+        types = self.types
+        st = work.state
+        ns = types.by_fork[work.fork]
+        payload = ns.ExecutionPayload.default()
+        parent_hash = bytes(st.latest_execution_payload_header.block_hash)
+        payload.parent_hash = parent_hash
+        payload.prev_randao = bytes(
+            util.get_randao_mix(st, util.get_current_epoch(st))
+        )
+        payload.timestamp = (
+            st.genesis_time + slot * self.cfg.SECONDS_PER_SLOT
+        )
+        payload.block_number = slot
+        payload.gas_limit = 30_000_000
+        payload.block_hash = sha256(
+            b"dev-exec" + slot.to_bytes(8, "little") + parent_hash
+        ).digest()
+        if work.fork_seq >= ForkSeq.capella:
+            from ..statetransition.block import (
+                BlockCtx,
+                get_expected_withdrawals,
+            )
+
+            ctx = BlockCtx(self.cfg, st, types, work.fork_seq, False)
+            payload.withdrawals = get_expected_withdrawals(ctx)[0]
+        return payload
+
+    # -- finality ----------------------------------------------------------
+
+    @property
+    def finalized_checkpoint(self) -> Checkpoint:
+        return self.fork_choice.finalized_checkpoint
+
+    @property
+    def justified_checkpoint(self) -> Checkpoint:
+        return self.fork_choice.justified_checkpoint
+
+    async def close(self) -> None:
+        await self.verifier.close()

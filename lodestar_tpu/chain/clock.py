@@ -1,0 +1,78 @@
+"""Slot clock.
+
+Reference analog: beacon-node/src/chain/../util/clock.ts:66 — emits
+slot/epoch events off genesis time. Supports real (asyncio) ticking and
+manual stepping for dev chains/tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+from ..params import preset
+
+
+class Clock:
+    def __init__(self, cfg, genesis_time: int, now: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.genesis_time = genesis_time
+        self._now = now
+        self._slot_handlers: list[Callable[[int], Awaitable[None] | None]] = []
+        self._epoch_handlers: list[Callable[[int], Awaitable[None] | None]] = []
+        self._task: asyncio.Task | None = None
+
+    @property
+    def current_slot(self) -> int:
+        dt = self._now() - self.genesis_time
+        if dt < 0:
+            return 0
+        return int(dt // self.cfg.SECONDS_PER_SLOT)
+
+    @property
+    def current_epoch(self) -> int:
+        return self.current_slot // preset().SLOTS_PER_EPOCH
+
+    def seconds_into_slot(self) -> float:
+        dt = self._now() - self.genesis_time
+        return dt % self.cfg.SECONDS_PER_SLOT if dt >= 0 else 0.0
+
+    def on_slot(self, fn) -> None:
+        self._slot_handlers.append(fn)
+
+    def on_epoch(self, fn) -> None:
+        self._epoch_handlers.append(fn)
+
+    async def emit_slot(self, slot: int) -> None:
+        p = preset()
+        if slot % p.SLOTS_PER_EPOCH == 0:
+            for fn in self._epoch_handlers:
+                r = fn(slot // p.SLOTS_PER_EPOCH)
+                if asyncio.iscoroutine(r):
+                    await r
+        for fn in self._slot_handlers:
+            r = fn(slot)
+            if asyncio.iscoroutine(r):
+                await r
+
+    async def run(self) -> None:
+        """Real-time loop: sleep to each slot boundary, emit."""
+        last = self.current_slot - 1
+        while True:
+            slot = self.current_slot
+            if slot > last:
+                last = slot
+                await self.emit_slot(slot)
+            next_boundary = (
+                self.genesis_time + (last + 1) * self.cfg.SECONDS_PER_SLOT
+            )
+            await asyncio.sleep(max(0.01, next_boundary - self._now()))
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self.run())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None

@@ -1,0 +1,204 @@
+"""DevNode: single-process dev chain with in-proc interop validators.
+
+Reference analog: `lodestar dev` (cli/src/cmds/dev/) — instant-genesis
+local chain where one process hosts the beacon chain and all validator
+duties (propose, attest, sync-committee). Every block goes through the
+FULL import pipeline: signature-set extraction -> batch verification on
+the verifier service (TPU kernels) -> state transition -> fork choice.
+This is SURVEY.md §7 step 4's minimum end-to-end slice.
+"""
+
+from __future__ import annotations
+
+from ..crypto.bls.signature import aggregate_signatures, sign
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+    ForkSeq,
+    preset,
+)
+from ..ssz import uint64 as ssz_uint64
+from ..statetransition import create_interop_genesis_state, interop_secret_key, util
+from ..statetransition.block import compute_signing_root, get_domain
+from ..config.beacon_config import compute_signing_root_from_roots
+from .chain import BeaconChain
+from .oppools import AggregatedAttestationPool
+
+
+class DevNode:
+    def __init__(
+        self,
+        cfg,
+        types,
+        n_validators: int,
+        verifier=None,
+        genesis_time: int = 0,
+        verify_attestations: bool = True,
+    ):
+        self.cfg = cfg
+        self.types = types
+        self.n = n_validators
+        genesis = create_interop_genesis_state(
+            cfg, types, n_validators, genesis_time=genesis_time
+        )
+        self.chain = BeaconChain(cfg, types, genesis, verifier=verifier)
+        self.sks = {
+            i: interop_secret_key(i) for i in range(n_validators)
+        }
+        self.att_pool = AggregatedAttestationPool(types)
+        self.slot = genesis.state.slot
+        self.verify_attestations = verify_attestations
+
+    # -- duties ----------------------------------------------------------
+
+    def _sign_attestation(self, st, committee, data):
+        types = self.types
+        domain = get_domain(
+            self.cfg, st, DOMAIN_BEACON_ATTESTER, int(data.target.epoch)
+        )
+        root = compute_signing_root(types.AttestationData, data, domain)
+        sigs = [sign(self.sks[int(v)], root) for v in committee]
+        att = types.Attestation.default()
+        att.data = data
+        att.aggregation_bits = [True] * len(committee)
+        att.signature = aggregate_signatures(sigs)
+        return att
+
+    async def _attest_head(self) -> None:
+        """All committees of the current slot attest to the head block
+        (validator AttestationService analog, attestation.ts:35)."""
+        types = self.types
+        head_root = self.chain.head_root
+        view = self.chain.get_state(head_root)
+        st = view.state
+        s = self.slot
+        epoch = util.compute_epoch_at_slot(s)
+        sh = util.EpochShuffling(st, epoch)
+        try:
+            target_root = util.get_block_root(st, epoch)
+        except ValueError:
+            target_root = head_root  # epoch-start block is the head
+        for ci, committee in enumerate(sh.committees_at_slot(s)):
+            if not len(committee):
+                continue
+            data = types.AttestationData.default()
+            data.slot = s
+            data.index = ci
+            data.beacon_block_root = head_root
+            data.source = st.current_justified_checkpoint
+            tgt = types.Checkpoint.default()
+            tgt.epoch = epoch
+            tgt.root = target_root
+            data.target = tgt
+            att = self._sign_attestation(st, committee, data)
+            if self.verify_attestations:
+                from ..statetransition.signature_sets import SignatureSet
+                from ..crypto.bls.signature import aggregate_pubkeys
+
+                domain = get_domain(
+                    self.cfg, st, DOMAIN_BEACON_ATTESTER, epoch
+                )
+                root = compute_signing_root(
+                    types.AttestationData, data, domain
+                )
+                pk = aggregate_pubkeys(
+                    [bytes(st.validators[int(v)].pubkey) for v in committee]
+                )
+                ok = await self.chain.verifier.verify_signature_sets(
+                    [SignatureSet(pk, root, bytes(att.signature))],
+                    batchable=True,
+                )
+                if not ok:
+                    raise RuntimeError("gossip attestation failed verify")
+            self.att_pool.add(att)
+            await self.chain.on_attestation(att, committee)
+
+    def _sync_aggregate_for(self, parent_view, slot: int):
+        """Sync committee signs the previous slot's block root
+        (SyncCommitteeService analog)."""
+        types = self.types
+        st = parent_view.state
+        if parent_view.fork_seq < ForkSeq.altair:
+            return None
+        prev_slot = max(slot, 1) - 1
+        block_root = self.chain.head_root
+        domain = get_domain(
+            self.cfg,
+            st,
+            DOMAIN_SYNC_COMMITTEE,
+            util.compute_epoch_at_slot(prev_slot),
+        )
+        root = compute_signing_root_from_roots(block_root, domain)
+        pubkey2index = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        sigs = []
+        bits = []
+        for pk in st.current_sync_committee.pubkeys:
+            idx = pubkey2index[bytes(pk)]
+            sigs.append(sign(self.sks[idx], root))
+            bits.append(True)
+        sa = types.SyncAggregate.default()
+        sa.sync_committee_bits = bits
+        sa.sync_committee_signature = aggregate_signatures(sigs)
+        return sa
+
+    async def advance_slot(self) -> bytes:
+        """One full slot: propose (with pooled attestations + sync
+        aggregate), import through the verify pipeline, then attest."""
+        self.slot += 1
+        slot = self.slot
+        types = self.types
+        head = self.chain.get_state(self.chain.head_root)
+
+        # advance a scratch clone to compute proposer + domains
+        from .chain import _clone
+        from ..statetransition.slot import process_slots
+
+        scratch = _clone(head, types)
+        process_slots(self.cfg, scratch, slot, types)
+        st = scratch.state
+        proposer = util.get_beacon_proposer_index(
+            st, electra=scratch.fork_seq >= ForkSeq.electra
+        )
+        sk = self.sks[proposer]
+        epoch = util.get_current_epoch(st)
+        randao_reveal = sign(
+            sk,
+            compute_signing_root(
+                ssz_uint64, epoch, get_domain(self.cfg, st, DOMAIN_RANDAO)
+            ),
+        )
+        attestations = self.att_pool.get_attestations_for_block(slot)
+        sync_aggregate = self._sync_aggregate_for(scratch, slot)
+
+        block, post = self.chain.produce_block(
+            slot,
+            randao_reveal,
+            attestations=attestations,
+            sync_aggregate=sync_aggregate,
+        )
+        ns = types.by_fork[post.fork]
+        signed = ns.SignedBeaconBlock.default()
+        signed.message = block
+        signed.signature = sign(
+            sk,
+            compute_signing_root(
+                ns.BeaconBlock,
+                block,
+                get_domain(self.cfg, post.state, DOMAIN_BEACON_PROPOSER),
+            ),
+        )
+        root = await self.chain.process_block(signed)
+        await self._attest_head()
+        self.att_pool.prune(slot)
+        return root
+
+    async def run_until(self, slot: int) -> None:
+        while self.slot < slot:
+            await self.advance_slot()
+
+    async def close(self) -> None:
+        await self.chain.close()

@@ -1,0 +1,194 @@
+"""Operation pools: attestations awaiting aggregation / block packing.
+
+Reference analogs: AttestationPool (unaggregated, per-subnet,
+opPools/attestationPool.ts:66), AggregatedAttestationPool (block
+packing, aggregatedAttestationPool.ts:94 + MatchingDataAttestationGroup
+:453), OpPool (slashings/exits/blsChanges, opPool.ts:33).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..params import preset
+
+SLOTS_RETAINED = 8  # attestationPool.ts SLOTS_RETAINED
+
+
+class AttestationPool:
+    """Unaggregated single attestations keyed by (slot, data root).
+    `add` merges a single-bit attestation into the group's aggregate —
+    the naive CPU aggregation the reference does per subnet; the TPU
+    same-message batch path verifies them before they get here."""
+
+    def __init__(self, types):
+        self.types = types
+        # (slot, data_root) -> {"data": AttestationData, "bits": list,
+        #                        "sigs": {bit_index: signature}}
+        self._groups: dict[tuple, dict] = {}
+
+    def add(self, attestation, committee_len: int) -> None:
+        data = attestation.data
+        key = (
+            int(data.slot),
+            self.types.AttestationData.hash_tree_root(data),
+        )
+        g = self._groups.get(key)
+        if g is None:
+            g = {
+                "data": data,
+                "bits": [False] * committee_len,
+                "sigs": {},
+            }
+            self._groups[key] = g
+        bits = list(attestation.aggregation_bits)
+        for i, b in enumerate(bits):
+            if b and not g["bits"][i]:
+                g["bits"][i] = True
+                g["sigs"][i] = bytes(attestation.signature)
+
+    def get_aggregate(self, slot: int, data_root: bytes):
+        from ..crypto.bls.signature import aggregate_signatures
+
+        g = self._groups.get((slot, data_root))
+        if g is None or not g["sigs"]:
+            return None
+        agg = self.types.Attestation.default()
+        agg.data = g["data"]
+        agg.aggregation_bits = list(g["bits"])
+        agg.signature = aggregate_signatures(list(g["sigs"].values()))
+        return agg
+
+    def iter_groups(self, slot: int):
+        for (s, root), g in self._groups.items():
+            if s == slot:
+                yield root, g
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = current_slot - SLOTS_RETAINED
+        self._groups = {
+            k: v for k, v in self._groups.items() if k[0] > cutoff
+        }
+
+
+class AggregatedAttestationPool:
+    """Aggregated attestations for block packing, grouped by data."""
+
+    def __init__(self, types):
+        self.types = types
+        # (slot, data_root) -> list of {"bits": [...], "sig": bytes,
+        #                               "data": AttestationData}
+        self._groups: dict[tuple, list] = defaultdict(list)
+
+    def add(self, attestation) -> None:
+        data = attestation.data
+        key = (
+            int(data.slot),
+            self.types.AttestationData.hash_tree_root(data),
+        )
+        bits = list(attestation.aggregation_bits)
+        group = self._groups[key]
+        for existing in group:
+            if existing["bits"] == bits:
+                return  # exact duplicate
+        # keep only non-subset aggregates (MatchingDataAttestationGroup)
+        group[:] = [
+            e
+            for e in group
+            if not _is_subset(e["bits"], bits)
+        ]
+        if not any(_is_subset(bits, e["bits"]) for e in group):
+            group.append(
+                {
+                    "bits": bits,
+                    "sig": bytes(attestation.signature),
+                    "data": data,
+                }
+            )
+
+    def get_attestations_for_block(self, state_slot: int, max_atts=None):
+        """Best-coverage attestations includable at `state_slot`
+        (aggregatedAttestationPool.getAttestationsForBlock)."""
+        p = preset()
+        if max_atts is None:
+            max_atts = p.MAX_ATTESTATIONS
+        out = []
+        for (slot, _root), group in sorted(
+            self._groups.items(), key=lambda kv: -kv[0][0]
+        ):
+            if not (
+                slot + p.MIN_ATTESTATION_INCLUSION_DELAY <= state_slot
+                and state_slot <= slot + p.SLOTS_PER_EPOCH
+            ):
+                continue
+            for e in sorted(
+                group, key=lambda e: -sum(e["bits"])
+            ):
+                a = self.types.Attestation.default()
+                a.data = e["data"]
+                a.aggregation_bits = list(e["bits"])
+                a.signature = e["sig"]
+                out.append(a)
+                if len(out) >= max_atts:
+                    return out
+        return out
+
+    def prune(self, current_slot: int) -> None:
+        p = preset()
+        cutoff = current_slot - p.SLOTS_PER_EPOCH
+        self._groups = defaultdict(
+            list,
+            {k: v for k, v in self._groups.items() if k[0] > cutoff},
+        )
+
+
+def _is_subset(a: list[bool], b: list[bool]) -> bool:
+    """True if every set bit of a is set in b."""
+    return all((not x) or y for x, y in zip(a, b))
+
+
+class OpPool:
+    """Slashings / exits / bls-to-execution changes awaiting inclusion
+    (opPool.ts:33)."""
+
+    def __init__(self, types):
+        self.types = types
+        self.proposer_slashings: dict[int, object] = {}
+        self.attester_slashings: list = []
+        self.voluntary_exits: dict[int, object] = {}
+        self.bls_changes: dict[int, object] = {}
+
+    def add_proposer_slashing(self, s) -> None:
+        self.proposer_slashings[
+            int(s.signed_header_1.message.proposer_index)
+        ] = s
+
+    def add_attester_slashing(self, s) -> None:
+        self.attester_slashings.append(s)
+
+    def add_voluntary_exit(self, e) -> None:
+        self.voluntary_exits[int(e.message.validator_index)] = e
+
+    def add_bls_change(self, c) -> None:
+        self.bls_changes[int(c.message.validator_index)] = c
+
+    def get_for_block(self, state):
+        """Ops still valid against `state`, capped at block maxima."""
+        from ..params import FAR_FUTURE_EPOCH
+
+        p = preset()
+        slashings = [
+            s
+            for i, s in self.proposer_slashings.items()
+            if not state.validators[i].slashed
+        ][: p.MAX_PROPOSER_SLASHINGS]
+        att_slashings = self.attester_slashings[: p.MAX_ATTESTER_SLASHINGS]
+        exits = [
+            e
+            for i, e in self.voluntary_exits.items()
+            if state.validators[i].exit_epoch == FAR_FUTURE_EPOCH
+        ][: p.MAX_VOLUNTARY_EXITS]
+        changes = list(self.bls_changes.values())[
+            : p.MAX_BLS_TO_EXECUTION_CHANGES
+        ]
+        return slashings, att_slashings, exits, changes

@@ -210,10 +210,16 @@ def process_justification_and_finalization(cache, state, types) -> None:
             p.EFFECTIVE_BALANCE_INCREMENT,
             int(eb[tgt & ~cache.reg.slashed].sum()),
         )
-        # current-epoch target attesters
+        # current-epoch target attesters. At the epoch's first slot the
+        # epoch-start root is not in state yet (unrealized mid-epoch
+        # computation) — then no current-epoch attestation can have been
+        # included either, so the balance is zero.
         cur_tgt = np.zeros(cache.n, bool)
         shuffling = EpochShuffling(state, cache.current_epoch)
-        cur_target_root = get_block_root(state, cache.current_epoch)
+        try:
+            cur_target_root = get_block_root(state, cache.current_epoch)
+        except ValueError:
+            cur_target_root = None
         for att in state.current_epoch_attestations:
             if att.data.target.root != cur_target_root:
                 continue
@@ -696,6 +702,31 @@ def process_sync_committee_updates(cache, state, types) -> None:
     sc.pubkeys = pubkeys
     sc.aggregate_pubkey = aggregate_pubkeys(pubkeys)
     state.next_sync_committee = sc
+
+
+def compute_unrealized_checkpoints(cfg, state, types, fork_seq: int):
+    """What (justified, finalized) WOULD become if the epoch ended now —
+    the fork-choice 'unrealized' checkpoints (reference:
+    computeUnrealizedCheckpoints, fork-choice onBlock pull-up). Runs the
+    justification step on the live state and restores the mutated
+    fields."""
+    snapshot = (
+        state.previous_justified_checkpoint,
+        state.current_justified_checkpoint,
+        state.finalized_checkpoint,
+        list(state.justification_bits),
+    )
+    cache = EpochTransitionCache(cfg, state, fork_seq)
+    process_justification_and_finalization(cache, state, types)
+    uj = state.current_justified_checkpoint
+    uf = state.finalized_checkpoint
+    (
+        state.previous_justified_checkpoint,
+        state.current_justified_checkpoint,
+        state.finalized_checkpoint,
+        state.justification_bits,
+    ) = snapshot
+    return uj, uf
 
 
 # ---------------------------------------------------------------------------
