@@ -232,8 +232,12 @@ class ForkChoice:
             deltas,
             self.justified_checkpoint.epoch,
             self.finalized_checkpoint.epoch,
+            finalized_root=self.finalized_checkpoint.root,
+            current_slot=self.current_slot,
         )
-        self.head = self.proto.find_head(self.justified_checkpoint.root)
+        self.head = self.proto.find_head(
+            self.justified_checkpoint.root, current_slot=self.current_slot
+        )
         return self.head
 
     # -- queries ---------------------------------------------------------

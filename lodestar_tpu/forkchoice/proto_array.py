@@ -51,10 +51,16 @@ class ProtoArray:
         justified_epoch: int,
         finalized_epoch: int,
         prune_threshold: int = DEFAULT_PRUNE_THRESHOLD,
+        finalized_root: bytes | None = None,
     ):
         self.prune_threshold = prune_threshold
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        self.finalized_root = finalized_root
+        # advanced by apply_score_changes/find_head; drives the
+        # votingSourceEpoch+2 viability tolerance (protoArray.ts
+        # nodeIsViableForHead)
+        self.current_slot = 0
         self.nodes: list[ProtoNode] = []
         self.indices: dict[bytes, int] = {}
 
@@ -89,6 +95,8 @@ class ProtoArray:
         deltas: list[int],
         justified_epoch: int,
         finalized_epoch: int,
+        finalized_root: bytes | None = None,
+        current_slot: int | None = None,
     ) -> None:
         """One backward pass: apply vote deltas, bubble weights to
         parents, refresh best child/descendant (protoArray.ts
@@ -97,9 +105,20 @@ class ProtoArray:
             raise ProtoArrayError("deltas length mismatch")
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        if finalized_root is not None:
+            self.finalized_root = finalized_root
+        if current_slot is not None:
+            self.current_slot = max(self.current_slot, current_slot)
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
-            delta = deltas[i]
+            if node.execution_status == ExecutionStatus.invalid:
+                # an invalidated node must stay at zero weight no matter
+                # what vote movement says; force its applied delta to
+                # -weight so stale votes can't drive it negative
+                # (protoArray.ts applyScoreChanges nodeDelta)
+                delta = -node.weight
+            else:
+                delta = deltas[i]
             if delta:
                 node.weight += delta
                 if node.weight < 0:
@@ -113,17 +132,26 @@ class ProtoArray:
 
     # -- head ----------------------------------------------------------
 
-    def find_head(self, justified_root: bytes) -> bytes:
+    def find_head(
+        self, justified_root: bytes, current_slot: int | None = None
+    ) -> bytes:
+        if current_slot is not None:
+            self.current_slot = max(self.current_slot, current_slot)
         idx = self.indices.get(justified_root)
         if idx is None:
             raise ProtoArrayError("unknown justified root")
         node = self.nodes[idx]
-        best = (
-            self.nodes[node.best_descendant]
-            if node.best_descendant is not None
-            else node
+        best_idx = (
+            node.best_descendant if node.best_descendant is not None else idx
         )
-        if not self._node_is_viable_for_head(best):
+        best = self.nodes[best_idx]
+        # reference (protoArray.ts findHead) only runs the viability
+        # check when best != justified; an execution-invalid node must
+        # never become head in either case, so that part is checked
+        # unconditionally
+        if best.execution_status == ExecutionStatus.invalid:
+            raise ProtoArrayError("head candidate is execution-invalid")
+        if best_idx != idx and not self._node_is_viable_for_head(best):
             raise ProtoArrayError(
                 "best node is not viable for head (justified/finalized "
                 "mismatch or invalid execution)"
@@ -244,28 +272,69 @@ class ProtoArray:
     # -- internals -----------------------------------------------------
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """Spec filter_block_tree viability (protoArray.ts
+        nodeIsViableForHead): the node's voting source must match the
+        store's justified checkpoint or be no more than two epochs
+        behind the current epoch, and the node must descend from the
+        finalized root."""
         if node.execution_status == ExecutionStatus.invalid:
             return False
-        # spec filter_block_tree condition with unrealized justification
-        # (node counts as viable if its voting source matches the
-        # store's justified checkpoint, or it is ahead of it)
+        from ..params import preset
+
+        spe = preset().SLOTS_PER_EPOCH
+        current_epoch = self.current_slot // spe
+        # blocks from a previous epoch are filtered on their unrealized
+        # justification (what their state would justify at the epoch
+        # boundary); current-epoch blocks on the realized value
+        is_from_prev_epoch = node.slot // spe < current_epoch
+        voting_source_epoch = (
+            node.unrealized_justified_epoch
+            if is_from_prev_epoch
+            else node.justified_epoch
+        )
         correct_justified = (
             self.justified_epoch == 0
-            or node.justified_epoch == self.justified_epoch
-            or node.unrealized_justified_epoch >= self.justified_epoch
+            or voting_source_epoch == self.justified_epoch
+            or voting_source_epoch + 2 >= current_epoch
         )
         correct_finalized = (
             self.finalized_epoch == 0
-            or node.finalized_epoch >= self.finalized_epoch
-            or node.unrealized_finalized_epoch >= self.finalized_epoch
+            or self._is_finalized_root_or_descendant(node)
         )
         return correct_justified and correct_finalized
 
-    def _leads_to_viable_head(self, node: ProtoNode) -> bool:
-        if node.best_descendant is not None:
-            return self._node_is_viable_for_head(
-                self.nodes[node.best_descendant]
+    def _is_finalized_root_or_descendant(self, node: ProtoNode) -> bool:
+        """True iff node is the store's finalized root or descends from
+        it — a conflicting branch with a merely equal finalized_epoch
+        must not pass (protoArray.ts isFinalizedRootOrDescendant)."""
+        if self.finalized_root is None:
+            # root not tracked (legacy callers): fall back to the
+            # epoch-only check
+            return (
+                node.finalized_epoch >= self.finalized_epoch
+                or node.unrealized_finalized_epoch >= self.finalized_epoch
             )
+        fin_idx = self.indices.get(self.finalized_root)
+        if fin_idx is None:
+            # finalized block pruned below the anchor; everything we
+            # retain descends from it by construction
+            return True
+        idx: int | None = self.indices.get(node.block_root)
+        while idx is not None and idx >= fin_idx:
+            if idx == fin_idx:
+                return True
+            idx = self.nodes[idx].parent
+        return False
+
+    def _leads_to_viable_head(self, node: ProtoNode) -> bool:
+        # a node leads to a viable head if its best descendant is
+        # viable OR it is itself viable — a stale non-viable
+        # best_descendant pointer must not disqualify a viable node
+        # (protoArray.ts nodeLeadsToViableHead)
+        if node.best_descendant is not None and self._node_is_viable_for_head(
+            self.nodes[node.best_descendant]
+        ):
+            return True
         return self._node_is_viable_for_head(node)
 
     def _maybe_update_best_child_and_descendant(

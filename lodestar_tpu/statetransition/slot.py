@@ -151,15 +151,17 @@ def upgrade_to_altair(cfg, view: BeaconStateView, types) -> None:
     view.state = post
     view.fork = "altair"
 
-    # translate_participation over pre.previous_epoch_attestations
+    # translate_participation over pre.previous_epoch_attestations.
+    # Spec translate_participation asserts is_matching_source inside
+    # get_attestation_participation_flag_indices; a failure here means
+    # the pre-state held an attestation with a non-matching source,
+    # which is itself a bug — propagate rather than silently dropping
+    # participation flags (would change post-upgrade rewards).
     ctx = blockproc.BlockCtx(cfg, post, types, ForkSeq.altair, False)
     for att in pre.previous_epoch_attestations:
-        try:
-            flags = blockproc.get_attestation_participation_flag_indices(
-                ctx, att.data, att.inclusion_delay
-            )
-        except BlockProcessError:
-            continue
+        flags = blockproc.get_attestation_participation_flag_indices(
+            ctx, att.data, att.inclusion_delay
+        )
         shuffling = ctx.shuffling(att.data.target.epoch)
         committee = shuffling.committee(att.data.slot, att.data.index)
         bits = list(att.aggregation_bits)

@@ -80,13 +80,70 @@ class TestProtoArray:
         assert pa.find_head(_root(0)) == _root(2)
 
     def test_viability_filters_wrong_justification(self):
+        from lodestar_tpu.params import preset
+
+        spe = preset().SLOTS_PER_EPOCH
         pa = ProtoArray(1, 0)
         pa.on_block(_node(0, 0, None, je=1))
         pa.on_block(_node(1, 1, 0, je=1))
         pa.on_block(_node(2, 2, 1, je=0))  # stale justification
-        pa.apply_score_changes([0, 0, 100], 1, 0)
+        # far enough in the future that the votingSourceEpoch+2
+        # tolerance no longer saves the stale branch
+        pa.apply_score_changes([0, 0, 100], 1, 0, current_slot=3 * spe)
         # node 2 has je=0 < store 1 and unrealized 0 -> not viable
         assert pa.find_head(_root(0)) == _root(1)
+
+    def test_viability_tolerates_recent_voting_source(self):
+        # spec tolerance: a node whose voting source is within two
+        # epochs of current remains viable even if it mismatches the
+        # store's justified checkpoint
+        pa = ProtoArray(1, 0)
+        pa.on_block(_node(0, 0, None, je=1))
+        pa.on_block(_node(1, 1, 0, je=1))
+        pa.on_block(_node(2, 2, 1, je=0))
+        pa.apply_score_changes([0, 0, 100], 1, 0, current_slot=0)
+        assert pa.find_head(_root(0)) == _root(2)
+
+    def test_invalid_node_ignores_stale_vote_moves(self):
+        # a vote moving off an invalidated node must not drive its
+        # weight negative (ADVICE r1: forced -weight delta)
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        a = _node(1, 1, 0)
+        a.execution_status = ExecutionStatus.syncing
+        pa.on_block(a)
+        pa.apply_score_changes([0, 100], 0, 0)
+        pa.set_execution_invalid(_root(1))
+        # stale vote movement away from node 1 (its weight is already 0)
+        pa.apply_score_changes([0, -100], 0, 0)
+        assert pa.nodes[1].weight == 0
+        assert pa.find_head(_root(0)) == _root(0)
+
+    def test_finalized_descendance_filters_conflicting_branch(self):
+        from lodestar_tpu.params import preset
+
+        spe = preset().SLOTS_PER_EPOCH
+        # two branches off genesis; finalize one; the other must stop
+        # being viable even though its finalized_epoch matches
+        pa = ProtoArray(0, 0)
+        pa.on_block(_node(0, 0, None))
+        pa.on_block(_node(1, 1, 0, je=1))  # branch A (finalized)
+        pa.on_block(_node(1, 2, 0, je=1))  # branch B (conflicting)
+        pa.on_block(_node(2, 3, 1, je=1))
+        pa.on_block(_node(2, 4, 2, je=1))
+        for n in pa.nodes:
+            n.finalized_epoch = 1
+            n.unrealized_finalized_epoch = 1
+        pa.apply_score_changes(
+            [0, 0, 0, 0, 100],
+            1,
+            1,
+            finalized_root=_root(1),
+            current_slot=4 * spe,
+        )
+        # heavy branch B conflicts with the finalized root -> head must
+        # come from branch A
+        assert pa.find_head(_root(1)) == _root(3)
 
     def test_execution_invalidation_reorgs(self):
         pa = ProtoArray(0, 0)
