@@ -45,9 +45,24 @@ from . import limbs as L
 from .curve import FQ2_OPS, JacPoint, jac_from_affine, jac_select
 
 _U = -BLS_X  # positive |x|, low hamming weight
-_UBITS_AFTER_MSB = np.array(
-    [b == "1" for b in bin(_U)[3:]], np.bool_
-)  # 63 entries, MSB-first after the consumed top bit
+
+# |x| has hamming weight 6, so MSB-first square-and-multiply decomposes
+# into runs of squarings with only 5 multiplies. Precomputing the run
+# structure lets the hot loops scan over UNCONDITIONAL square/double
+# bodies (no per-iteration multiply+select) and unroll the 5
+# multiply/add steps between runs — the same structural trick blst's
+# serial code gets from branching on the exponent bits, expressed here
+# as static program structure (branch-free on device).
+_SEGMENTS: list[tuple[int, bool]] = []
+_run = 0
+for _b in bin(_U)[3:]:
+    _run += 1
+    if _b == "1":
+        _SEGMENTS.append((_run, True))
+        _run = 0
+if _run:
+    _SEGMENTS.append((_run, False))
+del _run, _b
 
 
 def _sparse_line(l0, l2, l3, batch):
@@ -127,22 +142,26 @@ def miller_loop(px, py, qx, qy):
     )
     T = jac_from_affine(FQ2_OPS, qx, qy)
     f = _norm12(tower.fq12_one(batch))
-    bits = jnp.asarray(_UBITS_AFTER_MSB)
 
-    def body(carry, bit):
+    def dbl_body(carry, _):
         T, f = carry
         T2, (d0, d2, d3) = _dbl_step(T, px, py)
-        f2 = tower.fq12_mul(
-            tower.fq12_sqr(f), _sparse_line(d0, d2, d3, batch)
+        f2 = _norm12(
+            tower.fq12_mul(
+                tower.fq12_sqr(f), _sparse_line(d0, d2, d3, batch)
+            )
         )
-        T3, (a0, a2, a3) = _add_step(T2, qx, qy, px, py)
-        f3 = tower.fq12_mul(f2, _sparse_line(a0, a2, a3, batch))
-        bitb = jnp.broadcast_to(bit, batch)
-        T_next = jac_select(FQ2_OPS, bitb, T3, T2)
-        f_next = _norm12(tower.fq12_select(bitb, f3, f2))
-        return (T_next, f_next), None
+        return (T2, f2), None
 
-    (_, f), _ = jax.lax.scan(body, (T, f), bits)
+    # runs of doubling-only iterations; the chord-line add step only at
+    # the 5 set bits of |x| (unrolled, no per-iteration select)
+    for run, has_add in _SEGMENTS:
+        (T, f), _ = jax.lax.scan(dbl_body, (T, f), None, length=run)
+        if has_add:
+            T, (a0, a2, a3) = _add_step(T, qx, qy, px, py)
+            f = _norm12(
+                tower.fq12_mul(f, _sparse_line(a0, a2, a3, batch))
+            )
     return tower.fq12_conj(f)
 
 
@@ -152,25 +171,20 @@ def miller_loop(px, py, qx, qy):
 
 
 def _pow_u(f):
-    """f^|x| on the cyclotomic subgroup via a 64-bit LSB-first scan."""
-    nbits = _U.bit_length()
-    bits = jnp.asarray(
-        np.array([(_U >> i) & 1 for i in range(nbits)], np.bool_)
-    )
+    """f^|x| on the cyclotomic subgroup: runs of cyclotomic squarings
+    (one scan per run) with the 5 multiplies of |x|'s hamming weight
+    unrolled between runs — no per-iteration multiply or select."""
     f = _norm12(f)
-    batch = f[0][0][0].v.shape[:-1]
-    one = _norm12(tower.fq12_one(batch))
 
-    def body(carry, bit):
-        result, base = carry
-        nxt = tower.fq12_mul(result, base)
-        bitb = jnp.broadcast_to(bit, batch)
-        result = _norm12(tower.fq12_select(bitb, nxt, result))
-        base = _norm12(tower.fq12_sqr(base))
-        return (result, base), None
+    def sqr_body(c, _):
+        return _norm12(tower.fq12_cyclotomic_sqr(c)), None
 
-    (result, _), _ = jax.lax.scan(body, (one, f), bits)
-    return result
+    r = f
+    for run, has_mul in _SEGMENTS:
+        r, _ = jax.lax.scan(sqr_body, r, None, length=run)
+        if has_mul:
+            r = _norm12(tower.fq12_mul(r, f))
+    return r
 
 
 def _pow_x(f):

@@ -231,6 +231,47 @@ def fq2_tuple_double(a):
     return tuple((L.mul_small(c[0], 2), L.mul_small(c[1], 2)) for c in a)
 
 
+def _fq4_sqr(x0, x1):
+    """Squaring in Fq4 = Fq2[W]/(W^2 - xi): (x0 + x1 W)^2 =
+    (x0^2 + xi x1^2) + (2 x0 x1) W. 2x0x1 via (x0+x1)^2 - x0^2 - x1^2
+    keeps it at 3 Fq2 squarings."""
+    s0 = fq2_sqr(x0)
+    s1 = fq2_sqr(x1)
+    sx = fq2_sqr(fq2_add(x0, x1))
+    r0 = fq2_add(s0, fq2_mul_by_xi(s1))
+    r1 = fq2_sub(fq2_sub(sx, s0), s1)
+    return r0, r1
+
+
+def fq12_cyclotomic_sqr(a):
+    """Granger-Scott squaring for unitary elements (the cyclotomic
+    subgroup final exponentiation lands in): 3 Fq4 squarings instead of
+    a full fq12_sqr. Derivation for this tower (w^2 = v, v^3 = xi):
+    with W = w^3 (W^2 = xi), the Fq4 pairs over w-powers
+    (w^0,w^3), (w^1,w^4), (w^2,w^5) are A=(g0,h1), B=(h0,g2), C=(g1,h2)
+    and f^2 = (3A^2 - 2conj A) + (3 C^2 W + 2conj B) w
+            + (3B^2 - 2conj C) w^2.
+    Validated against the oracle in tests/test_ops_pairing.py."""
+    (g0, g1, g2), (h0, h1, h2) = a
+
+    def three_minus_2(t, z):  # 3t - 2z
+        return fq2_sub(fq2_mul_small(t, 3), fq2_mul_small(z, 2))
+
+    def three_plus_2(t, z):  # 3t + 2z
+        return fq2_add(fq2_mul_small(t, 3), fq2_mul_small(z, 2))
+
+    a0, a1 = _fq4_sqr(g0, h1)
+    b0, b1 = _fq4_sqr(h0, g2)
+    c0, c1 = _fq4_sqr(g1, h2)
+    out_g0 = three_minus_2(a0, g0)
+    out_h1 = three_plus_2(a1, h1)
+    out_h0 = three_plus_2(fq2_mul_by_xi(c1), h0)
+    out_g2 = three_minus_2(c0, g2)
+    out_g1 = three_minus_2(b0, g1)
+    out_h2 = three_plus_2(b1, h2)
+    return ((out_g0, out_g1, out_g2), (out_h0, out_h1, out_h2))
+
+
 def fq12_conj(a):
     """f^(p^6): inverse on the cyclotomic subgroup (unitary elements)."""
     return (a[0], fq6_neg(a[1]))
