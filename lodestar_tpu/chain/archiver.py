@@ -1,0 +1,66 @@
+"""Archiver: migrate hot chain data to finalized archives on finality.
+
+Reference analog: chain/archiver/archiver.ts:20 +
+FrequencyStateArchiveStrategy (strategies/frequencyStateArchiveStrategy
+.ts:25): on each finalized-checkpoint advance, move finalized-canonical
+blocks from the hot repo to the slot-indexed archive, persist the
+finalized state, and drop non-canonical hot entries.
+"""
+
+from __future__ import annotations
+
+
+class Archiver:
+    def __init__(self, db, chain, state_archive_every_epochs: int = 1):
+        self.db = db
+        self.chain = chain
+        self.state_archive_every_epochs = state_archive_every_epochs
+        self._last_archived_epoch = -1
+
+    def on_finalized(self, checkpoint) -> None:
+        """checkpoint: forkchoice Checkpoint (epoch, root)."""
+        db = self.db
+        chain = self.chain
+        fin_root = checkpoint.root
+        proto = chain.fork_choice.proto
+        node = proto.get_node(fin_root)
+        if node is None:
+            return
+        # canonical finalized chain: finalized root and its ancestors
+        canonical = []
+        for n in proto.iter_chain(fin_root):
+            canonical.append(n)
+        # migrate hot blocks -> slot archive (skip if already archived)
+        for n in canonical:
+            raw = db.block.get_binary(n.block_root)
+            if raw is None:
+                continue
+            fork, block = db.block.decode_value(raw)
+            db.block_archive.put_with_indices(
+                n.slot, fork, block, n.block_root
+            )
+            db.block.delete(n.block_root)
+            db.state.delete(n.block_root)
+        # persist the finalized checkpoint state
+        if checkpoint.epoch - self._last_archived_epoch >= (
+            self.state_archive_every_epochs
+        ):
+            view = chain.get_state(fin_root)
+            if view is not None:
+                db.state_archive.put_binary(
+                    node.slot,
+                    db.state_archive.encode_fork_value(
+                        view.fork, view.state
+                    ),
+                )
+                db.checkpoint_state.put_binary(
+                    db.checkpoint_state.checkpoint_key(
+                        checkpoint.epoch, fin_root
+                    ),
+                    db.checkpoint_state.encode_fork_value(
+                        view.fork, view.state
+                    ),
+                )
+                self._last_archived_epoch = checkpoint.epoch
+        db.meta.put_raw("finalized_root", fin_root)
+        db.meta.put_int("finalized_epoch", checkpoint.epoch)

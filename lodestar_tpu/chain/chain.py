@@ -41,10 +41,33 @@ def _checkpoint(cp) -> Checkpoint:
 
 
 class BeaconChain:
-    def __init__(self, cfg, types, anchor: BeaconStateView, verifier=None):
+    def __init__(
+        self,
+        cfg,
+        types,
+        anchor: BeaconStateView,
+        verifier=None,
+        trusted_execution: bool = True,
+        db=None,
+    ):
         self.cfg = cfg
         self.types = types
         self.verifier = verifier or OracleBlsVerifier()
+        # persistence (BeaconDb) — optional; when present, imported
+        # blocks/states are written through and finality triggers the
+        # archiver (reference: importBlock.ts db writes + archiver.ts)
+        self.db = db
+        self.archiver = None
+        if db is not None:
+            from .archiver import Archiver
+
+            self.archiver = Archiver(db, self)
+        # Dev chains have no execution engine: self-built mock payloads
+        # are trusted (valid). With a real engine attached this must be
+        # False so payload blocks import optimistically (syncing) until
+        # an engine verdict flips them via fork_choice.proto
+        # set_execution_valid/invalid.
+        self.trusted_execution = trusted_execution
 
         p = preset()
         state = anchor.state
@@ -65,18 +88,17 @@ class BeaconChain:
         self.genesis_time = state.genesis_time
 
         anchor_epoch = util.compute_epoch_at_slot(state.slot)
+        # The anchor block IS the initial justified+finalized checkpoint
+        # (reference: forkChoice initialization from anchorState) — the
+        # state's own checkpoint roots point below the anchor and are
+        # unresolvable in a fresh proto array; imports pull the store's
+        # checkpoints up as new blocks justify.
         anchor_cp = Checkpoint(anchor_epoch, self.genesis_root)
-        justified = (
-            _checkpoint(state.current_justified_checkpoint)
-            if anchor_epoch > GENESIS_EPOCH
-            else anchor_cp
+        justified = anchor_cp
+        finalized = anchor_cp
+        proto = ProtoArray(
+            justified.epoch, finalized.epoch, finalized_root=finalized.root
         )
-        finalized = (
-            _checkpoint(state.finalized_checkpoint)
-            if anchor_epoch > GENESIS_EPOCH
-            else anchor_cp
-        )
-        proto = ProtoArray(justified.epoch, finalized.epoch)
         proto.on_block(
             ProtoNode(
                 slot=state.slot,
@@ -101,6 +123,64 @@ class BeaconChain:
         }
         self._state_order: list[bytes] = [self.genesis_root]
         self._justified_root_seen = justified.root
+        if db is not None:
+            db.meta.put_int("genesis_time", int(state.genesis_time))
+            db.meta.put_raw(
+                "genesis_validators_root",
+                bytes(state.genesis_validators_root),
+            )
+            db.meta.put_raw("anchor_root", self.genesis_root)
+            db.meta.put_raw("head_root", self.head_root)
+            if db.state.get_binary(self.genesis_root) is None:
+                db.state.put(
+                    self.genesis_root, (anchor.fork, anchor.state)
+                )
+
+    @classmethod
+    async def from_db(
+        cls, cfg, types, db, verifier=None, trusted_execution=True
+    ):
+        """Resume a chain from disk: anchor at the best persisted state
+        (latest archived finalized state, else the original anchor),
+        then replay hot blocks in slot order through the full import
+        pipeline (reference: initStateFromDb + loadFromDisk,
+        cli initBeaconState.ts / node/nodejs.ts:235)."""
+        anchor_view = None
+        archived = db.state_archive.values(reverse=True, limit=1)
+        if archived:
+            fork, state = archived[0]
+            anchor_view = BeaconStateView(state=state, fork=fork)
+        else:
+            anchor_root = db.meta.get_raw("anchor_root")
+            if anchor_root is None:
+                raise ChainError("empty database: no anchor state")
+            raw = db.state.get_binary(anchor_root)
+            if raw is None:
+                raise ChainError("anchor state missing from db")
+            fork, state = db.state.decode_value(raw)
+            anchor_view = BeaconStateView(state=state, fork=fork)
+        chain = cls(
+            cfg,
+            types,
+            anchor_view,
+            verifier=verifier,
+            trusted_execution=trusted_execution,
+            db=db,
+        )
+        # replay hot blocks above the anchor in slot order
+        anchor_slot = int(anchor_view.state.slot)
+        hot = []
+        for root, (fork, block) in db.block.entries():
+            if int(block.message.slot) > anchor_slot:
+                hot.append((int(block.message.slot), block))
+        hot.sort(key=lambda t: t[0])
+        for _, block in hot:
+            try:
+                await chain.process_block(block, is_timely=False)
+            except ChainError:
+                # non-canonical orphan whose parent was never persisted
+                continue
+        return chain
 
     # -- state access -----------------------------------------------------
 
@@ -129,9 +209,17 @@ class BeaconChain:
 
     # -- block import ------------------------------------------------------
 
-    async def process_block(self, signed_block) -> bytes:
+    async def process_block(
+        self, signed_block, is_timely: bool | None = None
+    ) -> bytes:
         """Full import: state transition + TPU signature batch + fork
-        choice + head update. Returns the block root."""
+        choice + head update. Returns the block root.
+
+        is_timely: proposer-boost eligibility. None derives it from the
+        wall clock (seconds-into-slot < SECONDS_PER_SLOT /
+        INTERVALS_PER_SLOT, reference importBlock.ts blockDelaySec
+        check); the devnode passes True because its simulated clock
+        produces exactly at the slot boundary."""
         types = self.types
         block = signed_block.message
         parent = self.get_state(bytes(block.parent_root))
@@ -182,6 +270,7 @@ class BeaconChain:
             exec_hash = bytes(
                 state.latest_execution_payload_header.block_hash
             )
+        prev_finalized = self.fork_choice.finalized_checkpoint.epoch
         self.fork_choice.on_tick(max(self.fork_choice.current_slot, block.slot))
         self.fork_choice.on_block(
             slot=block.slot,
@@ -197,13 +286,56 @@ class BeaconChain:
             unrealized_finalized=_checkpoint(uf),
             execution_block_hash=exec_hash,
             execution_status=(
-                ExecutionStatus.valid if exec_hash else None
+                (
+                    ExecutionStatus.valid
+                    if self.trusted_execution
+                    else ExecutionStatus.syncing
+                )
+                if exec_hash
+                else None
             ),
-            is_timely=True,
+            is_timely=(
+                self._is_timely(block.slot) if is_timely is None else is_timely
+            ),
         )
         self._refresh_justified_balances()
         self.head_root = self.fork_choice.update_head()
+        if self.db is not None:
+            self._persist_import(block_root, signed_block, work)
+            if self.fork_choice.finalized_checkpoint.epoch > prev_finalized:
+                self.archiver.on_finalized(
+                    self.fork_choice.finalized_checkpoint
+                )
         return block_root
+
+    def _persist_import(self, block_root, signed_block, work) -> None:
+        """Write-through on import (importBlock.ts writeBlockInputToDb +
+        head/meta updates)."""
+        db = self.db
+        db.block.put(block_root, (work.fork, signed_block))
+        # per-block states are NOT persisted (only the anchor and the
+        # archiver's checkpoint states are): resume rebuilds hot states
+        # by replaying blocks, matching the reference's block-only
+        # importBlock writes
+        db.meta.put_raw("head_root", self.head_root)
+        db.meta.put_int("latest_slot", int(signed_block.message.slot))
+        jc = self.fork_choice.justified_checkpoint
+        db.meta.put_raw("justified_root", jc.root)
+        db.meta.put_int("justified_epoch", jc.epoch)
+
+    def _is_timely(self, slot: int) -> bool:
+        """Arrived within the first interval of its slot per wall clock
+        (reference: importBlock.ts proposer-boost timeliness)."""
+        import time
+
+        from ..params import INTERVALS_PER_SLOT
+
+        sec_into_slot = (
+            time.time()
+            - (self.genesis_time + slot * self.cfg.SECONDS_PER_SLOT)
+        )
+        cutoff = self.cfg.SECONDS_PER_SLOT / INTERVALS_PER_SLOT
+        return 0 <= sec_into_slot < cutoff
 
     def _refresh_justified_balances(self) -> None:
         jr = self.fork_choice.justified_checkpoint.root

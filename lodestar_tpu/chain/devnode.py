@@ -36,6 +36,7 @@ class DevNode:
         verifier=None,
         genesis_time: int = 0,
         verify_attestations: bool = True,
+        db=None,
     ):
         self.cfg = cfg
         self.types = types
@@ -43,7 +44,9 @@ class DevNode:
         genesis = create_interop_genesis_state(
             cfg, types, n_validators, genesis_time=genesis_time
         )
-        self.chain = BeaconChain(cfg, types, genesis, verifier=verifier)
+        self.chain = BeaconChain(
+            cfg, types, genesis, verifier=verifier, db=db
+        )
         self.sks = {
             i: interop_secret_key(i) for i in range(n_validators)
         }
@@ -191,7 +194,8 @@ class DevNode:
                 get_domain(self.cfg, post.state, DOMAIN_BEACON_PROPOSER),
             ),
         )
-        root = await self.chain.process_block(signed)
+        # simulated clock: every self-produced block is at its slot start
+        root = await self.chain.process_block(signed, is_timely=True)
         await self._attest_head()
         self.att_pool.prune(slot)
         return root

@@ -1,0 +1,203 @@
+"""BeaconDb: the node's typed database.
+
+Reference analog: beacon-node/src/db/beacon.ts:31 + db/repositories/ —
+one KV store, per-object repositories in bucket-prefixed key ranges:
+hot blocks by root, finalized blocks by slot (with root/parent
+indices), state archive by slot, checkpoint states, op pools, and a
+chain-metadata bucket used on startup (`loadFromDisk`,
+node/nodejs.ts:235 / initStateFromDb).
+
+Fork-aware serde: blocks and states are stored as
+fork_seq byte + SSZ bytes, because container layouts differ per fork
+(reference solves this with config.getForkTypes at read time).
+"""
+
+from __future__ import annotations
+
+from ..params import ForkSeq
+from .buckets import Bucket, bucket_key, uint_key
+from .controller import (
+    DatabaseController,
+    MemoryDatabaseController,
+    NativeDatabaseController,
+)
+from .repository import Repository
+
+_FORKS = [f.name for f in ForkSeq]
+
+
+def _fork_tag(fork: str) -> bytes:
+    return bytes([int(ForkSeq[fork])])
+
+
+class _ForkTaggedRepository(Repository):
+    """Values prefixed with one fork byte; decode returns (fork, value)."""
+
+    def __init__(self, db, bucket, types, type_name: str, metrics=None):
+        super().__init__(db, bucket, None, metrics)
+        self.types = types
+        self.type_name = type_name
+
+    def _type_for(self, fork: str):
+        return getattr(self.types.by_fork[fork], self.type_name)
+
+    def encode_fork_value(self, fork: str, value) -> bytes:
+        return _fork_tag(fork) + self._type_for(fork).serialize(value)
+
+    def decode_value(self, data: bytes):
+        fork = _FORKS[data[0]]
+        return fork, self._type_for(fork).deserialize(data[1:])
+
+    def put(self, id, value) -> None:  # value = (fork, obj)
+        fork, obj = value
+        self.put_binary(id, self.encode_fork_value(fork, obj))
+
+
+class BlockRepository(_ForkTaggedRepository):
+    """Hot blocks: block root -> (fork, SignedBeaconBlock)."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(
+            db, Bucket.block, types, "SignedBeaconBlock", metrics
+        )
+
+
+class BlockArchiveRepository(_ForkTaggedRepository):
+    """Finalized blocks: slot -> (fork, SignedBeaconBlock) plus
+    root->slot and parent->slot indices (blockArchive.ts)."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(
+            db, Bucket.block_archive, types, "SignedBeaconBlock", metrics
+        )
+
+    def put_with_indices(
+        self, slot: int, fork: str, block, block_root: bytes
+    ) -> None:
+        parent_root = bytes(block.message.parent_root)
+        self.db.batch(
+            [
+                (
+                    "put",
+                    bucket_key(Bucket.block_archive, uint_key(slot)),
+                    self.encode_fork_value(fork, block),
+                ),
+                (
+                    "put",
+                    bucket_key(Bucket.block_archive_root_index, block_root),
+                    uint_key(slot),
+                ),
+                (
+                    "put",
+                    bucket_key(
+                        Bucket.block_archive_parent_index, parent_root
+                    ),
+                    uint_key(slot),
+                ),
+            ]
+        )
+
+    def slot_by_root(self, block_root: bytes) -> int | None:
+        raw = self.db.get(
+            bucket_key(Bucket.block_archive_root_index, block_root)
+        )
+        return None if raw is None else int.from_bytes(raw, "big")
+
+    def get_by_root(self, block_root: bytes):
+        slot = self.slot_by_root(block_root)
+        return None if slot is None else self.get(slot)
+
+
+class StateRepository(_ForkTaggedRepository):
+    """Hot states: block root -> (fork, BeaconState)."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(db, Bucket.state, types, "BeaconState", metrics)
+
+
+class StateArchiveRepository(_ForkTaggedRepository):
+    """Finalized states: slot -> (fork, BeaconState)."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(
+            db, Bucket.state_archive, types, "BeaconState", metrics
+        )
+
+
+class CheckpointStateRepository(_ForkTaggedRepository):
+    """Checkpoint states: epoch||root -> (fork, BeaconState)
+    (persistentCheckpointsCache datastore analog)."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(
+            db, Bucket.checkpoint_state, types, "BeaconState", metrics
+        )
+
+    def checkpoint_key(self, epoch: int, root: bytes) -> bytes:
+        return uint_key(epoch) + root
+
+
+class ChainMetaRepository(Repository):
+    """Fixed-key chain metadata: head/finalized/justified roots, anchor
+    info — what startup needs before any state is loaded."""
+
+    KEYS = (
+        "head_root",
+        "finalized_root",
+        "finalized_epoch",
+        "justified_root",
+        "justified_epoch",
+        "genesis_time",
+        "genesis_validators_root",
+        "latest_slot",
+    )
+
+    def __init__(self, db, metrics=None):
+        super().__init__(db, Bucket.chain_meta, None, metrics)
+
+    def encode_id(self, id):
+        return str(id).encode()
+
+    def put_raw(self, key: str, value: bytes) -> None:
+        self.put_binary(key, value)
+
+    def get_raw(self, key: str) -> bytes | None:
+        return self.get_binary(key)
+
+    def put_int(self, key: str, value: int) -> None:
+        self.put_binary(key, uint_key(value))
+
+    def get_int(self, key: str) -> int | None:
+        raw = self.get_binary(key)
+        return None if raw is None else int.from_bytes(raw, "big")
+
+
+class BeaconDb:
+    """Repository bundle over one controller (beacon.ts:31)."""
+
+    def __init__(self, controller: DatabaseController, types, metrics=None):
+        self.controller = controller
+        self.types = types
+        self.block = BlockRepository(controller, types, metrics)
+        self.block_archive = BlockArchiveRepository(
+            controller, types, metrics
+        )
+        self.state = StateRepository(controller, types, metrics)
+        self.state_archive = StateArchiveRepository(
+            controller, types, metrics
+        )
+        self.checkpoint_state = CheckpointStateRepository(
+            controller, types, metrics
+        )
+        self.meta = ChainMetaRepository(controller, metrics)
+
+    @classmethod
+    def open(cls, path, types, metrics=None) -> "BeaconDb":
+        return cls(NativeDatabaseController(path), types, metrics)
+
+    @classmethod
+    def in_memory(cls, types, metrics=None) -> "BeaconDb":
+        return cls(MemoryDatabaseController(), types, metrics)
+
+    def close(self) -> None:
+        self.controller.close()

@@ -1,0 +1,44 @@
+"""Bucket id allocation for the beacon database.
+
+Reference analog: beacon-node/src/db/buckets.ts — stable one-byte key
+prefixes so every repository lives in its own ordered key range of the
+single KV store. Values match the reference's allocation where a
+counterpart exists (so db dumps are recognisable), with unused ids
+skipped.
+"""
+
+from enum import IntEnum
+
+
+class Bucket(IntEnum):
+    # hot chain
+    block = 1                    # block root -> SignedBeaconBlock
+    state = 2                    # state root/block root -> BeaconState
+    checkpoint_state = 86        # checkpoint key -> BeaconState
+    # finalized chain
+    block_archive = 3            # slot -> SignedBeaconBlock
+    block_archive_parent_index = 4   # parent root -> slot
+    block_archive_root_index = 5     # block root -> slot
+    state_archive = 7            # slot -> BeaconState
+    state_archive_root_index = 26    # state root -> slot
+    # op pool
+    op_pool_attester_slashing = 12
+    op_pool_proposer_slashing = 13
+    op_pool_voluntary_exit = 14
+    op_pool_bls_to_execution_change = 24
+    # eth1
+    eth1_data = 16               # timestamp -> Eth1DataOrdered
+    deposit_data_root = 20       # deposit index -> root
+    # metadata
+    chain_meta = 40              # fixed keys -> misc chain metadata
+    backfilled_ranges = 42       # slot -> slot
+
+
+def bucket_key(bucket: Bucket, key: bytes) -> bytes:
+    return bytes([int(bucket)]) + key
+
+
+def uint_key(v: int) -> bytes:
+    """Big-endian 8-byte key: preserves numeric order under the store's
+    lexicographic ordering (classic-level uses the same encoding)."""
+    return int(v).to_bytes(8, "big")

@@ -1,0 +1,135 @@
+"""Abstract Repository over the KV controller.
+
+Reference analog: @lodestar/db `Repository<Id, Type>`
+(db/src/abstractRepository.ts:18): a bucket-prefixed key range with
+SSZ value serde, get/put/delete/batch and ordered iteration. Concrete
+repositories pick the id encoding (32-byte roots or big-endian slots).
+"""
+
+from __future__ import annotations
+
+from .buckets import Bucket, bucket_key, uint_key
+
+
+class Repository:
+    """bucket + ssz type -> typed KV access."""
+
+    def __init__(self, db, bucket: Bucket, ssz_type=None, metrics=None):
+        self.db = db
+        self.bucket = bucket
+        self.ssz_type = ssz_type
+        self.metrics = metrics
+
+    # id encoding (override in subclasses)
+    def encode_id(self, id) -> bytes:
+        if isinstance(id, (bytes, bytearray)):
+            return bytes(id)
+        return uint_key(id)
+
+    def decode_id(self, key: bytes):
+        if len(key) == 8:
+            return int.from_bytes(key, "big")
+        return key
+
+    def encode_value(self, value) -> bytes:
+        return self.ssz_type.serialize(value)
+
+    def decode_value(self, data: bytes):
+        return self.ssz_type.deserialize(data)
+
+    def _key(self, id) -> bytes:
+        return bucket_key(self.bucket, self.encode_id(id))
+
+    def _count(self, which: str) -> None:
+        if self.metrics is not None:
+            m = (
+                self.metrics.db.read_req_total
+                if which == "r"
+                else self.metrics.db.write_req_total
+            )
+            m.inc(bucket=self.bucket.name)
+
+    # -- typed access ---------------------------------------------------
+
+    def get(self, id):
+        self._count("r")
+        raw = self.db.get(self._key(id))
+        return None if raw is None else self.decode_value(raw)
+
+    def get_binary(self, id) -> bytes | None:
+        self._count("r")
+        return self.db.get(self._key(id))
+
+    def has(self, id) -> bool:
+        self._count("r")
+        return self.db.get(self._key(id)) is not None
+
+    def put(self, id, value) -> None:
+        self._count("w")
+        self.db.put(self._key(id), self.encode_value(value))
+
+    def put_binary(self, id, data: bytes) -> None:
+        self._count("w")
+        self.db.put(self._key(id), data)
+
+    def delete(self, id) -> None:
+        self._count("w")
+        self.db.delete(self._key(id))
+
+    def batch_put(self, items) -> None:
+        self._count("w")
+        self.db.batch(
+            [
+                ("put", self._key(i), self.encode_value(v))
+                for i, v in items
+            ]
+        )
+
+    def batch_delete(self, ids) -> None:
+        self._count("w")
+        self.db.batch([("del", self._key(i), None) for i in ids])
+
+    # -- ordered iteration ---------------------------------------------
+
+    def _range(self, start=None, end=None, reverse=False, limit=0):
+        prefix = bytes([int(self.bucket)])
+        lo = prefix + (self.encode_id(start) if start is not None else b"")
+        hi = (
+            prefix + self.encode_id(end)
+            if end is not None
+            else bytes([int(self.bucket) + 1])
+        )
+        return self.db.range(lo, hi, reverse=reverse, limit=limit)
+
+    def keys(self, start=None, end=None, reverse=False, limit=0):
+        self._count("r")
+        return [
+            self.decode_id(k[1:])
+            for k, _ in self._range(start, end, reverse, limit)
+        ]
+
+    def values(self, start=None, end=None, reverse=False, limit=0):
+        self._count("r")
+        return [
+            self.decode_value(v)
+            for _, v in self._range(start, end, reverse, limit)
+        ]
+
+    def entries(self, start=None, end=None, reverse=False, limit=0):
+        self._count("r")
+        return [
+            (self.decode_id(k[1:]), self.decode_value(v))
+            for k, v in self._range(start, end, reverse, limit)
+        ]
+
+    def first_value(self):
+        e = self._range(limit=1)
+        return self.decode_value(e[0][1]) if e else None
+
+    def last_value(self):
+        e = self._range(reverse=True, limit=1)
+        return self.decode_value(e[0][1]) if e else None
+
+    def last_key(self):
+        e = self._range(reverse=True, limit=1)
+        return self.decode_id(e[0][0][1:]) if e else None

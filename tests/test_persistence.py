@@ -1,0 +1,160 @@
+"""Persistence: repositories, write-through import, archiver, resume.
+
+Reference analogs: beacon-node/src/db/beacon.ts repositories, chain
+archiver (archiver.ts:20), and startup-from-db (nodejs.ts:235,
+initBeaconState.ts). The headline test kills a devnode mid-chain and
+resumes from disk with the same head (VERDICT r1 item 7's done-bar).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.db.beacon import BeaconDb
+from lodestar_tpu.db.controller import (
+    MemoryDatabaseController,
+    NativeDatabaseController,
+)
+from lodestar_tpu.params import preset
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class StubVerifier:
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+class TestRepositories:
+    def test_block_repo_fork_tagged_roundtrip(self, types):
+        db = BeaconDb.in_memory(types)
+        block = types.phase0.SignedBeaconBlock.default()
+        block.message.slot = 7
+        root = b"\x11" * 32
+        db.block.put(root, ("phase0", block))
+        fork, got = db.block.get(root)
+        assert fork == "phase0"
+        assert int(got.message.slot) == 7
+
+    def test_block_archive_indices(self, types):
+        db = BeaconDb.in_memory(types)
+        block = types.phase0.SignedBeaconBlock.default()
+        block.message.slot = 9
+        block.message.parent_root = b"\x22" * 32
+        root = b"\x33" * 32
+        db.block_archive.put_with_indices(9, "phase0", block, root)
+        assert db.block_archive.slot_by_root(root) == 9
+        fork, got = db.block_archive.get_by_root(root)
+        assert int(got.message.slot) == 9
+        # ordered iteration by slot
+        assert db.block_archive.keys() == [9]
+
+    def test_meta_roundtrip(self, types):
+        db = BeaconDb.in_memory(types)
+        db.meta.put_raw("head_root", b"\x44" * 32)
+        db.meta.put_int("latest_slot", 123)
+        assert db.meta.get_raw("head_root") == b"\x44" * 32
+        assert db.meta.get_int("latest_slot") == 123
+        assert db.meta.get_int("missing") is None
+
+
+class TestResume:
+    def test_devnode_restart_resumes_same_head(self, types, tmp_path):
+        cfg = _cfg()
+        db = BeaconDb(
+            NativeDatabaseController(tmp_path / "chaindb"), types
+        )
+        node = DevNode(
+            cfg, types, N, verifier=StubVerifier(),
+            verify_attestations=False, db=db,
+        )
+        p = preset()
+
+        async def run1():
+            # finality first lands at the 4-epoch boundary
+            await node.run_until(4 * p.SLOTS_PER_EPOCH + 2)
+            await node.close()
+
+        asyncio.run(run1())
+        head_before = node.chain.head_root
+        fin_before = node.chain.finalized_checkpoint.epoch
+        assert fin_before >= 1  # archiver must have fired
+        db.controller.flush()
+        db.close()
+
+        # "restart": fresh controller over the same directory
+        db2 = BeaconDb(
+            NativeDatabaseController(tmp_path / "chaindb"), types
+        )
+
+        async def run2():
+            chain = await BeaconChain.from_db(
+                cfg, types, db2, verifier=StubVerifier()
+            )
+            return chain
+
+        chain2 = asyncio.run(run2())
+        assert chain2.head_root == head_before
+        head_slot = chain2.get_state(chain2.head_root).state.slot
+        assert int(head_slot) == 4 * p.SLOTS_PER_EPOCH + 2
+        db2.close()
+
+    def test_archiver_migrates_finalized_blocks(self, types):
+        cfg = _cfg()
+        db = BeaconDb.in_memory(types)
+        node = DevNode(
+            cfg, types, N, verifier=StubVerifier(),
+            verify_attestations=False, db=db,
+        )
+        p = preset()
+
+        async def go():
+            await node.run_until(4 * p.SLOTS_PER_EPOCH + 1)
+            await node.close()
+
+        asyncio.run(go())
+        fin = node.chain.finalized_checkpoint
+        assert fin.epoch >= 2
+        # finalized-canonical blocks live in the slot archive now
+        archived_slots = db.block_archive.keys()
+        assert len(archived_slots) > 0
+        assert archived_slots == sorted(archived_slots)
+        # and are gone from the hot repo
+        for s in archived_slots:
+            fork, block = db.block_archive.get(s)
+            root = types.by_fork[fork].BeaconBlock.hash_tree_root(
+                block.message
+            )
+            assert db.block.get_binary(root) is None
+        # finalized state archived
+        assert len(db.state_archive.keys()) >= 1
