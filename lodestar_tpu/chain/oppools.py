@@ -147,6 +147,101 @@ def _is_subset(a: list[bool], b: list[bool]) -> bool:
     return all((not x) or y for x, y in zip(a, b))
 
 
+class SyncCommitteeMessagePool:
+    """Per-subnet sync messages aggregated into contributions
+    (syncCommitteeMessagePool.ts:36): key (slot, block_root, subnet),
+    value = subcommittee bits + aggregate signature."""
+
+    def __init__(self, types):
+        self.types = types
+        self._groups: dict[tuple, dict] = {}
+
+    def add(
+        self, slot: int, block_root: bytes, subnet: int,
+        index_in_subcommittee: int, signature: bytes,
+    ) -> None:
+        from ..params import SYNC_COMMITTEE_SUBNET_COUNT, preset
+
+        p = preset()
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        key = (slot, bytes(block_root), subnet)
+        g = self._groups.get(key)
+        if g is None:
+            g = {"bits": [False] * sub_size, "sigs": []}
+            self._groups[key] = g
+        if not g["bits"][index_in_subcommittee]:
+            g["bits"][index_in_subcommittee] = True
+            g["sigs"].append(bytes(signature))
+
+    def get_contribution(self, slot: int, block_root: bytes, subnet: int):
+        from ..crypto.bls.signature import aggregate_signatures
+
+        g = self._groups.get((slot, bytes(block_root), subnet))
+        if g is None or not g["sigs"]:
+            return None
+        return {
+            "slot": slot,
+            "beacon_block_root": bytes(block_root),
+            "subcommittee_index": subnet,
+            "aggregation_bits": list(g["bits"]),
+            "signature": aggregate_signatures(g["sigs"]),
+        }
+
+    def prune(self, current_slot: int) -> None:
+        self._groups = {
+            k: v for k, v in self._groups.items() if k[0] >= current_slot - 2
+        }
+
+
+class SyncContributionAndProofPool:
+    """Best contribution per (slot, root, subcommittee); merged into the
+    block's SyncAggregate (syncContributionAndProofPool.ts:43)."""
+
+    def __init__(self, types):
+        self.types = types
+        self._best: dict[tuple, dict] = {}
+
+    def add(self, contribution: dict) -> None:
+        key = (
+            contribution["slot"],
+            contribution["beacon_block_root"],
+            contribution["subcommittee_index"],
+        )
+        cur = self._best.get(key)
+        n = sum(contribution["aggregation_bits"])
+        if cur is None or n > sum(cur["aggregation_bits"]):
+            self._best[key] = contribution
+
+    def get_sync_aggregate(self, slot: int, block_root: bytes):
+        """Merge subcommittee contributions into one SyncAggregate."""
+        from ..crypto.bls.signature import aggregate_signatures
+        from ..params import SYNC_COMMITTEE_SUBNET_COUNT, preset
+
+        p = preset()
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        bits = [False] * p.SYNC_COMMITTEE_SIZE
+        sigs = []
+        for subnet in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            c = self._best.get((slot, bytes(block_root), subnet))
+            if c is None:
+                continue
+            for i, b in enumerate(c["aggregation_bits"]):
+                bits[subnet * sub_size + i] = b
+            sigs.append(c["signature"])
+        sa = self.types.SyncAggregate.default()
+        sa.sync_committee_bits = bits
+        if sigs:
+            sa.sync_committee_signature = aggregate_signatures(sigs)
+        else:
+            sa.sync_committee_signature = b"\xc0" + b"\x00" * 95
+        return sa
+
+    def prune(self, current_slot: int) -> None:
+        self._best = {
+            k: v for k, v in self._best.items() if k[0] >= current_slot - 2
+        }
+
+
 class OpPool:
     """Slashings / exits / bls-to-execution changes awaiting inclusion
     (opPool.ts:33)."""

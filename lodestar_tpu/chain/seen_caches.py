@@ -1,0 +1,138 @@
+"""Seen caches: first-seen dedup for gossip objects.
+
+Reference analog: beacon-node/src/chain/seenCache/ —
+`SeenAttesters`/`SeenAggregators` (seenAttesters.ts:20,49),
+`SeenAttestationDatas` (seenAttestationData.ts:55) caching resolved
+attestation data + committee per attData-key per slot for the batch
+path, `SeenBlockProposers` (seenBlockProposers.ts:11),
+`SeenSyncCommitteeMessages` (seenCommittee.ts:15). All prune by epoch/
+slot advance so memory is bounded by a small window.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class SeenAttesters:
+    """validator index -> last target epoch seen attesting.
+
+    Gossip rule: at most one attestation per validator per target epoch
+    (seenAttesters.ts)."""
+
+    def __init__(self, lowest_kept_epoch: int = 0):
+        self._by_epoch: dict[int, set[int]] = {}
+        self.lowest_kept_epoch = lowest_kept_epoch
+
+    def is_known(self, target_epoch: int, index: int) -> bool:
+        s = self._by_epoch.get(target_epoch)
+        return s is not None and index in s
+
+    def add(self, target_epoch: int, index: int) -> None:
+        if target_epoch < self.lowest_kept_epoch:
+            raise ValueError("epoch below pruned window")
+        self._by_epoch.setdefault(target_epoch, set()).add(index)
+
+    def prune(self, finalized_epoch: int) -> None:
+        self.lowest_kept_epoch = finalized_epoch
+        for e in [e for e in self._by_epoch if e < finalized_epoch]:
+            del self._by_epoch[e]
+
+
+class SeenAggregators(SeenAttesters):
+    """Same shape keyed on (target_epoch, committee_index) per
+    aggregator index (seenAttesters.ts:49)."""
+
+    def is_known_agg(self, epoch: int, committee: int, index: int) -> bool:
+        return self.is_known(epoch, (committee << 40) | index)
+
+    def add_agg(self, epoch: int, committee: int, index: int) -> None:
+        self.add(epoch, (committee << 40) | index)
+
+
+class AttDataCacheEntry:
+    """Resolved per-attData context shared by every attestation in a
+    same-message batch: committee indices, signing root, subnet."""
+
+    __slots__ = ("data", "committee", "signing_root", "subnet")
+
+    def __init__(self, data, committee, signing_root, subnet):
+        self.data = data
+        self.committee = committee
+        self.signing_root = signing_root
+        self.subnet = subnet
+
+
+class SeenAttestationDatas:
+    """slot -> attData-bytes -> AttDataCacheEntry, capped per slot
+    (seenAttestationData.ts:55). Resolving committee + signing root
+    once per key is what makes the 50k/slot firehose tractable."""
+
+    def __init__(self, max_per_slot: int = 512, slot_window: int = 2):
+        self.max_per_slot = max_per_slot
+        self.slot_window = slot_window
+        self._by_slot: dict[int, OrderedDict[bytes, AttDataCacheEntry]] = {}
+        self.lowest_kept_slot = 0
+        self.hits = 0
+        self.misses = 0
+        self.rejected_overflow = 0
+
+    def get(self, slot: int, key: bytes) -> AttDataCacheEntry | None:
+        entry = self._by_slot.get(slot, {}).get(key)
+        if entry is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return entry
+
+    def put(self, slot: int, key: bytes, entry: AttDataCacheEntry) -> bool:
+        if slot < self.lowest_kept_slot:
+            return False
+        m = self._by_slot.setdefault(slot, OrderedDict())
+        if key not in m and len(m) >= self.max_per_slot:
+            self.rejected_overflow += 1
+            return False
+        m[key] = entry
+        return True
+
+    def on_slot(self, clock_slot: int) -> None:
+        self.lowest_kept_slot = max(0, clock_slot - self.slot_window)
+        for s in [s for s in self._by_slot if s < self.lowest_kept_slot]:
+            del self._by_slot[s]
+
+
+class SeenBlockProposers:
+    """(slot, proposer) pairs seen via gossip blocks; one block per
+    proposer per slot (seenBlockProposers.ts:11)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[int]] = {}
+        self.finalized_slot = 0
+
+    def is_known(self, slot: int, proposer: int) -> bool:
+        return proposer in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, proposer: int) -> None:
+        self._by_slot.setdefault(slot, set()).add(proposer)
+
+    def prune(self, finalized_slot: int) -> None:
+        self.finalized_slot = finalized_slot
+        for s in [s for s in self._by_slot if s < finalized_slot]:
+            del self._by_slot[s]
+
+
+class SeenSyncCommitteeMessages:
+    """(slot, subnet, validator) dedup (seenCommittee.ts:15)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[tuple[int, int]]] = {}
+
+    def is_known(self, slot: int, subnet: int, index: int) -> bool:
+        return (subnet, index) in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, subnet: int, index: int) -> None:
+        self._by_slot.setdefault(slot, set()).add((subnet, index))
+
+    def prune(self, min_slot: int) -> None:
+        for s in [s for s in self._by_slot if s < min_slot]:
+            del self._by_slot[s]

@@ -1,0 +1,19 @@
+"""Gossip object validation.
+
+Reference analog: beacon-node/src/chain/validation/ — per-type gossip
+validators returning ACCEPT/IGNORE/REJECT, with the batched
+attestation path (`validateGossipAttestationsSameAttData`,
+attestation.ts:92) that feeds the TPU same-message kernel.
+"""
+
+from .attestation import (
+    AttestationValidator,
+    GossipAction,
+    GossipValidationError,
+)
+
+__all__ = [
+    "AttestationValidator",
+    "GossipAction",
+    "GossipValidationError",
+]

@@ -1,0 +1,221 @@
+"""Gossip attestation validation — batched same-attData path.
+
+Reference analog: chain/validation/attestation.ts —
+`validateGossipAttestationsSameAttData` (:92) and
+`validateAttestation` (:134-142): per-key checks run once and are
+cached in `SeenAttestationDatas`; per-attestation work is only
+bit/index resolution + dedup; signatures go to the verifier service as
+ONE same-message batch (the north-star TPU workload). Failed batches
+fan out per signature inside the verifier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+import numpy as np
+
+from ...bls import api as bls_api
+from ...params import (
+    ATTESTATION_SUBNET_COUNT,
+    DOMAIN_BEACON_ATTESTER,
+    preset,
+)
+from ...statetransition import util
+from ...statetransition.block import compute_signing_root, get_domain
+from ..seen_caches import (
+    AttDataCacheEntry,
+    SeenAttestationDatas,
+    SeenAttesters,
+)
+
+# gossip conditions (consensus spec p2p-interface.md)
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+
+
+class GossipAction(str, Enum):
+    ACCEPT = "ACCEPT"
+    IGNORE = "IGNORE"
+    REJECT = "REJECT"
+
+
+class GossipValidationError(Exception):
+    def __init__(self, action: GossipAction, reason: str):
+        super().__init__(f"{action}: {reason}")
+        self.action = action
+        self.reason = reason
+
+
+@dataclass
+class AttestationValidationResult:
+    action: GossipAction
+    reason: str = ""
+    validator_index: int | None = None
+
+
+class AttestationValidator:
+    """Owns the attestation seen caches and the batch validation flow.
+    One instance per node, bound to a BeaconChain + verifier."""
+
+    def __init__(self, cfg, types, chain, verifier):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+        self.verifier = verifier
+        self.seen_attesters = SeenAttesters()
+        self.seen_att_datas = SeenAttestationDatas()
+        self.clock_slot = 0
+
+    def on_slot(self, slot: int) -> None:
+        self.clock_slot = slot
+        self.seen_att_datas.on_slot(slot)
+
+    def att_data_key(self, data) -> bytes:
+        """The same-message grouping key: serialized AttestationData
+        (reference: attDataBase64 peeked from raw gossip bytes)."""
+        return self.types.AttestationData.serialize(data)
+
+    # -- per-key resolution (cached) ------------------------------------
+
+    def _resolve_att_data(self, data, key: bytes) -> AttDataCacheEntry:
+        slot = int(data.slot)
+        cached = self.seen_att_datas.get(slot, key)
+        if cached is not None:
+            return cached
+        # [IGNORE] propagation window (with 1-slot clock disparity)
+        if not (
+            slot <= self.clock_slot + 1
+            and self.clock_slot <= slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+        ):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "outside propagation slot range"
+            )
+        # [REJECT] target epoch must match the slot's epoch
+        target_epoch = int(data.target.epoch)
+        if target_epoch != util.compute_epoch_at_slot(slot):
+            raise GossipValidationError(
+                GossipAction.REJECT, "target epoch != slot epoch"
+            )
+        # [IGNORE] head block must be known (else unknown-block sync)
+        root = bytes(data.beacon_block_root)
+        if not self.chain.fork_choice.has_block(root):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "unknown beacon_block_root"
+            )
+        # [REJECT] block must descend from finalized checkpoint
+        if not self.chain.fork_choice.is_descendant_of_finalized(root):
+            raise GossipValidationError(
+                GossipAction.REJECT, "not descendant of finalized"
+            )
+        # [REJECT] target must be an ancestor at the epoch start
+        tgt_root = bytes(data.target.root)
+        expected_tgt = self.chain.fork_choice.proto.ancestor_at_slot(
+            root, target_epoch * preset().SLOTS_PER_EPOCH
+        )
+        if expected_tgt is not None and expected_tgt != tgt_root:
+            raise GossipValidationError(
+                GossipAction.REJECT, "target is not head's epoch ancestor"
+            )
+        # committee + signing root, once per key
+        view = self.chain.get_state(root) or self.chain.head_state
+        st = view.state
+        shuffling = util.EpochShuffling(st, target_epoch)
+        committees = shuffling.committees_at_slot(slot)
+        index = int(data.index)
+        if index >= len(committees):
+            raise GossipValidationError(
+                GossipAction.REJECT, "committee index out of range"
+            )
+        committee = committees[index]
+        domain = get_domain(
+            self.cfg, st, DOMAIN_BEACON_ATTESTER, target_epoch
+        )
+        signing_root = compute_signing_root(
+            self.types.AttestationData, data, domain
+        )
+        subnet = index % ATTESTATION_SUBNET_COUNT
+        entry = AttDataCacheEntry(data, committee, signing_root, subnet)
+        self.seen_att_datas.put(slot, key, entry)
+        return entry
+
+    # -- batch path -----------------------------------------------------
+
+    async def validate_gossip_attestations_same_att_data(
+        self, attestations: list
+    ) -> list[AttestationValidationResult]:
+        """Validate a chunk of single-bit attestations sharing one
+        AttestationData. Returns per-attestation results; accepted ones
+        have been fed to fork choice and the attestation pool is the
+        caller's job (processor forwards accepts)."""
+        if not attestations:
+            return []
+        key = self.att_data_key(attestations[0].data)
+        out: list[AttestationValidationResult] = []
+        try:
+            entry = self._resolve_att_data(attestations[0].data, key)
+        except GossipValidationError as e:
+            return [
+                AttestationValidationResult(e.action, e.reason)
+                for _ in attestations
+            ]
+
+        committee = entry.committee
+        pending = []  # (result-slot index, validator_index, att)
+        for att in attestations:
+            bits = np.asarray(att.aggregation_bits, bool)
+            res = AttestationValidationResult(GossipAction.ACCEPT)
+            out.append(res)
+            # [REJECT] exactly one aggregation bit, matching committee len
+            if len(bits) != len(committee) or bits.sum() != 1:
+                res.action = GossipAction.REJECT
+                res.reason = "not a single-bit attestation"
+                continue
+            vindex = int(committee[int(np.argmax(bits))])
+            res.validator_index = vindex
+            # [IGNORE] already seen this validator for the target epoch
+            epoch = int(att.data.target.epoch)
+            if self.seen_attesters.is_known(epoch, vindex):
+                res.action = GossipAction.IGNORE
+                res.reason = "already seen attester"
+                continue
+            pending.append((len(out) - 1, vindex, att))
+
+        if not pending:
+            return out
+
+        view = self.chain.get_state(
+            bytes(entry.data.beacon_block_root)
+        ) or self.chain.head_state
+        validators = view.state.validators
+        sets = [
+            bls_api.SameMessageSet(
+                pubkey=bytes(validators[v].pubkey),
+                signature=bytes(att.signature),
+            )
+            for _, v, att in pending
+        ]
+        verdicts = await self.verifier.verify_signature_sets_same_message(
+            sets, entry.signing_root
+        )
+        for (slot_i, vindex, att), ok in zip(pending, verdicts):
+            res = out[slot_i]
+            if not ok:
+                res.action = GossipAction.REJECT
+                res.reason = "invalid signature"
+                continue
+            # double-observation check after async verify
+            # (attestation.ts:155-165): another copy may have been
+            # accepted while this batch was in flight
+            epoch = int(att.data.target.epoch)
+            if self.seen_attesters.is_known(epoch, vindex):
+                res.action = GossipAction.IGNORE
+                res.reason = "seen during verification"
+                continue
+            self.seen_attesters.add(epoch, vindex)
+            self.chain.fork_choice.on_attestation(
+                [vindex],
+                bytes(att.data.beacon_block_root),
+                epoch,
+            )
+        return out

@@ -1,0 +1,24 @@
+"""Networking layer: gossip ingest queues, processor, reqresp, and the
+in-process transport used by sync.
+
+Reference analog: beacon-node/src/network/ (SURVEY.md §2.4). The
+internet-facing libp2p stack stays host/CPU; what this package owns is
+everything between the wire and the chain: bounded gossip queues with
+attData-keyed batching, the work-order processor with verifier
+backpressure, and reqresp protocol framing.
+"""
+
+from .gossip_queues import (
+    IndexedGossipQueueMinSize,
+    LinearGossipQueue,
+    QueueType,
+)
+from .processor import GossipTopic, NetworkProcessor
+
+__all__ = [
+    "IndexedGossipQueueMinSize",
+    "LinearGossipQueue",
+    "QueueType",
+    "GossipTopic",
+    "NetworkProcessor",
+]

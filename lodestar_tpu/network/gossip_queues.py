@@ -1,0 +1,162 @@
+"""Bounded per-topic gossip queues.
+
+Reference analog: beacon-node/src/network/processor/gossipQueues/ —
+`LinearGossipQueue` (linear.ts:12) with FIFO/LIFO order and
+drop-on-overflow, and `IndexedGossipQueueMinSize` (indexed.ts:30): the
+attestation queue that groups messages by attestation-data key so one
+same-message TPU batch covers a whole chunk. The grouping key defines
+the device batch (SURVEY.md §2.2 topic-keyed batch accumulation).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from enum import Enum
+
+
+class QueueType(str, Enum):
+    FIFO = "FIFO"
+    LIFO = "LIFO"
+
+
+# Reference constants (gossipQueues/index.ts): batches above this size
+# hurt the retry path more than they help the happy path; below the min
+# size it's worth waiting MINIMUM_WAIT_TIME_MS to accumulate more.
+MAX_GOSSIP_ATTESTATION_BATCH_SIZE = 128
+MIN_SIGNATURE_SETS_TO_BATCH_VERIFY = 32
+MINIMUM_WAIT_TIME_MS = 50
+
+
+class LinearGossipQueue:
+    """Bounded queue; overflow drops from the opposite end."""
+
+    def __init__(self, max_length: int, order: QueueType = QueueType.FIFO):
+        self.max_length = max_length
+        self.order = order
+        self._items: deque = deque()
+        self.dropped_total = 0
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, item) -> int:
+        """Returns number of dropped items (0 or 1)."""
+        self._items.append(item)
+        if len(self._items) > self.max_length:
+            # FIFO keeps the oldest work flowing, so overflow drops the
+            # newest; LIFO serves the newest first and sheds the oldest
+            if self.order == QueueType.FIFO:
+                self._items.pop()
+            else:
+                self._items.popleft()
+            self.dropped_total += 1
+            return 1
+        return 0
+
+    def next(self):
+        if not self._items:
+            return None
+        if self.order == QueueType.FIFO:
+            return self._items.popleft()
+        return self._items.pop()
+
+    def clear(self) -> None:
+        self._items.clear()
+
+
+class IndexedGossipQueueMinSize:
+    """Attestation queue grouping items by a key (attestation-data
+    bytes); `next()` returns up to max_chunk_size items sharing one key,
+    preferring keys that already reached min_chunk_size (LIFO over
+    keys), else the newest key once its items waited >= min_wait_ms.
+
+    Each returned chunk is exactly one same-message verification batch.
+    """
+
+    def __init__(
+        self,
+        index_fn,
+        max_length: int = 24576,
+        min_chunk_size: int = MIN_SIGNATURE_SETS_TO_BATCH_VERIFY,
+        max_chunk_size: int = MAX_GOSSIP_ATTESTATION_BATCH_SIZE,
+        min_wait_ms: int = MINIMUM_WAIT_TIME_MS,
+    ):
+        if not 0 <= min_chunk_size <= max_chunk_size:
+            raise ValueError("invalid chunk sizes")
+        self.index_fn = index_fn
+        self.max_length = max_length
+        self.min_chunk_size = min_chunk_size
+        self.max_chunk_size = max_chunk_size
+        self.min_wait_ms = min_wait_ms
+        # key -> (first_seen_ms, deque of items); insertion-ordered
+        self._by_key: OrderedDict[bytes, tuple[float, deque]] = OrderedDict()
+        self._min_size_keys: OrderedDict[bytes, None] = OrderedDict()
+        self._length = 0
+        self.dropped_total = 0
+
+    def __len__(self) -> int:
+        return self._length
+
+    @property
+    def key_count(self) -> int:
+        return len(self._by_key)
+
+    def add(self, item) -> int:
+        key = self.index_fn(item)
+        if key is None:
+            return 0
+        entry = self._by_key.get(key)
+        if entry is None:
+            entry = (time.monotonic() * 1000, deque())
+            self._by_key[key] = entry
+        entry[1].append(item)
+        if len(entry[1]) >= self.min_chunk_size:
+            self._min_size_keys[key] = None
+            self._min_size_keys.move_to_end(key)
+        self._length += 1
+        if self._length <= self.max_length:
+            return 0
+        # overflow: drop the oldest item of the oldest key
+        first_key, (seen, items) = next(iter(self._by_key.items()))
+        items.popleft()
+        self._length -= 1
+        self.dropped_total += 1
+        if not items:
+            self._drop_key(first_key)
+        return 1
+
+    def _drop_key(self, key) -> None:
+        self._by_key.pop(key, None)
+        self._min_size_keys.pop(key, None)
+
+    def next(self) -> list | None:
+        """One same-key chunk, or None if nothing is ready yet."""
+        # newest key that reached min_chunk_size first (LIFO-ish)
+        if self._min_size_keys:
+            key = next(reversed(self._min_size_keys))
+            return self._pop_chunk(key)
+        # else: the newest key whose items have waited long enough
+        now_ms = time.monotonic() * 1000
+        for key in reversed(self._by_key):
+            seen, _items = self._by_key[key]
+            if now_ms - seen >= self.min_wait_ms:
+                return self._pop_chunk(key)
+        return None
+
+    def _pop_chunk(self, key) -> list:
+        seen, items = self._by_key[key]
+        out = []
+        while items and len(out) < self.max_chunk_size:
+            out.append(items.popleft())
+        self._length -= len(out)
+        if not items:
+            self._drop_key(key)
+        elif len(items) < self.min_chunk_size:
+            self._min_size_keys.pop(key, None)
+        return out
+
+    def clear(self) -> None:
+        self._by_key.clear()
+        self._min_size_keys.clear()
+        self._length = 0

@@ -1,0 +1,160 @@
+"""NetworkProcessor: gossip ingest with bounded queues + backpressure.
+
+Reference analog: beacon-node/src/network/processor/index.ts:148 — the
+work-order table between gossipsub and the chain: per-topic queues
+(attestations through `IndexedGossipQueueMinSize`), blocks bypass the
+queues, work execution yields to the event loop and is gated on
+`chain.bls.canAcceptWork()` (the verifier-service backpressure contract
+the TPU dispatch keeps, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..chain.validation import GossipAction
+from .gossip_queues import (
+    IndexedGossipQueueMinSize,
+    LinearGossipQueue,
+    QueueType,
+)
+
+
+class GossipTopic:
+    beacon_block = "beacon_block"
+    beacon_attestation = "beacon_attestation"
+    beacon_aggregate_and_proof = "beacon_aggregate_and_proof"
+    voluntary_exit = "voluntary_exit"
+    proposer_slashing = "proposer_slashing"
+    attester_slashing = "attester_slashing"
+    sync_committee = "sync_committee"
+
+
+class NetworkProcessor:
+    """Single-loop ingest pump. Producers call `on_gossip_message`;
+    an internal task drains queues whenever the verifier can accept
+    work, handing attestation chunks to the batch validator."""
+
+    def __init__(
+        self,
+        chain,
+        attestation_validator,
+        verifier,
+        att_pool=None,
+        metrics=None,
+        max_batches_in_flight: int = 4,
+    ):
+        self.chain = chain
+        self.validator = attestation_validator
+        self.verifier = verifier
+        self.att_pool = att_pool
+        self.metrics = metrics
+        self.att_queue = IndexedGossipQueueMinSize(
+            index_fn=lambda att: self.validator.att_data_key(att.data),
+        )
+        self.aggregate_queue = LinearGossipQueue(5120, QueueType.LIFO)
+        self.exit_queue = LinearGossipQueue(4096, QueueType.FIFO)
+        self._wake = asyncio.Event()
+        self._pump_task: asyncio.Task | None = None
+        self._closed = False
+        self._in_flight = 0
+        self._max_in_flight = max_batches_in_flight
+        self.accepted = 0
+        self.ignored = 0
+        self.rejected = 0
+        self.dropped = 0
+
+    # -- producer side --------------------------------------------------
+
+    def on_gossip_message(self, topic: str, obj) -> None:
+        """Non-async enqueue (gossip thread -> main loop boundary in the
+        reference; here producers run on the same loop)."""
+        if topic == GossipTopic.beacon_attestation:
+            self.dropped += self.att_queue.add(obj)
+        elif topic == GossipTopic.beacon_aggregate_and_proof:
+            self.dropped += self.aggregate_queue.add(obj)
+        else:
+            self.dropped += self.exit_queue.add(obj)
+        if self.metrics is not None:
+            self.metrics.gossip.queue_length.set(
+                len(self.att_queue), topic=GossipTopic.beacon_attestation
+            )
+        self._wake.set()
+
+    async def process_block(self, signed_block):
+        """Blocks bypass the queues entirely (processor/index.ts:66-80
+        `bypassQueue`)."""
+        return await self.chain.process_block(signed_block)
+
+    # -- pump -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._pump_task is None:
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def stop(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._pump_task is not None:
+            await self._pump_task
+            self._pump_task = None
+
+    async def drain(self) -> None:
+        """Wait until every queued attestation chunk has been handed to
+        the verifier and resolved (test/bench hook)."""
+        while len(self.att_queue) or self._in_flight:
+            await asyncio.sleep(0.005)
+
+    async def _pump(self) -> None:
+        while not self._closed:
+            progressed = await self._execute_work()
+            if not progressed:
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+                except asyncio.TimeoutError:
+                    pass  # re-check min-wait chunks
+
+    async def _execute_work(self) -> bool:
+        """One scheduling round; True if any work was dispatched."""
+        if self._in_flight >= self._max_in_flight:
+            await asyncio.sleep(0)
+            return False
+        # backpressure: don't pull work the verifier can't take
+        # (processor executeWork gating on canAcceptWork)
+        if not self.verifier.can_accept_work():
+            await asyncio.sleep(0.005)
+            return False
+        chunk = self.att_queue.next()
+        if chunk:
+            self._in_flight += 1
+            asyncio.ensure_future(self._run_att_chunk(chunk))
+            return True
+        return False
+
+    async def _run_att_chunk(self, chunk: list) -> None:
+        try:
+            results = (
+                await self.validator.validate_gossip_attestations_same_att_data(
+                    chunk
+                )
+            )
+            for att, res in zip(chunk, results):
+                if res.action == GossipAction.ACCEPT:
+                    self.accepted += 1
+                    if self.att_pool is not None:
+                        self.att_pool.add(att)
+                elif res.action == GossipAction.IGNORE:
+                    self.ignored += 1
+                else:
+                    self.rejected += 1
+                if self.metrics is not None:
+                    bucket = {
+                        GossipAction.ACCEPT: self.metrics.gossip.accept_total,
+                        GossipAction.IGNORE: self.metrics.gossip.ignore_total,
+                        GossipAction.REJECT: self.metrics.gossip.reject_total,
+                    }[res.action]
+                    bucket.inc(topic=GossipTopic.beacon_attestation)
+        finally:
+            self._in_flight -= 1
+            self._wake.set()

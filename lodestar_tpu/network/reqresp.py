@@ -1,0 +1,273 @@
+"""ReqResp: eth2 request/response protocols over a pluggable transport.
+
+Reference analogs: the transport-only protocol engine
+(packages/reqresp/src/ReqResp.ts:46) with `ssz_snappy` encoding
+(encodingStrategies/sszSnappy/), and the beacon-node protocol table
+`ReqRespBeaconNode` (network/reqresp/ReqRespBeaconNode.ts:62,
+protocols.ts:7-95): Status, Goodbye, Ping, Metadata,
+BeaconBlocksByRange, BeaconBlocksByRoot. Server handlers stream from
+chain/db (network/reqresp/handlers/*.ts).
+
+Wire format per the consensus p2p spec:
+  request  = ssz_snappy(payload)
+  response = chunks of: <result:1 byte> <context-bytes?> <ssz_snappy>
+with result 0 = success, 1 = InvalidRequest, 2 = ServerError,
+3 = ResourceUnavailable. v2 block responses carry a 4-byte fork-digest
+context. The transport here is in-process (two nodes in one process,
+SURVEY.md §4 e2e style); the framing is the real one so a socket
+transport can slot in underneath.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from ..utils import snappy
+
+# protocol ids (p2p spec names; /eth2/beacon_chain/req/ prefix)
+PROTOCOL_STATUS = "status/1"
+PROTOCOL_GOODBYE = "goodbye/1"
+PROTOCOL_PING = "ping/1"
+PROTOCOL_BLOCKS_BY_RANGE = "beacon_blocks_by_range/2"
+PROTOCOL_BLOCKS_BY_ROOT = "beacon_blocks_by_root/2"
+
+RESP_SUCCESS = 0
+RESP_INVALID_REQUEST = 1
+RESP_SERVER_ERROR = 2
+RESP_RESOURCE_UNAVAILABLE = 3
+
+MAX_REQUEST_BLOCKS = 1024
+DEFAULT_TIMEOUT = 10.0
+
+
+class ReqRespError(Exception):
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(f"reqresp error {code}: {message}")
+        self.code = code
+
+
+@dataclass
+class ResponseChunk:
+    context: bytes  # fork digest for v2 block protocols, b"" otherwise
+    payload: bytes  # ssz bytes (already unframed)
+
+
+class GRCARateLimiter:
+    """Generic cell rate limiter (reqresp/src/rate_limiter/
+    rateLimiterGRCA.ts:22): allows `quota` units per `quota_time`
+    seconds with burst tolerance, per peer."""
+
+    def __init__(self, quota: int, quota_time: float):
+        self.quota = quota
+        self.quota_time = quota_time
+        self._tat: dict[object, float] = {}
+
+    def allows(self, peer, units: int, now: float) -> bool:
+        emission = self.quota_time / max(1, self.quota)
+        increment = emission * units
+        tat = self._tat.get(peer, now)
+        new_tat = max(tat, now) + increment
+        if new_tat - now > self.quota_time:
+            return False
+        self._tat[peer] = new_tat
+        return True
+
+    def prune(self, before: float) -> None:
+        self._tat = {p: t for p, t in self._tat.items() if t > before}
+
+
+class InProcessTransport:
+    """A process-local wire: nodes register by peer id; open_stream
+    hands the server handler a request and returns raw response bytes.
+    Keeps real encode/decode on both sides (the bytes crossing this
+    "wire" are exactly what a TCP/libp2p stream would carry)."""
+
+    def __init__(self):
+        self._peers: dict[str, "ReqResp"] = {}
+
+    def register(self, peer_id: str, node: "ReqResp") -> None:
+        self._peers[peer_id] = node
+
+    def peers(self) -> list[str]:
+        return list(self._peers)
+
+    async def request_raw(
+        self, from_peer: str, to_peer: str, protocol: str, data: bytes
+    ) -> bytes:
+        node = self._peers.get(to_peer)
+        if node is None:
+            raise ReqRespError(RESP_SERVER_ERROR, f"unknown peer {to_peer}")
+        return await node._serve_raw(from_peer, protocol, data)
+
+
+class ReqResp:
+    """One node's protocol engine: client `request()` + server handler
+    registry. Handlers are async generators yielding (context, ssz
+    bytes) chunks."""
+
+    def __init__(
+        self,
+        peer_id: str,
+        transport: InProcessTransport,
+        rate_limit_quota: tuple[int, float] = (500, 10.0),
+    ):
+        self.peer_id = peer_id
+        self.transport = transport
+        self._handlers: dict[str, object] = {}
+        self._limiter = GRCARateLimiter(*rate_limit_quota)
+        transport.register(peer_id, self)
+
+    def register_handler(self, protocol: str, handler) -> None:
+        """handler: async generator fn(peer_id, request_payload: bytes)
+        -> yields ResponseChunk | (context, payload)."""
+        self._handlers[protocol] = handler
+
+    # -- client side ----------------------------------------------------
+
+    async def request(
+        self,
+        peer: str,
+        protocol: str,
+        payload: bytes,
+        timeout: float = DEFAULT_TIMEOUT,
+    ) -> list[ResponseChunk]:
+        data = snappy.frame_compress(payload)
+        raw = await asyncio.wait_for(
+            self.transport.request_raw(self.peer_id, peer, protocol, data),
+            timeout=timeout,
+        )
+        return _decode_response(raw, _context_len(protocol))
+
+    # -- server side ----------------------------------------------------
+
+    async def _serve_raw(
+        self, from_peer: str, protocol: str, data: bytes
+    ) -> bytes:
+        loop = asyncio.get_event_loop()
+        if not self._limiter.allows(from_peer, 1, loop.time()):
+            return _error_chunk(RESP_RESOURCE_UNAVAILABLE, "rate limited")
+        handler = self._handlers.get(protocol)
+        if handler is None:
+            return _error_chunk(
+                RESP_INVALID_REQUEST, f"unsupported protocol {protocol}"
+            )
+        try:
+            payload = snappy.frame_uncompress(data)
+        except snappy.SnappyError as e:
+            return _error_chunk(RESP_INVALID_REQUEST, str(e))
+        out = bytearray()
+        try:
+            async for chunk in handler(from_peer, payload):
+                if isinstance(chunk, tuple):
+                    chunk = ResponseChunk(*chunk)
+                out.append(RESP_SUCCESS)
+                out += chunk.context
+                out += _varint(len(chunk.payload))
+                out += snappy.frame_compress(chunk.payload)
+        except ReqRespError as e:
+            return bytes(out) + _error_chunk(e.code, str(e))
+        except Exception as e:  # handler bug -> ServerError on the wire
+            return bytes(out) + _error_chunk(RESP_SERVER_ERROR, repr(e))
+        return bytes(out)
+
+
+def _context_len(protocol: str) -> int:
+    return 4 if protocol in (PROTOCOL_BLOCKS_BY_RANGE, PROTOCOL_BLOCKS_BY_ROOT) else 0
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _read_varint(raw: bytes, off: int) -> tuple[int, int]:
+    v = 0
+    shift = 0
+    while off < len(raw):
+        b = raw[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v, off
+        shift += 7
+    raise ReqRespError(RESP_INVALID_REQUEST, "truncated varint")
+
+
+def _error_chunk(code: int, message: str) -> bytes:
+    body = message.encode()[:256]
+    return bytes([code]) + _varint(len(body)) + snappy.frame_compress(body)
+
+
+_STREAM_ID_HDR = b"\xff\x06\x00\x00sNaPpY"
+
+
+def _read_framed(raw: bytes, off: int, target_len: int) -> tuple[bytes, int]:
+    """Consume exactly one snappy frame stream producing target_len
+    bytes. Chunk headers are length-prefixed, so the walk is
+    deterministic (the spec's 'read until declared ssz length')."""
+    import struct
+
+    if raw[off : off + len(_STREAM_ID_HDR)] != _STREAM_ID_HDR:
+        raise ReqRespError(RESP_INVALID_REQUEST, "missing stream id")
+    end = off + len(_STREAM_ID_HDR)
+    produced = 0
+    while produced < target_len or (target_len == 0 and produced == 0):
+        if end + 4 > len(raw):
+            raise ReqRespError(RESP_INVALID_REQUEST, "truncated frame")
+        hdr = struct.unpack_from("<I", raw, end)[0]
+        clen = hdr >> 8
+        if end + 4 + clen > len(raw):
+            raise ReqRespError(RESP_INVALID_REQUEST, "truncated chunk")
+        ctype = hdr & 0xFF
+        if ctype in (0x00, 0x01):
+            body = raw[end + 4 + 4 : end + 4 + clen]  # skip masked crc
+            if ctype == 0x00:
+                produced += _block_uncompressed_len(body)
+            else:
+                produced += len(body)
+        end += 4 + clen
+        if target_len == 0:
+            break
+    frame = raw[off:end]
+    payload = snappy.frame_uncompress(frame)
+    if len(payload) != target_len:
+        raise ReqRespError(
+            RESP_INVALID_REQUEST,
+            f"length mismatch: declared {target_len} got {len(payload)}",
+        )
+    return payload, end
+
+
+def _block_uncompressed_len(body: bytes) -> int:
+    v = 0
+    shift = 0
+    for i, b in enumerate(body):
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v
+        shift += 7
+    raise ReqRespError(RESP_INVALID_REQUEST, "bad block preamble")
+
+
+def _decode_response(raw: bytes, ctx_len: int) -> list[ResponseChunk]:
+    """Walk the response stream chunk by chunk."""
+    chunks: list[ResponseChunk] = []
+    off = 0
+    while off < len(raw):
+        result = raw[off]
+        off += 1
+        ctx = b""
+        if result == RESP_SUCCESS and ctx_len:
+            ctx = raw[off : off + ctx_len]
+            off += ctx_len
+        declared, off = _read_varint(raw, off)
+        payload, off = _read_framed(raw, off, declared)
+        if result != RESP_SUCCESS:
+            raise ReqRespError(result, payload.decode(errors="replace"))
+        chunks.append(ResponseChunk(ctx, payload))
+    return chunks

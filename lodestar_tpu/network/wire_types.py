@@ -1,0 +1,43 @@
+"""ReqResp wire message SSZ types.
+
+Reference analog: the request/response types of the 13 protocols
+(network/reqresp/protocols.ts:7-95): Status, Goodbye, Ping, Metadata,
+BeaconBlocksByRangeRequest, BeaconBlocksByRootRequest.
+"""
+
+from ..ssz import Bytes4, Root, uint64
+from ..ssz.composite import ContainerType, ListType
+from .reqresp import MAX_REQUEST_BLOCKS
+
+Status = ContainerType(
+    "Status",
+    [
+        ("fork_digest", Bytes4),
+        ("finalized_root", Root),
+        ("finalized_epoch", uint64),
+        ("head_root", Root),
+        ("head_slot", uint64),
+    ],
+)
+
+Goodbye = uint64
+Ping = uint64
+
+BeaconBlocksByRangeRequest = ContainerType(
+    "BeaconBlocksByRangeRequest",
+    [
+        ("start_slot", uint64),
+        ("count", uint64),
+        ("step", uint64),
+    ],
+)
+
+BeaconBlocksByRootRequest = ListType(Root, MAX_REQUEST_BLOCKS)
+
+Metadata = ContainerType(
+    "Metadata",
+    [
+        ("seq_number", uint64),
+        # attnets/syncnets bitvectors omitted until subnet services land
+    ],
+)

@@ -1,0 +1,279 @@
+"""Gossip ingest pipeline tests: queues, seen caches, batched
+attestation validation, processor backpressure.
+
+Reference analogs: network/processor/gossipQueues tests, chain/
+validation/attestation.ts `validateGossipAttestationsSameAttData`
+(SURVEY.md §3.2 — the north-star hot path) driven here by a synthetic
+single-bit-attestation firehose against a dev chain.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.validation import AttestationValidator, GossipAction
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.network import (
+    GossipTopic,
+    IndexedGossipQueueMinSize,
+    LinearGossipQueue,
+    NetworkProcessor,
+    QueueType,
+)
+from lodestar_tpu.params import preset
+from lodestar_tpu.statetransition import util
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg(**forks):
+    base = dict(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    base.update(forks)
+    return ChainConfig(**base)
+
+
+class TestLinearQueue:
+    def test_fifo_order_and_overflow(self):
+        q = LinearGossipQueue(3, QueueType.FIFO)
+        for i in range(3):
+            assert q.add(i) == 0
+        assert q.add(99) == 1  # newest dropped in FIFO
+        assert [q.next(), q.next(), q.next()] == [0, 1, 2]
+        assert q.next() is None
+
+    def test_lifo_order_and_overflow(self):
+        q = LinearGossipQueue(3, QueueType.LIFO)
+        for i in range(4):
+            q.add(i)
+        assert q.dropped_total == 1  # oldest dropped in LIFO
+        assert q.next() == 3
+
+
+class TestIndexedQueue:
+    def test_min_chunk_batching(self):
+        q = IndexedGossipQueueMinSize(
+            index_fn=lambda x: x[0], min_chunk_size=3, max_chunk_size=4,
+            min_wait_ms=10_000,
+        )
+        for i in range(2):
+            q.add(("a", i))
+        assert q.next() is None  # below min size, not waited
+        q.add(("a", 2))
+        chunk = q.next()
+        assert [c[1] for c in chunk] == [0, 1, 2]
+        assert len(q) == 0
+
+    def test_max_chunk_size_split(self):
+        q = IndexedGossipQueueMinSize(
+            index_fn=lambda x: x[0], min_chunk_size=2, max_chunk_size=3,
+            min_wait_ms=10_000,
+        )
+        for i in range(5):
+            q.add(("k", i))
+        assert len(q.next()) == 3
+        assert len(q.next()) == 2
+
+    def test_newest_min_size_key_first(self):
+        q = IndexedGossipQueueMinSize(
+            index_fn=lambda x: x[0], min_chunk_size=2, max_chunk_size=8,
+            min_wait_ms=10_000,
+        )
+        q.add(("a", 0)); q.add(("a", 1))
+        q.add(("b", 0)); q.add(("b", 1))
+        assert q.next()[0][0] == "b"  # LIFO over ready keys
+        assert q.next()[0][0] == "a"
+
+    def test_wait_time_fallback(self):
+        q = IndexedGossipQueueMinSize(
+            index_fn=lambda x: x[0], min_chunk_size=3, max_chunk_size=8,
+            min_wait_ms=0,
+        )
+        q.add(("a", 0))
+        chunk = q.next()  # below min size but waited long enough (0ms)
+        assert [c[1] for c in chunk] == [0]
+
+    def test_overflow_drops_oldest_key(self):
+        q = IndexedGossipQueueMinSize(
+            index_fn=lambda x: x[0], max_length=3, min_chunk_size=2,
+            max_chunk_size=8, min_wait_ms=10_000,
+        )
+        q.add(("old", 0))
+        q.add(("new", 0)); q.add(("new", 1)); q.add(("new", 2))
+        assert q.dropped_total == 1
+        assert q.key_count == 1  # "old" evicted entirely
+
+
+def _make_firehose_node(types, verifier=None):
+    cfg = _cfg()
+    node = DevNode(
+        cfg, types, N, verifier=verifier, verify_attestations=False
+    )
+    return cfg, node
+
+
+def _single_bit_attestations(node, types, slot):
+    """All validators of `slot`'s committees as single-bit gossip
+    attestations on the current head (the firehose shape: BASELINE
+    config #4)."""
+    from lodestar_tpu.chain.devnode import DOMAIN_BEACON_ATTESTER
+    from lodestar_tpu.crypto.bls.signature import sign
+    from lodestar_tpu.statetransition.block import (
+        compute_signing_root,
+        get_domain,
+    )
+
+    head_root = node.chain.head_root
+    st = node.chain.get_state(head_root).state
+    epoch = util.compute_epoch_at_slot(slot)
+    sh = util.EpochShuffling(st, epoch)
+    try:
+        target_root = util.get_block_root(st, epoch)
+    except ValueError:
+        target_root = head_root
+    out = []
+    for ci, committee in enumerate(sh.committees_at_slot(slot)):
+        if not len(committee):
+            continue
+        data = types.AttestationData.default()
+        data.slot = slot
+        data.index = ci
+        data.beacon_block_root = head_root
+        data.source = st.current_justified_checkpoint
+        tgt = types.Checkpoint.default()
+        tgt.epoch = epoch
+        tgt.root = target_root
+        data.target = tgt
+        domain = get_domain(node.cfg, st, DOMAIN_BEACON_ATTESTER, epoch)
+        root = compute_signing_root(types.AttestationData, data, domain)
+        for pos, v in enumerate(committee):
+            att = types.Attestation.default()
+            att.data = data
+            bits = [False] * len(committee)
+            bits[pos] = True
+            att.aggregation_bits = bits
+            att.signature = sign(node.sks[int(v)], root)
+            out.append(att)
+    return out
+
+
+class TestBatchValidation:
+    def test_firehose_accepts_and_dedups(self, types):
+        cfg, node = _make_firehose_node(types)
+
+        async def go():
+            await node.run_until(2)
+            validator = AttestationValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            validator.on_slot(node.slot)
+            proc = NetworkProcessor(
+                node.chain, validator, node.chain.verifier
+            )
+            proc.start()
+            # one slot's committees: N / SLOTS_PER_EPOCH validators
+            atts = _single_bit_attestations(node, types, node.slot)
+            n_att = len(atts)
+            assert n_att == N // preset().SLOTS_PER_EPOCH
+            for att in atts:
+                proc.on_gossip_message(GossipTopic.beacon_attestation, att)
+            # duplicates must be ignored, not re-verified
+            for att in atts[:2]:
+                proc.on_gossip_message(GossipTopic.beacon_attestation, att)
+            await proc.drain()
+            await proc.stop()
+            assert proc.accepted == n_att
+            assert proc.ignored == 2
+            assert proc.rejected == 0
+            # accepted votes reached fork choice
+            fc_votes = sum(
+                1 for v in node.chain.fork_choice.votes.values()
+                if v.next_root is not None
+            )
+            assert fc_votes >= n_att
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_bad_signature_rejected_only_that_one(self, types):
+        cfg, node = _make_firehose_node(types)
+
+        async def go():
+            await node.run_until(2)
+            validator = AttestationValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            validator.on_slot(node.slot)
+            atts = _single_bit_attestations(node, types, node.slot)
+            assert len(atts) >= 2
+            # corrupt one signature (another validator's signature —
+            # still a valid point, wrong message binding)
+            atts[0].signature = bytes(atts[1].signature)
+            chunk = [a for a in atts if bytes(
+                types.AttestationData.serialize(a.data)
+            ) == bytes(types.AttestationData.serialize(atts[0].data))]
+            res = await validator.validate_gossip_attestations_same_att_data(
+                chunk
+            )
+            actions = [r.action for r in res]
+            assert actions.count(GossipAction.REJECT) == 1
+            assert all(
+                a in (GossipAction.ACCEPT, GossipAction.REJECT)
+                for a in actions
+            )
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_unknown_block_root_ignored(self, types):
+        cfg, node = _make_firehose_node(types)
+
+        async def go():
+            await node.run_until(2)
+            validator = AttestationValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            validator.on_slot(node.slot)
+            atts = _single_bit_attestations(node, types, node.slot)
+            for att in atts:
+                att.data.beacon_block_root = b"\xde" * 32
+            res = await validator.validate_gossip_attestations_same_att_data(
+                atts[:4]
+            )
+            assert all(r.action == GossipAction.IGNORE for r in res)
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_wrong_target_epoch_rejected(self, types):
+        cfg, node = _make_firehose_node(types)
+
+        async def go():
+            await node.run_until(2)
+            validator = AttestationValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            validator.on_slot(node.slot)
+            atts = _single_bit_attestations(node, types, node.slot)
+            atts[0].data.target.epoch = 5
+            res = await validator.validate_gossip_attestations_same_att_data(
+                [atts[0]]
+            )
+            assert res[0].action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
