@@ -1,0 +1,127 @@
+"""Structured logger package.
+
+Reference analog: packages/logger — `Logger` interface
+(src/interface.ts) with winston implementation (src/winston.ts:41):
+leveled logs, per-module child loggers with their own level overrides,
+human console format `[module] level: message key=value`, optional
+timestamped file output. Built on stdlib logging so host libraries
+integrate, but with the reference's child/module semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any
+
+LEVELS = {
+    "error": logging.ERROR,
+    "warn": logging.WARNING,
+    "info": logging.INFO,
+    "verbose": logging.INFO - 2,
+    "debug": logging.DEBUG,
+    "trace": logging.DEBUG - 2,
+}
+logging.addLevelName(LEVELS["verbose"], "VERBOSE")
+logging.addLevelName(LEVELS["trace"], "TRACE")
+
+
+def _fmt_meta(meta: dict[str, Any]) -> str:
+    if not meta:
+        return ""
+    return " " + ", ".join(f"{k}={_fmt_val(v)}" for k, v in meta.items())
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, bytes):
+        return "0x" + v.hex()[:18] + ("…" if len(v) > 9 else "")
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+class _ConsoleFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        t = time.strftime("%b-%d %H:%M:%S", time.localtime(record.created))
+        ms = int(record.msecs)
+        module = getattr(record, "lodestar_module", record.name)
+        meta = getattr(record, "lodestar_meta", {})
+        lvl = record.levelname.lower()
+        msg = record.getMessage()
+        return f"{t}.{ms:03d}[{module:<12}] {lvl:<7}: {msg}{_fmt_meta(meta)}"
+
+
+class Logger:
+    """Leveled logger with reference-style (message, meta) calls and
+    child() per-module loggers (logger/src/interface.ts)."""
+
+    def __init__(self, module: str = "", level: str = "info", _base=None):
+        self.module = module
+        if _base is not None:
+            self._log = _base
+        else:
+            self._log = logging.getLogger(f"lodestar.{module or 'root'}")
+            self._log.setLevel(LEVELS.get(level, logging.INFO))
+            self._log.propagate = False
+            if not self._log.handlers:
+                h = logging.StreamHandler(sys.stderr)
+                h.setFormatter(_ConsoleFormatter())
+                self._log.addHandler(h)
+
+    def child(self, module: str, level: str | None = None) -> "Logger":
+        name = f"{self.module}/{module}" if self.module else module
+        c = Logger.__new__(Logger)
+        c.module = name
+        c._log = self._log
+        if level is not None:
+            # per-module override: wrap with an independent stdlib logger
+            c._log = logging.getLogger(f"lodestar.{name}")
+            c._log.setLevel(LEVELS.get(level, logging.INFO))
+            c._log.propagate = False
+            if not c._log.handlers:
+                h = logging.StreamHandler(sys.stderr)
+                h.setFormatter(_ConsoleFormatter())
+                c._log.addHandler(h)
+        return c
+
+    def _emit(self, level: str, message: str, meta: dict | None) -> None:
+        self._log.log(
+            LEVELS[level],
+            message,
+            extra={
+                "lodestar_module": self.module,
+                "lodestar_meta": meta or {},
+            },
+        )
+
+    def error(self, message: str, meta: dict | None = None, exc=None):
+        if exc is not None:
+            meta = dict(meta or {})
+            meta["error"] = repr(exc)
+        self._emit("error", message, meta)
+
+    def warn(self, message: str, meta: dict | None = None):
+        self._emit("warn", message, meta)
+
+    def info(self, message: str, meta: dict | None = None):
+        self._emit("info", message, meta)
+
+    def verbose(self, message: str, meta: dict | None = None):
+        self._emit("verbose", message, meta)
+
+    def debug(self, message: str, meta: dict | None = None):
+        self._emit("debug", message, meta)
+
+    def trace(self, message: str, meta: dict | None = None):
+        self._emit("trace", message, meta)
+
+    def add_file_output(self, path: str, level: str = "debug") -> None:
+        h = logging.FileHandler(path)
+        h.setFormatter(_ConsoleFormatter())
+        h.setLevel(LEVELS.get(level, logging.DEBUG))
+        self._log.addHandler(h)
+
+
+def get_logger(module: str = "", level: str = "info") -> Logger:
+    return Logger(module, level)

@@ -1,0 +1,29 @@
+"""Metrics: prometheus-style registry, metric types, HTTP exposition.
+
+Reference analog: packages/beacon-node/src/metrics/ —
+`RegistryMetricCreator` (utils/registryMetricCreator.ts:20), the
+lodestar metric catalog (metrics/lodestar.ts, bls pool at :403-506),
+and the prom-client HTTP server (server/http.ts:23). Implemented
+natively (no prom-client dependency): metric objects render the
+Prometheus text exposition format themselves.
+"""
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RegistryMetricCreator,
+)
+from .server import MetricsServer
+from .beacon import create_lodestar_metrics
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RegistryMetricCreator",
+    "MetricsServer",
+    "create_lodestar_metrics",
+]

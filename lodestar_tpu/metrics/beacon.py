@@ -1,0 +1,168 @@
+"""The lodestar metric catalog (TPU edition).
+
+Reference analog: beacon-node/src/metrics/metrics/lodestar.ts — in
+particular the `lodestar_bls_thread_pool_*` family (:403-506), kept
+name-compatible so the reference's Grafana dashboard
+(dashboards/lodestar_bls_thread_pool.json) scrapes unchanged. "Worker"
+here means the TPU device pipeline behind the verifier service; the
+queue metrics expose the verifier's buffered-job queue, which BASELINE
+requires to "never back up".
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from .registry import RegistryMetricCreator
+
+
+def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
+    m = SimpleNamespace()
+
+    # -- bls verifier service (north star) ------------------------------
+    b = SimpleNamespace()
+    m.bls_thread_pool = b
+    b.success_jobs_signature_sets_count = reg.counter(
+        "lodestar_bls_thread_pool_success_jobs_signature_sets_count",
+        "Count of total verified signature sets",
+    )
+    b.error_jobs_signature_sets_count = reg.counter(
+        "lodestar_bls_thread_pool_error_jobs_signature_sets_count",
+        "Count of total error-ed signature sets",
+    )
+    b.job_wait_time = reg.histogram(
+        "lodestar_bls_thread_pool_queue_job_wait_time_seconds",
+        "Time from job added to the queue to starting the job in seconds",
+        buckets=(0.01, 0.02, 0.05, 0.1, 0.3, 1),
+    )
+    b.queue_length = reg.gauge(
+        "lodestar_bls_thread_pool_queue_length",
+        "Count of total verifier queue length",
+    )
+    b.jobs_started_total = reg.counter(
+        "lodestar_bls_thread_pool_jobs_started_total",
+        "Count of total jobs started in the verifier, jobs include 1+ sets",
+    )
+    b.job_groups_started_total = reg.counter(
+        "lodestar_bls_thread_pool_job_groups_started_total",
+        "Count of total job groups (device dispatches) started",
+    )
+    b.sig_sets_started_total = reg.counter(
+        "lodestar_bls_thread_pool_sig_sets_started_total",
+        "Count of total signature sets started",
+    )
+    b.batch_retries_total = reg.counter(
+        "lodestar_bls_thread_pool_batch_retries_total",
+        "Count of total batches that failed and had to be verified again",
+    )
+    b.batch_sigs_success_total = reg.counter(
+        "lodestar_bls_thread_pool_batch_sigs_success_total",
+        "Count of signature sets verified successfully in batches",
+    )
+    b.same_message_jobs_retries_total = reg.counter(
+        "lodestar_bls_thread_pool_same_message_jobs_retries_total",
+        "Count of same-message jobs that failed and re-verified per set",
+    )
+    b.same_message_sets_retries_total = reg.counter(
+        "lodestar_bls_thread_pool_same_message_sets_retries_total",
+        "Count of same-message sets re-verified individually",
+    )
+    b.time_seconds_sum = reg.counter(
+        "lodestar_bls_thread_pool_time_seconds_sum",
+        "Total time spent verifying signature sets on the device",
+    )
+    b.sig_sets_total = reg.counter(
+        "lodestar_bls_thread_pool_sig_sets_total",
+        "Count of total signature sets",
+    )
+    b.prioritized_sig_sets_total = reg.counter(
+        "lodestar_bls_thread_pool_prioritized_sig_sets_total",
+        "Count of total prioritized signature sets",
+    )
+    b.batchable_sig_sets_total = reg.counter(
+        "lodestar_bls_thread_pool_batchable_sig_sets_total",
+        "Count of total batchable signature sets",
+    )
+
+    # -- gossip ingest --------------------------------------------------
+    g = SimpleNamespace()
+    m.gossip = g
+    g.queue_length = reg.gauge(
+        "lodestar_gossip_validation_queue_length",
+        "Current count of items in the gossip validation queue",
+        label_names=("topic",),
+    )
+    g.queue_dropped_total = reg.counter(
+        "lodestar_gossip_validation_queue_dropped_jobs_total",
+        "Total gossip jobs dropped for queue overflow",
+        label_names=("topic",),
+    )
+    g.queue_job_time = reg.histogram(
+        "lodestar_gossip_validation_queue_job_time_seconds",
+        "Time to process a gossip job",
+        label_names=("topic",),
+    )
+    g.queue_wait_time = reg.histogram(
+        "lodestar_gossip_validation_queue_job_wait_time_seconds",
+        "Queue wait time of a gossip job",
+        label_names=("topic",),
+        buckets=(0.01, 0.05, 0.1, 0.5, 1, 5),
+    )
+    g.accept_total = reg.counter(
+        "lodestar_gossip_validation_accept_total",
+        "Gossip objects accepted",
+        label_names=("topic",),
+    )
+    g.ignore_total = reg.counter(
+        "lodestar_gossip_validation_ignore_total",
+        "Gossip objects ignored",
+        label_names=("topic",),
+    )
+    g.reject_total = reg.counter(
+        "lodestar_gossip_validation_reject_total",
+        "Gossip objects rejected",
+        label_names=("topic",),
+    )
+
+    # -- chain / block import -------------------------------------------
+    c = SimpleNamespace()
+    m.chain = c
+    c.block_import_time = reg.histogram(
+        "lodestar_block_import_seconds",
+        "Full block import pipeline time",
+        buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5),
+    )
+    c.state_transition_time = reg.histogram(
+        "lodestar_state_transition_seconds",
+        "State transition time per block",
+        buckets=(0.01, 0.05, 0.1, 0.5, 1, 2),
+    )
+    c.epoch_transition_time = reg.histogram(
+        "lodestar_epoch_transition_seconds",
+        "Epoch transition time",
+        buckets=(0.05, 0.1, 0.5, 1, 5),
+    )
+    c.head_slot = reg.gauge(
+        "beacon_head_slot", "Slot of the current chain head"
+    )
+    c.finalized_epoch = reg.gauge(
+        "beacon_finalized_epoch", "Current finalized epoch"
+    )
+    c.current_justified_epoch = reg.gauge(
+        "beacon_current_justified_epoch", "Current justified epoch"
+    )
+
+    # -- db -------------------------------------------------------------
+    d = SimpleNamespace()
+    m.db = d
+    d.read_req_total = reg.counter(
+        "lodestar_db_read_req_total",
+        "Total db read requests",
+        label_names=("bucket",),
+    )
+    d.write_req_total = reg.counter(
+        "lodestar_db_write_req_total",
+        "Total db write requests",
+        label_names=("bucket",),
+    )
+    return m

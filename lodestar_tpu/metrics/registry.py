@@ -1,0 +1,243 @@
+"""Prometheus-style metric primitives and registry.
+
+Reference analog: prom-client as used through
+`RegistryMetricCreator` (beacon-node/src/metrics/utils/
+registryMetricCreator.ts:20) and the typed wrappers in
+metrics/utils/{counter,gauge,histogram}.ts. Same semantics: labelled
+counters/gauges/histograms, a registry that renders the text
+exposition format, and helper sugar (`timer()` context managers on
+histograms, child handles per label set).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    parts = [
+        '%s="%s"' % (n, str(v).replace("\\", r"\\").replace('"', r"\""))
+        for n, v in zip(names, values)
+    ]
+    return "{" + ",".join(parts) + "}"
+
+
+@dataclass
+class _MetricBase:
+    name: str
+    help: str
+    label_names: tuple = ()
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple:
+        try:
+            return tuple(labels[n] for n in self.label_names)
+        except KeyError as e:
+            raise ValueError(
+                f"metric {self.name} missing label {e}"
+            ) from None
+
+
+class Counter(_MetricBase):
+    """Monotonic counter, optionally labelled."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def get(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def collect(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+        ]
+        values = self._values or ({(): 0.0} if not self.label_names else {})
+        for k, v in sorted(values.items()):
+            lines.append(
+                f"{self.name}{_fmt_labels(self.label_names, k)} {_fmt_value(v)}"
+            )
+        return "\n".join(lines)
+
+
+class Gauge(_MetricBase):
+    """Settable value; supports a collect callback for sampled gauges
+    (reference: addCollect on queue-length gauges)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        self._values: dict[tuple, float] = {}
+        self._collect_fns: list = []
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def get(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def add_collect(self, fn) -> None:
+        """fn(gauge) runs at scrape time to sample a live value."""
+        self._collect_fns.append(fn)
+
+    def collect(self) -> str:
+        for fn in self._collect_fns:
+            fn(self)
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+        ]
+        values = self._values or ({(): 0.0} if not self.label_names else {})
+        for k, v in sorted(values.items()):
+            lines.append(
+                f"{self.name}{_fmt_labels(self.label_names, k)} {_fmt_value(v)}"
+            )
+        return "\n".join(lines)
+
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60,
+)
+
+
+class Histogram(_MetricBase):
+    """Cumulative-bucket histogram with observe() and timer()."""
+
+    def __init__(self, name, help, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, tuple(label_names))
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            if k not in self._counts:
+                self._counts[k] = [0] * len(self.buckets)
+                self._sums[k] = 0.0
+                self._totals[k] = 0
+            counts = self._counts[k]
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[k] += value
+            self._totals[k] += 1
+
+    class _Timer:
+        def __init__(self, hist, labels):
+            self.hist, self.labels = hist, labels
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.hist.observe(
+                time.perf_counter() - self.t0, **self.labels
+            )
+            return False
+
+    def timer(self, **labels) -> "_Timer":
+        return Histogram._Timer(self, labels)
+
+    def get_count(self, **labels) -> int:
+        return self._totals.get(self._key(labels), 0)
+
+    def get_sum(self, **labels) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def collect(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        keys = self._counts or ({(): [0] * len(self.buckets)} if not self.label_names else {})
+        for k in sorted(keys):
+            counts = self._counts.get(k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                lbl = _fmt_labels(
+                    self.label_names + ("le",), k + (_fmt_value(b),)
+                )
+                lines.append(f"{self.name}_bucket{lbl} {counts[i]}")
+            lbl_inf = _fmt_labels(self.label_names + ("le",), k + ("+Inf",))
+            lines.append(
+                f"{self.name}_bucket{lbl_inf} {self._totals.get(k, 0)}"
+            )
+            base = _fmt_labels(self.label_names, k)
+            lines.append(
+                f"{self.name}_sum{base} {_fmt_value(self._sums.get(k, 0.0))}"
+            )
+            lines.append(f"{self.name}_count{base} {self._totals.get(k, 0)}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    """Holds metrics; renders the full text exposition."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def expose(self) -> str:
+        return (
+            "\n".join(m.collect() for m in self._metrics.values()) + "\n"
+        )
+
+
+class RegistryMetricCreator(MetricsRegistry):
+    """Factory + registry in one (registryMetricCreator.ts:20)."""
+
+    def counter(self, name, help, label_names=()) -> Counter:
+        return self.register(Counter(name, help, tuple(label_names)))
+
+    def gauge(self, name, help, label_names=()) -> Gauge:
+        return self.register(Gauge(name, help, tuple(label_names)))
+
+    def histogram(
+        self, name, help, label_names=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self.register(
+            Histogram(name, help, tuple(label_names), buckets)
+        )

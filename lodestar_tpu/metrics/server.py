@@ -1,0 +1,63 @@
+"""Prometheus scrape endpoint.
+
+Reference analog: getHttpMetricsServer
+(beacon-node/src/metrics/server/http.ts:23) — a tiny HTTP server
+serving /metrics with the registry exposition. stdlib http.server in a
+daemon thread; scrape cost is sampled into its own histogram like the
+reference's scrape_time metric.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MetricsServer:
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 8008):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.scrape_time = None  # optional Histogram
+
+    def start(self) -> int:
+        registry = self.registry
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                t0 = time.perf_counter()
+                body = registry.expose().encode()
+                if server.scrape_time is not None:
+                    server.scrape_time.observe(time.perf_counter() - t0)
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass  # no stderr spam per scrape
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

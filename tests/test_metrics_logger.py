@@ -1,0 +1,116 @@
+"""Metrics registry/exposition + logger tests.
+
+Reference analog: beacon-node metrics unit tests and prom-client
+exposition semantics (SURVEY.md §5.5); verifies the
+lodestar_bls_thread_pool_* catalog names survive so the reference
+Grafana dashboard can scrape them.
+"""
+
+import urllib.request
+
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.metrics import (
+    MetricsServer,
+    RegistryMetricCreator,
+    create_lodestar_metrics,
+)
+
+
+class TestRegistry:
+    def test_counter_exposition(self):
+        reg = RegistryMetricCreator()
+        c = reg.counter("test_total", "help text")
+        c.inc()
+        c.inc(2)
+        out = reg.expose()
+        assert "# TYPE test_total counter" in out
+        assert "test_total 3" in out
+
+    def test_labelled_gauge(self):
+        reg = RegistryMetricCreator()
+        g = reg.gauge("queue_len", "h", label_names=("topic",))
+        g.set(5, topic="beacon_attestation")
+        g.inc(topic="beacon_block")
+        out = reg.expose()
+        assert 'queue_len{topic="beacon_attestation"} 5' in out
+        assert 'queue_len{topic="beacon_block"} 1' in out
+
+    def test_gauge_collect_fn_sampled_at_scrape(self):
+        reg = RegistryMetricCreator()
+        g = reg.gauge("sampled", "h")
+        state = {"v": 0}
+        g.add_collect(lambda gauge: gauge.set(state["v"]))
+        state["v"] = 42
+        assert "sampled 42" in reg.expose()
+
+    def test_histogram_buckets_and_timer(self):
+        reg = RegistryMetricCreator()
+        h = reg.histogram("lat", "h", buckets=(0.1, 1, 10))
+        h.observe(0.05)
+        h.observe(5)
+        with h.timer():
+            pass
+        out = reg.expose()
+        assert 'lat_bucket{le="0.1"} 2' in out
+        assert 'lat_bucket{le="10"} 3' in out
+        assert 'lat_bucket{le="+Inf"} 3' in out
+        assert "lat_count 3" in out
+        assert h.get_count() == 3
+
+    def test_duplicate_name_rejected(self):
+        reg = RegistryMetricCreator()
+        reg.counter("x_total", "h")
+        try:
+            reg.counter("x_total", "h")
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_lodestar_catalog_dashboard_names(self):
+        reg = RegistryMetricCreator()
+        m = create_lodestar_metrics(reg)
+        m.bls_thread_pool.queue_length.set(3)
+        m.bls_thread_pool.job_wait_time.observe(0.02)
+        out = reg.expose()
+        # the names the reference Grafana bls dashboard scrapes
+        assert "lodestar_bls_thread_pool_queue_length 3" in out
+        assert (
+            "lodestar_bls_thread_pool_queue_job_wait_time_seconds_count 1"
+            in out
+        )
+
+
+class TestServer:
+    def test_scrape_endpoint(self):
+        reg = RegistryMetricCreator()
+        c = reg.counter("scraped_total", "h")
+        c.inc(7)
+        srv = MetricsServer(reg, port=0)
+        port = srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "scraped_total 7" in body
+        finally:
+            srv.stop()
+
+
+class TestLogger:
+    def test_child_and_meta(self, capsys):
+        log = get_logger("node", level="debug")
+        chain = log.child("chain")
+        chain.info("block imported", {"slot": 7, "root": b"\xaa" * 32})
+        err = capsys.readouterr().err
+        assert "[node/chain" in err
+        assert "block imported" in err
+        assert "slot=7" in err
+        assert "root=0x" in err
+
+    def test_level_filtering(self, capsys):
+        log = get_logger("quiet", level="info")
+        log.debug("hidden")
+        log.info("shown")
+        err = capsys.readouterr().err
+        assert "hidden" not in err
+        assert "shown" in err
