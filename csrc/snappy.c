@@ -1,0 +1,256 @@
+/* Snappy block-format codec + CRC32C, for ssz_snappy wire framing.
+ *
+ * Reference analog: the `snappyjs` dependency and in-repo snappy frame
+ * codec Lodestar uses for gossip payloads and reqresp `ssz_snappy`
+ * encoding (packages/reqresp/src/encodingStrategies/sszSnappy/,
+ * network/gossip/encoding.ts:69). Implemented natively (C) like the
+ * rest of this repo's host-side hot codecs; exposed through ctypes
+ * (lodestar_tpu/utils/snappy.py) which adds the stream framing.
+ *
+ * Format per google/snappy format_description.txt:
+ *   preamble: uncompressed length, little-endian varint
+ *   tags: 2 LSBs: 00 literal, 01 copy1 (3-bit len, 11-bit offset),
+ *         10 copy2 (6-bit len, 16-bit LE offset), 11 copy4.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#define TAG_LITERAL 0
+#define TAG_COPY1 1
+#define TAG_COPY2 2
+#define TAG_COPY4 3
+
+uint64_t snappy_max_compressed_length(uint64_t n) {
+  /* worst case: all literals with 5-byte headers every 2^32 chunk +
+   * varint preamble; the canonical bound from the reference impl */
+  return 32 + n + n / 6;
+}
+
+static int put_varint(uint8_t *dst, uint64_t cap, uint64_t v,
+                      uint64_t *off) {
+  while (v >= 0x80) {
+    if (*off >= cap) return -1;
+    dst[(*off)++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  if (*off >= cap) return -1;
+  dst[(*off)++] = (uint8_t)v;
+  return 0;
+}
+
+static int get_varint(const uint8_t *src, uint64_t n, uint64_t *off,
+                      uint64_t *out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*off < n && shift < 64) {
+    uint8_t b = src[(*off)++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return 0;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+static void emit_literal(const uint8_t *src, uint64_t len, uint8_t *dst,
+                         uint64_t *off) {
+  if (len == 0) return;
+  uint64_t n = len - 1;
+  if (n < 60) {
+    dst[(*off)++] = (uint8_t)(n << 2) | TAG_LITERAL;
+  } else if (n < (1u << 8)) {
+    dst[(*off)++] = (60u << 2) | TAG_LITERAL;
+    dst[(*off)++] = (uint8_t)n;
+  } else if (n < (1u << 16)) {
+    dst[(*off)++] = (61u << 2) | TAG_LITERAL;
+    dst[(*off)++] = (uint8_t)n;
+    dst[(*off)++] = (uint8_t)(n >> 8);
+  } else if (n < (1ull << 24)) {
+    dst[(*off)++] = (62u << 2) | TAG_LITERAL;
+    dst[(*off)++] = (uint8_t)n;
+    dst[(*off)++] = (uint8_t)(n >> 8);
+    dst[(*off)++] = (uint8_t)(n >> 16);
+  } else {
+    dst[(*off)++] = (63u << 2) | TAG_LITERAL;
+    dst[(*off)++] = (uint8_t)n;
+    dst[(*off)++] = (uint8_t)(n >> 8);
+    dst[(*off)++] = (uint8_t)(n >> 16);
+    dst[(*off)++] = (uint8_t)(n >> 24);
+  }
+  memcpy(dst + *off, src, len);
+  *off += len;
+}
+
+static void emit_copy(uint64_t offset, uint64_t len, uint8_t *dst,
+                      uint64_t *off) {
+  /* split long matches into <=64-byte copies */
+  while (len >= 68) {
+    dst[(*off)++] = (63u << 2) | TAG_COPY2;
+    dst[(*off)++] = (uint8_t)offset;
+    dst[(*off)++] = (uint8_t)(offset >> 8);
+    len -= 64;
+  }
+  if (len > 64) {
+    /* emit 60 so the remainder is >= 4 (min copy len) */
+    dst[(*off)++] = (59u << 2) | TAG_COPY2;
+    dst[(*off)++] = (uint8_t)offset;
+    dst[(*off)++] = (uint8_t)(offset >> 8);
+    len -= 60;
+  }
+  if (len >= 12 || offset >= 2048) {
+    dst[(*off)++] = (uint8_t)((len - 1) << 2) | TAG_COPY2;
+    dst[(*off)++] = (uint8_t)offset;
+    dst[(*off)++] = (uint8_t)(offset >> 8);
+  } else {
+    dst[(*off)++] = (uint8_t)(((offset >> 8) << 5) | ((len - 4) << 2) |
+                              TAG_COPY1);
+    dst[(*off)++] = (uint8_t)offset;
+  }
+}
+
+#define HASH_BITS 14
+#define HASH_SIZE (1u << HASH_BITS)
+
+static inline uint32_t load32(const uint8_t *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1e35a7bd) >> (32 - HASH_BITS);
+}
+
+/* returns 0 ok; *dst_len in = capacity, out = bytes written */
+int snappy_compress(const uint8_t *src, uint64_t n, uint8_t *dst,
+                    uint64_t *dst_len) {
+  uint64_t cap = *dst_len;
+  uint64_t off = 0;
+  if (put_varint(dst, cap, n, &off)) return -1;
+  if (cap < snappy_max_compressed_length(n)) return -1;
+
+  uint32_t table[HASH_SIZE];
+  memset(table, 0xff, sizeof(table));
+
+  uint64_t ip = 0, lit_start = 0;
+  if (n >= 15) {
+    uint64_t limit = n - 14; /* need 4-byte loads with slack */
+    while (ip < limit) {
+      uint32_t cur = load32(src + ip);
+      uint32_t h = hash32(cur);
+      uint32_t cand = table[h];
+      table[h] = (uint32_t)ip;
+      if (cand != 0xffffffffu && (uint64_t)cand < ip &&
+          ip - cand < 65536 && load32(src + cand) == cur) {
+        emit_literal(src + lit_start, ip - lit_start, dst, &off);
+        /* extend match */
+        uint64_t m = 4;
+        while (ip + m < n && src[cand + m] == src[ip + m]) m++;
+        emit_copy(ip - cand, m, dst, &off);
+        ip += m;
+        lit_start = ip;
+      } else {
+        ip++;
+      }
+    }
+  }
+  emit_literal(src + lit_start, n - lit_start, dst, &off);
+  *dst_len = off;
+  return 0;
+}
+
+int snappy_uncompressed_length(const uint8_t *src, uint64_t n,
+                               uint64_t *out) {
+  uint64_t off = 0;
+  return get_varint(src, n, &off, out);
+}
+
+/* returns 0 ok; *dst_len in = capacity, out = bytes written */
+int snappy_uncompress(const uint8_t *src, uint64_t n, uint8_t *dst,
+                      uint64_t *dst_len) {
+  uint64_t off = 0, total, op = 0, cap = *dst_len;
+  if (get_varint(src, n, &off, &total)) return -1;
+  if (total > cap) return -1;
+  while (off < n) {
+    uint8_t tag = src[off++];
+    uint64_t len, offset;
+    switch (tag & 3) {
+      case TAG_LITERAL: {
+        len = tag >> 2;
+        if (len >= 60) {
+          uint32_t extra = (uint32_t)len - 59;
+          if (off + extra > n) return -1;
+          len = 0;
+          for (uint32_t i = 0; i < extra; i++)
+            len |= (uint64_t)src[off + i] << (8 * i);
+          off += extra;
+        }
+        len += 1;
+        if (off + len > n || op + len > total) return -1;
+        memcpy(dst + op, src + off, len);
+        off += len;
+        op += len;
+        break;
+      }
+      case TAG_COPY1: {
+        if (off >= n) return -1;
+        len = ((tag >> 2) & 7) + 4;
+        offset = ((uint64_t)(tag >> 5) << 8) | src[off++];
+        goto do_copy;
+      }
+      case TAG_COPY2: {
+        if (off + 2 > n) return -1;
+        len = (tag >> 2) + 1;
+        offset = (uint64_t)src[off] | ((uint64_t)src[off + 1] << 8);
+        off += 2;
+        goto do_copy;
+      }
+      default: { /* TAG_COPY4 */
+        if (off + 4 > n) return -1;
+        len = (tag >> 2) + 1;
+        offset = (uint64_t)src[off] | ((uint64_t)src[off + 1] << 8) |
+                 ((uint64_t)src[off + 2] << 16) |
+                 ((uint64_t)src[off + 3] << 24);
+        off += 4;
+        goto do_copy;
+      }
+      do_copy : {
+        if (offset == 0 || offset > op || op + len > total) return -1;
+        /* byte-wise: copies may overlap forward (RLE) */
+        for (uint64_t i = 0; i < len; i++) dst[op + i] = dst[op + i - offset];
+        op += len;
+        break;
+      }
+    }
+  }
+  if (op != total) return -1;
+  *dst_len = op;
+  return 0;
+}
+
+/* ---- CRC32C (Castagnoli), table-driven; framing checksums ---- */
+
+static uint32_t crc_table[256];
+static int crc_init_done = 0;
+
+static void crc_init(void) {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  crc_init_done = 1;
+}
+
+uint32_t snappy_crc32c(const uint8_t *buf, uint64_t n) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = 0xffffffffu;
+  for (uint64_t i = 0; i < n; i++)
+    c = crc_table[(c ^ buf[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
