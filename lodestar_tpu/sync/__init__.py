@@ -1,0 +1,11 @@
+"""Sync: range sync over reqresp.
+
+Reference analog: packages/beacon-node/src/sync/ — `BeaconSync`
+(sync.ts:19) switching head/range modes, `RangeSync`/`SyncChain`
+(range/range.ts:77, range/chain.ts:78) with epoch-batch state machines
+(range/batch.ts:62) and peer balancing (range/utils/peerBalancer.ts).
+"""
+
+from .range_sync import Batch, BatchStatus, RangeSync, SyncServer
+
+__all__ = ["Batch", "BatchStatus", "RangeSync", "SyncServer"]

@@ -1,0 +1,253 @@
+"""Range sync: epoch-batch download + batched-verify import.
+
+Reference analog: sync/range/chain.ts:78 (SyncChain), batch.ts:62
+(Batch state machine: AwaitingDownload -> Downloading -> AwaitingProcess
+-> Processing -> AwaitingValidation, with retry + peer replacement on
+failure), peerBalancer.ts:10. Downloads go through the reqresp
+BeaconBlocksByRange protocol; imports run the chain's full pipeline, so
+each batch's signatures hit the TPU verifier as bulk sets — the
+reference's "~8,000 sigs per 64-block batch" shape (BASELINE.md).
+
+`SyncServer` is the serving side: the reqresp handlers a node registers
+so peers can sync from it (network/reqresp/handlers/*.ts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from enum import Enum
+
+from ..network import reqresp as rr
+from ..network.wire_types import (
+    BeaconBlocksByRangeRequest,
+    Status,
+)
+from ..params import preset
+
+EPOCHS_PER_BATCH = 2  # range/batch.ts EPOCHS_PER_BATCH
+MAX_BATCH_DOWNLOAD_ATTEMPTS = 5
+MAX_BATCH_PROCESSING_ATTEMPTS = 3
+
+
+class BatchStatus(str, Enum):
+    awaiting_download = "AwaitingDownload"
+    downloading = "Downloading"
+    awaiting_process = "AwaitingProcess"
+    processing = "Processing"
+    done = "Done"
+    failed = "Failed"
+
+
+class Batch:
+    """One EPOCHS_PER_BATCH slot span (batch.ts:62)."""
+
+    def __init__(self, start_slot: int, count: int):
+        self.start_slot = start_slot
+        self.count = count
+        self.status = BatchStatus.awaiting_download
+        self.blocks: list = []
+        self.download_attempts = 0
+        self.processing_attempts = 0
+        self.failed_peers: set[str] = set()
+
+
+class SyncServer:
+    """Server-side reqresp handlers backed by a chain + db."""
+
+    def __init__(self, chain, beacon_cfg, types):
+        self.chain = chain
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+
+    def register(self, node: rr.ReqResp) -> None:
+        node.register_handler(rr.PROTOCOL_STATUS, self.on_status)
+        node.register_handler(rr.PROTOCOL_PING, self.on_ping)
+        node.register_handler(
+            rr.PROTOCOL_BLOCKS_BY_RANGE, self.on_blocks_by_range
+        )
+
+    def local_status(self):
+        chain = self.chain
+        head = chain.fork_choice.proto.get_node(chain.head_root)
+        fin = chain.finalized_checkpoint
+        head_epoch = (head.slot if head else 0) // preset().SLOTS_PER_EPOCH
+        st = Status(
+            fork_digest=self.beacon_cfg.fork_digest(head_epoch),
+            finalized_root=fin.root,
+            finalized_epoch=fin.epoch,
+            head_root=chain.head_root,
+            head_slot=head.slot if head else 0,
+        )
+        return st
+
+    async def on_status(self, peer, payload):
+        yield (b"", Status.serialize(self.local_status()))
+
+    async def on_ping(self, peer, payload):
+        from ..ssz import uint64
+
+        yield (b"", uint64.serialize(0))
+
+    async def on_blocks_by_range(self, peer, payload):
+        """Stream canonical blocks in [start, start+count) slot order
+        (network/reqresp/handlers/beaconBlocksByRange.ts)."""
+        req = BeaconBlocksByRangeRequest.deserialize(payload)
+        start = int(req.start_slot)
+        count = min(int(req.count), rr.MAX_REQUEST_BLOCKS)
+        if count == 0:
+            raise rr.ReqRespError(rr.RESP_INVALID_REQUEST, "count 0")
+        chain = self.chain
+        types = self.types
+        spe = preset().SLOTS_PER_EPOCH
+        served = 0
+        # canonical chain walk: head back to start (hot part), plus the
+        # finalized slot archive (db) for anything below
+        by_slot = {}
+        if chain.db is not None:
+            for slot, (fork, block) in chain.db.block_archive.entries(
+                start=start, end=start + count
+            ):
+                by_slot[slot] = (fork, block)
+        node = chain.fork_choice.proto.get_node(chain.head_root)
+        for n in chain.fork_choice.proto.iter_chain(chain.head_root):
+            if start <= n.slot < start + count:
+                got = self._block_by_root(n.block_root)
+                if got is not None:
+                    by_slot[n.slot] = got
+        for slot in sorted(by_slot):
+            fork, block = by_slot[slot]
+            digest = self.beacon_cfg.fork_digest(slot // spe)
+            yield (
+                digest,
+                self.types.by_fork[fork].SignedBeaconBlock.serialize(block),
+            )
+            served += 1
+
+    def _block_by_root(self, root: bytes):
+        if self.chain.db is None:
+            return None
+        raw = self.chain.db.block.get_binary(root)
+        if raw is None:
+            return None
+        return self.chain.db.block.decode_value(raw)
+
+
+class RangeSync:
+    """Client-side finalized-range sync loop (range/chain.ts:78,
+    simplified to one SyncChain): pull batches from peers, import
+    through the full verify pipeline, retry failed batches on another
+    peer, stop at the target head."""
+
+    def __init__(self, chain, beacon_cfg, types, node: rr.ReqResp):
+        self.chain = chain
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+        self.node = node
+        self.peers: list[str] = []
+        self.batches_processed = 0
+        self.blocks_imported = 0
+
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id not in self.peers:
+            self.peers.append(peer_id)
+
+    async def status_handshake(self, peer: str):
+        chunks = await self.node.request(
+            peer,
+            rr.PROTOCOL_STATUS,
+            Status.serialize(
+                SyncServer(self.chain, self.beacon_cfg, self.types)
+                .local_status()
+            ),
+        )
+        return Status.deserialize(chunks[0].payload)
+
+    def _head_slot(self) -> int:
+        n = self.chain.fork_choice.proto.get_node(self.chain.head_root)
+        return n.slot if n else 0
+
+    async def sync_to(self, target_slot: int) -> int:
+        """Sync forward to target_slot; returns blocks imported."""
+        spe = preset().SLOTS_PER_EPOCH
+        batch_span = EPOCHS_PER_BATCH * spe
+        imported_total = 0
+        if not self.peers:
+            raise RuntimeError("no peers")
+        while self._head_slot() < target_slot:
+            start = self._head_slot() + 1
+            batch = Batch(start, min(batch_span, target_slot - start + 1))
+            ok = await self._run_batch(batch)
+            if not ok:
+                raise RuntimeError(
+                    f"batch at slot {batch.start_slot} failed after retries"
+                )
+            if not batch.blocks:
+                break  # peer has nothing more for us
+            imported_total += len(batch.blocks)
+            self.batches_processed += 1
+        return imported_total
+
+    async def _run_batch(self, batch: Batch) -> bool:
+        while batch.download_attempts < MAX_BATCH_DOWNLOAD_ATTEMPTS:
+            peer = self._pick_peer(batch)
+            if peer is None:
+                return False
+            batch.status = BatchStatus.downloading
+            batch.download_attempts += 1
+            try:
+                blocks = await self._download(peer, batch)
+            except (rr.ReqRespError, asyncio.TimeoutError):
+                batch.failed_peers.add(peer)
+                batch.status = BatchStatus.awaiting_download
+                continue
+            batch.blocks = blocks
+            batch.status = BatchStatus.processing
+            try:
+                await self._process(batch)
+            except Exception:
+                batch.processing_attempts += 1
+                batch.failed_peers.add(peer)
+                batch.status = BatchStatus.awaiting_download
+                if batch.processing_attempts >= MAX_BATCH_PROCESSING_ATTEMPTS:
+                    batch.status = BatchStatus.failed
+                    return False
+                continue
+            batch.status = BatchStatus.done
+            return True
+        batch.status = BatchStatus.failed
+        return False
+
+    def _pick_peer(self, batch: Batch) -> str | None:
+        """Prefer peers that haven't failed this batch
+        (peerBalancer.ts:10)."""
+        fresh = [p for p in self.peers if p not in batch.failed_peers]
+        pool = fresh or self.peers
+        if not pool:
+            return None
+        return pool[batch.download_attempts % len(pool)]
+
+    async def _download(self, peer: str, batch: Batch) -> list:
+        req = BeaconBlocksByRangeRequest(
+            start_slot=batch.start_slot, count=batch.count, step=1
+        )
+        chunks = await self.node.request(
+            peer,
+            rr.PROTOCOL_BLOCKS_BY_RANGE,
+            BeaconBlocksByRangeRequest.serialize(req),
+        )
+        blocks = []
+        for ch in chunks:
+            fork = self.beacon_cfg.fork_name_from_digest(ch.context)
+            blocks.append(
+                self.types.by_fork[fork].SignedBeaconBlock.deserialize(
+                    ch.payload
+                )
+            )
+        return blocks
+
+    async def _process(self, batch: Batch) -> None:
+        """chain.processChainSegment analog: sequential import; each
+        block's signature sets go through the batch verifier."""
+        for block in batch.blocks:
+            await self.chain.process_block(block, is_timely=False)
+            self.blocks_imported += 1
