@@ -1,0 +1,15 @@
+"""Beacon node REST API: typed routes, server, client.
+
+Reference analog: packages/api (typed endpoint definitions,
+src/beacon/routes/*) + beacon-node/src/api/{impl,rest} (route business
+logic over the chain, fastify server at rest/index.ts:38). Routes are
+defined once (routes.py) and drive both the HTTP server (server.py)
+and the client (client.py) — the reference's single-source-of-truth
+design.
+"""
+
+from .routes import ROUTES, ApiError
+from .server import BeaconRestApiServer
+from .client import ApiClient
+
+__all__ = ["ROUTES", "ApiError", "BeaconRestApiServer", "ApiClient"]

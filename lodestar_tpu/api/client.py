@@ -1,0 +1,81 @@
+"""Beacon API HTTP client.
+
+Reference analog: packages/api/src/utils/client/httpClient.ts:74 —
+route-table-driven callers with base-url fallback and timeouts; used by
+the validator client to talk to the beacon node.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .impl import ApiError
+from .routes import ROUTES
+
+
+class ApiClient:
+    def __init__(self, base_urls, timeout: float = 10.0):
+        if isinstance(base_urls, str):
+            base_urls = [base_urls]
+        self.base_urls = [u.rstrip("/") for u in base_urls]
+        self.timeout = timeout
+        self._routes = {r.operation_id: r for r in ROUTES}
+
+    def call(self, operation_id: str, params=None, body=None):
+        route = self._routes[operation_id]
+        path = route.path
+        for k, v in (params or {}).items():
+            path = path.replace("{" + k + "}", str(v))
+        data = json.dumps(body).encode() if body is not None else None
+        last_err = None
+        for base in self.base_urls:  # fallback URLs (httpClient.ts)
+            req = urllib.request.Request(
+                base + path,
+                data=data,
+                method=route.method,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout
+                ) as resp:
+                    if resp.status == 200 and resp.length in (0, None) and (
+                        operation_id == "getHealth"
+                    ):
+                        return resp.status
+                    payload = resp.read()
+                    if not payload:
+                        return resp.status
+                    out = json.loads(payload)
+                    return out.get("data", out) if route.wrap_data else out
+            except urllib.error.HTTPError as e:
+                try:
+                    err = json.loads(e.read())
+                    raise ApiError(
+                        e.code, err.get("message", str(e))
+                    ) from None
+                except (ValueError, KeyError):
+                    raise ApiError(e.code, str(e)) from None
+            except urllib.error.URLError as e:
+                last_err = e
+                continue
+        raise ApiError(503, f"all base urls failed: {last_err}")
+
+    # sugar for common calls
+    def get_genesis(self):
+        return self.call("getGenesis")
+
+    def get_syncing(self):
+        return self.call("getSyncingStatus")
+
+    def get_proposer_duties(self, epoch: int):
+        return self.call("getProposerDuties", {"epoch": epoch})
+
+    def get_attester_duties(self, epoch: int, indices: list[int]):
+        return self.call(
+            "getAttesterDuties",
+            {"epoch": epoch},
+            body=[str(i) for i in indices],
+        )

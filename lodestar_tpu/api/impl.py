@@ -1,0 +1,272 @@
+"""API business logic over the beacon chain.
+
+Reference analog: beacon-node/src/api/impl/ — the per-namespace route
+implementations (beacon, validator, node, config, debug). Each method
+returns JSON-compatible data per the eth2 beacon-API spec shapes
+(snake_case keys, numbers as strings, 0x-hex roots).
+"""
+
+from __future__ import annotations
+
+from ..params import ForkSeq, preset
+from ..statetransition import util
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _cp(cp) -> dict:
+    return {"epoch": str(int(cp.epoch)), "root": _hex(cp.root)}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class BeaconApiImpl:
+    """All namespaces in one impl bound to a chain (+ optional pools,
+    node services)."""
+
+    def __init__(self, cfg, types, chain, node=None, version="lodestar-tpu/r2"):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+        self.node = node
+        self.version = version
+
+    # -- beacon namespace ----------------------------------------------
+
+    def get_genesis(self) -> dict:
+        st = self.chain.get_state(self.chain.genesis_root).state
+        return {
+            "genesis_time": str(int(self.chain.genesis_time)),
+            "genesis_validators_root": _hex(
+                bytes(st.genesis_validators_root)
+            ),
+            "genesis_fork_version": _hex(self.cfg.GENESIS_FORK_VERSION),
+        }
+
+    def _resolve_state(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state
+        if state_id == "genesis":
+            return chain.get_state(chain.genesis_root)
+        if state_id == "finalized":
+            v = chain.get_state(chain.finalized_checkpoint.root)
+            if v is None:
+                raise ApiError(404, "finalized state pruned")
+            return v
+        if state_id == "justified":
+            v = chain.get_state(chain.justified_checkpoint.root)
+            if v is None:
+                raise ApiError(404, "justified state pruned")
+            return v
+        if state_id.startswith("0x"):
+            for root, view in chain._states.items():
+                if view.hash_tree_root(self.types) == bytes.fromhex(
+                    state_id[2:]
+                ):
+                    return view
+            raise ApiError(404, f"state {state_id} not found")
+        # by slot
+        try:
+            slot = int(state_id)
+        except ValueError:
+            raise ApiError(400, f"invalid state id {state_id}") from None
+        for root, view in self.chain._states.items():
+            if int(view.state.slot) == slot:
+                return view
+        raise ApiError(404, f"state at slot {slot} not found")
+
+    def get_state_fork(self, state_id: str) -> dict:
+        st = self._resolve_state(state_id).state
+        return {
+            "previous_version": _hex(bytes(st.fork.previous_version)),
+            "current_version": _hex(bytes(st.fork.current_version)),
+            "epoch": str(int(st.fork.epoch)),
+        }
+
+    def get_state_finality_checkpoints(self, state_id: str) -> dict:
+        st = self._resolve_state(state_id).state
+        return {
+            "previous_justified": _cp(st.previous_justified_checkpoint),
+            "current_justified": _cp(st.current_justified_checkpoint),
+            "finalized": _cp(st.finalized_checkpoint),
+        }
+
+    def get_state_validators(self, state_id: str) -> list:
+        st = self._resolve_state(state_id).state
+        epoch = util.get_current_epoch(st)
+        out = []
+        for i, (v, bal) in enumerate(zip(st.validators, st.balances)):
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(int(bal)),
+                    "status": _validator_status(v, epoch),
+                    "validator": {
+                        "pubkey": _hex(bytes(v.pubkey)),
+                        "effective_balance": str(int(v.effective_balance)),
+                        "slashed": bool(v.slashed),
+                        "activation_epoch": str(int(v.activation_epoch)),
+                        "exit_epoch": str(int(v.exit_epoch)),
+                    },
+                }
+            )
+        return out
+
+    def get_block_header(self, block_id: str) -> dict:
+        root = self._resolve_block_root(block_id)
+        node = self.chain.fork_choice.proto.get_node(root)
+        if node is None:
+            raise ApiError(404, f"block {block_id} not found")
+        return {
+            "root": _hex(root),
+            "canonical": True,
+            "header": {
+                "message": {
+                    "slot": str(node.slot),
+                    "parent_root": _hex(node.parent_root or b"\x00" * 32),
+                    "state_root": _hex(node.state_root),
+                },
+            },
+        }
+
+    def _resolve_block_root(self, block_id: str) -> bytes:
+        chain = self.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "genesis":
+            return chain.genesis_root
+        if block_id == "finalized":
+            return chain.finalized_checkpoint.root
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        try:
+            slot = int(block_id)
+        except ValueError:
+            raise ApiError(400, f"invalid block id {block_id}") from None
+        root = chain.fork_choice.proto.ancestor_at_slot(
+            chain.head_root, slot
+        )
+        if root is None:
+            raise ApiError(404, f"no block at slot {slot}")
+        return root
+
+    async def publish_block(self, signed_block) -> dict:
+        await self.chain.process_block(signed_block)
+        return {}
+
+    # -- validator namespace --------------------------------------------
+
+    def get_proposer_duties(self, epoch: int) -> list:
+        """Per-slot proposers for an epoch, computed on the head state
+        (api/impl/validator getProposerDuties)."""
+        from ..params import DOMAIN_BEACON_PROPOSER
+
+        p = preset()
+        view = self.chain.head_state
+        st = view.state
+        head_epoch = util.get_current_epoch(st)
+        if epoch not in (head_epoch, head_epoch + 1):
+            raise ApiError(
+                400, f"epoch {epoch} not current or next ({head_epoch})"
+            )
+        electra = view.fork_seq >= ForkSeq.electra
+        indices = util.get_active_validator_indices(st, epoch)
+        duties = []
+        for s in range(
+            epoch * p.SLOTS_PER_EPOCH, (epoch + 1) * p.SLOTS_PER_EPOCH
+        ):
+            seed = util.hash32(
+                util.get_seed(st, epoch, DOMAIN_BEACON_PROPOSER)
+                + util.uint_to_bytes8(s)
+            )
+            idx = util.compute_proposer_index(
+                st, indices, seed, electra=electra
+            )
+            duties.append(
+                {
+                    "pubkey": _hex(bytes(st.validators[idx].pubkey)),
+                    "validator_index": str(idx),
+                    "slot": str(s),
+                }
+            )
+        return duties
+
+    def get_attester_duties(self, epoch: int, indices: list[int]) -> list:
+        st = self.chain.head_state.state
+        sh = util.EpochShuffling(st, epoch)
+        p = preset()
+        wanted = set(indices)
+        duties = []
+        for slot in range(
+            epoch * p.SLOTS_PER_EPOCH, (epoch + 1) * p.SLOTS_PER_EPOCH
+        ):
+            for ci, committee in enumerate(sh.committees_at_slot(slot)):
+                for pos, v in enumerate(committee):
+                    if int(v) in wanted:
+                        duties.append(
+                            {
+                                "pubkey": _hex(
+                                    bytes(st.validators[int(v)].pubkey)
+                                ),
+                                "validator_index": str(int(v)),
+                                "committee_index": str(ci),
+                                "committee_length": str(len(committee)),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return duties
+
+    # -- node namespace --------------------------------------------------
+
+    def get_health(self) -> int:
+        return 200
+
+    def get_version(self) -> dict:
+        return {"version": self.version}
+
+    def get_syncing(self) -> dict:
+        head = self.chain.fork_choice.proto.get_node(self.chain.head_root)
+        return {
+            "head_slot": str(head.slot if head else 0),
+            "sync_distance": "0",
+            "is_syncing": False,
+            "is_optimistic": False,
+            "el_offline": True,
+        }
+
+    # -- config namespace -------------------------------------------------
+
+    def get_spec(self) -> dict:
+        p = preset()
+        return {
+            "SECONDS_PER_SLOT": str(self.cfg.SECONDS_PER_SLOT),
+            "SLOTS_PER_EPOCH": str(p.SLOTS_PER_EPOCH),
+            "ALTAIR_FORK_EPOCH": str(self.cfg.ALTAIR_FORK_EPOCH),
+            "BELLATRIX_FORK_EPOCH": str(self.cfg.BELLATRIX_FORK_EPOCH),
+            "CAPELLA_FORK_EPOCH": str(self.cfg.CAPELLA_FORK_EPOCH),
+            "DENEB_FORK_EPOCH": str(self.cfg.DENEB_FORK_EPOCH),
+            "ELECTRA_FORK_EPOCH": str(self.cfg.ELECTRA_FORK_EPOCH),
+            "MAX_COMMITTEES_PER_SLOT": str(p.MAX_COMMITTEES_PER_SLOT),
+            "TARGET_COMMITTEE_SIZE": str(p.TARGET_COMMITTEE_SIZE),
+        }
+
+
+def _validator_status(v, epoch: int) -> str:
+    from ..params import FAR_FUTURE_EPOCH
+
+    if int(v.activation_epoch) > epoch:
+        return "pending_queued"
+    if int(v.exit_epoch) == FAR_FUTURE_EPOCH:
+        return "active_ongoing"
+    if epoch < int(v.exit_epoch):
+        return "active_exiting"
+    return "exited_slashed" if v.slashed else "exited_unslashed"

@@ -1,0 +1,93 @@
+"""Route table: single source of truth for server + client.
+
+Reference analog: packages/api/src/beacon/routes/ — each endpoint
+declared once with method, path template, and impl binding; the server
+registers them (api/utils/server/) and the client generates callers
+(api/utils/client/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .impl import ApiError  # re-export for package __init__
+
+
+@dataclass(frozen=True)
+class Route:
+    operation_id: str
+    method: str  # GET | POST
+    path: str  # template with {param} segments
+    impl_name: str  # method on BeaconApiImpl
+    wrap_data: bool = True  # beacon-api {"data": ...} envelope
+
+
+ROUTES: list[Route] = [
+    # beacon
+    Route("getGenesis", "GET", "/eth/v1/beacon/genesis", "get_genesis"),
+    Route(
+        "getStateFork",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/fork",
+        "get_state_fork",
+    ),
+    Route(
+        "getStateFinalityCheckpoints",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+        "get_state_finality_checkpoints",
+    ),
+    Route(
+        "getStateValidators",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validators",
+        "get_state_validators",
+    ),
+    Route(
+        "getBlockHeader",
+        "GET",
+        "/eth/v1/beacon/headers/{block_id}",
+        "get_block_header",
+    ),
+    # validator
+    Route(
+        "getProposerDuties",
+        "GET",
+        "/eth/v1/validator/duties/proposer/{epoch}",
+        "get_proposer_duties",
+    ),
+    Route(
+        "getAttesterDuties",
+        "POST",
+        "/eth/v1/validator/duties/attester/{epoch}",
+        "get_attester_duties",
+    ),
+    # node
+    Route("getHealth", "GET", "/eth/v1/node/health", "get_health", wrap_data=False),
+    Route("getNodeVersion", "GET", "/eth/v1/node/version", "get_version"),
+    Route("getSyncingStatus", "GET", "/eth/v1/node/syncing", "get_syncing"),
+    # config
+    Route("getSpec", "GET", "/eth/v1/config/spec", "get_spec"),
+]
+
+
+def match_route(method: str, path: str):
+    """Returns (route, params) or None."""
+    parts = [p for p in path.split("/") if p]
+    for route in ROUTES:
+        if route.method != method:
+            continue
+        tparts = [p for p in route.path.split("/") if p]
+        if len(tparts) != len(parts):
+            continue
+        params = {}
+        ok = True
+        for t, p in zip(tparts, parts):
+            if t.startswith("{") and t.endswith("}"):
+                params[t[1:-1]] = p
+            elif t != p:
+                ok = False
+                break
+        if ok:
+            return route, params
+    return None

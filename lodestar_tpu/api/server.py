@@ -1,0 +1,124 @@
+"""Beacon REST API HTTP server.
+
+Reference analog: BeaconRestApiServer on fastify
+(beacon-node/src/api/rest/index.ts:38). stdlib ThreadingHTTPServer in a
+daemon thread; async impl methods are bridged onto the node's asyncio
+loop with run_coroutine_threadsafe (the fastify->chain boundary in the
+reference is the same thread-hop, worker bridge §1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .impl import ApiError, BeaconApiImpl
+from .routes import match_route
+
+
+class BeaconRestApiServer:
+    def __init__(
+        self,
+        impl: BeaconApiImpl,
+        host: str = "127.0.0.1",
+        port: int = 9596,
+        loop: asyncio.AbstractEventLoop | None = None,
+    ):
+        self.impl = impl
+        self.host = host
+        self.port = port
+        self.loop = loop
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        impl = self.impl
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _run(self):
+                m = match_route(
+                    self.command, self.path.split("?")[0]
+                )
+                if m is None:
+                    self._json(404, {"code": 404, "message": "route not found"})
+                    return
+                route, params = m
+                body = None
+                if self.command == "POST":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(n) if n else b""
+                    body = json.loads(raw) if raw else None
+                try:
+                    args = list(params.values())
+                    # numeric path params (epoch) arrive as strings
+                    args = [
+                        int(a) if a.isdigit() else a for a in args
+                    ]
+                    if body is not None:
+                        args.append(
+                            [int(x) for x in body]
+                            if isinstance(body, list)
+                            else body
+                        )
+                    fn = getattr(impl, route.impl_name)
+                    result = fn(*args)
+                    if inspect.iscoroutine(result):
+                        if server.loop is None:
+                            raise ApiError(500, "no loop for async route")
+                        result = asyncio.run_coroutine_threadsafe(
+                            result, server.loop
+                        ).result(timeout=30)
+                except ApiError as e:
+                    self._json(
+                        e.status, {"code": e.status, "message": e.message}
+                    )
+                    return
+                except Exception as e:
+                    self._json(500, {"code": 500, "message": repr(e)})
+                    return
+                if not route.wrap_data:
+                    if isinstance(result, int):  # health: status only
+                        self.send_response(result)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    self._json(200, result)
+                    return
+                self._json(200, {"data": result})
+
+            def _json(self, status: int, obj) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._run()
+
+            def do_POST(self):
+                self._run()
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

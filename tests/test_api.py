@@ -1,0 +1,148 @@
+"""REST API tests: route matching, server/client roundtrips over HTTP.
+
+Reference analog: beacon-node test/e2e/api — REST API against a dev
+node (SURVEY.md §4 E2E tier).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api import ApiClient, BeaconRestApiServer
+from lodestar_tpu.api.impl import ApiError, BeaconApiImpl
+from lodestar_tpu.api.routes import match_route
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.params import preset
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class StubVerifier:
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+class TestRouting:
+    def test_match_with_params(self):
+        r, params = match_route(
+            "GET", "/eth/v1/beacon/states/head/fork"
+        )
+        assert r.operation_id == "getStateFork"
+        assert params == {"state_id": "head"}
+
+    def test_no_match(self):
+        assert match_route("GET", "/eth/v1/nope") is None
+        assert match_route("POST", "/eth/v1/beacon/genesis") is None
+
+
+@pytest.fixture(scope="module")
+def dev_node(types):
+    cfg = _cfg()
+    node = DevNode(
+        cfg, types, N, verifier=StubVerifier(), verify_attestations=False
+    )
+
+    async def go():
+        await node.run_until(preset().SLOTS_PER_EPOCH + 2)
+
+    asyncio.run(go())
+    return cfg, node
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def server_client(self, types, dev_node):
+        cfg, node = dev_node
+        impl = BeaconApiImpl(cfg, types, node.chain)
+        srv = BeaconRestApiServer(impl, port=0)
+        port = srv.start()
+        client = ApiClient(f"http://127.0.0.1:{port}")
+        yield impl, client
+        srv.stop()
+
+    def test_genesis(self, server_client):
+        _, client = server_client
+        g = client.get_genesis()
+        assert g["genesis_validators_root"].startswith("0x")
+
+    def test_state_fork_and_finality(self, server_client, dev_node):
+        _, client = server_client
+        fork = client.call("getStateFork", {"state_id": "head"})
+        assert fork["current_version"].startswith("0x")
+        fc = client.call(
+            "getStateFinalityCheckpoints", {"state_id": "head"}
+        )
+        assert set(fc) == {
+            "previous_justified",
+            "current_justified",
+            "finalized",
+        }
+
+    def test_validators_listing(self, server_client):
+        _, client = server_client
+        vals = client.call("getStateValidators", {"state_id": "head"})
+        assert len(vals) == N
+        assert vals[0]["status"] == "active_ongoing"
+
+    def test_block_header(self, server_client, dev_node):
+        _, client = server_client
+        cfg, node = dev_node
+        h = client.call("getBlockHeader", {"block_id": "head"})
+        assert h["root"] == "0x" + node.chain.head_root.hex()
+
+    def test_proposer_duties_full_epoch(self, server_client, dev_node):
+        _, client = server_client
+        duties = client.get_proposer_duties(1)
+        assert len(duties) == preset().SLOTS_PER_EPOCH
+        slots = sorted(int(d["slot"]) for d in duties)
+        assert slots == list(
+            range(preset().SLOTS_PER_EPOCH, 2 * preset().SLOTS_PER_EPOCH)
+        )
+
+    def test_attester_duties(self, server_client):
+        _, client = server_client
+        duties = client.get_attester_duties(1, [0, 1, 2])
+        assert len(duties) == 3
+        assert {int(d["validator_index"]) for d in duties} == {0, 1, 2}
+
+    def test_node_and_spec(self, server_client):
+        _, client = server_client
+        assert client.call("getHealth") == 200
+        sync = client.get_syncing()
+        assert sync["is_syncing"] is False
+        spec = client.call("getSpec")
+        assert spec["SLOTS_PER_EPOCH"] == str(preset().SLOTS_PER_EPOCH)
+
+    def test_error_status_propagates(self, server_client):
+        _, client = server_client
+        with pytest.raises(ApiError) as ei:
+            client.call("getStateFork", {"state_id": "0x" + "ab" * 32})
+        assert ei.value.status == 404
