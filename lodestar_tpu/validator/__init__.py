@@ -1,0 +1,28 @@
+"""Validator client package.
+
+Reference analog: packages/validator — `Validator` (src/validator.ts:82)
+orchestrating duty services over a REST api client, `ValidatorStore`
+(services/validatorStore.ts:149) holding keys + signing every object
+type behind slashing protection (src/slashingProtection/index.ts:31,
+EIP-3076 interchange) and doppelganger gating
+(services/doppelgangerService.ts:39).
+"""
+
+from .slashing_protection import (
+    InterchangeError,
+    SlashingProtection,
+    SlashingProtectionError,
+)
+from .store import ValidatorStore
+from .validator import Validator
+from .doppelganger import DoppelgangerService, DoppelgangerStatus
+
+__all__ = [
+    "InterchangeError",
+    "SlashingProtection",
+    "SlashingProtectionError",
+    "ValidatorStore",
+    "Validator",
+    "DoppelgangerService",
+    "DoppelgangerStatus",
+]

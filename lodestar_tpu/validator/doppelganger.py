@@ -1,0 +1,67 @@
+"""Doppelganger protection.
+
+Reference analog: validator/src/services/doppelgangerService.ts:39 —
+newly started validators stay silent for DEFAULT_REMAINING_DETECTION_
+EPOCHS, watching the network for their own indices attesting; any
+liveness hit means another instance runs the same keys and the client
+shuts down rather than self-slash.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+REMAINING_DETECTION_EPOCHS = 1
+
+
+class DoppelgangerStatus(str, Enum):
+    verified_safe = "VerifiedSafe"
+    unverified = "Unverified"
+    doppelganger_detected = "DoppelgangerDetected"
+
+
+class DoppelgangerService:
+    def __init__(self, liveness_fn=None, process_shutdown_fn=None):
+        """liveness_fn(epoch, indices) -> set of indices seen live on
+        the network (api.validator.getLiveness in the reference)."""
+        self.liveness_fn = liveness_fn or (lambda epoch, idxs: set())
+        self.process_shutdown_fn = process_shutdown_fn
+        self._registered: dict[int, int] = {}  # index -> epoch registered
+        self._detected: set[int] = set()
+
+    def register(self, index: int, current_epoch: int) -> None:
+        self._registered.setdefault(index, current_epoch)
+
+    def status(self, index: int, current_epoch: int) -> DoppelgangerStatus:
+        if index in self._detected:
+            return DoppelgangerStatus.doppelganger_detected
+        start = self._registered.get(index)
+        if start is None:
+            return DoppelgangerStatus.unverified
+        if current_epoch - start > REMAINING_DETECTION_EPOCHS:
+            return DoppelgangerStatus.verified_safe
+        return DoppelgangerStatus.unverified
+
+    def is_signing_safe(self, index: int, current_epoch: int) -> bool:
+        return (
+            self.status(index, current_epoch)
+            == DoppelgangerStatus.verified_safe
+        )
+
+    def on_epoch(self, epoch: int) -> None:
+        """Run a liveness check for validators still in detection."""
+        pending = [
+            i
+            for i, start in self._registered.items()
+            if epoch - start <= REMAINING_DETECTION_EPOCHS
+            and i not in self._detected
+        ]
+        if not pending:
+            return
+        live = self.liveness_fn(epoch, pending)
+        if live:
+            self._detected.update(live)
+            if self.process_shutdown_fn is not None:
+                self.process_shutdown_fn(
+                    f"doppelganger detected for indices {sorted(live)}"
+                )

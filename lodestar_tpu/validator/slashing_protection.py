@@ -1,0 +1,213 @@
+"""Slashing protection: min-max surround vote DB + EIP-3076 interchange.
+
+Reference analog: validator/src/slashingProtection/ — attestation
+protection via min/max source-target tracking
+(attestation/attestationByTarget.ts + minMaxSurround/), block
+protection by slot, and the EIP-3076 JSON interchange format
+(interchange/formats/completeV4.ts). The rules enforced:
+  - never sign two different blocks at the same slot
+  - never sign an attestation whose target is <= a previously signed
+    target (double vote) unless identical
+  - never sign an attestation that surrounds or is surrounded by a
+    previous one
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+
+class SlashingProtectionError(Exception):
+    pass
+
+
+class InterchangeError(Exception):
+    pass
+
+
+@dataclass
+class SignedAttestationRecord:
+    source_epoch: int
+    target_epoch: int
+    signing_root: bytes | None = None
+
+
+@dataclass
+class SignedBlockRecord:
+    slot: int
+    signing_root: bytes | None = None
+
+
+class SlashingProtection:
+    """Per-pubkey signing history over a KV-ish store (dict or db
+    controller). The reference persists to LevelDB; this accepts any
+    mapping-like store and keeps an in-memory index."""
+
+    def __init__(self, genesis_validators_root: bytes = b"\x00" * 32):
+        self.genesis_validators_root = genesis_validators_root
+        self._atts: dict[bytes, list[SignedAttestationRecord]] = {}
+        self._blocks: dict[bytes, dict[int, SignedBlockRecord]] = {}
+
+    # -- blocks ---------------------------------------------------------
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes | None = None
+    ) -> None:
+        blocks = self._blocks.setdefault(bytes(pubkey), {})
+        existing = blocks.get(slot)
+        if existing is not None:
+            if (
+                existing.signing_root is not None
+                and signing_root is not None
+                and existing.signing_root == signing_root
+            ):
+                return  # identical re-sign is safe
+            raise SlashingProtectionError(
+                f"double block proposal at slot {slot}"
+            )
+        # lower-bound rule: refuse slots at or below the minimum known
+        # slot when history exists (EIP-3076 semantics)
+        if blocks and slot < min(blocks):
+            raise SlashingProtectionError(
+                f"block slot {slot} below protection lower bound"
+            )
+        blocks[slot] = SignedBlockRecord(slot, signing_root)
+
+    # -- attestations ----------------------------------------------------
+
+    def check_and_insert_attestation(
+        self,
+        pubkey: bytes,
+        source_epoch: int,
+        target_epoch: int,
+        signing_root: bytes | None = None,
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source after target")
+        history = self._atts.setdefault(bytes(pubkey), [])
+        for rec in history:
+            # double vote: same target, different data
+            if rec.target_epoch == target_epoch:
+                if (
+                    rec.signing_root is not None
+                    and signing_root is not None
+                    and rec.signing_root == signing_root
+                    and rec.source_epoch == source_epoch
+                ):
+                    return
+                raise SlashingProtectionError(
+                    f"double vote at target {target_epoch}"
+                )
+            # surround checks
+            if (
+                source_epoch < rec.source_epoch
+                and target_epoch > rec.target_epoch
+            ):
+                raise SlashingProtectionError(
+                    "new attestation surrounds a previous one"
+                )
+            if (
+                source_epoch > rec.source_epoch
+                and target_epoch < rec.target_epoch
+            ):
+                raise SlashingProtectionError(
+                    "new attestation is surrounded by a previous one"
+                )
+        # monotonic lower bound (pruned histories keep only min epochs)
+        if history:
+            min_target = min(r.target_epoch for r in history)
+            if target_epoch < min_target:
+                raise SlashingProtectionError(
+                    "target below protection lower bound"
+                )
+        history.append(
+            SignedAttestationRecord(source_epoch, target_epoch, signing_root)
+        )
+
+    # -- EIP-3076 interchange -------------------------------------------
+
+    def export_interchange(self) -> dict:
+        pubkeys = set(self._atts) | set(self._blocks)
+        data = []
+        for pk in sorted(pubkeys):
+            data.append(
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_blocks": [
+                        {
+                            "slot": str(b.slot),
+                            **(
+                                {"signing_root": "0x" + b.signing_root.hex()}
+                                if b.signing_root
+                                else {}
+                            ),
+                        }
+                        for b in sorted(
+                            self._blocks.get(pk, {}).values(),
+                            key=lambda b: b.slot,
+                        )
+                    ],
+                    "signed_attestations": [
+                        {
+                            "source_epoch": str(a.source_epoch),
+                            "target_epoch": str(a.target_epoch),
+                            **(
+                                {"signing_root": "0x" + a.signing_root.hex()}
+                                if a.signing_root
+                                else {}
+                            ),
+                        }
+                        for a in sorted(
+                            self._atts.get(pk, []),
+                            key=lambda a: a.target_epoch,
+                        )
+                    ],
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x"
+                + self.genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, obj: dict | str) -> int:
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        meta = obj.get("metadata", {})
+        if meta.get("interchange_format_version") not in ("4", "5"):
+            raise InterchangeError("unsupported interchange version")
+        gvr = meta.get("genesis_validators_root", "")
+        if (
+            gvr
+            and bytes.fromhex(gvr[2:]) != self.genesis_validators_root
+            and self.genesis_validators_root != b"\x00" * 32
+        ):
+            raise InterchangeError("genesis_validators_root mismatch")
+        n = 0
+        for entry in obj.get("data", []):
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            for b in entry.get("signed_blocks", []):
+                rec = SignedBlockRecord(
+                    int(b["slot"]),
+                    bytes.fromhex(b["signing_root"][2:])
+                    if "signing_root" in b
+                    else None,
+                )
+                self._blocks.setdefault(pk, {})[rec.slot] = rec
+                n += 1
+            for a in entry.get("signed_attestations", []):
+                self._atts.setdefault(pk, []).append(
+                    SignedAttestationRecord(
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        bytes.fromhex(a["signing_root"][2:])
+                        if "signing_root" in a
+                        else None,
+                    )
+                )
+                n += 1
+        return n

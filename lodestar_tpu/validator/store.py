@@ -1,0 +1,122 @@
+"""ValidatorStore: keys + signing for every duty object.
+
+Reference analog: validator/src/services/validatorStore.ts:149 — holds
+signers, computes domains/signing roots, and gates every block and
+attestation signature behind slashing protection and doppelganger
+status.
+"""
+
+from __future__ import annotations
+
+from ..config.beacon_config import compute_signing_root_from_roots
+from ..crypto.bls.signature import sign, sk_to_pk
+from ..params import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    preset,
+)
+from ..ssz import uint64 as ssz_uint64
+from .doppelganger import DoppelgangerService
+from .slashing_protection import SlashingProtection
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        beacon_cfg,
+        types,
+        secret_keys: dict[int, int],  # validator index -> sk
+        slashing_protection: SlashingProtection | None = None,
+        doppelganger: DoppelgangerService | None = None,
+    ):
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+        self.sks = dict(secret_keys)
+        self.pubkeys = {i: sk_to_pk(sk) for i, sk in self.sks.items()}
+        self.slashing_protection = (
+            slashing_protection or SlashingProtection()
+        )
+        self.doppelganger = doppelganger
+
+    def has_validator(self, index: int) -> bool:
+        return index in self.sks
+
+    def indices(self) -> list[int]:
+        return sorted(self.sks)
+
+    def _check_doppelganger(self, index: int, epoch: int) -> None:
+        if self.doppelganger is not None and not (
+            self.doppelganger.is_signing_safe(index, epoch)
+        ):
+            raise RuntimeError(
+                f"validator {index} not verified safe (doppelganger)"
+            )
+
+    # -- signing ---------------------------------------------------------
+
+    def sign_block(self, index: int, block, fork_name: str):
+        epoch = int(block.slot) // preset().SLOTS_PER_EPOCH
+        self._check_doppelganger(index, epoch)
+        ns = self.types.by_fork[fork_name]
+        root = ns.BeaconBlock.hash_tree_root(block)
+        domain = self.beacon_cfg.get_domain(DOMAIN_BEACON_PROPOSER, epoch)
+        signing_root = compute_signing_root_from_roots(root, domain)
+        self.slashing_protection.check_and_insert_block_proposal(
+            self.pubkeys[index], int(block.slot), signing_root
+        )
+        signed = ns.SignedBeaconBlock.default()
+        signed.message = block
+        signed.signature = sign(self.sks[index], signing_root)
+        return signed
+
+    def sign_attestation(self, index: int, data):
+        epoch = int(data.target.epoch)
+        self._check_doppelganger(index, epoch)
+        domain = self.beacon_cfg.get_domain(DOMAIN_BEACON_ATTESTER, epoch)
+        root = self.types.AttestationData.hash_tree_root(data)
+        signing_root = compute_signing_root_from_roots(root, domain)
+        self.slashing_protection.check_and_insert_attestation(
+            self.pubkeys[index],
+            int(data.source.epoch),
+            epoch,
+            signing_root,
+        )
+        return sign(self.sks[index], signing_root)
+
+    def sign_randao(self, index: int, epoch: int) -> bytes:
+        domain = self.beacon_cfg.get_domain(DOMAIN_RANDAO, epoch)
+        root = ssz_uint64.hash_tree_root(epoch)
+        return sign(
+            self.sks[index], compute_signing_root_from_roots(root, domain)
+        )
+
+    def sign_selection_proof(self, index: int, slot: int) -> bytes:
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        domain = self.beacon_cfg.get_domain(DOMAIN_SELECTION_PROOF, epoch)
+        root = ssz_uint64.hash_tree_root(slot)
+        return sign(
+            self.sks[index], compute_signing_root_from_roots(root, domain)
+        )
+
+    def sign_aggregate_and_proof(self, index: int, agg_and_proof, epoch):
+        domain = self.beacon_cfg.get_domain(
+            DOMAIN_AGGREGATE_AND_PROOF, epoch
+        )
+        root = self.types.AggregateAndProof.hash_tree_root(agg_and_proof)
+        return sign(
+            self.sks[index], compute_signing_root_from_roots(root, domain)
+        )
+
+    def sign_sync_committee_message(
+        self, index: int, slot: int, block_root: bytes
+    ) -> bytes:
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        domain = self.beacon_cfg.get_domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        return sign(
+            self.sks[index],
+            compute_signing_root_from_roots(bytes(block_root), domain),
+        )

@@ -1,0 +1,143 @@
+"""Validator: clock-driven duty orchestration.
+
+Reference analog: validator/src/validator.ts:82 + duty services
+(services/attestation.ts:35 per-slot flow: attest at 1/3 slot,
+aggregate at 2/3 slot; services/block.ts:64 propose at slot start).
+The api is pluggable: `InProcessApi` binds to a chain directly (the
+`lodestar dev` shape); an HTTP ApiClient binding slots in for a real
+separated VC.
+"""
+
+from __future__ import annotations
+
+from ..params import ForkSeq, preset
+from ..statetransition import util
+from .store import ValidatorStore
+
+
+class InProcessApi:
+    """Duck-typed beacon api over an in-process chain (test/dev mode;
+    the reference's equivalent seam is the REST api the VC talks to)."""
+
+    def __init__(self, cfg, types, chain):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+
+    def head_state(self):
+        return self.chain.head_state
+
+    def produce_block(self, slot: int, randao_reveal: bytes, attestations):
+        block, post = self.chain.produce_block(
+            slot, randao_reveal, attestations=attestations
+        )
+        return block, post.fork
+
+    async def publish_block(self, signed_block):
+        await self.chain.process_block(signed_block, is_timely=True)
+
+    def attestation_data(self, slot: int, committee_index: int):
+        chain = self.chain
+        types = self.types
+        head_root = chain.head_root
+        st = chain.get_state(head_root).state
+        epoch = util.compute_epoch_at_slot(slot)
+        try:
+            target_root = util.get_block_root(st, epoch)
+        except ValueError:
+            target_root = head_root
+        data = types.AttestationData.default()
+        data.slot = slot
+        data.index = committee_index
+        data.beacon_block_root = head_root
+        data.source = st.current_justified_checkpoint
+        tgt = types.Checkpoint.default()
+        tgt.epoch = epoch
+        tgt.root = target_root
+        data.target = tgt
+        return data
+
+    async def publish_attestation(self, attestation, committee):
+        await self.chain.on_attestation(attestation, committee)
+
+
+class Validator:
+    """Runs duties for a set of validator indices against an api."""
+
+    def __init__(self, api, store: ValidatorStore, att_pool=None):
+        self.api = api
+        self.store = store
+        self.types = store.types
+        self.att_pool = att_pool
+        self.blocks_proposed = 0
+        self.attestations_published = 0
+
+    # -- block duty ------------------------------------------------------
+
+    async def run_block_duties(self, slot: int) -> bytes | None:
+        """Propose if one of our validators owns the slot
+        (BlockProposingService.runBlockTasks)."""
+        view = self.api.head_state()
+        st = view.state
+        from ..chain.chain import _clone
+        from ..statetransition.slot import process_slots
+
+        scratch = _clone(view, self.types)
+        process_slots(self.api.cfg, scratch, slot, self.types)
+        proposer = util.get_beacon_proposer_index(
+            scratch.state, electra=scratch.fork_seq >= ForkSeq.electra
+        )
+        if not self.store.has_validator(proposer):
+            return None
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        randao = self.store.sign_randao(proposer, epoch)
+        atts = (
+            self.att_pool.get_attestations_for_block(slot)
+            if self.att_pool is not None
+            else []
+        )
+        block, fork = self.api.produce_block(slot, randao, atts)
+        signed = self.store.sign_block(proposer, block, fork)
+        await self.api.publish_block(signed)
+        self.blocks_proposed += 1
+        ns = self.types.by_fork[fork]
+        return ns.BeaconBlock.hash_tree_root(block)
+
+    # -- attestation duty -------------------------------------------------
+
+    async def run_attestation_duties(self, slot: int) -> int:
+        """All owned validators in this slot's committees attest
+        (AttestationService: one attestation data per committee, signed
+        per validator)."""
+        view = self.api.head_state()
+        st = view.state
+        epoch = util.compute_epoch_at_slot(slot)
+        sh = util.EpochShuffling(st, epoch)
+        published = 0
+        for ci, committee in enumerate(sh.committees_at_slot(slot)):
+            owned = [
+                (pos, int(v))
+                for pos, v in enumerate(committee)
+                if self.store.has_validator(int(v))
+            ]
+            if not owned:
+                continue
+            data = self.api.attestation_data(slot, ci)
+            for pos, vindex in owned:
+                sig = self.store.sign_attestation(vindex, data)
+                att = self.types.Attestation.default()
+                att.data = data
+                bits = [False] * len(committee)
+                bits[pos] = True
+                att.aggregation_bits = bits
+                att.signature = sig
+                await self.api.publish_attestation(att, committee)
+                if self.att_pool is not None:
+                    self.att_pool.add(att)
+                published += 1
+        self.attestations_published += published
+        return published
+
+    async def on_slot(self, slot: int) -> None:
+        await self.run_block_duties(slot)
+        await self.run_attestation_duties(slot)
