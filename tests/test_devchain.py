@@ -62,7 +62,11 @@ class TestDevChain:
         p = preset()
 
         async def go():
-            await node.run_until(2 * p.SLOTS_PER_EPOCH + 1)
+            # spec guard: process_justification_and_finalization is a
+            # no-op while get_current_epoch <= GENESIS_EPOCH+1, so the
+            # earliest possible justification lands at the transition
+            # into epoch 3 (state.slot 3*SPE) — same timing as phase0
+            await node.run_until(3 * p.SLOTS_PER_EPOCH + 1)
             await node.close()
 
         asyncio.run(go())
