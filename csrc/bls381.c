@@ -1,0 +1,1387 @@
+/* BLS12-381 native backend: field towers, curves, pairing, hash-to-G2.
+ *
+ * Reference analog: the supranational blst library behind
+ * @chainsafe/blst (SURVEY.md §2.1 row 1) — the reference's only crypto
+ * engine. Here the TPU kernels (lodestar_tpu/ops) are the batch engine
+ * and this library is the serial host side: decompression + subgroup
+ * checks + hash-to-curve in front of device dispatch (the
+ * aggregateWithRandomness-class host bottleneck, VERDICT r1 #10), and
+ * a fast oracle for tests. Math follows this repo's own pure-Python
+ * oracle (lodestar_tpu/crypto/bls/*, KAT-validated); constants are
+ * generated from it by tools/gen_bls_constants.py.
+ *
+ * Representation: Fp = 6x64-bit limbs, little-endian, Montgomery form
+ * (R = 2^384). Points are Jacobian internally; the ABI uses affine
+ * big-endian byte strings (48B per Fp), all-zero = infinity.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#include "bls381_constants.h"
+
+typedef unsigned __int128 u128;
+
+/* ------------------------------------------------------------------ */
+/* Fp arithmetic (Montgomery)                                          */
+/* ------------------------------------------------------------------ */
+
+static inline int fp_is_zero(const fp_t *a) {
+  uint64_t r = 0;
+  for (int i = 0; i < 6; i++) r |= a->l[i];
+  return r == 0;
+}
+
+static inline int fp_eq(const fp_t *a, const fp_t *b) {
+  uint64_t r = 0;
+  for (int i = 0; i < 6; i++) r |= a->l[i] ^ b->l[i];
+  return r == 0;
+}
+
+static inline int fp_gte_p(const fp_t *a) {
+  for (int i = 5; i >= 0; i--) {
+    if (a->l[i] > FP_P.l[i]) return 1;
+    if (a->l[i] < FP_P.l[i]) return 0;
+  }
+  return 1; /* equal */
+}
+
+static inline void fp_sub_p(fp_t *a) {
+  uint64_t borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a->l[i] - FP_P.l[i] - borrow;
+    a->l[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+static void fp_add(fp_t *out, const fp_t *a, const fp_t *b) {
+  uint64_t carry = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 s = (u128)a->l[i] + b->l[i] + carry;
+    out->l[i] = (uint64_t)s;
+    carry = (uint64_t)(s >> 64);
+  }
+  if (carry || fp_gte_p(out)) fp_sub_p(out);
+}
+
+static void fp_sub(fp_t *out, const fp_t *a, const fp_t *b) {
+  uint64_t borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a->l[i] - b->l[i] - borrow;
+    out->l[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  if (borrow) {
+    uint64_t carry = 0;
+    for (int i = 0; i < 6; i++) {
+      u128 s = (u128)out->l[i] + FP_P.l[i] + carry;
+      out->l[i] = (uint64_t)s;
+      carry = (uint64_t)(s >> 64);
+    }
+  }
+}
+
+static void fp_neg(fp_t *out, const fp_t *a) {
+  if (fp_is_zero(a)) {
+    *out = *a;
+    return;
+  }
+  fp_sub(out, &FP_P, a);
+  /* FP_P - a where a < p is already canonical */
+}
+
+static void fp_dbl(fp_t *out, const fp_t *a) { fp_add(out, a, a); }
+
+/* CIOS Montgomery multiplication */
+static void fp_mul(fp_t *out, const fp_t *a, const fp_t *b) {
+  uint64_t t[8] = {0};
+  for (int i = 0; i < 6; i++) {
+    uint64_t carry = 0;
+    for (int j = 0; j < 6; j++) {
+      u128 s = (u128)a->l[j] * b->l[i] + t[j] + carry;
+      t[j] = (uint64_t)s;
+      carry = (uint64_t)(s >> 64);
+    }
+    u128 s = (u128)t[6] + carry;
+    t[6] = (uint64_t)s;
+    t[7] = (uint64_t)(s >> 64);
+
+    uint64_t m = t[0] * FP_INV;
+    u128 c = (u128)m * FP_P.l[0] + t[0];
+    carry = (uint64_t)(c >> 64);
+    for (int j = 1; j < 6; j++) {
+      c = (u128)m * FP_P.l[j] + t[j] + carry;
+      t[j - 1] = (uint64_t)c;
+      carry = (uint64_t)(c >> 64);
+    }
+    c = (u128)t[6] + carry;
+    t[5] = (uint64_t)c;
+    t[6] = t[7] + (uint64_t)(c >> 64);
+    t[7] = 0;
+  }
+  fp_t r;
+  for (int i = 0; i < 6; i++) r.l[i] = t[i];
+  if (t[6] || fp_gte_p(&r)) fp_sub_p(&r);
+  *out = r;
+}
+
+static void fp_sqr(fp_t *out, const fp_t *a) { fp_mul(out, a, a); }
+
+/* exponentiation by a plain (non-Montgomery) little-endian exponent;
+   MSB-first square-and-multiply (1^2 = 1, so leading squares are free) */
+static void fp_pow(fp_t *out, const fp_t *a, const uint64_t *e, int nlimbs) {
+  fp_t acc = FP_ONE_M, base = *a;
+  int top = nlimbs * 64 - 1;
+  while (top >= 0 && !((e[top / 64] >> (top % 64)) & 1)) top--;
+  for (int i = top; i >= 0; i--) {
+    fp_sqr(&acc, &acc);
+    if ((e[i / 64] >> (i % 64)) & 1) fp_mul(&acc, &acc, &base);
+  }
+  *out = acc;
+}
+
+static void fp_inv(fp_t *out, const fp_t *a) {
+  fp_pow(out, a, EXP_P_MINUS_2.l, 6);
+}
+
+/* returns 1 and writes sqrt if a is a QR, else 0 */
+static int fp_sqrt(fp_t *out, const fp_t *a) {
+  fp_t c, c2;
+  fp_pow(&c, a, EXP_SQRT.l, 6);
+  fp_sqr(&c2, &c);
+  if (!fp_eq(&c2, a)) return 0;
+  *out = c;
+  return 1;
+}
+
+static void fp_from_mont(fp_t *out, const fp_t *a) {
+  fp_t one = {{1, 0, 0, 0, 0, 0}};
+  fp_mul(out, a, &one);
+}
+
+static void fp_to_mont(fp_t *out, const fp_t *a) {
+  fp_mul(out, a, &FP_R2);
+}
+
+static int fp_sgn0(const fp_t *a) { /* canonical LSB */
+  fp_t plain;
+  fp_from_mont(&plain, a);
+  return (int)(plain.l[0] & 1);
+}
+
+/* big-endian 48-byte decode (plain) -> Montgomery; returns 0 if >= p */
+static int fp_from_bytes(fp_t *out, const uint8_t in[48]) {
+  fp_t plain;
+  for (int i = 0; i < 6; i++) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; j++) v = (v << 8) | in[(5 - i) * 8 + j];
+    plain.l[i] = v;
+  }
+  if (fp_gte_p(&plain)) return 0;
+  fp_to_mont(out, &plain);
+  return 1;
+}
+
+static void fp_to_bytes(uint8_t out[48], const fp_t *a) {
+  fp_t plain;
+  fp_from_mont(&plain, a);
+  for (int i = 0; i < 6; i++) {
+    uint64_t v = plain.l[i];
+    for (int j = 0; j < 8; j++) {
+      out[(5 - i) * 8 + 7 - j] = (uint8_t)(v & 0xff);
+      v >>= 8;
+    }
+  }
+}
+
+/* 64-byte big-endian wide reduction (hash_to_field): hi*2^384 + lo */
+static void fp_from_bytes_wide(fp_t *out, const uint8_t in[64]) {
+  uint8_t hi_b[48] = {0}, lo_b[48];
+  memcpy(hi_b + 32, in, 16); /* top 16 bytes, right-aligned BE */
+  memcpy(lo_b, in + 16, 48);
+  fp_t hi, lo;
+  /* decode plain without range check (reduce via Montgomery muls) */
+  for (int k = 0; k < 2; k++) {
+    const uint8_t *src = k ? lo_b : hi_b;
+    fp_t plain;
+    for (int i = 0; i < 6; i++) {
+      uint64_t v = 0;
+      for (int j = 0; j < 8; j++) v = (v << 8) | src[(5 - i) * 8 + j];
+      plain.l[i] = v;
+    }
+    /* plain may exceed p; Montgomery mul reduces mod p regardless */
+    fp_t m;
+    fp_mul(&m, &plain, &FP_R2); /* = plain * R mod p */
+    if (k)
+      lo = m;
+    else
+      hi = m;
+  }
+  /* value*R = hi*R*2^384 + lo*R = mont_mul(hi_m, R2)*... :
+     hi_m = hi*R; hi*2^384*R = hi*R * (2^384 mod p) * R * R^-1
+     = mont_mul(hi_m, to_mont(2^384 mod p)); and to_mont(2^384) = R2 */
+  fp_t hi_shift;
+  fp_mul(&hi_shift, &hi, &FP_R2);
+  fp_add(out, &hi_shift, &lo);
+}
+
+/* ------------------------------------------------------------------ */
+/* Fp2 = Fp[u]/(u^2+1)                                                 */
+/* ------------------------------------------------------------------ */
+
+static const fp2_t FP2_ZERO = {{{0}}, {{0}}};
+
+static void fp2_add(fp2_t *o, const fp2_t *a, const fp2_t *b) {
+  fp_add(&o->c0, &a->c0, &b->c0);
+  fp_add(&o->c1, &a->c1, &b->c1);
+}
+
+static void fp2_sub(fp2_t *o, const fp2_t *a, const fp2_t *b) {
+  fp_sub(&o->c0, &a->c0, &b->c0);
+  fp_sub(&o->c1, &a->c1, &b->c1);
+}
+
+static void fp2_neg(fp2_t *o, const fp2_t *a) {
+  fp_neg(&o->c0, &a->c0);
+  fp_neg(&o->c1, &a->c1);
+}
+
+static void fp2_conj(fp2_t *o, const fp2_t *a) {
+  o->c0 = a->c0;
+  fp_neg(&o->c1, &a->c1);
+}
+
+static void fp2_dbl(fp2_t *o, const fp2_t *a) { fp2_add(o, a, a); }
+
+static void fp2_mul(fp2_t *o, const fp2_t *a, const fp2_t *b) {
+  fp_t t0, t1, s0, s1, r0;
+  fp_mul(&t0, &a->c0, &b->c0);
+  fp_mul(&t1, &a->c1, &b->c1);
+  fp_add(&s0, &a->c0, &a->c1);
+  fp_add(&s1, &b->c0, &b->c1);
+  fp_sub(&r0, &t0, &t1); /* c0 = a0b0 - a1b1 */
+  fp_mul(&s0, &s0, &s1);
+  fp_sub(&s0, &s0, &t0);
+  fp_sub(&s0, &s0, &t1); /* c1 = (a0+a1)(b0+b1) - t0 - t1 */
+  o->c0 = r0;
+  o->c1 = s0;
+}
+
+static void fp2_sqr(fp2_t *o, const fp2_t *a) {
+  fp_t s, d, m;
+  fp_add(&s, &a->c0, &a->c1);
+  fp_sub(&d, &a->c0, &a->c1);
+  fp_mul(&m, &a->c0, &a->c1);
+  fp_mul(&s, &s, &d); /* c0 = (a0+a1)(a0-a1) */
+  o->c0 = s;
+  fp_dbl(&o->c1, &m);
+}
+
+static void fp2_mul_fp(fp2_t *o, const fp2_t *a, const fp_t *k) {
+  fp_mul(&o->c0, &a->c0, k);
+  fp_mul(&o->c1, &a->c1, k);
+}
+
+/* (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1)u */
+static void fp2_mul_by_xi(fp2_t *o, const fp2_t *a) {
+  fp_t t0, t1;
+  fp_sub(&t0, &a->c0, &a->c1);
+  fp_add(&t1, &a->c0, &a->c1);
+  o->c0 = t0;
+  o->c1 = t1;
+}
+
+static void fp2_inv(fp2_t *o, const fp2_t *a) {
+  fp_t n, t;
+  fp_sqr(&n, &a->c0);
+  fp_sqr(&t, &a->c1);
+  fp_add(&n, &n, &t); /* norm = a0^2 + a1^2 */
+  fp_inv(&n, &n);
+  fp_mul(&o->c0, &a->c0, &n);
+  fp_neg(&t, &a->c1);
+  fp_mul(&o->c1, &t, &n);
+}
+
+static int fp2_is_zero(const fp2_t *a) {
+  return fp_is_zero(&a->c0) && fp_is_zero(&a->c1);
+}
+
+static int fp2_eq(const fp2_t *a, const fp2_t *b) {
+  return fp_eq(&a->c0, &b->c0) && fp_eq(&a->c1, &b->c1);
+}
+
+static int fp2_sgn0(const fp2_t *a) { /* RFC 9380 sgn0 for m=2 */
+  fp_t p0;
+  fp_from_mont(&p0, &a->c0);
+  int sign0 = (int)(p0.l[0] & 1);
+  int zero0 = fp_is_zero(&a->c0);
+  fp_t p1;
+  fp_from_mont(&p1, &a->c1);
+  int sign1 = (int)(p1.l[0] & 1);
+  return sign0 | (zero0 & sign1);
+}
+
+/* complex sqrt: returns 1 + writes root on success */
+static int fp2_sqrt(fp2_t *o, const fp2_t *a) {
+  if (fp_is_zero(&a->c1)) {
+    fp_t r;
+    if (fp_sqrt(&r, &a->c0)) {
+      o->c0 = r;
+      memset(&o->c1, 0, sizeof(fp_t));
+      return 1;
+    }
+    fp_t na;
+    fp_neg(&na, &a->c0);
+    if (fp_sqrt(&r, &na)) { /* a0 = -(r^2) -> sqrt = r*u */
+      memset(&o->c0, 0, sizeof(fp_t));
+      o->c1 = r;
+      return 1;
+    }
+    return 0;
+  }
+  fp_t n, t, alpha, delta, half, two_m, x0, x1;
+  fp_sqr(&n, &a->c0);
+  fp_sqr(&t, &a->c1);
+  fp_add(&n, &n, &t);
+  if (!fp_sqrt(&alpha, &n)) return 0;
+  /* delta = (a0 + alpha)/2 */
+  fp_t two_plain = {{2, 0, 0, 0, 0, 0}};
+  fp_to_mont(&two_m, &two_plain);
+  fp_inv(&half, &two_m);
+  fp_add(&delta, &a->c0, &alpha);
+  fp_mul(&delta, &delta, &half);
+  if (!fp_sqrt(&x0, &delta)) {
+    fp_sub(&delta, &a->c0, &alpha);
+    fp_mul(&delta, &delta, &half);
+    if (!fp_sqrt(&x0, &delta)) return 0;
+  }
+  fp_t inv2x0;
+  fp_dbl(&t, &x0);
+  fp_inv(&inv2x0, &t);
+  fp_mul(&x1, &a->c1, &inv2x0);
+  fp2_t cand = {x0, x1}, chk;
+  fp2_sqr(&chk, &cand);
+  if (!fp2_eq(&chk, a)) return 0;
+  *o = cand;
+  return 1;
+}
+
+/* ------------------------------------------------------------------ */
+/* Fp6 = Fp2[v]/(v^3 - xi), Fp12 = Fp6[w]/(w^2 - v)                    */
+/* ------------------------------------------------------------------ */
+
+typedef struct { fp2_t c0, c1, c2; } fp6_t;
+typedef struct { fp6_t c0, c1; } fp12_t;
+
+static void fp6_add(fp6_t *o, const fp6_t *a, const fp6_t *b) {
+  fp2_add(&o->c0, &a->c0, &b->c0);
+  fp2_add(&o->c1, &a->c1, &b->c1);
+  fp2_add(&o->c2, &a->c2, &b->c2);
+}
+
+static void fp6_sub(fp6_t *o, const fp6_t *a, const fp6_t *b) {
+  fp2_sub(&o->c0, &a->c0, &b->c0);
+  fp2_sub(&o->c1, &a->c1, &b->c1);
+  fp2_sub(&o->c2, &a->c2, &b->c2);
+}
+
+static void fp6_neg(fp6_t *o, const fp6_t *a) {
+  fp2_neg(&o->c0, &a->c0);
+  fp2_neg(&o->c1, &a->c1);
+  fp2_neg(&o->c2, &a->c2);
+}
+
+static void fp6_mul(fp6_t *o, const fp6_t *a, const fp6_t *b) {
+  fp2_t t0, t1, t2, s, u, r0, r1, r2;
+  fp2_mul(&t0, &a->c0, &b->c0);
+  fp2_mul(&t1, &a->c1, &b->c1);
+  fp2_mul(&t2, &a->c2, &b->c2);
+  /* c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2) */
+  fp2_add(&s, &a->c1, &a->c2);
+  fp2_add(&u, &b->c1, &b->c2);
+  fp2_mul(&s, &s, &u);
+  fp2_sub(&s, &s, &t1);
+  fp2_sub(&s, &s, &t2);
+  fp2_mul_by_xi(&s, &s);
+  fp2_add(&r0, &t0, &s);
+  /* c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2 */
+  fp2_add(&s, &a->c0, &a->c1);
+  fp2_add(&u, &b->c0, &b->c1);
+  fp2_mul(&s, &s, &u);
+  fp2_sub(&s, &s, &t0);
+  fp2_sub(&s, &s, &t1);
+  fp2_t xt2;
+  fp2_mul_by_xi(&xt2, &t2);
+  fp2_add(&r1, &s, &xt2);
+  /* c2 = (a0+a2)(b0+b2) - t0 - t2 + t1 */
+  fp2_add(&s, &a->c0, &a->c2);
+  fp2_add(&u, &b->c0, &b->c2);
+  fp2_mul(&s, &s, &u);
+  fp2_sub(&s, &s, &t0);
+  fp2_sub(&s, &s, &t2);
+  fp2_add(&r2, &s, &t1);
+  o->c0 = r0;
+  o->c1 = r1;
+  o->c2 = r2;
+}
+
+static void fp6_sqr(fp6_t *o, const fp6_t *a) { fp6_mul(o, a, a); }
+
+static void fp6_mul_by_v(fp6_t *o, const fp6_t *a) {
+  fp2_t t;
+  fp2_mul_by_xi(&t, &a->c2);
+  fp2_t a0 = a->c0, a1 = a->c1;
+  o->c0 = t;
+  o->c1 = a0;
+  o->c2 = a1;
+}
+
+static void fp6_mul_fp2(fp6_t *o, const fp6_t *a, const fp2_t *k) {
+  fp2_mul(&o->c0, &a->c0, k);
+  fp2_mul(&o->c1, &a->c1, k);
+  fp2_mul(&o->c2, &a->c2, k);
+}
+
+static void fp6_inv(fp6_t *o, const fp6_t *a) {
+  fp2_t c0, c1, c2, t, u;
+  fp2_sqr(&c0, &a->c0);
+  fp2_mul(&t, &a->c1, &a->c2);
+  fp2_mul_by_xi(&t, &t);
+  fp2_sub(&c0, &c0, &t); /* a0^2 - xi a1 a2 */
+  fp2_sqr(&c1, &a->c2);
+  fp2_mul_by_xi(&c1, &c1);
+  fp2_mul(&t, &a->c0, &a->c1);
+  fp2_sub(&c1, &c1, &t); /* xi a2^2 - a0 a1 */
+  fp2_sqr(&c2, &a->c1);
+  fp2_mul(&t, &a->c0, &a->c2);
+  fp2_sub(&c2, &c2, &t); /* a1^2 - a0 a2 */
+  /* norm = a0 c0 + xi(a2 c1 + a1 c2) */
+  fp2_mul(&t, &a->c2, &c1);
+  fp2_mul(&u, &a->c1, &c2);
+  fp2_add(&t, &t, &u);
+  fp2_mul_by_xi(&t, &t);
+  fp2_mul(&u, &a->c0, &c0);
+  fp2_add(&t, &t, &u);
+  fp2_inv(&t, &t);
+  fp2_mul(&o->c0, &c0, &t);
+  fp2_mul(&o->c1, &c1, &t);
+  fp2_mul(&o->c2, &c2, &t);
+}
+
+static void fp12_mul(fp12_t *o, const fp12_t *a, const fp12_t *b) {
+  fp6_t t0, t1, s, u, r0;
+  fp6_mul(&t0, &a->c0, &b->c0);
+  fp6_mul(&t1, &a->c1, &b->c1);
+  fp6_mul_by_v(&r0, &t1);
+  fp6_add(&r0, &r0, &t0); /* c0 = t0 + v t1 */
+  fp6_add(&s, &a->c0, &a->c1);
+  fp6_add(&u, &b->c0, &b->c1);
+  fp6_mul(&s, &s, &u);
+  fp6_sub(&s, &s, &t0);
+  fp6_sub(&s, &s, &t1); /* c1 */
+  o->c0 = r0;
+  o->c1 = s;
+}
+
+static void fp12_sqr(fp12_t *o, const fp12_t *a) { fp12_mul(o, a, a); }
+
+static void fp12_conj(fp12_t *o, const fp12_t *a) {
+  o->c0 = a->c0;
+  fp6_neg(&o->c1, &a->c1);
+}
+
+static void fp12_inv(fp12_t *o, const fp12_t *a) {
+  fp6_t t0, t1;
+  fp6_sqr(&t0, &a->c0);
+  fp6_sqr(&t1, &a->c1);
+  fp6_mul_by_v(&t1, &t1);
+  fp6_sub(&t0, &t0, &t1); /* a0^2 - v a1^2 */
+  fp6_inv(&t0, &t0);
+  fp6_mul(&o->c0, &a->c0, &t0);
+  fp6_t n;
+  fp6_neg(&n, &a->c1);
+  fp6_mul(&o->c1, &n, &t0);
+}
+
+static void fp12_one(fp12_t *o) {
+  memset(o, 0, sizeof(*o));
+  o->c0.c0.c0 = FP_ONE_M;
+}
+
+static int fp12_is_one(const fp12_t *a) {
+  fp12_t one;
+  fp12_one(&one);
+  return memcmp(a, &one, sizeof(one)) == 0 ||
+         (fp_eq(&a->c0.c0.c0, &FP_ONE_M) && fp_is_zero(&a->c0.c0.c1) &&
+          fp2_is_zero(&a->c0.c1) && fp2_is_zero(&a->c0.c2) &&
+          fp2_is_zero(&a->c1.c0) && fp2_is_zero(&a->c1.c1) &&
+          fp2_is_zero(&a->c1.c2));
+}
+
+static void fp6_frobenius(fp6_t *o, const fp6_t *a) {
+  /* (v^i)^p = v^i * XI^(i(p-1)/3) = v^i * FROB6_C1[i] */
+  fp2_conj(&o->c0, &a->c0);
+  fp2_t t;
+  fp2_conj(&t, &a->c1);
+  fp2_mul(&o->c1, &t, &FROB6_C1[1]);
+  fp2_conj(&t, &a->c2);
+  fp2_mul(&o->c2, &t, &FROB6_C1[2]);
+}
+
+static void fp12_frobenius(fp12_t *o, const fp12_t *a) {
+  fp6_frobenius(&o->c0, &a->c0);
+  fp6_t t;
+  fp6_frobenius(&t, &a->c1);
+  fp6_mul_fp2(&o->c1, &t, &FROB12_C1);
+}
+
+static void fp12_frobenius_n(fp12_t *o, const fp12_t *a, int n) {
+  *o = *a;
+  for (int i = 0; i < n; i++) fp12_frobenius(o, o);
+}
+
+/* ------------------------------------------------------------------ */
+/* Curves: G1 over Fp (b=4), G2 over Fp2 on the M-twist (b=4(1+u))     */
+/* ------------------------------------------------------------------ */
+
+typedef struct { fp_t x, y, z; int inf; } g1_t;
+typedef struct { fp2_t x, y, z; int inf; } g2_t;
+
+#define DEFINE_CURVE(NAME, FE, FE_ADD, FE_SUB, FE_MUL, FE_SQR, FE_DBL,  \
+                     FE_NEG, FE_IS_ZERO, FE_EQ, FE_INV, PT)             \
+  static void NAME##_dbl(PT *o, const PT *p) {                          \
+    if (p->inf) { *o = *p; return; }                                    \
+    FE a, b, c, d, e, f, t, x3, y3, z3;                                 \
+    FE_SQR(&a, &p->x);                                                  \
+    FE_SQR(&b, &p->y);                                                  \
+    FE_SQR(&c, &b);                                                     \
+    FE_ADD(&t, &p->x, &b);                                              \
+    FE_SQR(&t, &t);                                                     \
+    FE_SUB(&t, &t, &a);                                                 \
+    FE_SUB(&t, &t, &c);                                                 \
+    FE_DBL(&d, &t); /* d = 2((x+b)^2 - a - c) */                        \
+    FE_ADD(&e, &a, &a);                                                 \
+    FE_ADD(&e, &e, &a); /* e = 3a */                                    \
+    FE_SQR(&f, &e);                                                     \
+    FE_DBL(&t, &d);                                                     \
+    FE_SUB(&x3, &f, &t);                                                \
+    FE_SUB(&t, &d, &x3);                                                \
+    FE_MUL(&t, &e, &t);                                                 \
+    FE c8;                                                              \
+    FE_DBL(&c8, &c);                                                    \
+    FE_DBL(&c8, &c8);                                                   \
+    FE_DBL(&c8, &c8);                                                   \
+    FE_SUB(&y3, &t, &c8);                                               \
+    FE_MUL(&z3, &p->y, &p->z);                                          \
+    FE_DBL(&z3, &z3);                                                   \
+    o->x = x3; o->y = y3; o->z = z3; o->inf = 0;                        \
+  }                                                                     \
+  static void NAME##_add(PT *o, const PT *p, const PT *q) {             \
+    if (p->inf) { *o = *q; return; }                                    \
+    if (q->inf) { *o = *p; return; }                                    \
+    FE z1z1, z2z2, u1, u2, s1, s2, h, r, t;                             \
+    FE_SQR(&z1z1, &p->z);                                               \
+    FE_SQR(&z2z2, &q->z);                                               \
+    FE_MUL(&u1, &p->x, &z2z2);                                          \
+    FE_MUL(&u2, &q->x, &z1z1);                                          \
+    FE_MUL(&s1, &p->y, &q->z);                                          \
+    FE_MUL(&s1, &s1, &z2z2);                                            \
+    FE_MUL(&s2, &q->y, &p->z);                                          \
+    FE_MUL(&s2, &s2, &z1z1);                                            \
+    FE_SUB(&h, &u2, &u1);                                               \
+    FE_SUB(&r, &s2, &s1);                                               \
+    if (FE_IS_ZERO(&h)) {                                               \
+      if (FE_IS_ZERO(&r)) { NAME##_dbl(o, p); return; }                 \
+      o->inf = 1; return;                                               \
+    }                                                                   \
+    FE h2, h3, u1h2, x3, y3, z3;                                        \
+    FE_SQR(&h2, &h);                                                    \
+    FE_MUL(&h3, &h2, &h);                                               \
+    FE_MUL(&u1h2, &u1, &h2);                                            \
+    FE_SQR(&x3, &r);                                                    \
+    FE_SUB(&x3, &x3, &h3);                                              \
+    FE_DBL(&t, &u1h2);                                                  \
+    FE_SUB(&x3, &x3, &t);                                               \
+    FE_SUB(&t, &u1h2, &x3);                                             \
+    FE_MUL(&t, &r, &t);                                                 \
+    FE s1h3;                                                            \
+    FE_MUL(&s1h3, &s1, &h3);                                            \
+    FE_SUB(&y3, &t, &s1h3);                                             \
+    FE_MUL(&z3, &p->z, &q->z);                                          \
+    FE_MUL(&z3, &z3, &h);                                               \
+    o->x = x3; o->y = y3; o->z = z3; o->inf = 0;                        \
+  }                                                                     \
+  static void NAME##_mul_be(PT *o, const PT *p, const uint8_t *scalar,  \
+                            int nbytes) {                               \
+    PT acc;                                                             \
+    acc.inf = 1;                                                        \
+    for (int i = 0; i < nbytes; i++) {                                  \
+      uint8_t byte = scalar[i];                                         \
+      for (int b = 7; b >= 0; b--) {                                    \
+        NAME##_dbl(&acc, &acc);                                         \
+        if ((byte >> b) & 1) NAME##_add(&acc, &acc, p);                 \
+      }                                                                 \
+    }                                                                   \
+    *o = acc;                                                           \
+  }                                                                     \
+  static void NAME##_mul_limbs(PT *o, const PT *p, const uint64_t *e,   \
+                               int nlimbs) {                            \
+    PT acc;                                                             \
+    acc.inf = 1;                                                        \
+    for (int i = nlimbs * 64 - 1; i >= 0; i--) {                        \
+      NAME##_dbl(&acc, &acc);                                           \
+      if ((e[i / 64] >> (i % 64)) & 1) NAME##_add(&acc, &acc, p);       \
+    }                                                                   \
+    *o = acc;                                                           \
+  }                                                                     \
+  static void NAME##_to_affine(PT *o, const PT *p) {                    \
+    if (p->inf) { *o = *p; return; }                                    \
+    FE zi, zi2, zi3;                                                    \
+    FE_INV(&zi, &p->z);                                                 \
+    FE_SQR(&zi2, &zi);                                                  \
+    FE_MUL(&zi3, &zi2, &zi);                                            \
+    FE_MUL(&o->x, &p->x, &zi2);                                         \
+    FE_MUL(&o->y, &p->y, &zi3);                                         \
+    o->z = zi; /* unused marker */                                      \
+    o->inf = 0;                                                         \
+  }
+
+DEFINE_CURVE(g1, fp_t, fp_add, fp_sub, fp_mul, fp_sqr, fp_dbl, fp_neg,
+             fp_is_zero, fp_eq, fp_inv, g1_t)
+DEFINE_CURVE(g2, fp2_t, fp2_add, fp2_sub, fp2_mul, fp2_sqr, fp2_dbl,
+             fp2_neg, fp2_is_zero, fp2_eq, fp2_inv, g2_t)
+
+static void g1_set_affine(g1_t *o, const fp_t *x, const fp_t *y) {
+  o->x = *x;
+  o->y = *y;
+  o->z = FP_ONE_M;
+  o->inf = 0;
+}
+
+static void g2_set_affine(g2_t *o, const fp2_t *x, const fp2_t *y) {
+  o->x = *x;
+  o->y = *y;
+  o->z.c0 = FP_ONE_M;
+  memset(&o->z.c1, 0, sizeof(fp_t));
+  o->inf = 0;
+}
+
+static int g1_on_curve_affine(const fp_t *x, const fp_t *y) {
+  fp_t l, r;
+  fp_sqr(&l, y);
+  fp_sqr(&r, x);
+  fp_mul(&r, &r, x);
+  fp_add(&r, &r, &FP_B_M);
+  return fp_eq(&l, &r);
+}
+
+static int g2_on_curve_affine(const fp2_t *x, const fp2_t *y) {
+  fp2_t l, r, b;
+  fp2_sqr(&l, y);
+  fp2_sqr(&r, x);
+  fp2_mul(&r, &r, x);
+  /* b' = 4(1+u) */
+  b.c0 = FP_B_M;
+  b.c1 = FP_B_M;
+  fp2_add(&r, &r, &b);
+  return fp2_eq(&l, &r);
+}
+
+static int g1_in_subgroup(const g1_t *p) {
+  g1_t t;
+  g1_mul_limbs(&t, p, BLS_R, 4);
+  return t.inf;
+}
+
+static int g2_in_subgroup(const g2_t *p) {
+  g2_t t;
+  g2_mul_limbs(&t, p, BLS_R, 4);
+  return t.inf;
+}
+
+/* ------------------------------------------------------------------ */
+/* Pairing: optimal ate, Miller loop on the twist with sparse lines    */
+/* (same line formulas as lodestar_tpu/ops/pairing.py:_dbl_step/_add)  */
+/* ------------------------------------------------------------------ */
+
+/* multiply f by the sparse line l0 + l2 w^2 + l3 w^3
+   (slots: c0.c0 += l0, c0.c1 += l2, c1.c1 += l3) */
+static void fp12_mul_by_line(fp12_t *o, const fp12_t *f, const fp2_t *l0,
+                             const fp2_t *l2, const fp2_t *l3) {
+  fp12_t line;
+  memset(&line, 0, sizeof(line));
+  line.c0.c0 = *l0;
+  line.c0.c1 = *l2;
+  line.c1.c1 = *l3;
+  fp12_mul(o, f, &line);
+}
+
+static void miller_dbl_step(g2_t *T, const fp_t *px, const fp_t *py,
+                            fp2_t *l0, fp2_t *l2, fp2_t *l3) {
+  fp2_t A, B, C, Z2, XA, YZ, t, D, E, F2, x3, y3, z3;
+  fp2_sqr(&A, &T->x);
+  fp2_sqr(&B, &T->y);
+  fp2_sqr(&C, &B);
+  fp2_sqr(&Z2, &T->z);
+  fp2_mul(&XA, &T->x, &A); /* X^3 */
+  fp2_mul(&YZ, &T->y, &T->z);
+  /* l0 = 3X^3 - 2Y^2 */
+  fp2_dbl(&t, &XA);
+  fp2_add(&t, &t, &XA);
+  fp2_t twoB;
+  fp2_dbl(&twoB, &B);
+  fp2_sub(l0, &t, &twoB);
+  /* l2 = -3 X^2 Z^2 * px */
+  fp2_mul(&t, &A, &Z2);
+  fp2_dbl(l2, &t);
+  fp2_add(l2, l2, &t);
+  fp2_neg(l2, l2);
+  fp2_mul_fp(l2, l2, px);
+  /* l3 = 2 Y Z^3 * py */
+  fp2_mul(&t, &YZ, &Z2);
+  fp2_dbl(l3, &t);
+  fp2_mul_fp(l3, l3, py);
+  /* point doubling (dbl-2009-l) */
+  fp2_add(&t, &T->x, &B);
+  fp2_sqr(&t, &t);
+  fp2_sub(&t, &t, &A);
+  fp2_sub(&t, &t, &C);
+  fp2_dbl(&D, &t);
+  fp2_dbl(&E, &A);
+  fp2_add(&E, &E, &A);
+  fp2_sqr(&F2, &E);
+  fp2_dbl(&t, &D);
+  fp2_sub(&x3, &F2, &t);
+  fp2_sub(&t, &D, &x3);
+  fp2_mul(&t, &E, &t);
+  fp2_t c8;
+  fp2_dbl(&c8, &C);
+  fp2_dbl(&c8, &c8);
+  fp2_dbl(&c8, &c8);
+  fp2_sub(&y3, &t, &c8);
+  fp2_dbl(&z3, &YZ);
+  T->x = x3;
+  T->y = y3;
+  T->z = z3;
+}
+
+static void miller_add_step(g2_t *T, const fp2_t *qx, const fp2_t *qy,
+                            const fp_t *px, const fp_t *py, fp2_t *l0,
+                            fp2_t *l2, fp2_t *l3) {
+  fp2_t Z2, Z3, mu, th, Zmu, t, u;
+  fp2_sqr(&Z2, &T->z);
+  fp2_mul(&Z3, &Z2, &T->z);
+  fp2_mul(&mu, qx, &Z2);
+  fp2_sub(&mu, &mu, &T->x);
+  fp2_mul(&th, qy, &Z3);
+  fp2_sub(&th, &th, &T->y);
+  fp2_mul(&Zmu, &T->z, &mu);
+  /* l0 = th*qx - Zmu*qy */
+  fp2_mul(&t, &th, qx);
+  fp2_mul(&u, &Zmu, qy);
+  fp2_sub(l0, &t, &u);
+  /* l2 = -th * px */
+  fp2_neg(&t, &th);
+  fp2_mul_fp(l2, &t, px);
+  /* l3 = Zmu * py */
+  fp2_mul_fp(l3, &Zmu, py);
+  /* point mixed add */
+  fp2_t mu2, mu3, xmu2, x3, y3;
+  fp2_sqr(&mu2, &mu);
+  fp2_mul(&mu3, &mu2, &mu);
+  fp2_mul(&xmu2, &T->x, &mu2);
+  fp2_sqr(&x3, &th);
+  fp2_sub(&x3, &x3, &mu3);
+  fp2_dbl(&t, &xmu2);
+  fp2_sub(&x3, &x3, &t);
+  fp2_sub(&t, &xmu2, &x3);
+  fp2_mul(&t, &th, &t);
+  fp2_mul(&u, &T->y, &mu3);
+  fp2_sub(&y3, &t, &u);
+  T->x = x3;
+  T->y = y3;
+  T->z = Zmu;
+}
+
+/* accumulate one (P, Q) pair into f (both affine, not infinity) */
+static void miller_loop_acc(fp12_t *f, const fp_t *px, const fp_t *py,
+                            const fp2_t *qx, const fp2_t *qy) {
+  g2_t T;
+  g2_set_affine(&T, qx, qy);
+  fp12_t acc;
+  fp12_one(&acc);
+  fp2_t l0, l2, l3;
+  /* MSB-first over |x| after the top bit */
+  for (int i = 62; i >= 0; i--) {
+    fp12_sqr(&acc, &acc);
+    miller_dbl_step(&T, px, py, &l0, &l2, &l3);
+    fp12_mul_by_line(&acc, &acc, &l0, &l2, &l3);
+    if ((BLS_X_ABS >> i) & 1) {
+      miller_add_step(&T, qx, qy, px, py, &l0, &l2, &l3);
+      fp12_mul_by_line(&acc, &acc, &l0, &l2, &l3);
+    }
+  }
+  /* x < 0: conjugate */
+  fp12_conj(&acc, &acc);
+  fp12_mul(f, f, &acc);
+}
+
+/* f^|x| by square-and-multiply (cheap in C) */
+static void fp12_pow_u(fp12_t *o, const fp12_t *a) {
+  fp12_t r, base = *a;
+  fp12_one(&r);
+  for (int i = 63; i >= 0; i--) {
+    fp12_sqr(&r, &r);
+    if ((BLS_X_ABS >> i) & 1) fp12_mul(&r, &r, &base);
+  }
+  *o = r;
+}
+
+static void fp12_pow_x_minus_1(fp12_t *o, const fp12_t *a) {
+  fp12_t t;
+  fp12_pow_u(&t, a);
+  fp12_mul(&t, &t, a);
+  fp12_conj(o, &t); /* x negative, unitary input */
+}
+
+static void final_exponentiation(fp12_t *o, const fp12_t *f) {
+  /* easy: t = f^((q^6-1)(q^2+1)) */
+  fp12_t t, inv, u;
+  fp12_conj(&t, f);
+  fp12_inv(&inv, f);
+  fp12_mul(&t, &t, &inv);
+  fp12_frobenius_n(&u, &t, 2);
+  fp12_mul(&t, &u, &t);
+  /* hard (cubed map, same chain as ops/pairing.py): */
+  fp12_t a, b, c, t2;
+  fp12_pow_x_minus_1(&a, &t);
+  fp12_pow_x_minus_1(&a, &a);
+  fp12_pow_u(&b, &a);
+  fp12_conj(&b, &b); /* a^x */
+  fp12_frobenius_n(&u, &a, 1);
+  fp12_mul(&b, &b, &u);
+  fp12_pow_u(&c, &b);
+  fp12_conj(&c, &c);
+  fp12_pow_u(&c, &c);
+  fp12_conj(&c, &c); /* b^(x^2) */
+  fp12_frobenius_n(&u, &b, 2);
+  fp12_mul(&c, &c, &u);
+  fp12_conj(&u, &b);
+  fp12_mul(&c, &c, &u);
+  fp12_sqr(&t2, &t);
+  fp12_mul(&c, &c, &t2);
+  fp12_mul(o, &c, &t);
+}
+
+/* ------------------------------------------------------------------ */
+/* SHA-256 (for expand_message_xmd)                                    */
+/* ------------------------------------------------------------------ */
+
+static const uint32_t sha_k[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+typedef struct {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t len;
+  uint32_t buflen;
+} sha256_ctx;
+
+static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha256_block(sha256_ctx *c, const uint8_t *p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+           ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = c->h[0], b = c->h[1], cc = c->h[2], d = c->h[3], e = c->h[4],
+           f = c->h[5], g = c->h[6], h = c->h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + sha_k[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & cc) ^ (b & cc);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = cc; cc = b; b = a; a = t1 + t2;
+  }
+  c->h[0] += a; c->h[1] += b; c->h[2] += cc; c->h[3] += d;
+  c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+static void sha256_init(sha256_ctx *c) {
+  static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                 0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                 0x1f83d9ab, 0x5be0cd19};
+  memcpy(c->h, iv, sizeof(iv));
+  c->len = 0;
+  c->buflen = 0;
+}
+
+static void sha256_update(sha256_ctx *c, const uint8_t *p, uint64_t n) {
+  c->len += n;
+  while (n) {
+    uint32_t take = 64 - c->buflen;
+    if (take > n) take = (uint32_t)n;
+    memcpy(c->buf + c->buflen, p, take);
+    c->buflen += take;
+    p += take;
+    n -= take;
+    if (c->buflen == 64) {
+      sha256_block(c, c->buf);
+      c->buflen = 0;
+    }
+  }
+}
+
+static void sha256_final(sha256_ctx *c, uint8_t out[32]) {
+  uint64_t bits = c->len * 8;
+  uint8_t pad = 0x80;
+  sha256_update(c, &pad, 1);
+  uint8_t z = 0;
+  while (c->buflen != 56) sha256_update(c, &z, 1);
+  uint8_t lb[8];
+  for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8 * i));
+  sha256_update(c, lb, 8);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)(c->h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(c->h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(c->h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)(c->h[i]);
+  }
+}
+
+/* ------------------------------------------------------------------ */
+/* hash_to_curve G2 (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_)         */
+/* ------------------------------------------------------------------ */
+
+static void expand_message_xmd(const uint8_t *msg, uint32_t msg_len,
+                               const uint8_t *dst, uint32_t dst_len,
+                               uint8_t *out, uint32_t len_in_bytes) {
+  uint32_t ell = (len_in_bytes + 31) / 32;
+  uint8_t b0[32], bi[32];
+  sha256_ctx c;
+  sha256_init(&c);
+  uint8_t zpad[64] = {0};
+  sha256_update(&c, zpad, 64);
+  sha256_update(&c, msg, msg_len);
+  uint8_t lib[3] = {(uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes, 0};
+  sha256_update(&c, lib, 3);
+  sha256_update(&c, dst, dst_len);
+  uint8_t dlen = (uint8_t)dst_len;
+  sha256_update(&c, &dlen, 1);
+  sha256_final(&c, b0);
+
+  uint8_t prev[32];
+  for (uint32_t i = 1; i <= ell; i++) {
+    sha256_init(&c);
+    if (i == 1) {
+      sha256_update(&c, b0, 32);
+    } else {
+      uint8_t x[32];
+      for (int j = 0; j < 32; j++) x[j] = b0[j] ^ prev[j];
+      sha256_update(&c, x, 32);
+    }
+    uint8_t ib = (uint8_t)i;
+    sha256_update(&c, &ib, 1);
+    sha256_update(&c, dst, dst_len);
+    sha256_update(&c, &dlen, 1);
+    sha256_final(&c, bi);
+    memcpy(prev, bi, 32);
+    uint32_t off = (i - 1) * 32;
+    uint32_t take = len_in_bytes - off < 32 ? len_in_bytes - off : 32;
+    memcpy(out + off, bi, take);
+  }
+}
+
+static void map_to_curve_sswu(g2_t *o, const fp2_t *u) {
+  fp2_t u2, zu2, tv, x1, gx, y, t, negb, inva;
+  fp2_sqr(&u2, u);
+  fp2_mul(&zu2, &SSWU_Z, &u2);
+  fp2_sqr(&tv, &zu2);
+  fp2_add(&tv, &tv, &zu2);
+  if (fp2_is_zero(&tv)) {
+    /* x1 = B / (Z*A) */
+    fp2_t za;
+    fp2_mul(&za, &SSWU_Z, &SSWU_A);
+    fp2_inv(&za, &za);
+    fp2_mul(&x1, &SSWU_B, &za);
+  } else {
+    fp2_t tv1, one;
+    fp2_inv(&tv1, &tv);
+    memset(&one, 0, sizeof(one));
+    one.c0 = FP_ONE_M;
+    fp2_add(&tv1, &tv1, &one);
+    fp2_neg(&negb, &SSWU_B);
+    fp2_inv(&inva, &SSWU_A);
+    fp2_mul(&x1, &negb, &inva);
+    fp2_mul(&x1, &x1, &tv1);
+  }
+  /* g(x) = (x^2 + A) x + B */
+  fp2_sqr(&gx, &x1);
+  fp2_add(&gx, &gx, &SSWU_A);
+  fp2_mul(&gx, &gx, &x1);
+  fp2_add(&gx, &gx, &SSWU_B);
+  fp2_t x = x1;
+  if (!fp2_sqrt(&y, &gx)) {
+    fp2_mul(&x, &zu2, &x1);
+    fp2_sqr(&gx, &x);
+    fp2_add(&gx, &gx, &SSWU_A);
+    fp2_mul(&gx, &gx, &x);
+    fp2_add(&gx, &gx, &SSWU_B);
+    fp2_sqrt(&y, &gx); /* guaranteed */
+  }
+  if (fp2_sgn0(u) != fp2_sgn0(&y)) fp2_neg(&y, &y);
+  g2_set_affine(o, &x, &y);
+}
+
+static void horner(fp2_t *o, const fp2_t *coeffs, int n, const fp2_t *x) {
+  fp2_t acc = coeffs[n - 1];
+  for (int i = n - 2; i >= 0; i--) {
+    fp2_mul(&acc, &acc, x);
+    fp2_add(&acc, &acc, &coeffs[i]);
+  }
+  *o = acc;
+}
+
+static void iso_map_g2(g2_t *o, const g2_t *p) {
+  /* p affine on E2' */
+  fp2_t xn, xd, yn, yd, t;
+  horner(&xn, ISO_XNUM, 4, &p->x);
+  horner(&xd, ISO_XDEN, 3, &p->x);
+  horner(&yn, ISO_YNUM, 4, &p->x);
+  horner(&yd, ISO_YDEN, 4, &p->x);
+  fp2_t xo, yo;
+  fp2_inv(&t, &xd);
+  fp2_mul(&xo, &xn, &t);
+  fp2_inv(&t, &yd);
+  fp2_mul(&yo, &yn, &t);
+  fp2_mul(&yo, &yo, &p->y);
+  g2_set_affine(o, &xo, &yo);
+}
+
+static void hash_to_g2_point(g2_t *o, const uint8_t *msg, uint32_t msg_len,
+                             const uint8_t *dst, uint32_t dst_len) {
+  uint8_t uniform[256];
+  expand_message_xmd(msg, msg_len, dst, dst_len, uniform, 256);
+  fp2_t u0, u1;
+  fp_from_bytes_wide(&u0.c0, uniform);
+  fp_from_bytes_wide(&u0.c1, uniform + 64);
+  fp_from_bytes_wide(&u1.c0, uniform + 128);
+  fp_from_bytes_wide(&u1.c1, uniform + 192);
+  g2_t q0, q1, q0m, q1m, sum;
+  map_to_curve_sswu(&q0, &u0);
+  map_to_curve_sswu(&q1, &u1);
+  iso_map_g2(&q0m, &q0);
+  iso_map_g2(&q1m, &q1);
+  g2_add(&sum, &q0m, &q1m);
+  g2_mul_limbs(o, &sum, G2_H_EFF, G2_H_EFF_LIMBS);
+}
+
+/* ------------------------------------------------------------------ */
+/* Byte ABI                                                            */
+/* ------------------------------------------------------------------ */
+
+static int is_zero_bytes(const uint8_t *p, int n) {
+  uint8_t r = 0;
+  for (int i = 0; i < n; i++) r |= p[i];
+  return r == 0;
+}
+
+static int g1_from_affine_bytes(g1_t *o, const uint8_t in[96]) {
+  if (is_zero_bytes(in, 96)) {
+    o->inf = 1;
+    return 1;
+  }
+  fp_t x, y;
+  if (!fp_from_bytes(&x, in) || !fp_from_bytes(&y, in + 48)) return 0;
+  if (!g1_on_curve_affine(&x, &y)) return 0;
+  g1_set_affine(o, &x, &y);
+  return 1;
+}
+
+static void g1_to_affine_bytes(uint8_t out[96], const g1_t *p) {
+  if (p->inf) {
+    memset(out, 0, 96);
+    return;
+  }
+  g1_t a;
+  g1_to_affine(&a, p);
+  fp_to_bytes(out, &a.x);
+  fp_to_bytes(out + 48, &a.y);
+}
+
+static int g2_from_affine_bytes(g2_t *o, const uint8_t in[192]) {
+  if (is_zero_bytes(in, 192)) {
+    o->inf = 1;
+    return 1;
+  }
+  fp2_t x, y;
+  /* layout: x.c1 || x.c0 || y.c1 || y.c0 (BE, matching compressed order) */
+  if (!fp_from_bytes(&x.c1, in) || !fp_from_bytes(&x.c0, in + 48) ||
+      !fp_from_bytes(&y.c1, in + 96) || !fp_from_bytes(&y.c0, in + 144))
+    return 0;
+  if (!g2_on_curve_affine(&x, &y)) return 0;
+  g2_set_affine(o, &x, &y);
+  return 1;
+}
+
+static void g2_to_affine_bytes(uint8_t out[192], const g2_t *p) {
+  if (p->inf) {
+    memset(out, 0, 192);
+    return;
+  }
+  g2_t a;
+  g2_to_affine(&a, p);
+  fp_to_bytes(out, &a.x.c1);
+  fp_to_bytes(out + 48, &a.x.c0);
+  fp_to_bytes(out + 96, &a.y.c1);
+  fp_to_bytes(out + 144, &a.y.c0);
+}
+
+/* --- public API ---------------------------------------------------- */
+
+/* rc: 1 ok, 2 infinity, 0 invalid */
+int blsn_g1_decompress(const uint8_t in[48], uint8_t out[96]) {
+  uint8_t flags = in[0];
+  if (!(flags & 0x80)) return 0; /* must be compressed */
+  int infinity = (flags >> 6) & 1;
+  int sign = (flags >> 5) & 1;
+  uint8_t xb[48];
+  memcpy(xb, in, 48);
+  xb[0] &= 0x1f;
+  if (infinity) {
+    if (sign || !is_zero_bytes(xb, 48)) return 0;
+    memset(out, 0, 96);
+    return 2;
+  }
+  fp_t x, y2, y;
+  if (!fp_from_bytes(&x, xb)) return 0;
+  fp_sqr(&y2, &x);
+  fp_mul(&y2, &y2, &x);
+  fp_add(&y2, &y2, &FP_B_M);
+  if (!fp_sqrt(&y, &y2)) return 0;
+  /* pick lexicographically-larger y iff sign bit set */
+  fp_t neg_y, y_plain, ny_plain;
+  fp_neg(&neg_y, &y);
+  fp_from_mont(&y_plain, &y);
+  fp_from_mont(&ny_plain, &neg_y);
+  int y_larger = 0;
+  for (int i = 5; i >= 0; i--) {
+    if (y_plain.l[i] > ny_plain.l[i]) { y_larger = 1; break; }
+    if (y_plain.l[i] < ny_plain.l[i]) { y_larger = 0; break; }
+  }
+  if (y_larger != sign) y = neg_y;
+  g1_t p;
+  g1_set_affine(&p, &x, &y);
+  if (!g1_in_subgroup(&p)) return 0;
+  fp_to_bytes(out, &x);
+  fp_to_bytes(out + 48, &y);
+  return 1;
+}
+
+int blsn_g2_decompress(const uint8_t in[96], uint8_t out[192]) {
+  uint8_t flags = in[0];
+  if (!(flags & 0x80)) return 0;
+  int infinity = (flags >> 6) & 1;
+  int sign = (flags >> 5) & 1;
+  uint8_t xb[96];
+  memcpy(xb, in, 96);
+  xb[0] &= 0x1f;
+  if (infinity) {
+    if (sign || !is_zero_bytes(xb, 96)) return 0;
+    memset(out, 0, 192);
+    return 2;
+  }
+  fp2_t x, y2, y;
+  if (!fp_from_bytes(&x.c1, xb) || !fp_from_bytes(&x.c0, xb + 48)) return 0;
+  fp2_t b;
+  b.c0 = FP_B_M;
+  b.c1 = FP_B_M;
+  fp2_sqr(&y2, &x);
+  fp2_mul(&y2, &y2, &x);
+  fp2_add(&y2, &y2, &b);
+  if (!fp2_sqrt(&y, &y2)) return 0;
+  /* sign: lexicographic on (c1, c0) plain values */
+  fp2_t neg_y;
+  fp2_neg(&neg_y, &y);
+  fp_t yc1, nyc1, yc0, nyc0;
+  fp_from_mont(&yc1, &y.c1);
+  fp_from_mont(&nyc1, &neg_y.c1);
+  fp_from_mont(&yc0, &y.c0);
+  fp_from_mont(&nyc0, &neg_y.c0);
+  int y_larger = 0, decided = 0;
+  for (int i = 5; i >= 0 && !decided; i--) {
+    if (yc1.l[i] != nyc1.l[i]) {
+      y_larger = yc1.l[i] > nyc1.l[i];
+      decided = 1;
+    }
+  }
+  for (int i = 5; i >= 0 && !decided; i--) {
+    if (yc0.l[i] != nyc0.l[i]) {
+      y_larger = yc0.l[i] > nyc0.l[i];
+      decided = 1;
+    }
+  }
+  if (y_larger != sign) y = neg_y;
+  g2_t p;
+  g2_set_affine(&p, &x, &y);
+  if (!g2_in_subgroup(&p)) return 0;
+  g2_to_affine_bytes(out, &p);
+  return 1;
+}
+
+void blsn_g1_compress(const uint8_t aff[96], uint8_t out[48]) {
+  if (is_zero_bytes(aff, 96)) {
+    memset(out, 0, 48);
+    out[0] = 0xc0;
+    return;
+  }
+  memcpy(out, aff, 48);
+  out[0] |= 0x80;
+  /* sign of y */
+  fp_t y, ny, yp, nyp;
+  fp_from_bytes(&y, aff + 48);
+  fp_neg(&ny, &y);
+  fp_from_mont(&yp, &y);
+  fp_from_mont(&nyp, &ny);
+  for (int i = 5; i >= 0; i--) {
+    if (yp.l[i] > nyp.l[i]) {
+      out[0] |= 0x20;
+      break;
+    }
+    if (yp.l[i] < nyp.l[i]) break;
+  }
+}
+
+int blsn_g1_subgroup_check(const uint8_t aff[96]) {
+  g1_t p;
+  if (!g1_from_affine_bytes(&p, aff)) return 0;
+  if (p.inf) return 1;
+  return g1_in_subgroup(&p);
+}
+
+int blsn_g2_subgroup_check(const uint8_t aff[192]) {
+  g2_t p;
+  if (!g2_from_affine_bytes(&p, aff)) return 0;
+  if (p.inf) return 1;
+  return g2_in_subgroup(&p);
+}
+
+void blsn_hash_to_g2(const uint8_t *msg, uint32_t msg_len,
+                     const uint8_t *dst, uint32_t dst_len,
+                     uint8_t out[192]) {
+  g2_t p;
+  hash_to_g2_point(&p, msg, msg_len, dst, dst_len);
+  g2_to_affine_bytes(out, &p);
+}
+
+void blsn_g1_mul(const uint8_t aff[96], const uint8_t scalar_be[32],
+                 uint8_t out[96]) {
+  g1_t p, r;
+  if (!g1_from_affine_bytes(&p, aff)) {
+    memset(out, 0, 96);
+    return;
+  }
+  g1_mul_be(&r, &p, scalar_be, 32);
+  g1_to_affine_bytes(out, &r);
+}
+
+void blsn_g2_mul(const uint8_t aff[192], const uint8_t scalar_be[32],
+                 uint8_t out[192]) {
+  g2_t p, r;
+  if (!g2_from_affine_bytes(&p, aff)) {
+    memset(out, 0, 192);
+    return;
+  }
+  g2_mul_be(&r, &p, scalar_be, 32);
+  g2_to_affine_bytes(out, &r);
+}
+
+/* rc: 1 ok, 0 invalid input (out untouched) */
+int blsn_g1_add(const uint8_t a[96], const uint8_t b[96], uint8_t out[96]) {
+  g1_t pa, pb, r;
+  if (!g1_from_affine_bytes(&pa, a)) return 0;
+  if (!g1_from_affine_bytes(&pb, b)) return 0;
+  g1_add(&r, &pa, &pb);
+  g1_to_affine_bytes(out, &r);
+  return 1;
+}
+
+int blsn_g2_add(const uint8_t a[192], const uint8_t b[192],
+                uint8_t out[192]) {
+  g2_t pa, pb, r;
+  if (!g2_from_affine_bytes(&pa, a)) return 0;
+  if (!g2_from_affine_bytes(&pb, b)) return 0;
+  g2_add(&r, &pa, &pb);
+  g2_to_affine_bytes(out, &r);
+  return 1;
+}
+
+void blsn_g1_generator(uint8_t out[96]) {
+  fp_to_bytes(out, &G1_GEN_X);
+  fp_to_bytes(out + 48, &G1_GEN_Y);
+}
+
+void blsn_g2_generator(uint8_t out[192]) {
+  fp_to_bytes(out, &G2_GEN_X.c1);
+  fp_to_bytes(out + 48, &G2_GEN_X.c0);
+  fp_to_bytes(out + 96, &G2_GEN_Y.c1);
+  fp_to_bytes(out + 144, &G2_GEN_Y.c0);
+}
+
+/* prod e(P_i, Q_i) == 1; points affine bytes, infinity pairs skipped.
+   rc: 1 yes, 0 no, -1 invalid input */
+int blsn_pairing_product_is_one(const uint8_t *g1s, const uint8_t *g2s,
+                                uint32_t n) {
+  fp12_t f;
+  fp12_one(&f);
+  for (uint32_t i = 0; i < n; i++) {
+    g1_t p;
+    g2_t q;
+    if (!g1_from_affine_bytes(&p, g1s + 96 * i)) return -1;
+    if (!g2_from_affine_bytes(&q, g2s + 192 * i)) return -1;
+    if (p.inf || q.inf) continue;
+    miller_loop_acc(&f, &p.x, &p.y, &q.x, &q.y);
+  }
+  fp12_t e;
+  final_exponentiation(&e, &f);
+  return fp12_is_one(&e);
+}
+
+/* pairing value raw export for differential tests: e(P,Q) pre-final-exp
+   as 12 Fp values (48B BE each, basis c0.c0.c0, c0.c0.c1, c0.c1.c0 ...) */
+int blsn_miller_loop(const uint8_t g1[96], const uint8_t g2[192],
+                     uint8_t out[576]) {
+  g1_t p;
+  g2_t q;
+  if (!g1_from_affine_bytes(&p, g1)) return -1;
+  if (!g2_from_affine_bytes(&q, g2)) return -1;
+  fp12_t f;
+  fp12_one(&f);
+  if (!p.inf && !q.inf) miller_loop_acc(&f, &p.x, &p.y, &q.x, &q.y);
+  const fp2_t *cs[6] = {&f.c0.c0, &f.c0.c1, &f.c0.c2,
+                        &f.c1.c0, &f.c1.c1, &f.c1.c2};
+  for (int i = 0; i < 6; i++) {
+    fp_to_bytes(out + 96 * i, &cs[i]->c0);
+    fp_to_bytes(out + 96 * i + 48, &cs[i]->c1);
+  }
+  return 0;
+}

@@ -46,7 +46,18 @@ def decompress_pubkey(pk: bytes):
     """48B compressed -> affine ints; rejects infinity (spec
     KeyValidate) and off-curve/subgroup points. Cached: validator
     pubkeys recur constantly (reference pubkey-index-map, SURVEY.md
-    §2.1)."""
+    §2.1). Native backend (csrc/bls381.c) fuses decode + on-curve +
+    subgroup check."""
+    from ..crypto.bls import native
+
+    if native.available():
+        try:
+            p = native.g1_decompress(pk)
+        except native.NativeError as e:
+            raise InvalidPointError(str(e)) from e
+        if p is None:
+            raise InvalidPointError("pubkey is the identity")
+        return p
     try:
         p = oc.g1_from_bytes(pk)
     except Exception as e:  # malformed encoding
@@ -63,6 +74,13 @@ def decompress_signature(sig: bytes):
     """96B compressed -> affine ints on the twist; identity -> None
     (an identity signature can only verify for identity pubkeys, which
     KeyValidate already rejects — callers treat None as invalid)."""
+    from ..crypto.bls import native
+
+    if native.available():
+        try:
+            return native.g2_decompress(sig)
+        except native.NativeError as e:
+            raise InvalidPointError(str(e)) from e
     try:
         q = oc.g2_from_bytes(sig)
     except Exception as e:

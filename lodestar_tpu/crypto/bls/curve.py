@@ -108,7 +108,16 @@ def _mul(ops, pt, k: int):
 # -- public G1 ---------------------------------------------------------------
 
 
+def _native():
+    from . import native
+
+    return native if native.available() else None
+
+
 def g1_add(p1, p2):
+    n = _native()
+    if n is not None:
+        return n.g1_add(p1, p2)
     return _add(_FqOps, p1, p2)
 
 
@@ -117,7 +126,11 @@ def g1_neg(p):
 
 
 def g1_mul(p, k: int):
-    return _mul(_FqOps, p, k % R if p is not None and k >= 0 else k)
+    k = k % R if p is not None and k >= 0 else k
+    n = _native()
+    if n is not None and k >= 0:
+        return n.g1_mul(p, k)
+    return _mul(_FqOps, p, k)
 
 
 def g1_is_on_curve(p) -> bool:
@@ -125,6 +138,14 @@ def g1_is_on_curve(p) -> bool:
 
 
 def g1_in_subgroup(p) -> bool:
+    n = _native()
+    if n is not None and p is not None:
+        lib = n._load()
+        return (
+            bool(lib.blsn_g1_subgroup_check(n.g1_to_bytes(p)))
+            if g1_is_on_curve(p)
+            else False
+        )
     return g1_is_on_curve(p) and _mul(_FqOps, p, R) is None
 
 
@@ -132,6 +153,9 @@ def g1_in_subgroup(p) -> bool:
 
 
 def g2_add(p1, p2):
+    n = _native()
+    if n is not None:
+        return n.g2_add(p1, p2)
     return _add(_Fq2Ops, p1, p2)
 
 
@@ -140,7 +164,11 @@ def g2_neg(p):
 
 
 def g2_mul(p, k: int):
-    return _mul(_Fq2Ops, p, k % R if p is not None and k >= 0 else k)
+    k = k % R if p is not None and k >= 0 else k
+    n = _native()
+    if n is not None and k >= 0:
+        return n.g2_mul(p, k)
+    return _mul(_Fq2Ops, p, k)
 
 
 def g2_is_on_curve(p) -> bool:
@@ -148,6 +176,14 @@ def g2_is_on_curve(p) -> bool:
 
 
 def g2_in_subgroup(p) -> bool:
+    n = _native()
+    if n is not None and p is not None:
+        lib = n._load()
+        return (
+            bool(lib.blsn_g2_subgroup_check(n.g2_to_bytes(p)))
+            if g2_is_on_curve(p)
+            else False
+        )
     return g2_is_on_curve(p) and _mul(_Fq2Ops, p, R) is None
 
 
@@ -208,6 +244,12 @@ def g1_from_bytes(data: bytes):
     """Decompress + validate (on-curve and subgroup)."""
     if len(data) != 48:
         raise ValueError("G1 compressed point must be 48 bytes")
+    n = _native()
+    if n is not None:
+        try:
+            return n.g1_decompress(data)
+        except n.NativeError as e:
+            raise ValueError(str(e)) from e
     flags = data[0]
     if not flags & _C_FLAG:
         raise ValueError("uncompressed G1 not supported")
@@ -247,6 +289,12 @@ def g2_to_bytes(p) -> bytes:
 def g2_from_bytes(data: bytes):
     if len(data) != 96:
         raise ValueError("G2 compressed point must be 96 bytes")
+    n = _native()
+    if n is not None:
+        try:
+            return n.g2_decompress(data)
+        except n.NativeError as e:
+            raise ValueError(str(e)) from e
     flags = data[0]
     if not flags & _C_FLAG:
         raise ValueError("uncompressed G2 not supported")

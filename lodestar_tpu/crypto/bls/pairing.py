@@ -122,7 +122,18 @@ def pairing(p, q):
 
 
 def pairing_product_is_one(pairs) -> bool:
-    """prod e(P_i, Q_i) == 1, with one shared final exponentiation."""
+    """prod e(P_i, Q_i) == 1, with one shared final exponentiation.
+    Dispatches to the native backend (csrc/bls381.c) when available;
+    `pairing_product_is_one_py` is the pure oracle for differential
+    tests."""
+    from . import native
+
+    if native.available():
+        return native.pairing_product_is_one(pairs)
+    return pairing_product_is_one_py(pairs)
+
+
+def pairing_product_is_one_py(pairs) -> bool:
     f = FQ12_ONE
     for p, q in pairs:
         if p is None or q is None:

@@ -257,13 +257,14 @@ def scalar_mul(ops: _Ops, qx, qy, bits: jax.Array, q_inf=None) -> JacPoint:
 
 
 def scalars_to_bits(ks, nbits: int) -> jax.Array:
-    """Host: python ints -> (len(ks), nbits) bool tensor, MSB first."""
-    out = np.zeros((len(ks), nbits), np.bool_)
-    for i, k in enumerate(ks):
-        assert 0 <= k < (1 << nbits)
-        for j in range(nbits):
-            out[i, nbits - 1 - j] = (k >> j) & 1
-    return jnp.asarray(out)
+    """Host: python ints -> (len(ks), nbits) bool tensor, MSB first.
+    Vectorized via byte packing + np.unpackbits."""
+    nbytes = (nbits + 7) // 8
+    assert all(0 <= int(k) < (1 << nbits) for k in ks), "scalar out of range"
+    raw = b"".join(int(k).to_bytes(nbytes, "big") for k in ks)
+    mat = np.frombuffer(raw, np.uint8).reshape(len(ks), nbytes)
+    bits = np.unpackbits(mat, axis=1, bitorder="big")
+    return jnp.asarray(bits[:, -nbits:].astype(np.bool_))
 
 
 def jac_sum(ops: _Ops, p: JacPoint) -> JacPoint:

@@ -115,9 +115,33 @@ def const(x: int, batch_shape=()) -> Lv:
     return Lv(arr, bounds, bounds)
 
 
+_BIT_WEIGHTS = (1 << np.arange(BITS, dtype=np.int32))
+
+
 def from_ints(xs) -> Lv:
-    """Batch of canonical field elements from python ints; shape (len(xs),)."""
-    mat = np.stack([np.concatenate([int_to_limbs(x % P), [0]]) for x in xs])
+    """Batch of canonical field elements from python ints; shape
+    (len(xs),). Vectorized: ints -> little-endian bytes (C-speed) ->
+    numpy bit unpack -> 10-bit limb dot — the host-prep path must keep
+    up with 1000+-set device batches (VERDICT r1 item 10)."""
+    n = len(xs)
+    if n == 0:
+        return Lv(
+            jnp.zeros((0, NCANON), jnp.int32),
+            tuple([0] * NCANON),
+            tuple([B - 1] * NLIMB + [0]),
+        )
+    raw = b"".join((x % P).to_bytes(49, "little") for x in xs)
+    bytes_mat = np.frombuffer(raw, np.uint8).reshape(n, 49)
+    bits = np.unpackbits(bytes_mat, axis=1, bitorder="little")
+    limbs = (
+        bits[:, : NLIMB * BITS]
+        .reshape(n, NLIMB, BITS)
+        .astype(np.int32)
+        @ _BIT_WEIGHTS
+    )
+    mat = np.concatenate(
+        [limbs, np.zeros((n, 1), np.int32)], axis=1
+    )
     lo = tuple([0] * NCANON)
     hi = tuple([B - 1] * NLIMB + [0])
     return Lv(jnp.asarray(mat, jnp.int32), lo, hi)

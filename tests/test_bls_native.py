@@ -1,0 +1,202 @@
+"""Native BLS backend (csrc/bls381.c) differential tests vs the
+pure-Python oracle.
+
+Reference analog: blst's KAT/unit coverage; here every primitive is
+checked against the independently-implemented Python oracle
+(lodestar_tpu/crypto/bls/*_py paths), including adversarial encodings
+(non-canonical compression, wrong-subgroup points, identity cases) per
+VERDICT r1 item 8.
+"""
+
+import random
+
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as oc
+from lodestar_tpu.crypto.bls import native
+from lodestar_tpu.crypto.bls import pairing as op
+from lodestar_tpu.crypto.bls.fields import P, R
+from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2_py
+from lodestar_tpu.crypto.bls.signature import (
+    sign,
+    sk_to_pk,
+    verify,
+    verify_multiple_aggregate_signatures,
+)
+from lodestar_tpu.params import BLS_DST_SIG
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native backend unavailable"
+)
+
+# pure-python reference implementations (bypass native dispatch)
+from lodestar_tpu.crypto.bls.curve import _add, _mul, _FqOps, _Fq2Ops
+
+
+def py_g1_mul(p, k):
+    return _mul(_FqOps, p, k % R)
+
+
+def py_g2_mul(p, k):
+    return _mul(_Fq2Ops, p, k % R)
+
+
+class TestCurveOps:
+    def test_g1_mul_differential(self):
+        random.seed(11)
+        for _ in range(8):
+            k = random.randrange(1, R)
+            assert native.g1_mul(oc.G1_GEN, k) == py_g1_mul(oc.G1_GEN, k)
+
+    def test_g2_mul_differential(self):
+        random.seed(12)
+        for _ in range(4):
+            k = random.randrange(1, R)
+            assert native.g2_mul(oc.G2_GEN, k) == py_g2_mul(oc.G2_GEN, k)
+
+    def test_add_identities(self):
+        p = native.g1_mul(oc.G1_GEN, 7)
+        assert native.g1_add(p, None) == p
+        assert native.g1_add(None, p) == p
+        neg = (p[0], P - p[1])
+        assert native.g1_add(p, neg) is None
+
+    def test_doubling_path(self):
+        p = native.g1_mul(oc.G1_GEN, 5)
+        assert native.g1_add(p, p) == py_g1_mul(oc.G1_GEN, 10)
+
+    def test_mul_by_zero_is_infinity(self):
+        assert native.g1_mul(oc.G1_GEN, 0) is None
+        assert native.g2_mul(oc.G2_GEN, 0) is None
+
+
+class TestPairing:
+    def test_product_is_one_valid(self):
+        sk = 0x123456789ABCDEF
+        h = py_g2_mul(oc.G2_GEN, 55555)
+        pk = py_g1_mul(oc.G1_GEN, sk)
+        sig = py_g2_mul(h, sk)
+        assert native.pairing_product_is_one(
+            [(pk, h), ((oc.G1_GEN[0], P - oc.G1_GEN[1]), sig)]
+        )
+
+    def test_product_rejects_invalid(self):
+        sk = 0x123456789ABCDEF
+        h = py_g2_mul(oc.G2_GEN, 55555)
+        pk = py_g1_mul(oc.G1_GEN, sk)
+        sig = py_g2_mul(h, sk + 1)
+        assert not native.pairing_product_is_one(
+            [(pk, h), ((oc.G1_GEN[0], P - oc.G1_GEN[1]), sig)]
+        )
+
+    def test_matches_oracle_on_random_products(self):
+        random.seed(21)
+        # bilinearity: e(aG1, bG2) * e(-abG1, G2) == 1
+        a = random.randrange(1, 2**64)
+        b = random.randrange(1, 2**64)
+        lhs = py_g1_mul(oc.G1_GEN, a)
+        rhs = py_g2_mul(oc.G2_GEN, b)
+        ab = py_g1_mul(oc.G1_GEN, a * b % R)
+        neg_ab = (ab[0], P - ab[1])
+        pairs = [(lhs, rhs), (neg_ab, oc.G2_GEN)]
+        assert native.pairing_product_is_one(pairs)
+        assert op.pairing_product_is_one_py(pairs)
+
+
+class TestHashToCurve:
+    @pytest.mark.parametrize(
+        "msg", [b"", b"abc", b"a" * 100, bytes(range(64))]
+    )
+    def test_matches_python_oracle(self, msg):
+        assert native.hash_to_g2(msg, BLS_DST_SIG) == hash_to_g2_py(
+            msg, BLS_DST_SIG
+        )
+
+
+class TestDecompression:
+    def test_pubkey_roundtrip(self):
+        pk_bytes = sk_to_pk(424242)
+        pt = native.g1_decompress(pk_bytes)
+        assert pt == py_g1_mul(oc.G1_GEN, 424242)
+        assert native.g1_compress(pt) == pk_bytes
+
+    def test_signature_roundtrip(self):
+        sig = sign(99, b"data")
+        pt = native.g2_decompress(sig)
+        h = hash_to_g2_py(b"data", BLS_DST_SIG)
+        assert pt == py_g2_mul(h, 99)
+
+    def test_infinity_pubkey(self):
+        assert native.g1_decompress(b"\xc0" + b"\x00" * 47) is None
+        assert native.g2_decompress(b"\xc0" + b"\x00" * 95) is None
+
+    def test_uncompressed_flag_rejected(self):
+        pk = bytearray(sk_to_pk(5))
+        pk[0] &= 0x7F  # clear compression bit
+        with pytest.raises(native.NativeError):
+            native.g1_decompress(bytes(pk))
+
+    def test_x_above_modulus_rejected(self):
+        bad = bytearray(48)
+        bad[0] = 0x9F  # compressed flag + x >= p
+        bad[1:] = b"\xff" * 47
+        with pytest.raises(native.NativeError):
+            native.g1_decompress(bytes(bad))
+
+    def test_non_curve_x_rejected(self):
+        # x with no y^2 solution
+        for x in range(2, 40):
+            enc = bytearray(x.to_bytes(48, "big"))
+            enc[0] |= 0x80
+            try:
+                native.g1_decompress(bytes(enc))
+                ref_ok = True
+            except native.NativeError:
+                ref_ok = False
+            # compare against oracle decode path
+            try:
+                pt = oc.g1_from_bytes(bytes(enc))
+                py_ok = pt is not None and oc.g1_is_on_curve(pt) and oc.g1_in_subgroup(pt)
+            except Exception:
+                py_ok = False
+            assert ref_ok == py_ok, f"divergence at x={x}"
+
+    def test_wrong_subgroup_rejected(self):
+        # find a curve point NOT in the r-subgroup (cofactor != 1)
+        from lodestar_tpu.crypto.bls.fields import fq_sqrt
+
+        x = 3
+        while True:
+            y2 = (x**3 + 4) % P
+            y = fq_sqrt(y2)
+            if y is not None:
+                pt = (x, y)
+                if not oc.g1_in_subgroup(pt):
+                    break
+            x += 1
+        enc = bytearray(pt[0].to_bytes(48, "big"))
+        enc[0] |= 0x80
+        if pt[1] > P - pt[1]:
+            enc[0] |= 0x20
+        with pytest.raises(native.NativeError):
+            native.g1_decompress(bytes(enc))
+
+
+class TestEndToEndSignatures:
+    def test_sign_verify_through_native(self):
+        # the dispatching verify() now runs on the native backend
+        sig = sign(31337, b"beacon block root")
+        pk = sk_to_pk(31337)
+        assert verify(pk, b"beacon block root", sig)
+        assert not verify(pk, b"other", sig)
+
+    def test_batch_verify(self):
+        sets = []
+        for i in range(8):
+            sk = 1000 + i
+            msg = bytes([i]) * 32
+            sets.append((sk_to_pk(sk), msg, sign(sk, msg)))
+        assert verify_multiple_aggregate_signatures(sets)
+        bad = list(sets)
+        bad[3] = (bad[3][0], bad[3][1], sets[4][2])
+        assert not verify_multiple_aggregate_signatures(bad)
