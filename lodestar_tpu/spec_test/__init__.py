@@ -1,0 +1,35 @@
+"""Consensus-spec-tests harness.
+
+Reference analog: packages/spec-test-util (describeDirectorySpecTest,
+src/single.ts:94) + beacon-node/test/spec/presets/* — a generic runner
+over the official ethereum/consensus-spec-tests directory layout:
+
+  <root>/tests/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/
+      pre.ssz_snappy, post.ssz_snappy, blocks_0.ssz_snappy,
+      meta.yaml, ...
+
+Vectors are an external download (zero-egress environments run the
+differential/adversarial suites instead — tests/test_bls_native.py,
+tests/test_ops_*); point LODESTAR_SPEC_TESTS at an unpacked checkout
+and tests/test_spec_vectors.py runs everything this runner understands.
+"""
+
+from .runner import (
+    SpecCase,
+    discover_cases,
+    run_epoch_processing_case,
+    run_finality_case,
+    run_operations_case,
+    run_sanity_blocks_case,
+    run_sanity_slots_case,
+)
+
+__all__ = [
+    "SpecCase",
+    "discover_cases",
+    "run_epoch_processing_case",
+    "run_operations_case",
+    "run_sanity_blocks_case",
+    "run_sanity_slots_case",
+    "run_finality_case",
+]

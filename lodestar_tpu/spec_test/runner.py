@@ -1,0 +1,262 @@
+"""Directory runner over the consensus-spec-tests layout.
+
+Reference analog: spec-test-util/src/single.ts:94
+(describeDirectorySpecTest) and the per-suite bindings in
+beacon-node/test/spec/presets/{operations,epoch_processing,sanity,
+finality}.ts. A case directory's *.ssz_snappy files decode with this
+repo's own snappy + SSZ; expected-failure cases have no post state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..statetransition import BeaconStateView
+from ..statetransition import epoch as E
+from ..statetransition import util
+from ..statetransition.block import BlockCtx, BlockProcessError
+from ..statetransition.slot import process_slots, state_transition
+from ..params import ForkSeq
+from ..utils import snappy
+
+FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb", "electra")
+
+
+@dataclass
+class SpecCase:
+    preset: str
+    fork: str
+    runner: str
+    handler: str
+    suite: str
+    name: str
+    path: Path
+
+    def read_ssz(self, fname: str) -> bytes | None:
+        f = self.path / f"{fname}.ssz_snappy"
+        if not f.exists():
+            return None
+        return snappy.uncompress(f.read_bytes())
+
+    def read_yaml(self, fname: str):
+        f = self.path / f"{fname}.yaml"
+        if not f.exists():
+            return None
+        import yaml
+
+        return yaml.safe_load(f.read_text())
+
+
+def discover_cases(root: Path, preset: str) -> list[SpecCase]:
+    """tests/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/"""
+    out = []
+    base = Path(root) / "tests" / preset
+    if not base.is_dir():
+        return out
+    for fork_dir in sorted(base.iterdir()):
+        if fork_dir.name not in FORKS:
+            continue
+        for runner_dir in sorted(p for p in fork_dir.iterdir() if p.is_dir()):
+            for handler_dir in sorted(
+                p for p in runner_dir.iterdir() if p.is_dir()
+            ):
+                for suite_dir in sorted(
+                    p for p in handler_dir.iterdir() if p.is_dir()
+                ):
+                    for case_dir in sorted(
+                        p for p in suite_dir.iterdir() if p.is_dir()
+                    ):
+                        out.append(
+                            SpecCase(
+                                preset,
+                                fork_dir.name,
+                                runner_dir.name,
+                                handler_dir.name,
+                                suite_dir.name,
+                                case_dir.name,
+                                case_dir,
+                            )
+                        )
+    return out
+
+
+def _load_state(case: SpecCase, types, fname: str) -> BeaconStateView | None:
+    raw = case.read_ssz(fname)
+    if raw is None:
+        return None
+    t = types.by_fork[case.fork].BeaconState
+    return BeaconStateView(state=t.deserialize(raw), fork=case.fork)
+
+
+def _roots_equal(cfg, types, got: BeaconStateView, want: BeaconStateView):
+    g = got.hash_tree_root(types)
+    w = want.hash_tree_root(types)
+    return g == w, g, w
+
+
+# operation handler -> (ssz file name, type attr, apply fn) bindings
+# (beacon-node/test/spec/presets/operations.ts)
+_OPERATION_BINDINGS = {
+    "attestation": ("attestation", "Attestation", "process_attestation"),
+    "attester_slashing": (
+        "attester_slashing",
+        "AttesterSlashing",
+        "process_attester_slashing",
+    ),
+    "block_header": ("block", "BeaconBlock", "process_block_header"),
+    "deposit": ("deposit", "Deposit", "process_deposit"),
+    "proposer_slashing": (
+        "proposer_slashing",
+        "ProposerSlashing",
+        "process_proposer_slashing",
+    ),
+    "voluntary_exit": (
+        "voluntary_exit",
+        "SignedVoluntaryExit",
+        "process_voluntary_exit",
+    ),
+    "sync_aggregate": (
+        "sync_aggregate",
+        "SyncAggregate",
+        "process_sync_aggregate",
+    ),
+    "bls_to_execution_change": (
+        "address_change",
+        "SignedBLSToExecutionChange",
+        "process_bls_to_execution_change",
+    ),
+    "withdrawals": (
+        "execution_payload",
+        "ExecutionPayload",
+        "process_withdrawals",
+    ),
+}
+
+
+def run_operations_case(cfg, types, case: SpecCase) -> None:
+    from ..statetransition import block as B
+
+    binding = _OPERATION_BINDINGS.get(case.handler)
+    if binding is None:
+        raise NotImplementedError(f"operation {case.handler}")
+    fname, type_name, fn_name = binding
+    pre = _load_state(case, types, "pre")
+    post = _load_state(case, types, "post")
+    ns = types.by_fork[case.fork]
+    op_t = getattr(ns, type_name, None) or getattr(types, type_name)
+    op = op_t.deserialize(case.read_ssz(fname))
+    ctx = BlockCtx(
+        cfg, pre.state, types, int(ForkSeq[case.fork]), verify_signatures=True
+    )
+    fn = getattr(B, fn_name)
+    try:
+        fn(ctx, op)
+        ok = True
+    except (BlockProcessError, AssertionError, ValueError):
+        ok = False
+    if post is None:
+        assert not ok, f"{case.path}: expected failure but op succeeded"
+        return
+    assert ok, f"{case.path}: operation failed unexpectedly"
+    same, g, w = _roots_equal(cfg, types, pre, post)
+    assert same, f"{case.path}: post root {g.hex()} != {w.hex()}"
+
+
+# epoch-processing handler -> function over EpochTransitionCache
+# (beacon-node/test/spec/presets/epoch_processing.ts)
+_EPOCH_BINDINGS = {
+    "justification_and_finalization": "process_justification_and_finalization",
+    "inactivity_updates": "process_inactivity_updates",
+    "rewards_and_penalties": "process_rewards_and_penalties",
+    "registry_updates": "process_registry_updates",
+    "slashings": "process_slashings",
+    "eth1_data_reset": "process_eth1_data_reset",
+    "effective_balance_updates": "process_effective_balance_updates",
+    "slashings_reset": "process_slashings_reset",
+    "randao_mixes_reset": "process_randao_mixes_reset",
+    "historical_roots_update": "process_historical_roots_update",
+    "historical_summaries_update": "process_historical_summaries_update",
+    "participation_record_updates": "process_participation_record_updates",
+    "participation_flag_updates": "process_participation_flag_updates",
+    "sync_committee_updates": "process_sync_committee_updates",
+    "pending_deposits": "process_pending_deposits",
+    "pending_consolidations": "process_pending_consolidations",
+}
+
+_EPOCH_FNS_WITH_TYPES = {
+    "process_justification_and_finalization",
+    "process_historical_roots_update",
+    "process_historical_summaries_update",
+    "process_sync_committee_updates",
+    "process_pending_deposits",
+}
+
+
+def run_epoch_processing_case(cfg, types, case: SpecCase) -> None:
+    fn_name = _EPOCH_BINDINGS.get(case.handler)
+    if fn_name is None:
+        raise NotImplementedError(f"epoch step {case.handler}")
+    pre = _load_state(case, types, "pre")
+    post = _load_state(case, types, "post")
+    cache = E.EpochTransitionCache(
+        cfg, pre.state, int(ForkSeq[case.fork])
+    )
+    fn = getattr(E, fn_name)
+    try:
+        if fn_name in _EPOCH_FNS_WITH_TYPES:
+            fn(cache, pre.state, types)
+        else:
+            fn(cache, pre.state)
+        ok = True
+    except (AssertionError, ValueError, BlockProcessError):
+        ok = False
+    if post is None:
+        assert not ok, f"{case.path}: expected failure"
+        return
+    assert ok, f"{case.path}: epoch step failed unexpectedly"
+    same, g, w = _roots_equal(cfg, types, pre, post)
+    assert same, f"{case.path}: post root {g.hex()} != {w.hex()}"
+
+
+def run_sanity_slots_case(cfg, types, case: SpecCase) -> None:
+    pre = _load_state(case, types, "pre")
+    post = _load_state(case, types, "post")
+    meta = case.read_yaml("slots")
+    n_slots = int(meta)
+    process_slots(cfg, pre, int(pre.state.slot) + n_slots, types)
+    same, g, w = _roots_equal(cfg, types, pre, post)
+    assert same, f"{case.path}: post root {g.hex()} != {w.hex()}"
+
+
+def _iter_blocks(case: SpecCase, types, fork: str):
+    meta = case.read_yaml("meta") or {}
+    n = int(meta.get("blocks_count", 0))
+    ns = types.by_fork[fork]
+    for i in range(n):
+        raw = case.read_ssz(f"blocks_{i}")
+        yield ns.SignedBeaconBlock.deserialize(raw)
+
+
+def run_sanity_blocks_case(cfg, types, case: SpecCase) -> None:
+    pre = _load_state(case, types, "pre")
+    post = _load_state(case, types, "post")
+    ok = True
+    try:
+        for block in _iter_blocks(case, types, case.fork):
+            state_transition(
+                cfg, pre, block, types,
+                verify_state_root=True, verify_proposer=True,
+                verify_signatures=True,
+            )
+    except (BlockProcessError, AssertionError, ValueError):
+        ok = False
+    if post is None:
+        assert not ok, f"{case.path}: expected failure"
+        return
+    assert ok, f"{case.path}: block processing failed unexpectedly"
+    same, g, w = _roots_equal(cfg, types, pre, post)
+    assert same, f"{case.path}: post root {g.hex()} != {w.hex()}"
+
+
+run_finality_case = run_sanity_blocks_case  # same shape, longer chains
