@@ -62,6 +62,9 @@ class BeaconChain:
             from .archiver import Archiver
 
             self.archiver = Archiver(db, self)
+        # optional LightClientServer (lightclient/server.py), fed on
+        # import with each block's sync aggregate
+        self.light_client_server = None
         # Dev chains have no execution engine: self-built mock payloads
         # are trusted (valid). With a real engine attached this must be
         # False so payload blocks import optimistically (syncing) until
@@ -124,6 +127,11 @@ class BeaconChain:
         self._state_order: list[bytes] = [self.genesis_root]
         self._justified_root_seen = justified.root
         if db is not None:
+            from ..config.chain_config import chain_config_to_json
+
+            db.meta.put_raw(
+                "chain_config", chain_config_to_json(cfg).encode()
+            )
             db.meta.put_int("genesis_time", int(state.genesis_time))
             db.meta.put_raw(
                 "genesis_validators_root",
@@ -306,6 +314,13 @@ class BeaconChain:
                 self.archiver.on_finalized(
                     self.fork_choice.finalized_checkpoint
                 )
+        if (
+            self.light_client_server is not None
+            and work.fork_seq >= ForkSeq.altair
+        ):
+            self.light_client_server.on_import_block(
+                block_root, block.body.sync_aggregate, int(block.slot)
+            )
         return block_root
 
     def _persist_import(self, block_root, signed_block, work) -> None:

@@ -1,0 +1,13 @@
+"""Light client: update production (server) and verification (client).
+
+Reference analogs: LightClientServer
+(beacon-node/src/chain/lightClient/index.ts:198) producing updates
+from imported blocks with merkle proofs (proofs.ts), and the
+light-client package's `LightclientSpec` validation
+(light-client/src/spec/index.ts:19) + sync loop (src/index.ts:106).
+"""
+
+from .server import LightClientServer
+from .client import LightClient, LightClientError
+
+__all__ = ["LightClientServer", "LightClient", "LightClientError"]

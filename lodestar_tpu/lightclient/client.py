@@ -1,0 +1,156 @@
+"""Light client: spec validation + header-following store.
+
+Reference analog: light-client/src/spec/index.ts:19 (LightclientSpec —
+validate_light_client_update per the altair sync protocol) and the
+Lightclient store/sync loop (src/index.ts:106). Validation: merkle
+branches against the attested state root, sync-committee signature
+over the attested header's signing root, 2/3 participation for
+finalization.
+"""
+
+from __future__ import annotations
+
+from ..config.beacon_config import compute_signing_root_from_roots
+from ..crypto.bls.signature import eth_fast_aggregate_verify
+from ..params import DOMAIN_SYNC_COMMITTEE, preset
+from ..ssz.proofs import is_valid_merkle_branch
+
+# spec gindices (altair sync protocol)
+NEXT_SYNC_COMMITTEE_DEPTH, NEXT_SYNC_COMMITTEE_INDEX = 5, 23
+CURRENT_SYNC_COMMITTEE_DEPTH, CURRENT_SYNC_COMMITTEE_INDEX = 5, 22
+FINALITY_DEPTH, FINALITY_INDEX = 6, 41  # (20 << 1) | 1
+
+MIN_SYNC_COMMITTEE_PARTICIPANTS = 1
+
+
+class LightClientError(Exception):
+    pass
+
+
+class LightClient:
+    """Follows the chain from a trusted bootstrap using only
+    LightClientUpdate objects."""
+
+    def __init__(self, beacon_cfg, types, bootstrap, trusted_block_root):
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+        t = types
+        header_root = t.BeaconBlockHeader.hash_tree_root(
+            bootstrap.header.beacon
+        )
+        if bytes(header_root) != bytes(trusted_block_root):
+            raise LightClientError("bootstrap header != trusted root")
+        if not is_valid_merkle_branch(
+            t.SyncCommittee.hash_tree_root(
+                bootstrap.current_sync_committee
+            ),
+            [bytes(b) for b in bootstrap.current_sync_committee_branch],
+            CURRENT_SYNC_COMMITTEE_DEPTH,
+            CURRENT_SYNC_COMMITTEE_INDEX,
+            bytes(bootstrap.header.beacon.state_root),
+        ):
+            raise LightClientError("invalid current_sync_committee proof")
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.next_sync_committee = None
+
+    def _committee_for_slot(self, signature_slot: int):
+        p = preset()
+        period = lambda slot: slot // (
+            p.SLOTS_PER_EPOCH * p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        cur = period(int(self.finalized_header.beacon.slot))
+        sig = period(signature_slot)
+        if sig == cur:
+            return self.current_sync_committee
+        if sig == cur + 1 and self.next_sync_committee is not None:
+            return self.next_sync_committee
+        raise LightClientError("update outside known committee periods")
+
+    def process_update(self, update) -> None:
+        """validate_light_client_update + apply (spec process_l_c_u)."""
+        t = self.types
+        agg = update.sync_aggregate
+        bits = [bool(b) for b in agg.sync_committee_bits]
+        n_part = sum(bits)
+        if n_part < MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            raise LightClientError("no sync committee participation")
+        attested = update.attested_header.beacon
+        sig_slot = int(update.signature_slot)
+        if not sig_slot > int(attested.slot):
+            raise LightClientError("signature slot not after attested")
+        # next sync committee proof (against attested state root)
+        has_next = not _is_empty_committee(update.next_sync_committee)
+        if has_next and not is_valid_merkle_branch(
+            t.SyncCommittee.hash_tree_root(update.next_sync_committee),
+            [bytes(b) for b in update.next_sync_committee_branch],
+            NEXT_SYNC_COMMITTEE_DEPTH,
+            NEXT_SYNC_COMMITTEE_INDEX,
+            bytes(attested.state_root),
+        ):
+            raise LightClientError("invalid next_sync_committee proof")
+        # finality proof
+        has_finality = int(update.finalized_header.beacon.slot) > 0 or any(
+            bytes(b) != b"\x00" * 32 for b in update.finality_branch
+        )
+        if has_finality:
+            fin_root = t.BeaconBlockHeader.hash_tree_root(
+                update.finalized_header.beacon
+            )
+            if not is_valid_merkle_branch(
+                bytes(fin_root),
+                [bytes(b) for b in update.finality_branch],
+                FINALITY_DEPTH,
+                FINALITY_INDEX,
+                bytes(attested.state_root),
+            ):
+                raise LightClientError("invalid finality proof")
+        # sync committee signature over the attested header
+        committee = self._committee_for_slot(sig_slot)
+        pubkeys = [
+            bytes(pk)
+            for pk, b in zip(committee.pubkeys, bits)
+            if b
+        ]
+        p = preset()
+        epoch = max(0, (sig_slot - 1) // p.SLOTS_PER_EPOCH)
+        domain = self.beacon_cfg.get_domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        signing_root = compute_signing_root_from_roots(
+            bytes(t.BeaconBlockHeader.hash_tree_root(attested)), domain
+        )
+        if not eth_fast_aggregate_verify(
+            pubkeys,
+            signing_root,
+            bytes(agg.sync_committee_signature),
+        ):
+            raise LightClientError("invalid sync committee signature")
+        # apply (spec apply_light_client_update incl. period rotation)
+        p = preset()
+        span = p.SLOTS_PER_EPOCH * p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        store_period = int(self.finalized_header.beacon.slot) // span
+        if int(attested.slot) > int(self.optimistic_header.beacon.slot):
+            self.optimistic_header = update.attested_header
+        if has_next and self.next_sync_committee is None:
+            self.next_sync_committee = update.next_sync_committee
+        if has_finality and 3 * n_part >= 2 * len(bits):
+            if int(update.finalized_header.beacon.slot) > int(
+                self.finalized_header.beacon.slot
+            ):
+                new_period = (
+                    int(update.finalized_header.beacon.slot) // span
+                )
+                if (
+                    new_period > store_period
+                    and self.next_sync_committee is not None
+                ):
+                    # rotate committees on period advance
+                    self.current_sync_committee = self.next_sync_committee
+                    self.next_sync_committee = (
+                        update.next_sync_committee if has_next else None
+                    )
+                self.finalized_header = update.finalized_header
+
+
+def _is_empty_committee(sc) -> bool:
+    return all(bytes(pk) == b"\x00" * 48 for pk in sc.pubkeys[:1])

@@ -1,0 +1,150 @@
+"""LightClientServer: produce bootstrap/updates from chain state.
+
+Reference analog: chain/lightClient/index.ts:198 — on block import,
+assemble LightClientUpdate objects carrying the attested header, sync
+aggregate, and merkle-proven next_sync_committee / finalized header;
+serve bootstrap at finalized checkpoints. Proofs come from
+ssz/proofs.py (persistent-merkle-tree getSingleProof analog).
+"""
+
+from __future__ import annotations
+
+from ..ssz.proofs import container_field_branch, merkle_branch
+from ..ssz.proofs import container_field_roots
+
+
+class LightClientServer:
+    def __init__(self, cfg, types, chain):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+        self.best_update_by_period: dict[int, object] = {}
+        self.latest_finality_update = None
+        self.latest_optimistic_update = None
+
+    # -- proofs ---------------------------------------------------------
+
+    def _state_type(self, view):
+        return view.state_type(self.types)
+
+    def _sync_committee_branch(self, view, which: str):
+        leaf, branch, idx = container_field_branch(
+            self._state_type(view), view.state, which
+        )
+        return branch
+
+    def _finality_branch(self, view):
+        st_t = self._state_type(view)
+        chunks = container_field_roots(st_t, view.state)
+        f_idx = st_t.field_names.index("finalized_checkpoint")
+        outer = merkle_branch(chunks, f_idx)
+        cp_t = self.types.Checkpoint
+        cp_chunks = container_field_roots(
+            cp_t, view.state.finalized_checkpoint
+        )
+        inner = merkle_branch(cp_chunks, 1)  # .root is field 1
+        return inner + outer
+
+    def _header_for(self, block_root: bytes):
+        node = self.chain.fork_choice.proto.get_node(block_root)
+        view = self.chain.get_state(block_root)
+        if node is None or view is None:
+            return None
+        t = self.types
+        h = t.BeaconBlockHeader.default()
+        src = view.state.latest_block_header
+        h.slot = src.slot
+        h.proposer_index = src.proposer_index
+        h.parent_root = src.parent_root
+        h.body_root = src.body_root
+        h.state_root = (
+            bytes(src.state_root)
+            if bytes(src.state_root) != b"\x00" * 32
+            else view.hash_tree_root(t)
+        )
+        lch = t.LightClientHeader.default()
+        lch.beacon = h
+        return lch
+
+    # -- production -----------------------------------------------------
+
+    def get_bootstrap(self, block_root: bytes):
+        """LightClientBootstrap at a (finalized) block root."""
+        view = self.chain.get_state(block_root)
+        if view is None or view.fork == "phase0":
+            return None
+        t = self.types
+        b = t.LightClientBootstrap.default()
+        b.header = self._header_for(block_root)
+        b.current_sync_committee = view.state.current_sync_committee
+        b.current_sync_committee_branch = self._sync_committee_branch(
+            view, "current_sync_committee"
+        )
+        return b
+
+    def on_import_block(self, block_root: bytes, sync_aggregate, signature_slot: int):
+        """Called by the chain after importing a block carrying a sync
+        aggregate over `attested_root` (the block's parent)."""
+        from ..params import preset
+
+        t = self.types
+        chain = self.chain
+        node = chain.fork_choice.proto.get_node(block_root)
+        if node is None or node.parent_root is None:
+            return
+        attested_root = node.parent_root
+        attested_view = chain.get_state(attested_root)
+        if attested_view is None or attested_view.fork == "phase0":
+            return
+        attested_header = self._header_for(attested_root)
+        if attested_header is None:
+            return
+        # optimistic update
+        opt = t.LightClientOptimisticUpdate.default()
+        opt.attested_header = attested_header
+        opt.sync_aggregate = sync_aggregate
+        opt.signature_slot = signature_slot
+        self.latest_optimistic_update = opt
+        # finality update when the attested state's finalized block is known
+        fin_cp = attested_view.state.finalized_checkpoint
+        fin_header = (
+            self._header_for(bytes(fin_cp.root))
+            if int(fin_cp.epoch) > 0
+            else None
+        )
+        if fin_header is not None:
+            fu = t.LightClientFinalityUpdate.default()
+            fu.attested_header = attested_header
+            fu.finalized_header = fin_header
+            fu.finality_branch = self._finality_branch(attested_view)
+            fu.sync_aggregate = sync_aggregate
+            fu.signature_slot = signature_slot
+            self.latest_finality_update = fu
+        # full update, keyed by the period of the committee that SIGNED
+        # (signature slot): the client verifies period p's update with
+        # the committee it learned for p, so boundary blocks (attested
+        # in p-1, signed in p) land in p's bucket
+        p = preset()
+        period = signature_slot // (
+            p.SLOTS_PER_EPOCH * p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        upd = t.LightClientUpdate.default()
+        upd.attested_header = attested_header
+        upd.next_sync_committee = attested_view.state.next_sync_committee
+        upd.next_sync_committee_branch = self._sync_committee_branch(
+            attested_view, "next_sync_committee"
+        )
+        if fin_header is not None:
+            upd.finalized_header = fin_header
+            upd.finality_branch = self._finality_branch(attested_view)
+        upd.sync_aggregate = sync_aggregate
+        upd.signature_slot = signature_slot
+        best = self.best_update_by_period.get(period)
+        if best is None or _participation(sync_aggregate) >= _participation(
+            best.sync_aggregate
+        ):
+            self.best_update_by_period[period] = upd
+
+
+def _participation(sync_aggregate) -> int:
+    return sum(1 for b in sync_aggregate.sync_committee_bits if b)

@@ -1,0 +1,96 @@
+"""SSZ merkle proofs (single-leaf branches by generalized index).
+
+Reference analog: @chainsafe/persistent-merkle-tree's proof API used by
+the light-client server (chain/lightClient/proofs.ts): a branch is the
+sibling hashes from a leaf chunk up to the root; verification is the
+spec's is_valid_merkle_branch. Containers expose their field roots so
+branches compose across nesting levels (e.g. finalized_checkpoint.root
+inside BeaconState).
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+from .core import next_pow_of_two, zero_hash
+
+
+def _hash(a: bytes, b: bytes) -> bytes:
+    return sha256(a + b).digest()
+
+
+def merkle_branch(chunks: list[bytes], index: int, limit: int | None = None) -> list[bytes]:
+    """Sibling path for chunks[index] in the padded chunk tree —
+    bottom-up order, length = tree depth."""
+    count = len(chunks)
+    if limit is None:
+        limit = next_pow_of_two(count)
+    else:
+        limit = next_pow_of_two(limit)
+    depth = (limit - 1).bit_length() if limit > 1 else 0
+    layer = list(chunks)
+    branch = []
+    idx = index
+    for level in range(depth):
+        sib = idx ^ 1
+        if sib < len(layer):
+            branch.append(layer[sib])
+        else:
+            branch.append(zero_hash(level))
+        # next layer
+        nxt = []
+        if len(layer) % 2 == 1:
+            layer = layer + [zero_hash(level)]
+        for i in range(0, len(layer), 2):
+            nxt.append(_hash(layer[i], layer[i + 1]))
+        layer = nxt
+        idx //= 2
+    return branch
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch: list[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    """Spec is_valid_merkle_branch."""
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = _hash(branch[i], value)
+        else:
+            value = _hash(value, branch[i])
+    return value == root
+
+
+def container_field_roots(container_type, value) -> list[bytes]:
+    """Per-field hash tree roots of a container value (the container's
+    chunk layer)."""
+    return [
+        t.hash_tree_root(getattr(value, n))
+        for n, t in container_type.fields
+    ]
+
+
+def container_field_branch(
+    container_type, value, field_name: str
+) -> tuple[bytes, list[bytes], int]:
+    """(leaf, branch, field_index) proving `field_name` against the
+    container's hash tree root."""
+    chunks = container_field_roots(container_type, value)
+    idx = container_type.field_names.index(field_name)
+    return chunks[idx], merkle_branch(chunks, idx), idx
+
+
+def concat_branches(
+    inner_branch: list[bytes],
+    inner_index: int,
+    inner_depth: int,
+    outer_branch: list[bytes],
+    outer_index: int,
+) -> tuple[list[bytes], int]:
+    """Compose a proof of X inside F with a proof of F inside S into a
+    proof of X inside S: branch = inner + outer, generalized index
+    stacks the paths."""
+    return (
+        inner_branch + outer_branch,
+        (outer_index << inner_depth) | inner_index,
+    )

@@ -316,6 +316,42 @@ def create_ssz_types(p: BeaconPreset) -> SszTypes:  # noqa: PLR0915
     ])
     t.altair = altair
 
+    # light-client types (types/src/altair/sszTypes.ts LightClient*).
+    # Branch depths per spec altair/light-client/sync-protocol.md:
+    # next_sync_committee gindex 55 (depth 5), finality gindex 105
+    # (depth 6), current_sync_committee gindex 54 (depth 5).
+    t.LightClientHeader = _C("LightClientHeader", [
+        ("beacon", t.BeaconBlockHeader),
+    ])
+    SyncCommitteeBranch = VectorType(Root, 5)
+    FinalityBranch = VectorType(Root, 6)
+    t.LightClientBootstrap = _C("LightClientBootstrap", [
+        ("header", t.LightClientHeader),
+        ("current_sync_committee", t.SyncCommittee),
+        ("current_sync_committee_branch", SyncCommitteeBranch),
+    ])
+    t.LightClientUpdate = _C("LightClientUpdate", [
+        ("attested_header", t.LightClientHeader),
+        ("next_sync_committee", t.SyncCommittee),
+        ("next_sync_committee_branch", SyncCommitteeBranch),
+        ("finalized_header", t.LightClientHeader),
+        ("finality_branch", FinalityBranch),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+    t.LightClientFinalityUpdate = _C("LightClientFinalityUpdate", [
+        ("attested_header", t.LightClientHeader),
+        ("finalized_header", t.LightClientHeader),
+        ("finality_branch", FinalityBranch),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+    t.LightClientOptimisticUpdate = _C("LightClientOptimisticUpdate", [
+        ("attested_header", t.LightClientHeader),
+        ("sync_aggregate", t.SyncAggregate),
+        ("signature_slot", Slot),
+    ])
+
     # == bellatrix ==========================================================
     bellatrix = SimpleNamespace()
     Transaction = ByteListType(p.MAX_BYTES_PER_TRANSACTION)
