@@ -1,0 +1,218 @@
+"""Command-line interface.
+
+Reference analog: packages/cli (yargs program, src/cmds/): `beacon`
+(run a node from db or genesis), `dev` (instant-genesis local chain
+with in-process validators, cli/src/cmds/dev/), `lightclient`, and
+`validator` utilities (slashing-protection interchange import/export).
+
+Usage: python -m lodestar_tpu <cmd> [flags]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lodestar-tpu",
+        description="TPU-native Ethereum consensus client",
+    )
+    p.add_argument(
+        "--preset",
+        choices=("mainnet", "minimal"),
+        default=None,
+        help="compile-time preset (defaults to LODESTAR_PRESET env)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    dev = sub.add_parser("dev", help="instant-genesis local dev chain")
+    dev.add_argument("--validators", type=int, default=32)
+    dev.add_argument("--slots", type=int, default=32)
+    dev.add_argument("--altair-epoch", type=int, default=2**64 - 1)
+    dev.add_argument("--bellatrix-epoch", type=int, default=2**64 - 1)
+    dev.add_argument("--db", default=None, help="persist chain to this dir")
+    dev.add_argument("--api-port", type=int, default=None)
+    dev.add_argument("--metrics-port", type=int, default=None)
+    dev.add_argument(
+        "--real-time",
+        action="store_true",
+        help="advance with the wall clock instead of as fast as possible",
+    )
+
+    beacon = sub.add_parser("beacon", help="run a beacon node from a db")
+    beacon.add_argument("--db", required=True)
+    beacon.add_argument("--api-port", type=int, default=9596)
+    beacon.add_argument("--metrics-port", type=int, default=None)
+
+    vc = sub.add_parser("validator", help="validator client utilities")
+    vc.add_argument(
+        "--vc-db",
+        required=True,
+        help="validator client database file (signing history)",
+    )
+    vcsub = vc.add_subparsers(dest="vc_cmd", required=True)
+    imp = vcsub.add_parser(
+        "slashing-protection-import", help="import EIP-3076 interchange"
+    )
+    imp.add_argument("file")
+    exp = vcsub.add_parser(
+        "slashing-protection-export", help="export EIP-3076 interchange"
+    )
+    exp.add_argument("file")
+    return p
+
+
+def _set_preset(name: str | None) -> None:
+    if name:
+        import os
+
+        os.environ["LODESTAR_PRESET"] = name
+
+
+async def _run_dev(args) -> int:
+    from .chain.devnode import DevNode
+    from .config.chain_config import ChainConfig
+    from .db.beacon import BeaconDb
+    from .logger import get_logger
+    from .types import ssz_types
+
+    log = get_logger("dev")
+    FAR = 2**64 - 1
+    cfg = ChainConfig(
+        ALTAIR_FORK_EPOCH=args.altair_epoch,
+        BELLATRIX_FORK_EPOCH=args.bellatrix_epoch,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    types = ssz_types()
+    db = BeaconDb.open(args.db, types) if args.db else None
+    node = DevNode(
+        cfg, types, args.validators, verify_attestations=False, db=db
+    )
+    api_server = None
+    if args.api_port is not None:
+        from .api.impl import BeaconApiImpl
+        from .api.server import BeaconRestApiServer
+
+        impl = BeaconApiImpl(cfg, types, node.chain)
+        api_server = BeaconRestApiServer(
+            impl, port=args.api_port, loop=asyncio.get_event_loop()
+        )
+        log.info("rest api", {"port": api_server.start()})
+    metrics_server = None
+    if args.metrics_port is not None:
+        from .metrics import MetricsServer, RegistryMetricCreator
+
+        reg = RegistryMetricCreator()
+        metrics_server = MetricsServer(reg, port=args.metrics_port)
+        log.info("metrics", {"port": metrics_server.start()})
+    for s in range(1, args.slots + 1):
+        if args.real_time:
+            await asyncio.sleep(cfg.SECONDS_PER_SLOT)
+        root = await node.advance_slot()
+        log.info(
+            "slot advanced",
+            {
+                "slot": node.slot,
+                "root": root,
+                "justified": node.chain.justified_checkpoint.epoch,
+                "finalized": node.chain.finalized_checkpoint.epoch,
+            },
+        )
+    log.info(
+        "dev chain done",
+        {
+            "head_slot": node.slot,
+            "finalized_epoch": node.chain.finalized_checkpoint.epoch,
+        },
+    )
+    if api_server is not None:
+        api_server.stop()
+    if metrics_server is not None:
+        metrics_server.stop()
+    await node.close()
+    if db is not None:
+        db.controller.flush()
+        db.close()
+    return 0
+
+
+async def _run_beacon(args) -> int:
+    from .config.chain_config import chain_config_from_json
+    from .db.beacon import BeaconDb
+    from .node import BeaconNode
+    from .types import ssz_types
+
+    types = ssz_types()
+    db = BeaconDb.open(args.db, types)
+    # the db records the config it was created with (fork schedule must
+    # match or state/block SSZ decode goes wrong)
+    raw_cfg = db.meta.get_raw("chain_config")
+    if raw_cfg is None:
+        print("error: db has no chain_config metadata", file=sys.stderr)
+        return 1
+    cfg = chain_config_from_json(raw_cfg.decode())
+    node = await BeaconNode.init(
+        cfg=cfg,
+        types=types,
+        db=db,
+        api_port=args.api_port,
+        metrics_port=args.metrics_port,
+    )
+    node.notify_status()
+    try:
+        while True:
+            await asyncio.sleep(cfg.SECONDS_PER_SLOT)
+            node.notify_status()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await node.close()
+    return 0
+
+
+def _run_validator(args) -> int:
+    import os
+
+    from .validator import SlashingProtection
+
+    # the VC db IS an interchange-format JSON file (persistent store)
+    sp = SlashingProtection()
+    if os.path.exists(args.vc_db):
+        with open(args.vc_db) as f:
+            sp.import_interchange(f.read())
+    if args.vc_cmd == "slashing-protection-import":
+        with open(args.file) as f:
+            n = sp.import_interchange(f.read())
+        with open(args.vc_db, "w") as f:
+            json.dump(sp.export_interchange(), f, indent=2)
+        print(f"imported {n} records into {args.vc_db}")
+        return 0
+    if args.vc_cmd == "slashing-protection-export":
+        with open(args.file, "w") as f:
+            json.dump(sp.export_interchange(), f, indent=2)
+        print(f"wrote {args.file}")
+        return 0
+    return 1
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    _set_preset(args.preset)
+    if args.cmd == "dev":
+        return asyncio.run(_run_dev(args))
+    if args.cmd == "beacon":
+        return asyncio.run(_run_beacon(args))
+    if args.cmd == "validator":
+        return _run_validator(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

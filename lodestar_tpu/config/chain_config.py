@@ -5,7 +5,33 @@ configs/{mainnet,minimal}.ts. Matches ethereum/consensus-specs
 configs/{mainnet,minimal}.yaml.
 """
 
+import json
+
 from dataclasses import dataclass, replace, fields
+
+
+def chain_config_to_json(cfg: "ChainConfig") -> str:
+    """Serialize for persistence (db meta) — the reference stores the
+    network config alongside the db so `beacon --db` resumes with the
+    exact fork schedule (cli beaconNodeOptions)."""
+    out = {}
+    for f in fields(cfg):
+        v = getattr(cfg, f.name)
+        out[f.name] = "0x" + v.hex() if isinstance(v, bytes) else v
+    return json.dumps(out)
+
+
+def chain_config_from_json(data: str) -> "ChainConfig":
+    raw = json.loads(data)
+    kwargs = {}
+    for f in fields(ChainConfig):
+        if f.name not in raw:
+            continue
+        v = raw[f.name]
+        if isinstance(v, str) and v.startswith("0x"):
+            v = bytes.fromhex(v[2:])
+        kwargs[f.name] = v
+    return ChainConfig(**kwargs)
 
 
 @dataclass(frozen=True)

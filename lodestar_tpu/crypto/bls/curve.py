@@ -140,12 +140,8 @@ def g1_is_on_curve(p) -> bool:
 def g1_in_subgroup(p) -> bool:
     n = _native()
     if n is not None and p is not None:
-        lib = n._load()
-        return (
-            bool(lib.blsn_g1_subgroup_check(n.g1_to_bytes(p)))
-            if g1_is_on_curve(p)
-            else False
-        )
+        # the native check validates on-curve itself
+        return n.g1_subgroup_check(p)
     return g1_is_on_curve(p) and _mul(_FqOps, p, R) is None
 
 
@@ -178,12 +174,7 @@ def g2_is_on_curve(p) -> bool:
 def g2_in_subgroup(p) -> bool:
     n = _native()
     if n is not None and p is not None:
-        lib = n._load()
-        return (
-            bool(lib.blsn_g2_subgroup_check(n.g2_to_bytes(p)))
-            if g2_is_on_curve(p)
-            else False
-        )
+        return n.g2_subgroup_check(p)
     return g2_is_on_curve(p) and _mul(_Fq2Ops, p, R) is None
 
 

@@ -209,3 +209,16 @@ def g1_compress(pt) -> bytes:
     out = ctypes.create_string_buffer(48)
     lib.blsn_g1_compress(g1_to_bytes(pt), out)
     return out.raw
+
+
+def g1_subgroup_check(pt) -> bool:
+    """On-curve + r-subgroup membership (native does both)."""
+    if pt is None:
+        return True
+    return bool(_load().blsn_g1_subgroup_check(g1_to_bytes(pt)))
+
+
+def g2_subgroup_check(pt) -> bool:
+    if pt is None:
+        return True
+    return bool(_load().blsn_g2_subgroup_check(g2_to_bytes(pt)))

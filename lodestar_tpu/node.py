@@ -1,0 +1,176 @@
+"""BeaconNode: full node assembly.
+
+Reference analog: BeaconNode.init (beacon-node/src/node/nodejs.ts:143)
+— wires db -> metrics -> chain -> network processor -> sync -> api ->
+metrics server around one asyncio loop, with graceful close in reverse
+order; plus the NodeNotifier status line (notifier.ts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .api.impl import BeaconApiImpl
+from .api.server import BeaconRestApiServer
+from .chain.chain import BeaconChain
+from .chain.oppools import AggregatedAttestationPool, OpPool
+from .chain.validation import AttestationValidator
+from .config.beacon_config import BeaconConfig
+from .db.beacon import BeaconDb
+from .lightclient import LightClientServer
+from .logger import get_logger
+from .metrics import (
+    MetricsServer,
+    RegistryMetricCreator,
+    create_lodestar_metrics,
+)
+from .network.processor import NetworkProcessor
+from .network.reqresp import InProcessTransport, ReqResp
+from .params import preset
+from .sync import RangeSync, SyncServer
+
+
+class BeaconNode:
+    def __init__(
+        self,
+        cfg,
+        types,
+        anchor_state_view=None,
+        db: BeaconDb | None = None,
+        verifier=None,
+        api_port: int = 0,
+        metrics_port: int | None = None,
+        peer_id: str = "node",
+        transport: InProcessTransport | None = None,
+        logger=None,
+    ):
+        self.cfg = cfg
+        self.types = types
+        self.log = logger or get_logger("node")
+        self.metrics_registry = RegistryMetricCreator()
+        self.metrics = create_lodestar_metrics(self.metrics_registry)
+        self.db = db
+        self.anchor = anchor_state_view
+        self.verifier = verifier
+        self.api_port = api_port
+        self.metrics_port = metrics_port
+        self.peer_id = peer_id
+        self.transport = transport or InProcessTransport()
+        self.chain: BeaconChain | None = None
+        self.api_server = None
+        self.metrics_server = None
+        self.processor = None
+        self.range_sync = None
+        self.att_pool = None
+        self.op_pool = None
+
+    @classmethod
+    async def init(cls, **kwargs) -> "BeaconNode":
+        """Assemble and start all services (nodejs.ts:143-300)."""
+        node = cls(**kwargs)
+        log = node.log
+        # chain: resume from db when it has an anchor, else fresh
+        if node.anchor is None:
+            if node.db is None:
+                raise ValueError("need anchor_state_view or a db to resume")
+            log.info("resuming chain from db")
+            node.chain = await BeaconChain.from_db(
+                node.cfg, node.types, node.db, verifier=node.verifier
+            )
+        else:
+            node.chain = BeaconChain(
+                node.cfg,
+                node.types,
+                node.anchor,
+                verifier=node.verifier,
+                db=node.db,
+            )
+        gvr = bytes(
+            node.chain.head_state.state.genesis_validators_root
+        )
+        node.beacon_cfg = BeaconConfig(node.cfg, gvr)
+        node.chain.light_client_server = LightClientServer(
+            node.cfg, node.types, node.chain
+        )
+        node.att_pool = AggregatedAttestationPool(node.types)
+        node.op_pool = OpPool(node.types)
+        # gossip ingest
+        validator = AttestationValidator(
+            node.cfg, node.types, node.chain, node.chain.verifier
+        )
+        node.attestation_validator = validator
+        node.processor = NetworkProcessor(
+            node.chain,
+            validator,
+            node.chain.verifier,
+            att_pool=node.att_pool,
+            metrics=node.metrics,
+        )
+        node.processor.start()
+        # reqresp server + range sync client
+        node.reqresp = ReqResp(node.peer_id, node.transport)
+        SyncServer(node.chain, node.beacon_cfg, node.types).register(
+            node.reqresp
+        )
+        node.range_sync = RangeSync(
+            node.chain, node.beacon_cfg, node.types, node.reqresp
+        )
+        # REST API
+        impl = BeaconApiImpl(node.cfg, node.types, node.chain, node)
+        node.api_server = BeaconRestApiServer(
+            impl, port=node.api_port, loop=asyncio.get_event_loop()
+        )
+        port = node.api_server.start()
+        log.info("rest api listening", {"port": port})
+        # metrics
+        if node.metrics_port is not None:
+            node.metrics_server = MetricsServer(
+                node.metrics_registry, port=node.metrics_port
+            )
+            mport = node.metrics_server.start()
+            log.info("metrics listening", {"port": mport})
+        head = node.chain.fork_choice.proto.get_node(node.chain.head_root)
+        log.info(
+            "node ready",
+            {
+                "head_slot": head.slot if head else 0,
+                "finalized_epoch": node.chain.finalized_checkpoint.epoch,
+                "validators": len(node.chain.head_state.state.validators),
+            },
+        )
+        return node
+
+    def notify_status(self) -> None:
+        """NodeNotifier one-liner (notifier.ts)."""
+        head = self.chain.fork_choice.proto.get_node(self.chain.head_root)
+        self.log.info(
+            "status",
+            {
+                "slot": head.slot if head else 0,
+                "head": self.chain.head_root,
+                "finalized": self.chain.finalized_checkpoint.epoch,
+                "justified": self.chain.justified_checkpoint.epoch,
+                "queue": 0
+                if self.processor is None
+                else len(self.processor.att_queue),
+            },
+        )
+        c = self.metrics.chain
+        c.head_slot.set(head.slot if head else 0)
+        c.finalized_epoch.set(self.chain.finalized_checkpoint.epoch)
+        c.current_justified_epoch.set(
+            self.chain.justified_checkpoint.epoch
+        )
+
+    async def close(self) -> None:
+        """Reverse-order shutdown (graceful SIGINT path)."""
+        if self.api_server is not None:
+            self.api_server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        if self.processor is not None:
+            await self.processor.stop()
+        if self.chain is not None:
+            await self.chain.close()
+        if self.db is not None:
+            self.db.close()
