@@ -201,7 +201,7 @@ class BeaconApiImpl:
 
     def get_attester_duties(self, epoch: int, indices: list[int]) -> list:
         st = self.chain.head_state.state
-        sh = util.EpochShuffling(st, epoch)
+        sh = util.get_shuffling(st, epoch)
         p = preset()
         wanted = set(indices)
         duties = []

@@ -30,9 +30,13 @@ class ChainError(Exception):
 
 
 def _clone(view: BeaconStateView, types) -> BeaconStateView:
+    # structural copy that keeps hash caches warm (ssz/cached.py) — the
+    # ViewDU state.clone() analog; replaces serialize+deserialize
+    from ..ssz.cached import clone_value
+
     t = view.state_type(types)
     return BeaconStateView(
-        state=t.deserialize(t.serialize(view.state)), fork=view.fork
+        state=clone_value(t, view.state), fork=view.fork
     )
 
 

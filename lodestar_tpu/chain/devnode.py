@@ -78,7 +78,7 @@ class DevNode:
         st = view.state
         s = self.slot
         epoch = util.compute_epoch_at_slot(s)
-        sh = util.EpochShuffling(st, epoch)
+        sh = util.get_shuffling(st, epoch)
         try:
             target_root = util.get_block_root(st, epoch)
         except ValueError:

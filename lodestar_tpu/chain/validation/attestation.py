@@ -120,7 +120,7 @@ class AttestationValidator:
         # committee + signing root, once per key
         view = self.chain.get_state(root) or self.chain.head_state
         st = view.state
-        shuffling = util.EpochShuffling(st, target_epoch)
+        shuffling = util.get_shuffling(st, target_epoch)
         committees = shuffling.committees_at_slot(slot)
         index = int(data.index)
         if index >= len(committees):

@@ -1,0 +1,375 @@
+"""Incremental hashTreeRoot: value-attached merkle caches.
+
+Reference analog: @chainsafe/persistent-merkle-tree + ssz ViewDU
+(SURVEY.md §2.1) — the reference keeps states as tree-backed views so a
+block import re-hashes only changed subtrees. This framework keeps plain
+Python values (the state transition mutates them in place), so the
+equivalent is built from three pieces:
+
+  - `ContainerValue` carries a version counter bumped on every field
+    write (composite.py); "flat" containers (all fields immutable
+    Python values — e.g. Validator) cache their root keyed on that
+    version, making the per-element root an O(1) lookup when unchanged.
+  - `SszVec` (a list subclass produced by List/Vector deserialize and
+    default) carries a `_VecCache`: the packed leaf-chunk blob, the
+    element references/versions it was computed from, and the resulting
+    root. Re-hashing polls element identity+version, recomputes only
+    dirty leaf chunks, and re-merkleizes through the native batched
+    SHA-NI hasher (csrc/sha256_merkle.c) — the as-sha256 analog.
+  - `clone_value` structurally copies a value *with* its caches (new
+    element objects, warm roots), replacing O(state) serialize +
+    deserialize cloning (reference: state.clone() on ViewDU trees).
+
+The dominant costs of a naive hash — per-element SSZ serialization and
+SHA over every chunk — are thus paid only for elements that actually
+changed; the remaining cost is an identity/version poll over big lists
+plus a native re-merkleize of their (cached) chunk blobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .core import merkleize, next_pow_of_two, zero_hash
+
+# Element classification for list/vector caching:
+_K_IMMUT = 0  # element values are immutable (int/bool/bytes): identity poll
+_K_FLAT = 1  # flat containers: identity + version poll
+_K_OTHER = 2  # deep-mutable (nested lists/bitfields): always recompute
+
+# Dirty-index sets are capped; structural ops or overflow fall back to a
+# full poll (still cheap — the chunk blob is cached).
+_MAX_DIRTY = 8192
+
+
+class SszVec(list):
+    """List that carries a merkle cache and tracks element writes.
+
+    Produced by ListType/VectorType deserialize()/default(). Behaves as
+    a plain list; only the hashing layer looks at the extra slots.
+    """
+
+    __slots__ = ("_dirty", "_hc", "_aux")
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self._dirty = None  # None = unknown/all; else set of indices
+        self._hc = None
+        self._aux = None  # opaque consumer tag (e.g. pubkey-map watermark)
+
+    # -- index writes (tracked) --
+    def __setitem__(self, idx, val):
+        list.__setitem__(self, idx, val)
+        if isinstance(idx, int):
+            self._note(idx if idx >= 0 else idx + len(self))
+        else:
+            self._dirty = None
+
+    def _note(self, i: int) -> None:
+        d = self._dirty
+        if d is not None:
+            if len(d) >= _MAX_DIRTY:
+                self._dirty = None
+            else:
+                d.add(i)
+
+    # -- structural ops (cache-invalidating) --
+    def _structural(self):
+        self._dirty = None
+
+    def append(self, v):
+        list.append(self, v)
+        self._structural()
+
+    def extend(self, it):
+        list.extend(self, it)
+        self._structural()
+
+    def insert(self, i, v):
+        list.insert(self, i, v)
+        self._structural()
+
+    def pop(self, i=-1):
+        out = list.pop(self, i)
+        self._structural()
+        return out
+
+    def remove(self, v):
+        list.remove(self, v)
+        self._structural()
+
+    def clear(self):
+        list.clear(self)
+        self._structural()
+
+    def __delitem__(self, i):
+        list.__delitem__(self, i)
+        self._structural()
+
+    def sort(self, **kw):
+        list.sort(self, **kw)
+        self._structural()
+
+    def reverse(self):
+        list.reverse(self)
+        self._structural()
+
+    def __iadd__(self, it):
+        list.__iadd__(self, it)
+        self._structural()
+        return self
+
+    def __imul__(self, k):
+        list.__imul__(self, k)
+        self._structural()
+        return self
+
+    def copy(self):
+        return SszVec(self)
+
+    def __reduce__(self):  # pickle without the caches
+        return (SszVec, (list(self),))
+
+
+class _VecCache:
+    __slots__ = ("etype", "n", "chunks", "root", "refs", "vers")
+
+    def __init__(self, etype, n, chunks, root, refs, vers):
+        self.etype = etype  # element SSZType the cache was built for
+        self.n = n  # element count
+        self.chunks = chunks  # bytearray: packed leaf chunks
+        self.root = root  # merkle root over chunks (pre length-mix)
+        self.refs = refs  # element object refs at last hash (or None)
+        self.vers = vers  # element versions (flat containers) or None
+
+
+def elem_kind(et) -> int:
+    from . import composite as c
+    from .basic import BooleanType, UintType
+
+    if isinstance(et, (UintType, BooleanType, c.ByteVectorType, c.ByteListType)):
+        return _K_IMMUT
+    if isinstance(et, c.ContainerType) and et.is_flat():
+        return _K_FLAT
+    return _K_OTHER
+
+
+def _merkleize_blob(blob: bytes, count: int, limit: int | None) -> bytes:
+    """Merkle root of `count` chunks given as one packed byte blob."""
+    if limit is None:
+        limit = next_pow_of_two(count)
+    else:
+        limit = next_pow_of_two(limit)
+    depth = (limit - 1).bit_length() if limit > 1 else 0
+    if count == 0:
+        return zero_hash(depth)
+    from ..crypto import sha256_batch
+
+    if count >= 8 and sha256_batch.available():
+        return sha256_batch.merkleize_packed(bytes(blob), count, depth)
+    chunks = [bytes(blob[i * 32 : (i + 1) * 32]) for i in range(count)]
+    return merkleize(chunks, limit=limit)
+
+
+# ---------------------------------------------------------------------------
+# Basic-element sequences (uint*/boolean): packed chunk blob caching
+# ---------------------------------------------------------------------------
+
+_NP_DTYPES = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _fast_pack(et, value: list) -> bytes:
+    """Packed little-endian bytes of a basic-element sequence."""
+    size = et.fixed_size()
+    dt = _NP_DTYPES.get(size)
+    if dt is not None and value:
+        try:
+            arr = np.asarray(value, dtype=dt)
+            # numpy wraps out-of-range silently only via explicit casts;
+            # asarray from python ints raises OverflowError — desired.
+            return arr.tobytes()
+        except (OverflowError, TypeError, ValueError):
+            pass
+    return b"".join(et.serialize(v) for v in value)
+
+
+def basic_seq_root(et, value: list, limit_chunks: int | None) -> bytes:
+    """Root of a uint/boolean sequence with chunk-blob caching."""
+    esize = et.fixed_size()
+    per = 32 // esize
+    n = len(value)
+    nchunks = (n + per - 1) // per
+    cache = value._hc if isinstance(value, SszVec) else None
+    dirty = value._dirty if isinstance(value, SszVec) else None
+
+    if (
+        cache is not None
+        and cache.etype is et
+        and cache.n == n
+        and dirty is not None
+    ):
+        if not dirty:
+            return cache.root
+        blob = cache.chunks
+        for ci in {i // per for i in dirty}:
+            seg = _fast_pack(et, value[ci * per : (ci + 1) * per])
+            blob[ci * 32 : ci * 32 + len(seg)] = seg
+        cache.root = _merkleize_blob(blob, nchunks, limit_chunks)
+        value._dirty = set()
+        return cache.root
+
+    raw = _fast_pack(et, value)
+    pad = (-len(raw)) % 32
+    blob = bytearray(raw + b"\x00" * pad)
+    if cache is not None and cache.etype is et and cache.n == n and blob == cache.chunks:
+        root = cache.root
+    else:
+        root = _merkleize_blob(blob, nchunks, limit_chunks)
+    if isinstance(value, SszVec):
+        value._hc = _VecCache(et, n, blob, root, None, None)
+        value._dirty = set()
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Composite-element sequences: per-element root caching + identity poll
+# ---------------------------------------------------------------------------
+
+
+def composite_seq_root(et, value: list, limit_chunks: int | None) -> bytes:
+    """Root of a sequence of composite elements.
+
+    Flat-container and immutable elements are polled by identity (and
+    version); only dirty element roots are recomputed, and the chunk
+    blob re-merkleizes natively. Deep-mutable elements always recompute
+    (their own sub-caches absorb the cost).
+    """
+    kind = elem_kind(et)
+    n = len(value)
+    cache = value._hc if isinstance(value, SszVec) else None
+
+    if (
+        kind != _K_OTHER
+        and cache is not None
+        and cache.etype is et
+        and cache.n == n
+        and cache.refs is not None
+    ):
+        refs = cache.refs
+        vers = cache.vers
+        chunks = cache.chunks
+        if kind == _K_IMMUT:
+            dirty = [i for i in range(n) if value[i] is not refs[i]]
+        else:
+            dirty = [
+                i
+                for i in range(n)
+                if value[i] is not refs[i] or value[i]._v != vers[i]
+            ]
+        if not dirty:
+            return cache.root
+        for i in dirty:
+            e = value[i]
+            chunks[i * 32 : (i + 1) * 32] = et.hash_tree_root(e)
+            refs[i] = e
+            if vers is not None:
+                vers[i] = e._v
+        cache.root = _merkleize_blob(chunks, n, limit_chunks)
+        if isinstance(value, SszVec):
+            value._dirty = set()
+        return cache.root
+
+    roots = [et.hash_tree_root(e) for e in value]
+    blob = bytearray(b"".join(roots))
+    root = _merkleize_blob(blob, n, limit_chunks)
+    if isinstance(value, SszVec) and kind != _K_OTHER:
+        vers = [e._v for e in value] if kind == _K_FLAT else None
+        value._hc = _VecCache(et, n, blob, root, list(value), vers)
+        value._dirty = set()
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Structural clone preserving caches
+# ---------------------------------------------------------------------------
+
+
+def clone_value(t, v: Any) -> Any:
+    """Deep-copy an SSZ value so mutations to either side are invisible
+    to the other, preserving warm hash caches (the reference analog is
+    ViewDU state.clone() — O(1) there via structural sharing; here a
+    structural copy whose re-hash cost after cloning is ~zero)."""
+    from . import composite as c
+    from .basic import BooleanType, UintType
+
+    if isinstance(t, (UintType, BooleanType, c.ByteVectorType, c.ByteListType)):
+        return v  # immutable
+    if isinstance(t, (c.BitvectorType, c.BitlistType)):
+        return list(v)
+    if isinstance(t, (c.ListType, c.VectorType)):
+        et = t.element_type
+        kind = elem_kind(et)
+        if kind == _K_IMMUT:
+            out = SszVec(v)
+            out._aux = getattr(v, "_aux", None)
+        elif kind == _K_FLAT:
+            # copy-on-write: share the element objects and freeze them.
+            # Writers must replace elements (statetransition.util.mut);
+            # ContainerValue.__setattr__ enforces it. This makes state
+            # cloning O(list) instead of O(elements x fields) — the
+            # ViewDU structural-sharing analog.
+            for e in v:
+                object.__setattr__(e, "_shared", True)
+            out = SszVec(v)
+            # element identity is preserved, so consumer tags keyed on
+            # list contents (pubkey-map watermark) remain valid
+            out._aux = getattr(v, "_aux", None)
+        else:
+            out = SszVec(clone_value(et, e) for e in v)
+        old = v._hc if isinstance(v, SszVec) else None
+        if old is not None and old.etype is et and old.n == len(out):
+            refs = vers = None
+            if old.refs is not None:
+                # valid only if the old cache was in sync with v; poll
+                # cheaply: identity of old refs vs v's elements
+                in_sync = all(a is b for a, b in zip(old.refs, v)) and (
+                    old.vers is None
+                    or all(e._v == ver for e, ver in zip(v, old.vers))
+                )
+                if in_sync:
+                    refs = list(out)
+                    vers = (
+                        [e._v for e in out] if kind == _K_FLAT else None
+                    )
+                elif kind != _K_OTHER:
+                    refs = None
+            dirty_clean = isinstance(v, SszVec) and v._dirty == set()
+            if old.refs is not None and refs is not None:
+                out._hc = _VecCache(
+                    et, old.n, bytearray(old.chunks), old.root, refs, vers
+                )
+                out._dirty = set()
+            elif old.refs is None and dirty_clean:
+                # basic-element cache: blob validity == empty dirty set
+                out._hc = _VecCache(
+                    et, old.n, bytearray(old.chunks), old.root, None, None
+                )
+                out._dirty = set()
+        return out
+    if isinstance(t, c.ContainerType):
+        new = t.value_class.__new__(t.value_class)
+        for name, ft in t.fields:
+            object.__setattr__(new, name, clone_value(ft, getattr(v, name)))
+        object.__setattr__(new, "_v", 0)
+        hc = getattr(v, "_hc", None)
+        if hc is not None:
+            if t.is_flat():
+                if hc[0] == v._v:
+                    object.__setattr__(new, "_hc", (0, hc[1]))
+            else:
+                object.__setattr__(new, "_hc", hc)
+        return new
+    # unknown/basic union types: fall back to serde round-trip
+    return t.deserialize(t.serialize(v))

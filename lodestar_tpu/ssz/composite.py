@@ -17,8 +17,19 @@ from .core import (
     pack_bytes,
 )
 from .basic import UintType, BooleanType
+from . import cached
+from .cached import SszVec
 
 OFFSET_SIZE = 4
+
+
+class SharedMutationError(RuntimeError):
+    """Raised on in-place mutation of a value shared between clones.
+
+    Cloning a state (ssz.cached.clone_value) shares flat-container list
+    elements copy-on-write; writers must replace elements (or use
+    statetransition.util.mut) instead of mutating through a shared ref.
+    """
 
 
 def _is_basic(t: SSZType) -> bool:
@@ -330,8 +341,11 @@ class VectorType(SSZType):
             es = et.fixed_size()
             if len(data) != es * self.length:
                 raise ValueError("Vector: wrong byte length")
-            return [et.deserialize(data[i * es : (i + 1) * es]) for i in range(self.length)]
-        return _deserialize_sequence([et] * self.length, data)
+            return SszVec(
+                et.deserialize(data[i * es : (i + 1) * es])
+                for i in range(self.length)
+            )
+        return SszVec(_deserialize_sequence([et] * self.length, data))
 
     def chunk_count(self) -> int:
         if _is_basic(self.element_type):
@@ -343,13 +357,13 @@ class VectorType(SSZType):
             raise ValueError(f"Vector[{self.length}]: got {len(value)} elements")
         et = self.element_type
         if _is_basic(et):
-            data = b"".join(et.serialize(v) for v in value)
-            return merkleize(pack_bytes(data), limit=self.chunk_count())
-        chunks = [et.hash_tree_root(v) for v in value]
-        return merkleize(chunks, limit=self.chunk_count())
+            return cached.basic_seq_root(et, value, self.chunk_count())
+        return cached.composite_seq_root(et, value, self.chunk_count())
 
     def default(self) -> list:
-        return [self.element_type.default() for _ in range(self.length)]
+        return SszVec(
+            self.element_type.default() for _ in range(self.length)
+        )
 
 
 class ListType(SSZType):
@@ -388,9 +402,11 @@ class ListType(SSZType):
             n = len(data) // es
             if n > self.limit:
                 raise ValueError(f"List[{self.limit}]: got {n} elements")
-            return [et.deserialize(data[i * es : (i + 1) * es]) for i in range(n)]
+            return SszVec(
+                et.deserialize(data[i * es : (i + 1) * es]) for i in range(n)
+            )
         if len(data) == 0:
-            return []
+            return SszVec()
         # element count from the first offset
         first = int.from_bytes(data[:OFFSET_SIZE], "little")
         if first % OFFSET_SIZE or first == 0:
@@ -398,7 +414,7 @@ class ListType(SSZType):
         n = first // OFFSET_SIZE
         if n > self.limit:
             raise ValueError(f"List[{self.limit}]: got {n} elements")
-        return _deserialize_sequence([et] * n, data)
+        return SszVec(_deserialize_sequence([et] * n, data))
 
     def chunk_count(self) -> int:
         if _is_basic(self.element_type):
@@ -410,15 +426,13 @@ class ListType(SSZType):
             raise ValueError(f"List[{self.limit}]: got {len(value)} elements")
         et = self.element_type
         if _is_basic(et):
-            data = b"".join(et.serialize(v) for v in value)
-            root = merkleize(pack_bytes(data), limit=self.chunk_count())
+            root = cached.basic_seq_root(et, value, self.chunk_count())
         else:
-            chunks = [et.hash_tree_root(v) for v in value]
-            root = merkleize(chunks, limit=self.chunk_count())
+            root = cached.composite_seq_root(et, value, self.chunk_count())
         return mix_in_length(root, len(value))
 
     def default(self) -> list:
-        return []
+        return SszVec()
 
 
 # ---------------------------------------------------------------------------
@@ -427,12 +441,19 @@ class ListType(SSZType):
 
 
 class ContainerValue:
-    """Attribute-style value for ContainerType; generated per container."""
+    """Attribute-style value for ContainerType; generated per container.
+
+    Carries a version counter `_v` bumped on every field write and a
+    root cache `_hc` — the hooks the incremental hashTreeRoot layer
+    (cached.py) uses to skip re-hashing unchanged subtrees.
+    """
 
     _type: "ContainerType"
-    __slots__ = ()
+    __slots__ = ("_v", "_hc", "_shared")
 
     def __init__(self, **kwargs):
+        object.__setattr__(self, "_shared", False)
+        object.__setattr__(self, "_v", 0)
         for name in self._type.field_names:
             if name in kwargs:
                 setattr(self, name, kwargs.pop(name))
@@ -440,6 +461,21 @@ class ContainerValue:
                 setattr(self, name, self._type.field_types[name].default())
         if kwargs:
             raise TypeError(f"unknown fields {sorted(kwargs)} for {self._type.name}")
+
+    def __setattr__(self, name, value):
+        try:
+            if self._shared:
+                raise SharedMutationError(
+                    f"in-place mutation of {self._type.name} shared "
+                    "between cloned states; use copy-on-write "
+                    "(statetransition.util.mut / replace the element)"
+                )
+            ver = self._v
+        except AttributeError:
+            object.__setattr__(self, "_shared", False)
+            ver = 0
+        object.__setattr__(self, name, value)
+        object.__setattr__(self, "_v", ver + 1)
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, ContainerValue) or other._type is not self._type:
@@ -476,6 +512,20 @@ class ContainerType(SSZType):
             {"_type": self, "__slots__": tuple(self.field_names)},
         )
         self._fixed = all(t.is_fixed_size() for t in self._types_list)
+        self._flat = None  # lazy: all fields hold immutable Python values
+
+    def is_flat(self) -> bool:
+        """True when every field value is an immutable Python object
+        (int/bool/bytes) — then the value's version counter alone
+        certifies its cached root (no deep mutation possible)."""
+        if self._flat is None:
+            self._flat = all(
+                isinstance(
+                    t, (UintType, BooleanType, ByteVectorType, ByteListType)
+                )
+                for t in self._types_list
+            )
+        return self._flat
 
     def __repr__(self) -> str:
         return f"Container[{self.name}]"
@@ -515,10 +565,34 @@ class ContainerType(SSZType):
         return len(self.fields)
 
     def hash_tree_root(self, value: ContainerValue) -> bytes:
+        hc = getattr(value, "_hc", None)
+        if self.is_flat():
+            ver = getattr(value, "_v", None)
+            if hc is not None and hc[0] == ver:
+                return hc[1]
+            chunks = [
+                t.hash_tree_root(getattr(value, n)) for n, t in self.fields
+            ]
+            root = merkleize(chunks)
+            try:
+                object.__setattr__(value, "_hc", (ver, root))
+            except AttributeError:
+                pass
+            return root
+        # non-flat: child roots recompute cheaply through their own
+        # caches; memoize the merkle step on the child-root blob
         chunks = [
             t.hash_tree_root(getattr(value, n)) for n, t in self.fields
         ]
-        return merkleize(chunks)
+        blob = b"".join(chunks)
+        if hc is not None and hc[0] == blob:
+            return hc[1]
+        root = merkleize(chunks)
+        try:
+            object.__setattr__(value, "_hc", (blob, root))
+        except AttributeError:
+            pass
+        return root
 
     def default(self) -> ContainerValue:
         return self.value_class()

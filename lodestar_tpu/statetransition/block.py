@@ -16,6 +16,7 @@ from hashlib import sha256
 
 import numpy as np
 
+from ..ssz.cached import SszVec
 from ..config.beacon_config import compute_domain, compute_signing_root_from_roots
 from ..params import (
     BLS_WITHDRAWAL_PREFIX,
@@ -113,25 +114,17 @@ class BlockCtx:
         self._total_active: int | None = None
         self._pubkey2index: dict[bytes, int] | None = None
 
-    def pubkey2index(self) -> dict[bytes, int]:
-        """Registry pubkey -> index map, built once per block and kept
-        current across in-block registry appends (reference:
-        pubkey-index-map / Index2PubkeyCache, pubkeyCache.ts:2)."""
-        vals = self.state.validators
+    def pubkey2index(self) -> util.PubkeyIndexView:
+        """Registry pubkey -> index map, shared process-wide and synced
+        to this state's registry length (reference: pubkey-index-map /
+        Index2PubkeyCache, pubkeyCache.ts:2)."""
         if self._pubkey2index is None:
-            self._pubkey2index = {
-                bytes(v.pubkey): i for i, v in enumerate(vals)
-            }
-            self._pubkey2index_len = len(vals)
-        elif self._pubkey2index_len != len(vals):
-            for i in range(self._pubkey2index_len, len(vals)):
-                self._pubkey2index[bytes(vals[i].pubkey)] = i
-            self._pubkey2index_len = len(vals)
+            self._pubkey2index = util.PubkeyIndexView(self.state)
         return self._pubkey2index
 
     def shuffling(self, epoch: int) -> EpochShuffling:
         if epoch not in self._shufflings:
-            self._shufflings[epoch] = EpochShuffling(self.state, epoch)
+            self._shufflings[epoch] = util.get_shuffling(self.state, epoch)
         return self._shufflings[epoch]
 
     def proposer_index(self) -> int:
@@ -1021,7 +1014,7 @@ def process_withdrawals(ctx, payload) -> None:
     for w in expected:
         decrease_balance(state, int(w.validator_index), int(w.amount))
     if ctx.fork_seq >= ForkSeq.electra and partial_count:
-        state.pending_partial_withdrawals = list(
+        state.pending_partial_withdrawals = SszVec(
             state.pending_partial_withdrawals[partial_count:]
         )
     if expected:
@@ -1066,6 +1059,7 @@ def process_bls_to_execution_change(ctx, signed_change) -> None:
             ),
             "bad bls-to-execution-change signature",
         )
+    v = util.mut(state.validators, int(change.validator_index))
     v.withdrawal_credentials = (
         ETH1_ADDRESS_WITHDRAWAL_PREFIX
         + b"\x00" * 11
@@ -1179,7 +1173,7 @@ def compute_consolidation_epoch_and_update_churn(
 def switch_to_compounding_validator(ctx, index: int) -> None:
     state, types = ctx.state, ctx.types
     p = preset()
-    v = state.validators[index]
+    v = util.mut(state.validators, index)
     v.withdrawal_credentials = (
         COMPOUNDING_WITHDRAWAL_PREFIX + bytes(v.withdrawal_credentials)[1:]
     )
@@ -1253,6 +1247,7 @@ def process_consolidation_request(ctx, request) -> None:
         return
     if get_pending_balance_to_withdraw(state, source_index) > 0:
         return
+    source = util.mut(state.validators, source_index)
     source.exit_epoch = compute_consolidation_epoch_and_update_churn(
         cfg, state, source.effective_balance
     )

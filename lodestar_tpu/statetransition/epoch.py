@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..ssz.cached import SszVec
 from ..params import (
     BASE_REWARDS_PER_EPOCH,
     FAR_FUTURE_EPOCH,
@@ -117,7 +118,7 @@ def _phase0_attesting_masks(cache, state):
     best_delay = np.full(n, np.iinfo(np.int64).max, np.int64)
     best_proposer = np.full(n, -1, np.int64)
 
-    shuffling = EpochShuffling(state, cache.previous_epoch)
+    shuffling = util.get_shuffling(state, cache.previous_epoch)
     target_root = get_block_root(state, cache.previous_epoch)
     for att in state.previous_epoch_attestations:
         data = att.data
@@ -215,7 +216,7 @@ def process_justification_and_finalization(cache, state, types) -> None:
         # computation) — then no current-epoch attestation can have been
         # included either, so the balance is zero.
         cur_tgt = np.zeros(cache.n, bool)
-        shuffling = EpochShuffling(state, cache.current_epoch)
+        shuffling = util.get_shuffling(state, cache.current_epoch)
         try:
             cur_target_root = get_block_root(state, cache.current_epoch)
         except ValueError:
@@ -406,7 +407,9 @@ def process_registry_updates(cache, state) -> None:
 
     for index, v in enumerate(state.validators):
         if util.is_eligible_for_activation_queue(v, cache.fork_seq):
-            v.activation_eligibility_epoch = current_epoch + 1
+            util.mut(state.validators, index).activation_eligibility_epoch = (
+                current_epoch + 1
+            )
         elif (
             util.is_active_validator(v, current_epoch)
             and v.effective_balance <= cfg.EJECTION_BALANCE
@@ -415,8 +418,11 @@ def process_registry_updates(cache, state) -> None:
                 initiate_validator_exit_electra(cfg, state, index)
             else:
                 initiate_validator_exit(cfg, state, index)
+        v = state.validators[index]  # may have been replaced (CoW)
         if electra and util.is_eligible_for_activation(state, v):
-            v.activation_epoch = activation_epoch
+            util.mut(state.validators, index).activation_epoch = (
+                activation_epoch
+            )
 
     if not electra:
         queue = sorted(
@@ -435,7 +441,7 @@ def process_registry_updates(cache, state) -> None:
         else:
             churn = util.get_validator_churn_limit(cfg, state)
         for i in queue[:churn]:
-            state.validators[i].activation_epoch = activation_epoch
+            util.mut(state.validators, i).activation_epoch = activation_epoch
 
 
 # ---------------------------------------------------------------------------
@@ -497,9 +503,7 @@ def process_pending_deposits(cache, state, types) -> None:
     finalized_slot = compute_start_slot_at_epoch(
         state.finalized_checkpoint.epoch
     )
-    pubkey2index = {
-        bytes(v.pubkey): i for i, v in enumerate(state.validators)
-    }
+    pubkey2index = util.PubkeyIndexView(state)
 
     for dep in state.pending_deposits:
         if (
@@ -530,7 +534,7 @@ def process_pending_deposits(cache, state, types) -> None:
             _apply_pending_deposit(cfg, state, dep, pubkey2index, types)
         next_deposit_index += 1
 
-    state.pending_deposits = (
+    state.pending_deposits = SszVec(
         list(state.pending_deposits[next_deposit_index:]) + postponed
     )
     state.deposit_balance_to_consume = (
@@ -581,7 +585,7 @@ def process_pending_consolidations(cache, state) -> None:
         util.decrease_balance(state, pc.source_index, amount)
         increase_balance(state, pc.target_index, amount)
         done += 1
-    state.pending_consolidations = list(state.pending_consolidations[done:])
+    state.pending_consolidations = SszVec(state.pending_consolidations[done:])
 
 
 # ---------------------------------------------------------------------------
@@ -593,7 +597,7 @@ def process_eth1_data_reset(cache, state) -> None:
     p = preset()
     next_epoch = cache.current_epoch + 1
     if next_epoch % p.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
-        state.eth1_data_votes = []
+        state.eth1_data_votes = SszVec()
 
 
 def process_effective_balance_updates(cache, state) -> None:
@@ -622,7 +626,7 @@ def process_effective_balance_updates(cache, state) -> None:
             balance + down < v.effective_balance
             or v.effective_balance + up < balance
         ):
-            v.effective_balance = min(
+            util.mut(state.validators, index).effective_balance = min(
                 balance - balance % p.EFFECTIVE_BALANCE_INCREMENT, max_eb
             )
 
@@ -673,17 +677,17 @@ def process_historical_summaries_update(cache, state, types) -> None:
 
 
 def process_participation_record_updates(cache, state) -> None:
-    state.previous_epoch_attestations = list(
+    state.previous_epoch_attestations = SszVec(
         state.current_epoch_attestations
     )
-    state.current_epoch_attestations = []
+    state.current_epoch_attestations = SszVec()
 
 
 def process_participation_flag_updates(cache, state) -> None:
-    state.previous_epoch_participation = list(
+    state.previous_epoch_participation = SszVec(
         state.current_epoch_participation
     )
-    state.current_epoch_participation = [0] * len(state.validators)
+    state.current_epoch_participation = SszVec([0] * len(state.validators))
 
 
 def process_sync_committee_updates(cache, state, types) -> None:

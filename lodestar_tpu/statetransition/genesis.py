@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from hashlib import sha256
 
+from ..ssz.cached import SszVec
 from ..crypto.bls.fields import R as CURVE_ORDER
 from ..crypto.bls.signature import sk_to_pk
 from ..params import (
@@ -85,7 +86,7 @@ def create_interop_genesis_state(
         state.validators.append(v)
         state.balances.append(p.MAX_EFFECTIVE_BALANCE)
 
-    state.randao_mixes = [eth1_block_hash] * p.EPOCHS_PER_HISTORICAL_VECTOR
+    state.randao_mixes = SszVec([eth1_block_hash] * p.EPOCHS_PER_HISTORICAL_VECTOR)
     eth1 = types.Eth1Data.default()
     eth1.block_hash = eth1_block_hash
     eth1.deposit_count = len(pubkeys)
@@ -107,9 +108,9 @@ def create_interop_genesis_state(
 
     if fork_seq >= ForkSeq.altair:
         n = len(pubkeys)
-        state.previous_epoch_participation = [0] * n
-        state.current_epoch_participation = [0] * n
-        state.inactivity_scores = [0] * n
+        state.previous_epoch_participation = SszVec([0] * n)
+        state.current_epoch_participation = SszVec([0] * n)
+        state.inactivity_scores = SszVec([0] * n)
         _set_genesis_sync_committees(state, types, fork_seq)
     if fork_seq >= ForkSeq.bellatrix:
         # latest_execution_payload_header: pretend-merged genesis with

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..ssz.cached import SszVec
 from ..config.beacon_config import compute_domain
 from ..params import (
     DOMAIN_BEACON_PROPOSER,
@@ -145,9 +146,9 @@ def upgrade_to_altair(cfg, view: BeaconStateView, types) -> None:
     post = types.altair.BeaconState.default()
     _copy_fields(pre, post)
     _bump_fork(cfg, pre, post, cfg.ALTAIR_FORK_VERSION, types)
-    post.previous_epoch_participation = [0] * n
-    post.current_epoch_participation = [0] * n
-    post.inactivity_scores = [0] * n
+    post.previous_epoch_participation = SszVec([0] * n)
+    post.current_epoch_participation = SszVec([0] * n)
+    post.inactivity_scores = SszVec([0] * n)
     view.state = post
     view.fork = "altair"
 
@@ -210,7 +211,7 @@ def upgrade_to_capella(cfg, view: BeaconStateView, types) -> None:
     post.latest_execution_payload_header = hdr
     post.next_withdrawal_index = 0
     post.next_withdrawal_validator_index = 0
-    post.historical_summaries = []
+    post.historical_summaries = SszVec()
     view.state = post
     view.fork = "capella"
 
@@ -254,9 +255,9 @@ def upgrade_to_electra(cfg, view: BeaconStateView, types) -> None:
     post.earliest_consolidation_epoch = util.compute_activation_exit_epoch(
         cur
     )
-    post.pending_deposits = []
-    post.pending_partial_withdrawals = []
-    post.pending_consolidations = []
+    post.pending_deposits = SszVec()
+    post.pending_partial_withdrawals = SszVec()
+    post.pending_consolidations = SszVec()
     view.state = post
     view.fork = "electra"
 
@@ -281,7 +282,9 @@ def upgrade_to_electra(cfg, view: BeaconStateView, types) -> None:
 
 
 def _queue_entire_balance_and_reset_validator(state, index: int, types) -> None:
-    v = state.validators[index]
+    from .util import mut
+
+    v = mut(state.validators, index)
     balance = state.balances[index]
     state.balances[index] = 0
     v.effective_balance = 0

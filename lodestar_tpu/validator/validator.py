@@ -112,7 +112,7 @@ class Validator:
         view = self.api.head_state()
         st = view.state
         epoch = util.compute_epoch_at_slot(slot)
-        sh = util.EpochShuffling(st, epoch)
+        sh = util.get_shuffling(st, epoch)
         published = 0
         for ci, committee in enumerate(sh.committees_at_slot(slot)):
             owned = [

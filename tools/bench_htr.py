@@ -1,0 +1,93 @@
+"""Benchmark: incremental hashTreeRoot on a mainnet-preset beacon state.
+
+VERDICT r1 item 5 done-criterion: importing a block at mainnet preset
+with a 100k-validator state must re-hash only changed subtrees. This
+measures: cold full hash, warm no-change hash, warm hash after a
+block-import-like mutation set (1 proposer + ~128 attestations' worth
+of participation flags + a few balances), and structural clone time.
+
+Run: LODESTAR_PRESET=mainnet python tools/bench_htr.py [n_validators]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("LODESTAR_PRESET", "mainnet")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lodestar_tpu.ssz.cached import clone_value  # noqa: E402
+from lodestar_tpu.types import factory  # noqa: E402
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    types = factory.ssz_types()
+    ns = types.by_fork["altair"]
+    t = ns.BeaconState
+    state = t.default()
+    far = 2**64 - 1
+    for i in range(n):
+        state.validators.append(
+            types.Validator(
+                pubkey=i.to_bytes(48, "little"),
+                withdrawal_credentials=(i * 7).to_bytes(32, "little"),
+                effective_balance=32_000_000_000,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=far,
+                withdrawable_epoch=far,
+            )
+        )
+    state.balances.extend([32_000_000_000] * n)
+    state.previous_epoch_participation.extend([7] * n)
+    state.current_epoch_participation.extend([0] * n)
+    state.inactivity_scores.extend([0] * n)
+
+    t0 = time.perf_counter()
+    r0 = t.hash_tree_root(state)
+    cold = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    assert t.hash_tree_root(state) == r0
+    nochange = time.perf_counter() - t0
+
+    # block-import-like mutation set
+    state.slot += 1
+    state.latest_block_header.state_root = b"\x11" * 32
+    state.block_roots[state.slot % len(state.block_roots)] = b"\x22" * 32
+    state.validators[n // 2].effective_balance += 1
+    for i in range(0, 128 * 64, 64):  # ~128 committees' first members
+        state.current_epoch_participation[i % n] = 7
+    for i in range(16):
+        state.balances[(i * 997) % n] += 1000
+
+    t0 = time.perf_counter()
+    r1 = t.hash_tree_root(state)
+    warm = time.perf_counter() - t0
+    assert r1 != r0
+
+    t0 = time.perf_counter()
+    cl = clone_value(t, state)
+    clone_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    assert t.hash_tree_root(cl) == r1
+    clone_hash = time.perf_counter() - t0
+
+    print(
+        f"validators={n}\n"
+        f"cold_full_hash_s={cold:.3f}\n"
+        f"warm_nochange_hash_s={nochange:.4f}\n"
+        f"warm_after_block_import_s={warm:.4f}  (speedup {cold / warm:.0f}x)\n"
+        f"structural_clone_s={clone_s:.3f}\n"
+        f"clone_first_hash_s={clone_hash:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()
