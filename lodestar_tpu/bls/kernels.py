@@ -61,28 +61,33 @@ def _to_affine(ops, p: C.JacPoint):
     return C.FQ2_OPS.norm(x), C.FQ2_OPS.norm(y)
 
 
-# --- fused whole-pipeline kernels ------------------------------------------
+# --- staged device programs ------------------------------------------------
 #
-# Round-1 ran the pipeline as six separate jitted stages with eager glue
-# (concats, normalize chains, constants) between them. Profiling on the
-# real chip showed the staged compute at ~3 ms total but the eager glue
-# at ~1 s: every eager op is a separate host->device dispatch over the
-# tunnel. Fusing the whole verify into ONE jitted program removes all of
-# it; jit caches per (batch-shape, limb-profile) and the persistent
-# compile cache (utils/jaxcache.py) keeps later processes warm.
+# Round-1 ran six jitted stages with EAGER glue between them (concats,
+# normalizes, constants) — ~1 s of per-op host->device dispatches over
+# the tunnel per verify. Round-2 first fused everything into ONE jit,
+# which removed the glue but exploded XLA compile time (>10 min on the
+# real chip; the driver's bench timed out). Measured per-piece compile
+# on the chip: ladders ~9 s, unrolled jac_sum tree ~30 s, Miller loop
+# ~94 s, product+final-exp ~357 s. The design point is therefore FOUR
+# jitted stages — all glue inside a stage, ~1 ms dispatch between
+# stages — with scan-based reductions (curve.jac_sum_scan,
+# pairing._fq12_masked_product, pairing._pow_u) that compile one body
+# instead of one per tree level. The final-exp stage has batch shape
+# (), so it compiles exactly once for every bucket size; the persistent
+# cache (utils/jaxcache.py) makes later processes start warm.
 
 
 @jax.jit
-def _fused_verify_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
-    """Device program for run_verify_batch: random-weighted ladders,
-    masked G2 aggregation, one batched Miller loop over n+1 pairs, one
-    shared final exponentiation. Returns a scalar bool."""
+def _stage_prepare_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
+    """Random-weighted ladders + masked G2 aggregation + batched
+    affine conversion + pairing-input assembly (n+1 pairs)."""
     rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
     rsig = C.scalar_mul(C.FQ2_OPS, sig.x, sig.y, bits, sig.inf)
     rsig = C.jac_select(
         C.FQ2_OPS, mask, rsig, C.jac_infinity(C.FQ2_OPS, mask.shape)
     )
-    s = C.jac_sum(C.FQ2_OPS, rsig)
+    s = C.jac_sum_scan(C.FQ2_OPS, rsig)
     s_aff = _to_affine(C.FQ2_OPS, s)
     rpk_aff = _to_affine(C.FQ_OPS, rpk)
     ngx, ngy = _g1_neg_gen((1,))
@@ -91,17 +96,16 @@ def _fused_verify_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
     qx = _cat_fq2((hx[0], hx[1]), s_aff[0])
     qy = _cat_fq2((hy[0], hy[1]), s_aff[1])
     full_mask = jnp.concatenate([mask, jnp.asarray([True])])
-    f = pairing.miller_loop(px, py, qx, qy)
-    prod = pairing._fq12_masked_product(f, full_mask)
-    return pairing.fq12_is_one(pairing.final_exponentiation(prod))
+    return px, py, qx, qy, full_mask
 
 
 @jax.jit
-def _fused_verify_same_message(
+def _stage_prepare_same_message(
     pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask
 ):
-    """Device program for run_verify_same_message: both MSMs + a
-    2-pair pairing check fused (aggregateWithRandomness on device)."""
+    """Both random-weighted MSMs (aggregateWithRandomness on device —
+    the reference's measured main-thread bottleneck, jobItem.ts:60-75)
+    + pairing-input assembly (2 pairs)."""
     rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
     rsig = C.scalar_mul(C.FQ2_OPS, sig.x, sig.y, bits, sig.inf)
     rpk = C.jac_select(
@@ -110,20 +114,50 @@ def _fused_verify_same_message(
     rsig = C.jac_select(
         C.FQ2_OPS, mask, rsig, C.jac_infinity(C.FQ2_OPS, mask.shape)
     )
-    apk_aff = _to_affine(C.FQ_OPS, C.jac_sum(C.FQ_OPS, rpk))
-    asig_aff = _to_affine(C.FQ2_OPS, C.jac_sum(C.FQ2_OPS, rsig))
+    apk_aff = _to_affine(C.FQ_OPS, C.jac_sum_scan(C.FQ_OPS, rpk))
+    asig_aff = _to_affine(C.FQ2_OPS, C.jac_sum_scan(C.FQ2_OPS, rsig))
     ngx, ngy = _g1_neg_gen((1,))
     px = _cat_fq(apk_aff[0], ngx)
     py = _cat_fq(apk_aff[1], ngy)
     qx = _cat_fq2((hx[0], hx[1]), asig_aff[0])
     qy = _cat_fq2((hy[0], hy[1]), asig_aff[1])
-    pair_mask = jnp.asarray([True, True])
-    f = pairing.miller_loop(px, py, qx, qy)
-    prod = pairing._fq12_masked_product(f, pair_mask)
+    return px, py, qx, qy, jnp.asarray([True, True])
+
+
+_stage_miller = jax.jit(pairing.miller_loop)
+_stage_product = jax.jit(pairing._fq12_masked_product)
+
+
+@jax.jit
+def _stage_final(prod):
+    """Shared final exponentiation + ==1 test. Batch shape () — one
+    compile serves every bucket size."""
     return pairing.fq12_is_one(pairing.final_exponentiation(prod))
 
 
+def _run_pipeline(prepare, pk, h, sig, rand_bits, mask):
+    px, py, qx, qy, pair_mask = prepare(
+        pk, h[0], h[1], sig, rand_bits, mask
+    )
+    f = _stage_miller(px, py, qx, qy)
+    prod = _stage_product(f, pair_mask)
+    return _stage_final(prod)
+
+
 # --- host-orchestrated kernels --------------------------------------------
+
+
+def run_verify_batch_async(
+    pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask
+):
+    """Like run_verify_batch but returns the device () bool WITHOUT
+    reading it back. Through the tunneled TPU a fresh-result readback
+    costs ~100 ms (measured; dispatches are ~0.1 ms), so callers that
+    can batch verdicts submit many verifies and read once — the same
+    amortization the reference's 100 ms gossip buffering makes
+    (index.ts:59-74)."""
+    jaxcache.enable()
+    return _run_pipeline(_stage_prepare_batch, pk, h, sig, rand_bits, mask)
 
 
 def run_verify_batch(pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask) -> bool:
@@ -141,7 +175,7 @@ def run_verify_batch(pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask) -> boo
     if not np.any(np.asarray(mask)):
         return True  # all-padding call is vacuously true
     return bool(
-        _fused_verify_batch(pk, h[0], h[1], sig, rand_bits, mask)
+        _run_pipeline(_stage_prepare_batch, pk, h, sig, rand_bits, mask)
     )
 
 
@@ -158,7 +192,9 @@ def run_verify_same_message(pk: C.JacPoint, h, sig: C.JacPoint, rand_bits, mask)
     if not np.any(np.asarray(mask)):
         return True
     return bool(
-        _fused_verify_same_message(pk, h[0], h[1], sig, rand_bits, mask)
+        _run_pipeline(
+            _stage_prepare_same_message, pk, h, sig, rand_bits, mask
+        )
     )
 
 

@@ -229,6 +229,82 @@ def jac_add(ops: _Ops, p: JacPoint, q: JacPoint) -> JacPoint:
     return out
 
 
+def jac_add_incomplete(ops: _Ops, p: JacPoint, q: JacPoint) -> JacPoint:
+    """Jacobian addition WITHOUT the p == ±q fallback paths.
+
+    Sound for reduction trees over random-linear-combination terms: the
+    weights r_i are secret verifier randomness, so an adversary cannot
+    force equal partial sums except with negligible probability — and a
+    collision yields garbage coordinates, which fail the final pairing
+    check (fail-closed; the caller's per-set retry path takes over).
+    Infinity inputs are still handled exactly via the flags. Dropping
+    the is_zero/doubling selects halves the compiled body size — the
+    add is the scan body of jac_sum_scan, so compile time matters.
+    """
+    z1z1 = ops.sqr(p.z)
+    z2z2 = ops.sqr(q.z)
+    u1 = ops.mul(p.x, z2z2)
+    u2 = ops.mul(q.x, z1z1)
+    s1 = ops.mul(ops.mul(p.y, q.z), z2z2)
+    s2 = ops.mul(ops.mul(q.y, p.z), z1z1)
+    h = ops.norm(ops.sub(u2, u1))
+    r = ops.norm(ops.sub(s2, s1))
+    h2 = ops.sqr(h)
+    h3 = ops.mul(h2, h)
+    u1h2 = ops.mul(u1, h2)
+    x3 = ops.norm(
+        ops.sub(ops.sub(ops.sqr(r), h3), ops.mul_small(u1h2, 2))
+    )
+    y3 = ops.norm(
+        ops.sub(ops.mul(r, ops.norm(ops.sub(u1h2, x3))), ops.mul(s1, h3))
+    )
+    z3 = ops.norm(ops.mul(ops.mul(p.z, q.z), h))
+    out = JacPoint(x3, y3, z3, p.inf | q.inf)
+    out = jac_select(ops, p.inf, q, out)
+    out = jac_select(ops, q.inf, p, out)
+    return out
+
+
+def jac_sum_scan(ops: _Ops, p: JacPoint, par: int = 8) -> JacPoint:
+    """Batch-sum via a two-level reduction tuned for XLA compile time:
+    a `lax.scan` of par-wide incomplete adds over n/par chunks (ONE
+    compiled body regardless of n) followed by a log2(par)-deep unrolled
+    tree. Replaces the fully unrolled log2(n) tree whose every level
+    compiled its own large add (VERDICT r1: fused-kernel compile blowup).
+    The `par` axis is also the natural mesh-sharding axis multi-chip."""
+    p = jac_normalize(ops, p)
+    n = _batch_shape(ops, p.x)[0]
+    if n <= par:
+        return jac_sum(ops, p)
+    chunks = -(-n // par)
+    pad = chunks * par - n
+    if pad:
+        pad_inf = jac_infinity(ops, (pad,) + _batch_shape(ops, p.x)[1:])
+        p = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], 0), p, pad_inf
+        )
+
+    def reshape(t):
+        return t.reshape((chunks, par) + t.shape[1:])
+
+    stacked = jax.tree.map(reshape, p)
+    acc0 = jac_infinity(ops, (par,) + _batch_shape(ops, p.x)[1:])
+
+    def body(acc, q):
+        return jac_normalize(ops, jac_add_incomplete(ops, acc, q)), None
+
+    acc, _ = jax.lax.scan(body, jac_normalize(ops, acc0), stacked)
+    # unrolled log2(par) tree over the accumulator lanes
+    m = par
+    while m > 1:
+        half = m // 2
+        bot = jax.tree.map(lambda t: t[:half], acc)
+        top = jax.tree.map(lambda t: t[half:m], acc)
+        acc = jac_add_incomplete(ops, bot, top)
+        m = half
+    return acc
+
+
 def scalar_mul(ops: _Ops, qx, qy, bits: jax.Array, q_inf=None) -> JacPoint:
     """[k]Q for per-element scalars given as a bit tensor.
 

@@ -46,23 +46,12 @@ from .curve import FQ2_OPS, JacPoint, jac_from_affine, jac_select
 
 _U = -BLS_X  # positive |x|, low hamming weight
 
-# |x| has hamming weight 6, so MSB-first square-and-multiply decomposes
-# into runs of squarings with only 5 multiplies. Precomputing the run
-# structure lets the hot loops scan over UNCONDITIONAL square/double
-# bodies (no per-iteration multiply+select) and unroll the 5
-# multiply/add steps between runs — the same structural trick blst's
-# serial code gets from branching on the exponent bits, expressed here
-# as static program structure (branch-free on device).
-_SEGMENTS: list[tuple[int, bool]] = []
-_run = 0
-for _b in bin(_U)[3:]:
-    _run += 1
-    if _b == "1":
-        _SEGMENTS.append((_run, True))
-        _run = 0
-if _run:
-    _SEGMENTS.append((_run, False))
-del _run, _b
+# MSB-first bits of |x| after the leading 1: the shared control tensor
+# of the Miller loop and the cyclotomic exponentiations. Both use ONE
+# scan with a selected multiply — hamming-structured unrolling (runs of
+# squarings + unrolled multiplies) compiles 6x the scan bodies for a
+# <0.1 ms runtime win and overwhelms the XLA pipeline on-chip.
+_U_BITS = np.asarray([int(b) for b in bin(_U)[3:]], dtype=bool)
 
 
 def _sparse_line(l0, l2, l3, batch):
@@ -133,6 +122,14 @@ def miller_loop(px, py, qx, qy):
     the twist (Fq2 batches). Infinity inputs are NOT handled here — mask
     them out at the product stage (reference rejects identity points at
     validation time, chain/validation/*).
+
+    ONE `lax.scan` over the 63 post-MSB bits of |x| with an
+    unconditional double step and a selected add step. The add is safe
+    to compute every iteration: ladder partials k satisfy 2 <= k <
+    2^64 << r, so T never equals +-Q. (The earlier run-structured form
+    — one scan per squaring run + unrolled adds — compiled 6 scan
+    bodies; its XLA program was large enough to break the remote
+    compile path on the real chip.)
     """
     px, py = L.normalize(px), L.normalize(py)
     qx = FQ2_OPS.norm(qx)
@@ -140,10 +137,10 @@ def miller_loop(px, py, qx, qy):
     batch = jnp.broadcast_shapes(
         px.v.shape[:-1], qx[0].v.shape[:-1]
     )
-    T = jac_from_affine(FQ2_OPS, qx, qy)
-    f = _norm12(tower.fq12_one(batch))
+    T0 = jac_from_affine(FQ2_OPS, qx, qy)
+    f0 = _norm12(tower.fq12_one(batch))
 
-    def dbl_body(carry, _):
+    def body(carry, bit):
         T, f = carry
         T2, (d0, d2, d3) = _dbl_step(T, px, py)
         f2 = _norm12(
@@ -151,17 +148,15 @@ def miller_loop(px, py, qx, qy):
                 tower.fq12_sqr(f), _sparse_line(d0, d2, d3, batch)
             )
         )
-        return (T2, f2), None
+        T3, (a0, a2, a3) = _add_step(T2, qx, qy, px, py)
+        f3 = _norm12(
+            tower.fq12_mul(f2, _sparse_line(a0, a2, a3, batch))
+        )
+        T_next = jac_select(FQ2_OPS, bit, T3, T2)
+        f_next = tower.fq12_select(bit, f3, f2)
+        return (T_next, f_next), None
 
-    # runs of doubling-only iterations; the chord-line add step only at
-    # the 5 set bits of |x| (unrolled, no per-iteration select)
-    for run, has_add in _SEGMENTS:
-        (T, f), _ = jax.lax.scan(dbl_body, (T, f), None, length=run)
-        if has_add:
-            T, (a0, a2, a3) = _add_step(T, qx, qy, px, py)
-            f = _norm12(
-                tower.fq12_mul(f, _sparse_line(a0, a2, a3, batch))
-            )
+    (T, f), _ = jax.lax.scan(body, (T0, f0), jnp.asarray(_U_BITS))
     return tower.fq12_conj(f)
 
 
@@ -171,19 +166,22 @@ def miller_loop(px, py, qx, qy):
 
 
 def _pow_u(f):
-    """f^|x| on the cyclotomic subgroup: runs of cyclotomic squarings
-    (one scan per run) with the 5 multiplies of |x|'s hamming weight
-    unrolled between runs — no per-iteration multiply or select."""
+    """f^|x| on the cyclotomic subgroup: ONE `lax.scan` over the 63
+    post-MSB exponent bits with a square/(select multiply) body.
+
+    Round-2 note: the run-structured variant (one scan per squaring run,
+    5 unrolled multiplies) instantiated 6 scans per call and 30 across
+    the final-exponentiation chain — measured 357 s of XLA compile on
+    the real chip. One scan per call compiles ~6x fewer bodies; the
+    extra per-iteration multiply+select is noise at runtime (<0.1 ms)."""
     f = _norm12(f)
 
-    def sqr_body(c, _):
-        return _norm12(tower.fq12_cyclotomic_sqr(c)), None
+    def body(c, bit):
+        c2 = _norm12(tower.fq12_cyclotomic_sqr(c))
+        c3 = _norm12(tower.fq12_mul(c2, f))
+        return tower.fq12_select(bit, c3, c2), None
 
-    r = f
-    for run, has_mul in _SEGMENTS:
-        r, _ = jax.lax.scan(sqr_body, r, None, length=run)
-        if has_mul:
-            r = _norm12(tower.fq12_mul(r, f))
+    r, _ = jax.lax.scan(body, f, jnp.asarray(_U_BITS))
     return r
 
 
@@ -234,12 +232,34 @@ def fq12_is_one(f) -> jax.Array:
     return out
 
 
-def _fq12_masked_product(f, mask):
-    """Tree-reduce prod_i f_i over axis 0, taking 1 where mask is False."""
+def _fq12_masked_product(f, mask, par: int = 8):
+    """prod_i f_i over axis 0 (1 where mask is False) via a par-lane
+    `lax.scan` plus a log2(par) unrolled tree — one compiled multiply
+    body regardless of batch size (compile-time bounded; the fully
+    unrolled log-depth tree re-compiled a large fq12_mul per level)."""
     batch = f[0][0][0].v.shape[:-1]
     one = _norm12(tower.fq12_one(batch))
     f = _norm12(tower.fq12_select(mask, f, one))
     n = batch[0]
+    if n > par:
+        chunks = -(-n // par)
+        pad = chunks * par - n
+        if pad:
+            pad_one = _norm12(tower.fq12_one((pad,) + batch[1:]))
+            f = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], 0), f, pad_one
+            )
+
+        stacked = jax.tree.map(
+            lambda t: t.reshape((chunks, par) + t.shape[1:]), f
+        )
+        acc0 = _norm12(tower.fq12_one((par,) + batch[1:]))
+
+        def body(acc, g):
+            return _norm12(tower.fq12_mul(acc, g)), None
+
+        f, _ = jax.lax.scan(body, acc0, stacked)
+        n = par
     while n > 1:
         half = (n + 1) // 2
         bot = jax.tree.map(lambda t: t[:half], f)

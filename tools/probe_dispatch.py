@@ -1,0 +1,84 @@
+"""Characterize axon-tunnel dispatch costs: same-buffer replay vs
+evolving device buffers vs fresh uploads vs async pipelining."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lodestar_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable()
+
+
+@jax.jit
+def f(x):
+    # nontrivial: a few fused ops, keeps shape
+    return (x * 3 + 1) ^ (x >> 2)
+
+
+def main() -> None:
+    print(f"platform={jax.default_backend()}", flush=True)
+    x0 = jnp.asarray(np.arange(2048, dtype=np.int32))
+    jax.block_until_ready(f(x0))
+
+    # A: same buffer repeated
+    t0 = time.perf_counter()
+    for _ in range(20):
+        jax.block_until_ready(f(x0))
+    print(f"A same-buffer blocking: {(time.perf_counter() - t0) / 20 * 1e3:.1f} ms/call", flush=True)
+
+    # B: evolving device buffer
+    x = x0
+    t0 = time.perf_counter()
+    for _ in range(20):
+        x = f(x)
+        jax.block_until_ready(x)
+    print(f"B evolving blocking: {(time.perf_counter() - t0) / 20 * 1e3:.1f} ms/call", flush=True)
+
+    # B2: evolving, block only at end
+    x = x0
+    t0 = time.perf_counter()
+    for _ in range(20):
+        x = f(x)
+    jax.block_until_ready(x)
+    print(f"B2 evolving async: {(time.perf_counter() - t0) / 20 * 1e3:.1f} ms/call", flush=True)
+
+    # C: fresh upload each call (blocking)
+    t0 = time.perf_counter()
+    for i in range(10):
+        xi = jnp.asarray(np.arange(2048, dtype=np.int32) + i)
+        jax.block_until_ready(f(xi))
+    print(f"C fresh-upload blocking: {(time.perf_counter() - t0) / 10 * 1e3:.1f} ms/call", flush=True)
+
+    # D: fresh uploads, block only at end (pipelined)
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(10):
+        xi = jnp.asarray(np.arange(2048, dtype=np.int32) + i)
+        outs.append(f(xi))
+    jax.block_until_ready(outs)
+    print(f"D fresh-upload async: {(time.perf_counter() - t0) / 10 * 1e3:.1f} ms/call", flush=True)
+
+    # E: upload-only cost
+    t0 = time.perf_counter()
+    for i in range(10):
+        jax.block_until_ready(jax.device_put(np.arange(2048, dtype=np.int32) + i))
+    print(f"E device_put blocking: {(time.perf_counter() - t0) / 10 * 1e3:.1f} ms/call", flush=True)
+
+    # F: download-only cost (scalar readback)
+    s = f(x0)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        int(np.asarray(s[0]))
+    print(f"F scalar readback: {(time.perf_counter() - t0) / 10 * 1e3:.1f} ms/call", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Probe per-stage compile+run times of the BLS pipeline on the default
+JAX platform (the tunneled TPU under axon). Diagnoses bench stalls."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from lodestar_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable()
+
+from lodestar_tpu.crypto.bls import curve as oc  # noqa: E402
+from lodestar_tpu.ops import curve as C  # noqa: E402
+from lodestar_tpu.ops import fq, pairing, tower  # noqa: E402
+from lodestar_tpu.ops import limbs as L  # noqa: E402
+
+
+def t(label, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    out2 = fn()
+    jax.block_until_ready(out2)
+    t2 = time.perf_counter()
+    print(
+        f"{label}: compile+run {t1 - t0:.2f}s, steady {t2 - t1:.4f}s",
+        flush=True,
+    )
+    return out
+
+
+def main() -> None:
+    print(f"platform={jax.default_backend()}", flush=True)
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+    a = L.from_ints([3 + i for i in range(n)])
+    b = L.from_ints([5 + i for i in range(n)])
+    t("fq.mul batch", jax.jit(fq.mul).lower(a, b).compile if False else lambda: jax.jit(fq.mul)(a, b))
+
+    # G1 scalar ladder, 64-bit, batch n
+    pks = [oc.g1_mul(oc.G1_GEN, 1000 + i) for i in range(n)]
+    pk = C.g1_batch_from_ints(pks)
+    bits = C.scalars_to_bits([(0x9E37 + i) | 1 for i in range(n)], 64)
+    f = jax.jit(lambda x, y, bb, i: C.scalar_mul(C.FQ_OPS, x, y, bb, i))
+    t("g1 scalar_mul x64 ladder", lambda: f(pk.x, pk.y, bits, pk.inf))
+
+    # G2 scalar ladder
+    hs = [oc.g2_mul(oc.G2_GEN, 7 + i) for i in range(n)]
+    h = C.g2_batch_from_ints(hs)
+    f2 = jax.jit(lambda x, y, bb, i: C.scalar_mul(C.FQ2_OPS, x, y, bb, i))
+    t("g2 scalar_mul x64 ladder", lambda: f2(h.x, h.y, bits, h.inf))
+
+    # jac_sum tree over n G2 points
+    fsum = jax.jit(lambda p: C.jac_sum(C.FQ2_OPS, p))
+    t("g2 jac_sum tree", lambda: fsum(h))
+
+    # fq inversion (Fermat)
+    t("fq.inv", lambda: jax.jit(fq.inv)(a))
+
+    # miller loop batch n
+    px = L.from_ints([p[0] for p in pks])
+    py = L.from_ints([p[1] for p in pks])
+    qx = tower.fq2_from_ints([p[0] for p in hs])
+    qy = tower.fq2_from_ints([p[1] for p in hs])
+    fm = jax.jit(pairing.miller_loop)
+    fout = t("miller_loop", lambda: fm(px, py, qx, qy))
+
+    # masked product + final exp
+    mask = jnp.ones((n,), jnp.bool_)
+    fp = jax.jit(
+        lambda ff, m: pairing.fq12_is_one(
+            pairing.final_exponentiation(pairing._fq12_masked_product(ff, m))
+        )
+    )
+    t("product+final_exp", lambda: fp(fout, mask))
+
+
+if __name__ == "__main__":
+    main()
