@@ -1,0 +1,144 @@
+"""Blob sidecar production + validation (deneb data availability).
+
+Reference analog: chain/validation/blobSidecar.ts
+(validateBlobSidecars: index bounds, header/block binding, KZG
+commitment inclusion proof, batched KZG proof verification) and
+produceBlock blob bundle assembly
+(produceBlock/validateBlobsAndKzgCommitments.ts). KZG math:
+crypto/kzg.py (c-kzg analog).
+"""
+
+from __future__ import annotations
+
+from ..crypto import kzg
+from ..params import preset
+from ..ssz.proofs import (
+    container_field_branch,
+    is_valid_merkle_branch,
+    merkle_branch,
+)
+
+
+class BlobError(ValueError):
+    pass
+
+
+def _commitment_list_layout(types, fork: str):
+    body_t = types.by_fork[fork].BeaconBlockBody
+    ct = body_t.field_types["blob_kzg_commitments"]
+    list_depth = (ct.limit - 1).bit_length()
+    field_idx = body_t.field_names.index("blob_kzg_commitments")
+    field_depth = (len(body_t.fields) - 1).bit_length()
+    return body_t, ct, list_depth, field_idx, field_depth
+
+
+def inclusion_proof_gindex(types, fork: str, index: int) -> tuple[int, int]:
+    """(path_index, depth) of commitment `index` under the body root:
+    list chunks (list_depth) -> length mix-in (1) -> body field tree."""
+    _, _, list_depth, field_idx, field_depth = _commitment_list_layout(
+        types, fork
+    )
+    depth = list_depth + 1 + field_depth
+    path = (field_idx << (list_depth + 1)) | index  # mix-in bit = 0
+    return path, depth
+
+
+def compute_inclusion_proof(types, fork: str, body, index: int) -> list[bytes]:
+    """Sibling branch proving body.blob_kzg_commitments[index] against
+    the body's hash tree root."""
+    body_t, ct, list_depth, field_idx, _ = _commitment_list_layout(
+        types, fork
+    )
+    comms = body.blob_kzg_commitments
+    chunks = [ct.element_type.hash_tree_root(c) for c in comms]
+    inner = merkle_branch(chunks, index, limit=ct.limit)
+    length_leaf = len(comms).to_bytes(32, "little")
+    _, field_branch, _ = container_field_branch(
+        body_t, body, "blob_kzg_commitments"
+    )
+    return inner + [length_leaf] + field_branch
+
+
+def verify_blob_sidecar_inclusion_proof(types, fork: str, sidecar) -> bool:
+    """Spec verify_blob_sidecar_inclusion_proof."""
+    _, ct, _, _, _ = _commitment_list_layout(types, fork)
+    path, depth = inclusion_proof_gindex(types, fork, int(sidecar.index))
+    leaf = ct.element_type.hash_tree_root(sidecar.kzg_commitment)
+    return is_valid_merkle_branch(
+        leaf,
+        [bytes(b) for b in sidecar.kzg_commitment_inclusion_proof],
+        depth,
+        path,
+        bytes(sidecar.signed_block_header.message.body_root),
+    )
+
+
+def blob_sidecars_from_block(
+    types, fork: str, signed_block, blobs: list[bytes], proofs: list[bytes]
+) -> list:
+    """Producer side: wrap blobs into BlobSidecars with inclusion
+    proofs (reference: beacon API publishBlock blob bundle split)."""
+    ns = types.by_fork[fork]
+    body = signed_block.message.body
+    comms = body.blob_kzg_commitments
+    if not (len(blobs) == len(proofs) == len(comms)):
+        raise BlobError("blobs/proofs/commitments length mismatch")
+    header = types.BeaconBlockHeader.default()
+    header.slot = signed_block.message.slot
+    header.proposer_index = signed_block.message.proposer_index
+    header.parent_root = bytes(signed_block.message.parent_root)
+    header.state_root = bytes(signed_block.message.state_root)
+    header.body_root = ns.BeaconBlockBody.hash_tree_root(body)
+    signed_header = types.SignedBeaconBlockHeader.default()
+    signed_header.message = header
+    signed_header.signature = bytes(signed_block.signature)
+    out = []
+    for i, (blob, proof, comm) in enumerate(zip(blobs, proofs, comms)):
+        sc = ns.BlobSidecar.default()
+        sc.index = i
+        sc.blob = bytes(blob)
+        sc.kzg_commitment = bytes(comm)
+        sc.kzg_proof = bytes(proof)
+        sc.signed_block_header = signed_header
+        sc.kzg_commitment_inclusion_proof = compute_inclusion_proof(
+            types, fork, body, i
+        )
+        out.append(sc)
+    return out
+
+
+def validate_blob_sidecars(
+    types, fork: str, block_root: bytes, block, sidecars
+) -> None:
+    """Data-availability check for an imported block: every commitment
+    must be covered by a sidecar bound to this block, with a valid
+    inclusion proof and a valid (batched) KZG proof. Raises BlobError.
+    Reference: validateBlobSidecars (chain/validation/blobSidecar.ts) +
+    verifyBlocksDataAvailability (chain/blocks/)."""
+    p = preset()
+    comms = [bytes(c) for c in block.body.blob_kzg_commitments]
+    if len(sidecars) != len(comms):
+        raise BlobError(
+            f"expected {len(comms)} sidecars, got {len(sidecars)}"
+        )
+    header_t = types.BeaconBlockHeader
+    for i, sc in enumerate(sidecars):
+        if int(sc.index) != i:
+            raise BlobError(f"sidecar {i} has index {int(sc.index)}")
+        if int(sc.index) >= p.MAX_BLOB_COMMITMENTS_PER_BLOCK:
+            raise BlobError("sidecar index out of range")
+        if bytes(sc.kzg_commitment) != comms[i]:
+            raise BlobError(f"sidecar {i} commitment mismatch")
+        hdr_root = header_t.hash_tree_root(sc.signed_block_header.message)
+        if hdr_root != block_root:
+            raise BlobError(f"sidecar {i} not bound to block")
+        if not verify_blob_sidecar_inclusion_proof(types, fork, sc):
+            raise BlobError(f"sidecar {i} inclusion proof invalid")
+    if comms:
+        ok = kzg.verify_blob_kzg_proof_batch(
+            [bytes(sc.blob) for sc in sidecars],
+            comms,
+            [bytes(sc.kzg_proof) for sc in sidecars],
+        )
+        if not ok:
+            raise BlobError("batched blob KZG proof verification failed")

@@ -69,6 +69,7 @@ def _load():
             ("blsn_g2_subgroup_check", ctypes.c_int),
             ("blsn_pairing_product_is_one", ctypes.c_int),
             ("blsn_miller_loop", ctypes.c_int),
+            ("blsn_g1_msm", ctypes.c_int),
         ):
             getattr(lib, name).restype = res
         _lib = lib
@@ -168,6 +169,22 @@ def pairing_product_is_one(pairs) -> bool:
     if rc < 0:
         raise NativeError("invalid pairing input")
     return rc == 1
+
+
+def g1_msm(pts, scalars) -> "tuple | None":
+    """Pippenger multi-scalar multiplication: sum_i scalars[i]*pts[i].
+    pts: list of oracle int tuples (None = infinity); scalars: ints."""
+    lib = _load()
+    n = len(pts)
+    buf = b"".join(g1_to_bytes(p) for p in pts)
+    sc = b"".join((int(k) % R_ORDER).to_bytes(32, "big") for k in scalars)
+    out = ctypes.create_string_buffer(96)
+    if lib.blsn_g1_msm(buf, sc, n, out) != 1:
+        raise NativeError("invalid G1 point in MSM")
+    return g1_from_bytes_affine(out.raw)
+
+
+R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 
 
 def g1_mul(pt, k: int):

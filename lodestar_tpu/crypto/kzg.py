@@ -1,0 +1,462 @@
+"""KZG polynomial commitments for EIP-4844 blob sidecars.
+
+Reference analog: the `c-kzg` native library loaded at node startup
+(beacon-node: node/nodejs.ts:162-165 initCKZG/loadEthereumTrustedSetup)
+and used by blob validation (chain/validation/blobSidecar.ts) and block
+production (produceBlock/validateBlobsAndKzgCommitments.ts). Fresh
+implementation of consensus-specs deneb/polynomial-commitments.md.
+
+Group arithmetic runs on the native C backend (csrc/bls381.c — incl. a
+Pippenger MSM for the 4096-point lagrange lincombs) with the
+pure-Python oracle as fallback. Scalar-field (Fr) arithmetic is plain
+Python ints with Montgomery batch inversion.
+
+Trusted setup: `load_trusted_setup(path)` reads the standard JSON
+format ({"g1_lagrange": [...48B hex...], "g2_monomial": [...]}), so the
+ceremony output used in production drops in. For tests/dev,
+`dev_trusted_setup()` generates an **INSECURE** setup from a known
+secret tau (the whole point of the ceremony is that tau is unknown —
+never use the dev setup outside tests), cached on disk after first
+generation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from hashlib import sha256
+from pathlib import Path
+
+from . import bls as _bls  # noqa: F401  (package init side effects)
+from .bls import curve as oc
+from .bls import native
+
+BLS_MODULUS = (
+    0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+)
+PRIMITIVE_ROOT_OF_UNITY = 7
+BYTES_PER_FIELD_ELEMENT = 32
+FIELD_ELEMENTS_PER_BLOB = 4096
+BYTES_PER_BLOB = BYTES_PER_FIELD_ELEMENT * FIELD_ELEMENTS_PER_BLOB
+
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVH"
+RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBAT"
+
+G1_POINT_AT_INFINITY_COMPRESSED = b"\xc0" + b"\x00" * 47
+
+
+class KzgError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Fr helpers
+# ---------------------------------------------------------------------------
+
+
+def _fr_inv(a: int) -> int:
+    return pow(a, BLS_MODULUS - 2, BLS_MODULUS)
+
+
+def _fr_batch_inv(xs: list[int]) -> list[int]:
+    """Montgomery trick: one inversion + 3n multiplications."""
+    n = len(xs)
+    prefix = [1] * (n + 1)
+    for i, x in enumerate(xs):
+        if x == 0:
+            raise KzgError("division by zero in batch inversion")
+        prefix[i + 1] = prefix[i] * x % BLS_MODULUS
+    inv = _fr_inv(prefix[n])
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = prefix[i] * inv % BLS_MODULUS
+        inv = inv * xs[i] % BLS_MODULUS
+    return out
+
+
+def _bit_reversal_permutation(seq: list) -> list:
+    n = len(seq)
+    bits = n.bit_length() - 1
+    assert 1 << bits == n, "length must be a power of two"
+    return [seq[int(format(i, f"0{bits}b")[::-1], 2)] for i in range(n)]
+
+
+def compute_roots_of_unity(order: int = FIELD_ELEMENTS_PER_BLOB) -> list[int]:
+    assert (BLS_MODULUS - 1) % order == 0
+    root = pow(PRIMITIVE_ROOT_OF_UNITY, (BLS_MODULUS - 1) // order, BLS_MODULUS)
+    out = [1]
+    for _ in range(order - 1):
+        out.append(out[-1] * root % BLS_MODULUS)
+    return out
+
+
+_ROOTS_BRP: list[int] | None = None
+
+
+def _roots_brp() -> list[int]:
+    global _ROOTS_BRP
+    if _ROOTS_BRP is None:
+        _ROOTS_BRP = _bit_reversal_permutation(
+            compute_roots_of_unity(FIELD_ELEMENTS_PER_BLOB)
+        )
+    return _ROOTS_BRP
+
+
+# ---------------------------------------------------------------------------
+# Group helpers (native with oracle fallback); points as oracle tuples
+# ---------------------------------------------------------------------------
+
+
+# oc.* auto-dispatches to the native backend when available
+
+
+def _g1_decompress(b: bytes):
+    """48B compressed -> point (on-curve + subgroup checked inside)."""
+    return oc.g1_from_bytes(bytes(b))
+
+
+def _g1_compress(pt) -> bytes:
+    return oc.g1_to_bytes(pt)
+
+
+_g1_add = oc.g1_add
+_g1_mul = oc.g1_mul
+_g2_add = oc.g2_add
+_g2_mul = oc.g2_mul
+
+
+def _g1_lincomb(points, scalars):
+    """sum_i scalars[i] * points[i] (Pippenger when native)."""
+    assert len(points) == len(scalars)
+    if native.available():
+        return native.g1_msm(points, scalars)
+    acc = None
+    for p, s in zip(points, scalars):
+        acc = oc.g1_add(acc, oc.g1_mul(p, s % BLS_MODULUS))
+    return acc
+
+
+def _pairings_one(pairs) -> bool:
+    if native.available():
+        return native.pairing_product_is_one(pairs)
+    from .pairing import pairing_product_is_one as _oc_pairs
+
+    return _oc_pairs(pairs)
+
+
+# ---------------------------------------------------------------------------
+# Trusted setup
+# ---------------------------------------------------------------------------
+
+
+class TrustedSetup:
+    """g1_lagrange_brp: blob-width lagrange-basis G1 points, bit-reversal
+    permuted (the order polynomials-in-evaluation-form use);
+    g2_monomial_1: tau*G2."""
+
+    def __init__(self, g1_lagrange: list, g2_monomial: list):
+        if len(g1_lagrange) != FIELD_ELEMENTS_PER_BLOB:
+            raise KzgError(
+                f"setup has {len(g1_lagrange)} G1 points, "
+                f"need {FIELD_ELEMENTS_PER_BLOB}"
+            )
+        if len(g2_monomial) < 2:
+            raise KzgError("setup needs >= 2 G2 monomial points")
+        self.g1_lagrange_brp = _bit_reversal_permutation(g1_lagrange)
+        self.g2_monomial_1 = g2_monomial[1]
+
+
+_ACTIVE_SETUP: TrustedSetup | None = None
+
+
+def load_trusted_setup(path: str | os.PathLike) -> TrustedSetup:
+    """Load + activate a setup in the standard JSON format."""
+    data = json.loads(Path(path).read_text())
+    g1 = [
+        _g1_decompress(bytes.fromhex(h.removeprefix("0x")))
+        for h in data["g1_lagrange"]
+    ]
+    g2 = [
+        oc.g2_from_bytes(bytes.fromhex(h.removeprefix("0x")))
+        for h in data["g2_monomial"][:2]
+    ]
+    setup = TrustedSetup(g1, g2)
+    activate_trusted_setup(setup)
+    return setup
+
+
+def activate_trusted_setup(setup: TrustedSetup) -> None:
+    global _ACTIVE_SETUP
+    _ACTIVE_SETUP = setup
+
+
+def _setup() -> TrustedSetup:
+    if _ACTIVE_SETUP is None:
+        activate_trusted_setup(dev_trusted_setup())
+    return _ACTIVE_SETUP
+
+
+_DEV_TAU_SEED = b"lodestar_tpu INSECURE dev trusted setup tau v1"
+
+
+def dev_trusted_setup(cache_dir: str | None = None) -> TrustedSetup:
+    """Generate (or load the cached) **INSECURE** dev setup.
+
+    tau is derived from a public seed, so anyone can forge proofs
+    against this setup — tests and dev chains only. Production must
+    `load_trusted_setup` with the ceremony output.
+    """
+    d = Path(
+        cache_dir
+        or os.environ.get(
+            "LODESTAR_TPU_NATIVE_DIR",
+            Path.home() / ".cache" / "lodestar_tpu" / "native",
+        )
+    )
+    d.mkdir(parents=True, exist_ok=True)
+    cache = d / f"dev_trusted_setup_{FIELD_ELEMENTS_PER_BLOB}.json"
+    if cache.exists():
+        try:
+            data = json.loads(cache.read_text())
+            g1 = [oc_from_hex(h) for h in data["g1_lagrange"]]
+            g2 = [g2_from_json(v) for v in data["g2_monomial"]]
+            return TrustedSetup(g1, g2)
+        except Exception:
+            cache.unlink()
+
+    tau = int.from_bytes(sha256(_DEV_TAU_SEED).digest(), "big") % BLS_MODULUS
+    n = FIELD_ELEMENTS_PER_BLOB
+    roots = compute_roots_of_unity(n)
+    # L_i(tau) = w^i * (tau^n - 1) / (n * (tau - w^i))
+    tau_n_minus_1 = (pow(tau, n, BLS_MODULUS) - 1) % BLS_MODULUS
+    denoms = _fr_batch_inv([(tau - w) % BLS_MODULUS for w in roots])
+    n_inv = _fr_inv(n)
+    scalars = [
+        w * tau_n_minus_1 % BLS_MODULUS * d % BLS_MODULUS * n_inv % BLS_MODULUS
+        for w, d in zip(roots, denoms)
+    ]
+    g1 = [_g1_mul(oc.G1_GEN, s) for s in scalars]
+    g2 = [oc.G2_GEN, _g2_mul(oc.G2_GEN, tau)]
+    cache.write_text(
+        json.dumps(
+            {
+                "g1_lagrange": [oc_to_hex(p) for p in g1],
+                "g2_monomial": [g2_to_json_val(p) for p in g2],
+            }
+        )
+    )
+    return TrustedSetup(g1, g2)
+
+
+def oc_to_hex(p) -> str:
+    return native.g1_to_bytes(p).hex()
+
+
+def oc_from_hex(h: str):
+    return native.g1_from_bytes_affine(bytes.fromhex(h))
+
+
+def g2_to_json_val(p) -> str:
+    return native.g2_to_bytes(p).hex()
+
+
+def g2_from_json(h: str):
+    return native.g2_from_bytes_affine(bytes.fromhex(h))
+
+
+# ---------------------------------------------------------------------------
+# Blob <-> polynomial
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_bls_field(b: bytes) -> int:
+    x = int.from_bytes(b, "big")
+    if x >= BLS_MODULUS:
+        raise KzgError("field element >= BLS modulus")
+    return x
+
+
+def hash_to_bls_field(data: bytes) -> int:
+    return int.from_bytes(sha256(data).digest(), "big") % BLS_MODULUS
+
+
+def blob_to_polynomial(blob: bytes) -> list[int]:
+    if len(blob) != BYTES_PER_BLOB:
+        raise KzgError(f"blob must be {BYTES_PER_BLOB} bytes")
+    return [
+        bytes_to_bls_field(blob[i * 32 : (i + 1) * 32])
+        for i in range(FIELD_ELEMENTS_PER_BLOB)
+    ]
+
+
+def _validate_g1(b: bytes):
+    """48B compressed -> point, with curve+subgroup checks."""
+    if len(b) != 48:
+        raise KzgError("compressed G1 must be 48 bytes")
+    try:
+        return _g1_decompress(bytes(b))
+    except Exception as e:
+        raise KzgError(f"invalid G1 point: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Core spec functions
+# ---------------------------------------------------------------------------
+
+
+def blob_to_kzg_commitment(blob: bytes) -> bytes:
+    poly = blob_to_polynomial(blob)
+    return _g1_compress(_g1_lincomb(_setup().g1_lagrange_brp, poly))
+
+
+def evaluate_polynomial_in_evaluation_form(poly: list[int], z: int) -> int:
+    """Barycentric evaluation over the brp'd domain."""
+    width = FIELD_ELEMENTS_PER_BLOB
+    roots = _roots_brp()
+    if z in roots:
+        return poly[roots.index(z)]
+    inv = _fr_batch_inv([(z - w) % BLS_MODULUS for w in roots])
+    acc = 0
+    for p_i, w, iv in zip(poly, roots, inv):
+        acc = (acc + p_i * w % BLS_MODULUS * iv) % BLS_MODULUS
+    zn_minus_1 = (pow(z, width, BLS_MODULUS) - 1) % BLS_MODULUS
+    return acc * zn_minus_1 % BLS_MODULUS * _fr_inv(width) % BLS_MODULUS
+
+
+def compute_kzg_proof_impl(poly: list[int], z: int) -> tuple[bytes, int]:
+    """Proof that poly(z) == y; returns (proof48, y)."""
+    roots = _roots_brp()
+    y = evaluate_polynomial_in_evaluation_form(poly, z)
+    numers = [(p - y) % BLS_MODULUS for p in poly]
+    if z in roots:
+        m = roots.index(z)
+        # quotient value at the domain point itself
+        # (compute_quotient_eval_within_domain)
+        q = [0] * FIELD_ELEMENTS_PER_BLOB
+        inv = _fr_batch_inv(
+            [
+                (w - z) % BLS_MODULUS if i != m else 1
+                for i, w in enumerate(roots)
+            ]
+        )
+        qm = 0
+        z_inv = _fr_inv(z)
+        for i, (num, w, iv) in enumerate(zip(numers, roots, inv)):
+            if i == m:
+                continue
+            q[i] = num * iv % BLS_MODULUS
+            # spec compute_quotient_eval_within_domain:
+            # += (p_i - y) * w_i / (z * (z - w_i)) — note (z - w_i)
+            qm = (
+                qm
+                - num * w % BLS_MODULUS * iv % BLS_MODULUS * z_inv
+            ) % BLS_MODULUS
+        q[m] = qm
+    else:
+        inv = _fr_batch_inv([(w - z) % BLS_MODULUS for w in roots])
+        q = [n * iv % BLS_MODULUS for n, iv in zip(numers, inv)]
+    proof = _g1_compress(_g1_lincomb(_setup().g1_lagrange_brp, q))
+    return proof, y
+
+
+def compute_kzg_proof(blob: bytes, z_bytes: bytes) -> tuple[bytes, bytes]:
+    poly = blob_to_polynomial(blob)
+    proof, y = compute_kzg_proof_impl(poly, bytes_to_bls_field(z_bytes))
+    return proof, int(y).to_bytes(32, "big")
+
+
+def verify_kzg_proof(
+    commitment_bytes: bytes, z_bytes: bytes, y_bytes: bytes, proof_bytes: bytes
+) -> bool:
+    return verify_kzg_proof_impl(
+        _validate_g1(commitment_bytes),
+        bytes_to_bls_field(z_bytes),
+        bytes_to_bls_field(y_bytes),
+        _validate_g1(proof_bytes),
+    )
+
+
+def verify_kzg_proof_impl(commitment, z: int, y: int, proof) -> bool:
+    """e(C - y*G1, -G2) * e(proof, tau*G2 - z*G2) == 1."""
+    s = _setup()
+    p_minus_y = _g1_add(commitment, _g1_mul(oc.G1_GEN, (-y) % BLS_MODULUS))
+    x_minus_z = _g2_add(
+        s.g2_monomial_1, _g2_mul(oc.G2_GEN, (-z) % BLS_MODULUS)
+    )
+    return _pairings_one(
+        [(p_minus_y, oc.g2_neg(oc.G2_GEN)), (proof, x_minus_z)]
+    )
+
+
+def compute_challenge(blob: bytes, commitment_bytes: bytes) -> int:
+    degree = FIELD_ELEMENTS_PER_BLOB.to_bytes(16, "little")
+    return hash_to_bls_field(
+        FIAT_SHAMIR_PROTOCOL_DOMAIN + degree + blob + commitment_bytes
+    )
+
+
+def compute_blob_kzg_proof(blob: bytes, commitment_bytes: bytes) -> bytes:
+    _validate_g1(commitment_bytes)
+    z = compute_challenge(blob, commitment_bytes)
+    proof, _ = compute_kzg_proof_impl(blob_to_polynomial(blob), z)
+    return proof
+
+
+def verify_blob_kzg_proof(
+    blob: bytes, commitment_bytes: bytes, proof_bytes: bytes
+) -> bool:
+    commitment = _validate_g1(commitment_bytes)
+    z = compute_challenge(blob, commitment_bytes)
+    y = evaluate_polynomial_in_evaluation_form(blob_to_polynomial(blob), z)
+    return verify_kzg_proof_impl(commitment, z, y, _validate_g1(proof_bytes))
+
+
+def verify_blob_kzg_proof_batch(
+    blobs: list[bytes],
+    commitment_bytes_list: list[bytes],
+    proof_bytes_list: list[bytes],
+) -> bool:
+    """Random-linear-combination batch verification (spec
+    verify_kzg_proof_batch): one 2-pairing check for n blobs."""
+    n = len(blobs)
+    if not (n == len(commitment_bytes_list) == len(proof_bytes_list)):
+        raise KzgError("batch length mismatch")
+    if n == 0:
+        return True
+    commitments = [_validate_g1(c) for c in commitment_bytes_list]
+    proofs = [_validate_g1(p) for p in proof_bytes_list]
+    zs, ys = [], []
+    for blob, cb in zip(blobs, commitment_bytes_list):
+        z = compute_challenge(blob, cb)
+        zs.append(z)
+        ys.append(
+            evaluate_polynomial_in_evaluation_form(
+                blob_to_polynomial(blob), z
+            )
+        )
+    # Fiat-Shamir the whole statement into one scalar; use its powers
+    data = RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
+    data += FIELD_ELEMENTS_PER_BLOB.to_bytes(8, "little")
+    data += n.to_bytes(8, "little")
+    for cb, z, y, pb in zip(commitment_bytes_list, zs, ys, proof_bytes_list):
+        data += bytes(cb) + z.to_bytes(32, "big") + y.to_bytes(32, "big")
+        data += bytes(pb)
+    r = hash_to_bls_field(data)
+    r_powers = [pow(r, i, BLS_MODULUS) for i in range(n)]
+
+    proof_lincomb = _g1_lincomb(proofs, r_powers)
+    proof_z_lincomb = _g1_lincomb(
+        proofs, [rp * z % BLS_MODULUS for rp, z in zip(r_powers, zs)]
+    )
+    c_minus_y = [
+        _g1_add(c, _g1_mul(oc.G1_GEN, (-y) % BLS_MODULUS))
+        for c, y in zip(commitments, ys)
+    ]
+    c_minus_y_lincomb = _g1_lincomb(c_minus_y, r_powers)
+    lhs = _g1_add(c_minus_y_lincomb, proof_z_lincomb)
+    return _pairings_one(
+        [
+            (lhs, oc.g2_neg(oc.G2_GEN)),
+            (proof_lincomb, _setup().g2_monomial_1),
+        ]
+    )

@@ -137,6 +137,33 @@ class CheckpointStateRepository(_ForkTaggedRepository):
         return uint_key(epoch) + root
 
 
+class BlobSidecarsRepository(Repository):
+    """block root -> list of BlobSidecars, serialized per fork
+    (reference: db/repositories/blobSidecars.ts). Values are stored as
+    fork-tagged concatenations of fixed-size sidecar encodings."""
+
+    def __init__(self, db, types, metrics=None):
+        super().__init__(db, Bucket.blob_sidecars, metrics=metrics)
+        self.types = types
+
+    def encode_value(self, value) -> bytes:
+        fork, sidecars = value
+        t = self.types.by_fork[fork].BlobSidecar
+        tag = fork.encode() + b"\x00"
+        return tag + b"".join(t.serialize(s) for s in sidecars)
+
+    def decode_value(self, data: bytes):
+        sep = data.index(b"\x00")
+        fork = data[:sep].decode()
+        t = self.types.by_fork[fork].BlobSidecar
+        size = t.fixed_size()
+        body = data[sep + 1 :]
+        n = len(body) // size
+        return fork, [
+            t.deserialize(body[i * size : (i + 1) * size]) for i in range(n)
+        ]
+
+
 class ChainMetaRepository(Repository):
     """Fixed-key chain metadata: head/finalized/justified roots, anchor
     info — what startup needs before any state is loaded."""
@@ -187,6 +214,9 @@ class BeaconDb:
             controller, types, metrics
         )
         self.checkpoint_state = CheckpointStateRepository(
+            controller, types, metrics
+        )
+        self.blob_sidecars = BlobSidecarsRepository(
             controller, types, metrics
         )
         self.meta = ChainMetaRepository(controller, metrics)

@@ -33,6 +33,9 @@ class Bucket(IntEnum):
     chain_meta = 40              # fixed keys -> misc chain metadata
     backfilled_ranges = 42       # slot -> slot
 
+    blob_sidecars = 44           # block root -> BlobSidecars wrapper
+    blob_sidecars_archive = 45   # slot -> BlobSidecars wrapper
+
 
 def bucket_key(bucket: Bucket, key: bytes) -> bytes:
     return bytes([int(bucket)]) + key

@@ -1,0 +1,136 @@
+"""KZG commitments (EIP-4844): spec identities against the dev setup.
+
+Reference analog: c-kzg-4844 as used by blob validation
+(chain/validation/blobSidecar.ts). The dev trusted setup derives tau
+from a public seed, which lets these tests ALSO check against directly
+computed tau-side values — an independent algebraic oracle: a
+commitment to p must equal p(tau)*G1.
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+import pytest
+
+from lodestar_tpu.crypto import kzg
+from lodestar_tpu.crypto.bls import curve as oc
+
+pytestmark = pytest.mark.skipif(
+    not kzg.native.available(), reason="native BLS backend unavailable"
+)
+
+N = kzg.FIELD_ELEMENTS_PER_BLOB
+MOD = kzg.BLS_MODULUS
+
+
+def mk_blob(seed: int) -> bytes:
+    out = bytearray()
+    for i in range(N):
+        v = int.from_bytes(
+            sha256(seed.to_bytes(8, "little") + i.to_bytes(8, "little")).digest(),
+            "big",
+        ) % MOD
+        out += v.to_bytes(32, "big")
+    return bytes(out)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def setup():
+    kzg.activate_trusted_setup(kzg.dev_trusted_setup())
+
+
+def _dev_tau() -> int:
+    return (
+        int.from_bytes(sha256(kzg._DEV_TAU_SEED).digest(), "big") % MOD
+    )
+
+
+class TestAgainstTauOracle:
+    def test_commitment_equals_eval_at_tau(self):
+        """C = sum p_i L_i(tau) G1 must equal p(tau)*G1 where p is the
+        interpolation of the (brp-ordered) evaluations."""
+        blob = mk_blob(1)
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        tau = _dev_tau()
+        p_tau = kzg.evaluate_polynomial_in_evaluation_form(
+            kzg.blob_to_polynomial(blob), tau
+        )
+        expect = oc.g1_to_bytes(oc.g1_mul(oc.G1_GEN, p_tau))
+        assert commitment == expect
+
+
+class TestProofs:
+    def test_point_eval_roundtrip(self):
+        blob = mk_blob(2)
+        z = (123456789).to_bytes(32, "big")
+        proof, y = kzg.compute_kzg_proof(blob, z)
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        assert kzg.verify_kzg_proof(commitment, z, y, proof)
+        # wrong y rejected
+        bad_y = ((int.from_bytes(y, "big") + 1) % MOD).to_bytes(32, "big")
+        assert not kzg.verify_kzg_proof(commitment, z, bad_y, proof)
+
+    def test_proof_at_domain_point(self):
+        blob = mk_blob(3)
+        poly = kzg.blob_to_polynomial(blob)
+        root = kzg._roots_brp()[5]
+        z = root.to_bytes(32, "big")
+        proof, y = kzg.compute_kzg_proof(blob, z)
+        assert int.from_bytes(y, "big") == poly[5]
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        assert kzg.verify_kzg_proof(commitment, z, y, proof)
+
+    def test_blob_proof_roundtrip(self):
+        blob = mk_blob(4)
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        proof = kzg.compute_blob_kzg_proof(blob, commitment)
+        assert kzg.verify_blob_kzg_proof(blob, commitment, proof)
+        # corrupt one field element -> reject
+        bad = bytearray(blob)
+        bad[5] ^= 1
+        assert not kzg.verify_blob_kzg_proof(bytes(bad), commitment, proof)
+
+    def test_batch_verify(self):
+        blobs = [mk_blob(s) for s in (10, 11, 12)]
+        commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+        proofs = [
+            kzg.compute_blob_kzg_proof(b, c)
+            for b, c in zip(blobs, commitments)
+        ]
+        assert kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+        # swap two proofs -> reject
+        assert not kzg.verify_blob_kzg_proof_batch(
+            blobs, commitments, [proofs[1], proofs[0], proofs[2]]
+        )
+        assert kzg.verify_blob_kzg_proof_batch([], [], [])
+
+
+class TestValidation:
+    def test_rejects_out_of_range_field_element(self):
+        blob = bytearray(mk_blob(5))
+        blob[:32] = (MOD).to_bytes(32, "big")  # == modulus: invalid
+        with pytest.raises(kzg.KzgError):
+            kzg.blob_to_kzg_commitment(bytes(blob))
+
+    def test_rejects_bad_point(self):
+        blob = mk_blob(6)
+        with pytest.raises(Exception):
+            kzg.verify_blob_kzg_proof(blob, b"\x01" * 48, b"\x02" * 48)
+
+
+class TestMsm:
+    def test_native_msm_matches_naive(self):
+        pts = [oc.g1_mul(oc.G1_GEN, 3 + i) for i in range(20)]
+        scalars = [(7 * i + 1) for i in range(20)]
+        fast = kzg.native.g1_msm(pts, scalars)
+        slow = None
+        for p, s in zip(pts, scalars):
+            slow = oc.g1_add(slow, oc.g1_mul(p, s))
+        assert fast == slow
+
+    def test_msm_with_infinity_and_zero_scalars(self):
+        pts = [oc.G1_GEN, None, oc.g1_mul(oc.G1_GEN, 9)]
+        scalars = [5, 7, 0]
+        out = kzg.native.g1_msm(pts, scalars)
+        assert out == oc.g1_mul(oc.G1_GEN, 5)
