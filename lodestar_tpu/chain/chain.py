@@ -23,6 +23,7 @@ from ..statetransition.signature_sets import get_block_signature_sets
 from ..statetransition.slot import process_slots
 
 MAX_CACHED_STATES = 48  # FIFOBlockStateCache-ish bound
+MAX_CACHED_BLOCKS = 2048  # hot signed-block window feeding regen
 
 
 class ChainError(Exception):
@@ -69,6 +70,15 @@ class BeaconChain:
         # optional LightClientServer (lightclient/server.py), fed on
         # import with each block's sync aggregate
         self.light_client_server = None
+        # optional IExecutionEngine (execution/): when attached, payload
+        # blocks are verified via engine_newPayload and head updates
+        # notify engine_forkchoiceUpdated (reference:
+        # verifyBlocksExecutionPayloads + importBlock fcU)
+        self.execution_engine = None
+        # optional Eth1DepositDataTracker (eth1/) for block production
+        self.eth1 = None
+        # optional ValidatorMonitor (metrics/validator_monitor.py)
+        self.validator_monitor = None
         # Dev chains have no execution engine: self-built mock payloads
         # are trusted (valid). With a real engine attached this must be
         # False so payload blocks import optimistically (syncing) until
@@ -130,6 +140,14 @@ class BeaconChain:
         }
         self._state_order: list[bytes] = [self.genesis_root]
         self._justified_root_seen = justified.root
+        # in-memory signed-block store (db-independent) feeding regen;
+        # bounded FIFO like the hot-block window the reference keeps in
+        # its block repository before archival
+        self._blocks: dict[bytes, object] = {}
+        self._block_order: list[bytes] = []
+        from .regen import StateRegenerator
+
+        self.regen = StateRegenerator(self)
         if db is not None:
             from ..config.chain_config import chain_config_to_json
 
@@ -203,6 +221,23 @@ class BeaconChain:
     def get_state(self, block_root: bytes) -> BeaconStateView | None:
         return self._states.get(block_root)
 
+    def get_or_regen_state(self, block_root: bytes) -> BeaconStateView:
+        """Cached post-state, regenerating synchronously on eviction."""
+        st = self.get_state(block_root)
+        if st is None:
+            st = self.regen.replay_sync(block_root)
+        return st
+
+    def get_block(self, block_root: bytes):
+        return self._blocks.get(block_root)
+
+    def _store_block(self, root: bytes, signed_block) -> None:
+        if root not in self._blocks:
+            self._block_order.append(root)
+        self._blocks[root] = signed_block
+        while len(self._block_order) > MAX_CACHED_BLOCKS:
+            self._blocks.pop(self._block_order.pop(0), None)
+
     def _store_state(self, root: bytes, view: BeaconStateView) -> None:
         if root not in self._states:
             self._state_order.append(root)
@@ -222,7 +257,10 @@ class BeaconChain:
     # -- block import ------------------------------------------------------
 
     async def process_block(
-        self, signed_block, is_timely: bool | None = None
+        self,
+        signed_block,
+        is_timely: bool | None = None,
+        blob_sidecars=None,
     ) -> bytes:
         """Full import: state transition + TPU signature batch + fork
         choice + head update. Returns the block root.
@@ -236,7 +274,15 @@ class BeaconChain:
         block = signed_block.message
         parent = self.get_state(bytes(block.parent_root))
         if parent is None:
-            raise ChainError("unknown parent state (no regen yet)")
+            # evicted from the state cache: rebuild by replay
+            from .regen import RegenError
+
+            try:
+                parent = await self.regen.get_state(
+                    bytes(block.parent_root)
+                )
+            except RegenError as e:
+                raise ChainError(f"unknown parent state: {e}") from e
 
         work = _clone(parent, types)
         process_slots(self.cfg, work, block.slot, types)
@@ -266,7 +312,46 @@ class BeaconChain:
 
         block_t = types.by_fork[work.fork].BeaconBlock
         block_root = block_t.hash_tree_root(block)
+
+        # data availability (deneb+): every commitment needs a bound,
+        # KZG-verified sidecar (verifyBlocksDataAvailability analog)
+        if work.fork_seq >= ForkSeq.deneb:
+            from .blobs import BlobError, validate_blob_sidecars
+
+            n_comms = len(block.body.blob_kzg_commitments)
+            if n_comms and blob_sidecars is None:
+                raise ChainError(
+                    f"block carries {n_comms} blob commitments but no "
+                    "sidecars were provided (data unavailable)"
+                )
+            if blob_sidecars is not None:
+                try:
+                    validate_blob_sidecars(
+                        types, work.fork, block_root, block, blob_sidecars
+                    )
+                except BlobError as e:
+                    raise ChainError(f"blob validation failed: {e}") from e
+
+        # execution verification via the engine when attached
+        # (verifyBlocksExecutionPayloads analog); trusted_execution dev
+        # chains skip straight to valid. Must run BEFORE any stores: an
+        # INVALID payload's block/state must never enter the caches or
+        # be served to peers.
+        engine_status = None
+        if (
+            self.execution_engine is not None
+            and work.fork_seq >= ForkSeq.bellatrix
+        ):
+            engine_status = await self._notify_new_payload(
+                work, block, block_root
+            )
+
         self._store_state(block_root, work)
+        self._store_block(block_root, signed_block)
+        if blob_sidecars and self.db is not None:
+            self.db.blob_sidecars.put(
+                block_root, (work.fork, list(blob_sidecars))
+            )
 
         state = work.state
         epoch = util.compute_epoch_at_slot(block.slot)
@@ -299,9 +384,13 @@ class BeaconChain:
             execution_block_hash=exec_hash,
             execution_status=(
                 (
-                    ExecutionStatus.valid
-                    if self.trusted_execution
-                    else ExecutionStatus.syncing
+                    engine_status
+                    if engine_status is not None
+                    else (
+                        ExecutionStatus.valid
+                        if self.trusted_execution
+                        else ExecutionStatus.syncing
+                    )
                 )
                 if exec_hash
                 else None
@@ -325,7 +414,116 @@ class BeaconChain:
             self.light_client_server.on_import_block(
                 block_root, block.body.sync_aggregate, int(block.slot)
             )
+        if self.validator_monitor is not None:
+            self.validator_monitor.on_block_imported(block)
         return block_root
+
+    async def _notify_new_payload(self, work, block, block_root):
+        """engine_newPayload -> fork-choice ExecutionStatus. INVALID
+        payloads abort the import (reference: verifyBlock invalid
+        handling); SYNCING/ACCEPTED import optimistically."""
+        from ..execution.engine import ExecutionPayloadStatus as EPS
+
+        payload = block.body.execution_payload
+        versioned_hashes = None
+        if work.fork_seq >= ForkSeq.deneb:
+            versioned_hashes = [
+                b"\x01" + __import__("hashlib").sha256(bytes(c)).digest()[1:]
+                for c in block.body.blob_kzg_commitments
+            ]
+        execution_requests = None
+        if work.fork_seq >= ForkSeq.electra:
+            # EIP-7685 type-prefixed encodings of non-empty request lists
+            er = block.body.execution_requests
+            ert = self.types.ExecutionRequests
+            execution_requests = [
+                bytes([prefix]) + ert.field_types[name].serialize(
+                    getattr(er, name)
+                )
+                for prefix, name in (
+                    (0, "deposits"),
+                    (1, "withdrawals"),
+                    (2, "consolidations"),
+                )
+                if len(getattr(er, name))
+            ]
+        st = await self.execution_engine.notify_new_payload(
+            work.fork,
+            payload,
+            versioned_hashes=versioned_hashes,
+            parent_root=bytes(block.parent_root),
+            execution_requests=execution_requests,
+        )
+        if st.status in (EPS.VALID,):
+            return ExecutionStatus.valid
+        if st.status in (EPS.INVALID, EPS.INVALID_BLOCK_HASH):
+            raise ChainError(
+                f"execution payload invalid: {st.validation_error}"
+            )
+        return ExecutionStatus.syncing
+
+    async def notify_forkchoice_update(self, attributes=None):
+        """engine_forkchoiceUpdated for the current head/finalized pair
+        (importBlock.ts / prepareNextSlot fcU). Returns payload_id when
+        attributes request a build."""
+        if self.execution_engine is None:
+            return None
+        from ..execution.engine import ForkchoiceState
+
+        head = self.get_or_regen_state(self.head_root)
+        if head.fork_seq < ForkSeq.bellatrix:
+            return None
+        head_hash = bytes(
+            head.state.latest_execution_payload_header.block_hash
+        )
+        try:
+            fin = self.get_or_regen_state(self.finalized_checkpoint.root)
+        except Exception:
+            fin = None
+        fin_hash = (
+            bytes(fin.state.latest_execution_payload_header.block_hash)
+            if fin is not None and fin.fork_seq >= ForkSeq.bellatrix
+            else b"\x00" * 32
+        )
+        resp = await self.execution_engine.notify_forkchoice_update(
+            head.fork,
+            ForkchoiceState(head_hash, head_hash, fin_hash),
+            attributes,
+        )
+        return resp.payload_id
+
+    async def prepare_execution_payload(self, slot: int, work):
+        """fcU with attributes + getPayload for block production
+        (reference: prepareExecutionPayload, produceBlockBody.ts:373).
+        Returns (payload, blobs_bundle|None)."""
+        from ..execution.engine import PayloadAttributes
+
+        st = work.state
+        withdrawals = None
+        if work.fork_seq >= ForkSeq.capella:
+            from ..statetransition.block import (
+                BlockCtx,
+                get_expected_withdrawals,
+            )
+
+            ctx = BlockCtx(self.cfg, st, self.types, work.fork_seq, False)
+            withdrawals = get_expected_withdrawals(ctx)[0]
+        attrs = PayloadAttributes(
+            timestamp=st.genesis_time + slot * self.cfg.SECONDS_PER_SLOT,
+            prev_randao=bytes(
+                util.get_randao_mix(st, util.get_current_epoch(st))
+            ),
+            suggested_fee_recipient=b"\x00" * 20,
+            withdrawals=withdrawals,
+            parent_beacon_block_root=(
+                self.head_root if work.fork_seq >= ForkSeq.deneb else None
+            ),
+        )
+        payload_id = await self.notify_forkchoice_update(attrs)
+        if payload_id is None:
+            return None, None
+        got = await self.execution_engine.get_payload(work.fork, payload_id)
+        return got.execution_payload, got.blobs_bundle
 
     def _persist_import(self, block_root, signed_block, work) -> None:
         """Write-through on import (importBlock.ts writeBlockInputToDb +
@@ -403,12 +601,13 @@ class BeaconChain:
         attester_slashings=(),
         voluntary_exits=(),
         bls_to_execution_changes=(),
+        execution_payload=None,
     ):
         """Assemble + run the unsigned block, returning (block, post_view).
         Reference: produceBlockWrapper/produceBlockBody (chain.ts:648,
         produceBlockBody.ts)."""
         types = self.types
-        head = self.get_state(self.head_root)
+        head = self.get_or_regen_state(self.head_root)
         work = _clone(head, types)
         process_slots(self.cfg, work, slot, types)
         st = work.state
@@ -441,7 +640,11 @@ class BeaconChain:
         if work.fork_seq >= ForkSeq.capella:
             body.bls_to_execution_changes = list(bls_to_execution_changes)
         if work.fork_seq >= ForkSeq.bellatrix:
-            body.execution_payload = self._build_dev_payload(work, slot)
+            body.execution_payload = (
+                execution_payload
+                if execution_payload is not None
+                else self._build_dev_payload(work, slot)
+            )
         block.body = body
 
         signed = ns.SignedBeaconBlock.default()

@@ -154,7 +154,7 @@ class DevNode:
         self.slot += 1
         slot = self.slot
         types = self.types
-        head = self.chain.get_state(self.chain.head_root)
+        head = self.chain.get_or_regen_state(self.chain.head_root)
 
         # advance a scratch clone to compute proposer + domains
         from .chain import _clone

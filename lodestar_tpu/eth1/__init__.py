@@ -1,0 +1,16 @@
+"""Eth1 deposit tracking: contract-log following + deposit merkle tree.
+
+Reference analog: beacon-node/src/eth1/ — Eth1DepositDataTracker
+(eth1DepositDataTracker.ts:57), deposit tree utilities (utils/deposits.ts,
+utils/eth1Vote.ts), JSON-RPC provider (provider/eth1Provider.ts).
+"""
+
+from .deposit_tree import DepositTree
+from .tracker import Eth1DepositDataTracker, Eth1Error, MockEth1Provider
+
+__all__ = [
+    "DepositTree",
+    "Eth1DepositDataTracker",
+    "Eth1Error",
+    "MockEth1Provider",
+]

@@ -1,0 +1,87 @@
+"""Append-only deposit merkle tree (depth 32, mix-in count root).
+
+Reference analog: the deposit tree the reference maintains from
+contract logs (eth1/utils/deposits.ts over @chainsafe/
+persistent-merkle-tree) matching the deposit contract's incremental
+merkle root. Roots/proofs follow the spec: root =
+hash(merkle_root_of_2^32_padded_leaves ++ count_le32), proofs are
+DEPOSIT_CONTRACT_TREE_DEPTH+1 long with the count leaf last
+(is_valid_merkle_branch over depth+1).
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+_ZERO = [b"\x00" * 32]
+for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH + 1):
+    _ZERO.append(sha256(_ZERO[-1] + _ZERO[-1]).digest())
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return sha256(a + b).digest()
+
+
+class DepositTree:
+    """Keeps all leaves; computes roots/branches with zero-subtree
+    shortcuts (node count touched per op is O(log n), computed lazily
+    with a per-(level,index) memo invalidated on append path)."""
+
+    def __init__(self):
+        self.leaves: list[bytes] = []
+        self._memo: dict[tuple[int, int], bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self.leaves)
+
+    def push(self, leaf: bytes) -> None:
+        """Append a deposit-data root."""
+        idx = len(self.leaves)
+        self.leaves.append(bytes(leaf))
+        # invalidate the path of the new leaf
+        for level in range(DEPOSIT_CONTRACT_TREE_DEPTH + 1):
+            self._memo.pop((level, idx >> level), None)
+
+    def _node(self, level: int, idx: int, size: int) -> bytes:
+        """Root of the subtree at (level, idx) over the first `size`
+        leaves, padded with zero subtrees."""
+        start = idx << level
+        if start >= size:
+            return _ZERO[level]
+        full_under = size >= ((idx + 1) << level)
+        key = (level, idx)
+        if full_under and key in self._memo:
+            return self._memo[key]
+        if level == 0:
+            out = self.leaves[idx]
+        else:
+            out = _h(
+                self._node(level - 1, 2 * idx, size),
+                self._node(level - 1, 2 * idx + 1, size),
+            )
+        if full_under:
+            self._memo[key] = out
+        return out
+
+    def root_at(self, size: int) -> bytes:
+        """Spec deposit root for the first `size` leaves (mix-in count)."""
+        inner = self._node(DEPOSIT_CONTRACT_TREE_DEPTH, 0, size)
+        return _h(inner, size.to_bytes(32, "little"))
+
+    @property
+    def root(self) -> bytes:
+        return self.root_at(len(self.leaves))
+
+    def branch(self, index: int, size: int) -> list[bytes]:
+        """Proof of leaf `index` against root_at(size): depth-32 sibling
+        path + the count leaf (spec Deposit.proof layout)."""
+        assert 0 <= index < size <= len(self.leaves)
+        out = []
+        idx = index
+        for level in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            out.append(self._node(level, idx ^ 1, size))
+            idx >>= 1
+        out.append(size.to_bytes(32, "little"))
+        return out

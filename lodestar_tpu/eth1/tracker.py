@@ -1,0 +1,297 @@
+"""Eth1 deposit-data tracker: follow contract logs, serve eth1 votes +
+deposit proofs for block production.
+
+Reference analog: Eth1DepositDataTracker (eth1/eth1DepositDataTracker.ts:57)
++ Eth1DataCache (eth1DataCache.ts) + eth1 vote selection
+(utils/eth1Vote.ts) + Eth1ForBlockProduction (index.ts:60). The
+provider side mirrors IEth1Provider (provider/eth1Provider.ts):
+deposit logs + block headers over JSON-RPC; `MockEth1Provider` is the
+test double (reference uses mocked providers in eth1 e2e tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from hashlib import sha256
+
+from ..params import preset
+from .deposit_tree import DepositTree
+
+# keccak256("DepositEvent(bytes,bytes,bytes,bytes,bytes)") — constant
+# from the deposit contract ABI, carried verbatim (no keccak dep needed)
+DEPOSIT_EVENT_TOPIC = (
+    "0x649bbc62d0e31342afea4e5cd82d4049e7e1ee912fc0889aa790803be39038c5"
+)
+
+
+class Eth1Error(Exception):
+    pass
+
+
+@dataclass
+class DepositLog:
+    index: int
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    signature: bytes
+    block_number: int
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+
+
+def parse_deposit_event_data(data: bytes, block_number: int) -> DepositLog:
+    """ABI-decode DepositEvent(bytes,bytes,bytes,bytes,bytes): head of
+    five 32B offsets, each tail = len(32B) + padded payload."""
+
+    def dyn(off_slot: int) -> bytes:
+        off = int.from_bytes(data[off_slot * 32 : off_slot * 32 + 32], "big")
+        n = int.from_bytes(data[off : off + 32], "big")
+        return data[off + 32 : off + 32 + n]
+
+    pubkey = dyn(0)
+    wc = dyn(1)
+    amount = int.from_bytes(dyn(2), "little")
+    sig = dyn(3)
+    index = int.from_bytes(dyn(4), "little")
+    if len(pubkey) != 48 or len(wc) != 32 or len(sig) != 96:
+        raise Eth1Error("malformed DepositEvent payload")
+    return DepositLog(index, pubkey, wc, amount, sig, block_number)
+
+
+class MockEth1Provider:
+    """Scriptable in-memory eth1 chain (IEth1Provider test double)."""
+
+    def __init__(self, genesis_time: int = 0, block_time: int = 14):
+        self.logs: list[DepositLog] = []
+        self.head_number = 0
+        self.genesis_time = genesis_time
+        self.block_time = block_time
+
+    def add_deposit(
+        self, pubkey: bytes, wc: bytes, amount: int, signature: bytes,
+        block_number: int | None = None,
+    ) -> None:
+        bn = (
+            block_number
+            if block_number is not None
+            else self.head_number
+        )
+        self.logs.append(
+            DepositLog(len(self.logs), pubkey, wc, amount, signature, bn)
+        )
+        self.head_number = max(self.head_number, bn)
+
+    def advance(self, n: int = 1) -> None:
+        self.head_number += n
+
+    async def get_block_number(self) -> int:
+        return self.head_number
+
+    async def get_block(self, number: int) -> Eth1Block:
+        return Eth1Block(
+            number=number,
+            hash=sha256(b"eth1-block" + number.to_bytes(8, "little")).digest(),
+            timestamp=self.genesis_time + number * self.block_time,
+        )
+
+    async def get_deposit_logs(self, from_block: int, to_block: int):
+        return [
+            log
+            for log in self.logs
+            if from_block <= log.block_number <= to_block
+        ]
+
+
+class JsonRpcEth1Provider:
+    """IEth1Provider over eth JSON-RPC (provider/eth1Provider.ts)."""
+
+    def __init__(self, rpc, deposit_contract: bytes):
+        # rpc: execution.http.JsonRpcHttpClient
+        self.rpc = rpc
+        self.deposit_contract = deposit_contract
+
+    async def get_block_number(self) -> int:
+        return int(await self.rpc.call("eth_blockNumber", []), 16)
+
+    async def get_block(self, number: int) -> Eth1Block:
+        obj = await self.rpc.call(
+            "eth_getBlockByNumber", [hex(number), False]
+        )
+        if obj is None:
+            raise Eth1Error(f"eth1 block {number} not found")
+        return Eth1Block(
+            number=int(obj["number"], 16),
+            hash=bytes.fromhex(obj["hash"].removeprefix("0x")),
+            timestamp=int(obj["timestamp"], 16),
+        )
+
+    async def get_deposit_logs(self, from_block: int, to_block: int):
+        logs = await self.rpc.call(
+            "eth_getLogs",
+            [
+                {
+                    "fromBlock": hex(from_block),
+                    "toBlock": hex(to_block),
+                    "address": "0x" + self.deposit_contract.hex(),
+                    "topics": [DEPOSIT_EVENT_TOPIC],
+                }
+            ],
+        )
+        out = []
+        for lg in logs:
+            out.append(
+                parse_deposit_event_data(
+                    bytes.fromhex(lg["data"].removeprefix("0x")),
+                    int(lg["blockNumber"], 16),
+                )
+            )
+        return out
+
+
+def _voting_period_start_time(cfg, state) -> int:
+    from ..params import preset as _p
+
+    p = _p()
+    period_slots = p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH
+    period_start_slot = int(state.slot) - int(state.slot) % period_slots
+    return int(state.genesis_time) + period_start_slot * cfg.SECONDS_PER_SLOT
+
+
+MAX_FOLLOWED_BLOCKS = 4096  # bound the followed-header window
+
+
+class Eth1DepositDataTracker:
+    """Follows deposit logs into a DepositTree and answers block
+    production's get_eth1_data_and_deposits (spec get_eth1_vote +
+    deposit proof assembly)."""
+
+    def __init__(self, cfg, types, provider):
+        self.cfg = cfg
+        self.types = types
+        self.provider = provider
+        self.tree = DepositTree()
+        self.deposits: list[DepositLog] = []
+        self.blocks: dict[int, Eth1Block] = {}  # followed eth1 blocks
+        self._synced_to = -1
+
+    # -- log following -----------------------------------------------------
+
+    async def update(self) -> None:
+        """One polling round: fetch new logs up to the follow distance
+        (eth1DepositDataTracker.ts update loop)."""
+        head = await self.provider.get_block_number()
+        followed = max(0, head - self.cfg.ETH1_FOLLOW_DISTANCE)
+        if followed <= self._synced_to:
+            return
+        logs = await self.provider.get_deposit_logs(
+            self._synced_to + 1, followed
+        )
+        for log in sorted(logs, key=lambda x: x.index):
+            if log.index != len(self.deposits):
+                raise Eth1Error(
+                    f"deposit log gap: got {log.index}, "
+                    f"expected {len(self.deposits)}"
+                )
+            self.deposits.append(log)
+            self.tree.push(self._deposit_data_root(log))
+        for bn in range(self._synced_to + 1, followed + 1):
+            self.blocks[bn] = await self.provider.get_block(bn)
+        while len(self.blocks) > MAX_FOLLOWED_BLOCKS:
+            self.blocks.pop(min(self.blocks))
+        self._synced_to = followed
+
+    def _deposit_data_root(self, log: DepositLog) -> bytes:
+        dd = self.types.DepositData.default()
+        dd.pubkey = log.pubkey
+        dd.withdrawal_credentials = log.withdrawal_credentials
+        dd.amount = log.amount
+        dd.signature = log.signature
+        return self.types.DepositData.hash_tree_root(dd)
+
+    # -- block production --------------------------------------------------
+
+    def _eth1_data_for_block(self, block: Eth1Block):
+        count = sum(
+            1 for d in self.deposits if d.block_number <= block.number
+        )
+        e = self.types.Eth1Data.default()
+        e.deposit_root = self.tree.root_at(count)
+        e.deposit_count = count
+        e.block_hash = block.hash
+        return e, count
+
+    def get_eth1_vote(self, state):
+        """Spec get_eth1_vote (utils/eth1Vote.ts): candidates are
+        followed blocks inside the voting-period timestamp window whose
+        deposit_count doesn't regress the state's; majority vote among
+        those, else the newest candidate."""
+        if not self.blocks:
+            return state.eth1_data
+        p = preset()
+        period_start = _voting_period_start_time(self.cfg, state)
+        lo = period_start - (
+            self.cfg.ETH1_FOLLOW_DISTANCE
+            * 2
+            * self.cfg.SECONDS_PER_ETH1_BLOCK
+        )
+        hi = period_start - (
+            self.cfg.ETH1_FOLLOW_DISTANCE * self.cfg.SECONDS_PER_ETH1_BLOCK
+        )
+        floor = int(state.eth1_data.deposit_count)
+        candidates = []
+        for b in sorted(self.blocks.values(), key=lambda b: b.number):
+            if not (lo <= b.timestamp <= hi):
+                continue
+            data, count = self._eth1_data_for_block(b)
+            if count < floor:
+                continue
+            candidates.append(data)
+        if not candidates:
+            return state.eth1_data
+        t = self.types.Eth1Data
+        valid = {t.serialize(c): c for c in candidates}
+        tally: dict[bytes, int] = {}
+        for vote in state.eth1_data_votes:
+            key = t.serialize(vote)
+            if key in valid:
+                tally[key] = tally.get(key, 0) + 1
+        if tally:
+            best = max(tally.items(), key=lambda kv: kv[1])[0]
+            return valid[best]
+        return candidates[-1]
+
+    def get_deposits(self, state, eth1_data) -> list:
+        """Deposit objects (with proofs) the block must include:
+        state.eth1_deposit_index .. min(count, index+MAX_DEPOSITS)."""
+        p = preset()
+        count = int(eth1_data.deposit_count)
+        start = int(state.eth1_deposit_index)
+        end = min(count, start + p.MAX_DEPOSITS)
+        out = []
+        for i in range(start, end):
+            log = self.deposits[i]
+            dep = self.types.Deposit.default()
+            dd = self.types.DepositData.default()
+            dd.pubkey = log.pubkey
+            dd.withdrawal_credentials = log.withdrawal_credentials
+            dd.amount = log.amount
+            dd.signature = log.signature
+            dep.data = dd
+            dep.proof = self.tree.branch(i, count)
+            out.append(dep)
+        return out
+
+    async def get_eth1_data_and_deposits(self, state):
+        """(eth1_data, deposits) for produceBlockBody (reference:
+        Eth1ForBlockProduction.getEth1DataAndDeposits)."""
+        await self.update()
+        eth1_data = self.get_eth1_vote(state)
+        deposits = self.get_deposits(state, eth1_data)
+        return eth1_data, deposits

@@ -1,0 +1,23 @@
+"""Execution-layer integration: engine API + builder API.
+
+Reference analog: beacon-node/src/execution/ — `IExecutionEngine`
+(engine/interface.ts:133-181), `ExecutionEngineHttp` (engine/http.ts:115),
+`ExecutionEngineMockBackend` (engine/mock.ts), and the MEV-boost
+builder client (builder/http.ts:60).
+"""
+
+from .engine import (
+    ExecutionPayloadStatus,
+    ForkchoiceState,
+    PayloadAttributes,
+    PayloadStatus,
+)
+from .mock import MockExecutionEngine
+
+__all__ = [
+    "ExecutionPayloadStatus",
+    "ForkchoiceState",
+    "PayloadAttributes",
+    "PayloadStatus",
+    "MockExecutionEngine",
+]

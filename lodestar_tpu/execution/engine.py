@@ -1,0 +1,156 @@
+"""Engine API types + payload <-> JSON codecs.
+
+Reference analog: execution/engine/interface.ts (IExecutionEngine,
+ExecutePayloadStatus at interface.ts:23-60) and the serializers in
+engine/types.ts. The JSON forms follow the Engine API spec: QUANTITY
+as 0x-hex without leading zeros, DATA as 0x-hex bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class ExecutionPayloadStatus(str, Enum):
+    """engine_newPayload verdicts (interface.ts:23-60)."""
+
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+    ELERROR = "ELERROR"  # client-side: EL unreachable/errored
+    UNAVAILABLE = "UNAVAILABLE"
+
+
+@dataclass
+class PayloadStatus:
+    status: ExecutionPayloadStatus
+    latest_valid_hash: bytes | None = None
+    validation_error: str | None = None
+
+
+@dataclass
+class ForkchoiceState:
+    head_block_hash: bytes
+    safe_block_hash: bytes
+    finalized_block_hash: bytes
+
+
+@dataclass
+class PayloadAttributes:
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes
+    withdrawals: list | None = None  # capella+
+    parent_beacon_block_root: bytes | None = None  # deneb+
+
+
+@dataclass
+class ForkchoiceResponse:
+    payload_status: PayloadStatus
+    payload_id: bytes | None = None
+
+
+@dataclass
+class GetPayloadResponse:
+    execution_payload: object  # SSZ ExecutionPayload value
+    block_value: int = 0
+    blobs_bundle: dict | None = None  # {commitments, proofs, blobs}
+    should_override_builder: bool = False
+
+
+# ---------------------------------------------------------------------------
+# JSON codecs (Engine API wire form)
+# ---------------------------------------------------------------------------
+
+
+def quantity(n: int) -> str:
+    return hex(int(n))
+
+
+def data(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def from_quantity(s: str) -> int:
+    return int(s, 16)
+
+
+def from_data(s: str) -> bytes:
+    return bytes.fromhex(s.removeprefix("0x"))
+
+
+def payload_to_json(payload, fork_seq: int) -> dict:
+    """SSZ ExecutionPayload value -> engine API ExecutionPayloadV1/2/3."""
+    from ..params import ForkSeq
+
+    out = {
+        "parentHash": data(payload.parent_hash),
+        "feeRecipient": data(payload.fee_recipient),
+        "stateRoot": data(payload.state_root),
+        "receiptsRoot": data(payload.receipts_root),
+        "logsBloom": data(payload.logs_bloom),
+        "prevRandao": data(payload.prev_randao),
+        "blockNumber": quantity(payload.block_number),
+        "gasLimit": quantity(payload.gas_limit),
+        "gasUsed": quantity(payload.gas_used),
+        "timestamp": quantity(payload.timestamp),
+        "extraData": data(payload.extra_data),
+        "baseFeePerGas": quantity(payload.base_fee_per_gas),
+        "blockHash": data(payload.block_hash),
+        "transactions": [data(tx) for tx in payload.transactions],
+    }
+    if fork_seq >= ForkSeq.capella:
+        out["withdrawals"] = [
+            {
+                "index": quantity(w.index),
+                "validatorIndex": quantity(w.validator_index),
+                "address": data(w.address),
+                "amount": quantity(w.amount),
+            }
+            for w in payload.withdrawals
+        ]
+    if fork_seq >= ForkSeq.deneb:
+        out["blobGasUsed"] = quantity(payload.blob_gas_used)
+        out["excessBlobGas"] = quantity(payload.excess_blob_gas)
+    return out
+
+
+def payload_from_json(types, fork: str, obj: dict):
+    """engine API ExecutionPayloadV* -> SSZ value of the fork's type."""
+    from ..params import ForkSeq
+
+    fork_seq = int(ForkSeq[fork])
+    payload = types.by_fork[fork].ExecutionPayload.default()
+    payload.parent_hash = from_data(obj["parentHash"])
+    payload.fee_recipient = from_data(obj["feeRecipient"])
+    payload.state_root = from_data(obj["stateRoot"])
+    payload.receipts_root = from_data(obj["receiptsRoot"])
+    payload.logs_bloom = from_data(obj["logsBloom"])
+    payload.prev_randao = from_data(obj["prevRandao"])
+    payload.block_number = from_quantity(obj["blockNumber"])
+    payload.gas_limit = from_quantity(obj["gasLimit"])
+    payload.gas_used = from_quantity(obj["gasUsed"])
+    payload.timestamp = from_quantity(obj["timestamp"])
+    payload.extra_data = from_data(obj["extraData"])
+    payload.base_fee_per_gas = from_quantity(obj["baseFeePerGas"])
+    payload.block_hash = from_data(obj["blockHash"])
+    payload.transactions = [from_data(tx) for tx in obj["transactions"]]
+    if fork_seq >= ForkSeq.capella:
+        ws = []
+        for w in obj.get("withdrawals") or []:
+            wd = types.Withdrawal.default()
+            wd.index = from_quantity(w["index"])
+            wd.validator_index = from_quantity(w["validatorIndex"])
+            wd.address = from_data(w["address"])
+            wd.amount = from_quantity(w["amount"])
+            ws.append(wd)
+        payload.withdrawals = ws
+    if fork_seq >= ForkSeq.deneb:
+        payload.blob_gas_used = from_quantity(obj.get("blobGasUsed", "0x0"))
+        payload.excess_blob_gas = from_quantity(
+            obj.get("excessBlobGas", "0x0")
+        )
+    return payload

@@ -1,0 +1,115 @@
+"""Mock execution engine for tests and dev chains.
+
+Reference analog: ExecutionEngineMockBackend (execution/engine/mock.ts)
+— keeps a hash-chained payload tree, answers newPayload/fcU/getPayload
+with configurable verdicts, builds payloads for requested attributes.
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+
+from ..params import ForkSeq
+from .engine import (
+    ExecutionPayloadStatus,
+    ForkchoiceResponse,
+    ForkchoiceState,
+    GetPayloadResponse,
+    PayloadAttributes,
+    PayloadStatus,
+)
+
+
+class MockExecutionEngine:
+    """In-process IExecutionEngine with scriptable verdicts."""
+
+    def __init__(self, types, genesis_block_hash: bytes = b"\x00" * 32):
+        self.types = types
+        self.known_blocks: dict[bytes, dict] = {genesis_block_hash: {}}
+        self.head: bytes = genesis_block_hash
+        self.finalized: bytes = genesis_block_hash
+        # scripting hooks
+        self.payload_verdict = ExecutionPayloadStatus.VALID
+        self.fcu_verdict = ExecutionPayloadStatus.VALID
+        self._payloads: dict[bytes, tuple[str, PayloadAttributes, bytes]] = {}
+        self._payload_seq = 0
+        self.calls: list[tuple] = []
+
+    # -- IExecutionEngine --------------------------------------------------
+
+    async def notify_new_payload(
+        self,
+        fork: str,
+        payload,
+        versioned_hashes=None,
+        parent_root=None,
+        execution_requests=None,
+    ) -> PayloadStatus:
+        self.calls.append(("newPayload", bytes(payload.block_hash)))
+        if self.payload_verdict is not ExecutionPayloadStatus.VALID:
+            return PayloadStatus(self.payload_verdict)
+        parent = bytes(payload.parent_hash)
+        if parent not in self.known_blocks:
+            return PayloadStatus(ExecutionPayloadStatus.SYNCING)
+        bh = bytes(payload.block_hash)
+        self.known_blocks[bh] = {"parent": parent}
+        return PayloadStatus(
+            ExecutionPayloadStatus.VALID, latest_valid_hash=bh
+        )
+
+    async def notify_forkchoice_update(
+        self,
+        fork: str,
+        state: ForkchoiceState,
+        attributes: PayloadAttributes | None = None,
+    ) -> ForkchoiceResponse:
+        self.calls.append(("fcU", bytes(state.head_block_hash)))
+        if self.fcu_verdict is not ExecutionPayloadStatus.VALID:
+            return ForkchoiceResponse(PayloadStatus(self.fcu_verdict))
+        self.head = bytes(state.head_block_hash)
+        self.finalized = bytes(state.finalized_block_hash)
+        payload_id = None
+        if attributes is not None:
+            self._payload_seq += 1
+            payload_id = self._payload_seq.to_bytes(8, "big")
+            self._payloads[payload_id] = (fork, attributes, self.head)
+        return ForkchoiceResponse(
+            PayloadStatus(ExecutionPayloadStatus.VALID), payload_id
+        )
+
+    async def get_payload(
+        self, fork: str, payload_id: bytes
+    ) -> GetPayloadResponse:
+        self.calls.append(("getPayload", payload_id))
+        fork_at_req, attrs, parent_hash = self._payloads[payload_id]
+        payload = self._build(fork, attrs, parent_hash)
+        self.known_blocks[bytes(payload.block_hash)] = {
+            "parent": parent_hash
+        }
+        return GetPayloadResponse(payload, block_value=10**9)
+
+    async def get_payload_bodies_by_hash(self, fork: str, hashes):
+        return [None for _ in hashes]
+
+    # -- internals ---------------------------------------------------------
+
+    def _build(self, fork: str, attrs: PayloadAttributes, parent: bytes):
+        payload = self.types.by_fork[fork].ExecutionPayload.default()
+        payload.parent_hash = parent
+        payload.fee_recipient = bytes(attrs.suggested_fee_recipient)
+        payload.prev_randao = bytes(attrs.prev_randao)
+        payload.timestamp = int(attrs.timestamp)
+        payload.block_number = len(self.known_blocks)
+        payload.gas_limit = 30_000_000
+        if (
+            int(ForkSeq[fork]) >= ForkSeq.capella
+            and attrs.withdrawals is not None
+        ):
+            payload.withdrawals = list(attrs.withdrawals)
+        payload.block_hash = sha256(
+            b"mock-exec"
+            + parent
+            + int(attrs.timestamp).to_bytes(8, "little")
+            + bytes(attrs.prev_randao)
+        ).digest()
+        return payload

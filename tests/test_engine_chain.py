@@ -1,0 +1,130 @@
+"""Chain <-> execution engine integration over the mock engine.
+
+Reference analog: verifyBlocksExecutionPayloads + importBlock fcU +
+prepareExecutionPayload, driven against ExecutionEngineMockBackend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain, ChainError
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.execution import ExecutionPayloadStatus, MockExecutionEngine
+from lodestar_tpu.statetransition import create_interop_genesis_state
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=0,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+def _mk(types, verdict=None):
+    cfg = _cfg()
+    node = DevNode(cfg, types, N, verify_attestations=False)
+    chain = node.chain
+    genesis_view = chain.get_state(chain.genesis_root)
+    genesis_exec_hash = bytes(
+        genesis_view.state.latest_execution_payload_header.block_hash
+    )
+    eng = MockExecutionEngine(types, genesis_block_hash=genesis_exec_hash)
+    if verdict is not None:
+        eng.payload_verdict = verdict
+    chain.execution_engine = eng
+    chain.trusted_execution = False
+    return node, chain, eng
+
+
+class TestEngineIntegration:
+    def test_valid_payloads_import_and_fcu(self, types):
+        node, chain, eng = _mk(types)
+
+        async def go():
+            for _ in range(3):
+                await node.advance_slot()
+                await chain.notify_forkchoice_update()
+            await node.close()
+
+        asyncio.run(go())
+        kinds = [k for k, _ in eng.calls]
+        assert kinds.count("newPayload") == 3
+        assert "fcU" in kinds
+        head = chain.fork_choice.proto.get_node(chain.head_root)
+        assert head.slot == 3
+        # engine-confirmed: node should be fully valid, not optimistic
+        from lodestar_tpu.forkchoice import ExecutionStatus
+
+        assert head.execution_status is ExecutionStatus.valid
+
+    def test_invalid_payload_rejected(self, types):
+        node, chain, eng = _mk(
+            types, verdict=ExecutionPayloadStatus.INVALID
+        )
+
+        async def go():
+            with pytest.raises(ChainError, match="payload invalid"):
+                await node.advance_slot()
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_syncing_imports_optimistically(self, types):
+        node, chain, eng = _mk(
+            types, verdict=ExecutionPayloadStatus.SYNCING
+        )
+
+        async def go():
+            await node.advance_slot()
+            await node.close()
+
+        asyncio.run(go())
+        from lodestar_tpu.forkchoice import ExecutionStatus
+
+        head = chain.fork_choice.proto.get_node(chain.head_root)
+        assert head.execution_status is ExecutionStatus.syncing
+
+    def test_engine_payload_production(self, types):
+        """prepare_execution_payload builds via the engine and the
+        produced block imports cleanly."""
+        node, chain, eng = _mk(types)
+
+        async def go():
+            # seed the engine head with genesis exec hash
+            payload, bundle = await chain.prepare_execution_payload(
+                1, _advanced(chain, 1)
+            )
+            assert payload is not None
+            assert bundle is None
+            # devnode flow with the engine payload
+            await node.advance_slot()
+            await node.close()
+
+        asyncio.run(go())
+        assert any(k == "getPayload" for k, _ in eng.calls)
+
+
+def _advanced(chain, slot):
+    from lodestar_tpu.chain.chain import _clone
+    from lodestar_tpu.statetransition.slot import process_slots
+
+    work = _clone(chain.get_state(chain.head_root), chain.types)
+    process_slots(chain.cfg, work, slot, chain.types)
+    return work

@@ -1,0 +1,243 @@
+"""Execution engine: mock backend flow + HTTP JSON-RPC client with JWT.
+
+Reference analog: execution/engine tests against
+ExecutionEngineMockBackend (engine/mock.ts) and the JWT auth of
+jsonRpcHttpClient.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hmac
+import json
+import threading
+from hashlib import sha256
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lodestar_tpu.execution import (
+    ExecutionPayloadStatus,
+    ForkchoiceState,
+    MockExecutionEngine,
+    PayloadAttributes,
+)
+from lodestar_tpu.execution.engine import (
+    payload_from_json,
+    payload_to_json,
+)
+from lodestar_tpu.execution.http import (
+    ExecutionEngineHttp,
+    JsonRpcHttpClient,
+    jwt_token,
+)
+from lodestar_tpu.params import ForkSeq
+from lodestar_tpu.types import ssz_types
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+class TestMockEngine:
+    def test_payload_build_flow(self, types):
+        async def go():
+            eng = MockExecutionEngine(types)
+            fcu = await eng.notify_forkchoice_update(
+                "capella",
+                ForkchoiceState(b"\x00" * 32, b"\x00" * 32, b"\x00" * 32),
+                PayloadAttributes(
+                    timestamp=1234,
+                    prev_randao=b"\x01" * 32,
+                    suggested_fee_recipient=b"\x02" * 20,
+                    withdrawals=[],
+                ),
+            )
+            assert fcu.payload_id is not None
+            got = await eng.get_payload("capella", fcu.payload_id)
+            st = await eng.notify_new_payload(
+                "capella", got.execution_payload
+            )
+            assert st.status is ExecutionPayloadStatus.VALID
+            # unknown parent -> SYNCING
+            orphan = types.by_fork["capella"].ExecutionPayload.default()
+            orphan.parent_hash = b"\xaa" * 32
+            orphan.block_hash = b"\xbb" * 32
+            st2 = await eng.notify_new_payload("capella", orphan)
+            assert st2.status is ExecutionPayloadStatus.SYNCING
+
+        asyncio.run(go())
+
+
+class TestPayloadJson:
+    def test_roundtrip_deneb(self, types):
+        p = types.by_fork["deneb"].ExecutionPayload.default()
+        p.parent_hash = b"\x11" * 32
+        p.block_number = 77
+        p.base_fee_per_gas = 10**12
+        p.transactions = [b"\x01\x02", b"\x03"]
+        w = types.Withdrawal.default()
+        w.index = 5
+        w.validator_index = 9
+        w.address = b"\x04" * 20
+        w.amount = 1000
+        p.withdrawals = [w]
+        p.blob_gas_used = 3
+        obj = payload_to_json(p, int(ForkSeq.deneb))
+        back = payload_from_json(types, "deneb", obj)
+        t = types.by_fork["deneb"].ExecutionPayload
+        assert t.serialize(back) == t.serialize(p)
+
+
+class _MockElHandler(BaseHTTPRequestHandler):
+    secret = b"\x07" * 32
+    types = None
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        auth = self.headers.get("Authorization", "")
+        if not self._check_jwt(auth):
+            self.send_response(401)
+            self.end_headers()
+            return
+        req = json.loads(
+            self.rfile.read(int(self.headers["Content-Length"]))
+        )
+        method = req["method"]
+        if method == "engine_forkchoiceUpdatedV2":
+            result = {
+                "payloadStatus": {
+                    "status": "VALID",
+                    "latestValidHash": req["params"][0]["headBlockHash"],
+                    "validationError": None,
+                },
+                "payloadId": "0x0000000000000001"
+                if req["params"][1]
+                else None,
+            }
+        elif method == "engine_newPayloadV2":
+            result = {
+                "status": "VALID",
+                "latestValidHash": req["params"][0]["blockHash"],
+                "validationError": None,
+            }
+        elif method == "engine_getPayloadV2":
+            p = self.types.by_fork["capella"].ExecutionPayload.default()
+            from lodestar_tpu.execution.engine import payload_to_json
+
+            result = {
+                "executionPayload": payload_to_json(
+                    p, int(ForkSeq.capella)
+                ),
+                "blockValue": "0x9184e72a000",
+            }
+        else:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(
+                json.dumps(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": req["id"],
+                        "error": {"code": -32601, "message": "no method"},
+                    }
+                ).encode()
+            )
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(
+            json.dumps(
+                {"jsonrpc": "2.0", "id": req["id"], "result": result}
+            ).encode()
+        )
+
+    def _check_jwt(self, auth: str) -> bool:
+        if not auth.startswith("Bearer "):
+            return False
+        tok = auth[len("Bearer ") :]
+        try:
+            h, c, s = tok.split(".")
+            pad = lambda x: x + "=" * (-len(x) % 4)  # noqa: E731
+            sig = base64.urlsafe_b64decode(pad(s))
+            want = hmac.new(
+                self.secret, f"{h}.{c}".encode(), sha256
+            ).digest()
+            return hmac.compare_digest(sig, want)
+        except Exception:
+            return False
+
+
+class TestHttpEngine:
+    def test_jwt_round_trip_and_calls(self, types):
+        _MockElHandler.types = types
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockElHandler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            eng = ExecutionEngineHttp.connect(
+                url, jwt_secret=_MockElHandler.secret
+            )
+
+            async def go():
+                fcu = await eng.notify_forkchoice_update(
+                    "capella",
+                    ForkchoiceState(
+                        b"\x11" * 32, b"\x11" * 32, b"\x00" * 32
+                    ),
+                    PayloadAttributes(
+                        timestamp=9,
+                        prev_randao=b"\x01" * 32,
+                        suggested_fee_recipient=b"\x02" * 20,
+                        withdrawals=[],
+                    ),
+                )
+                assert (
+                    fcu.payload_status.status
+                    is ExecutionPayloadStatus.VALID
+                )
+                assert fcu.payload_id == b"\x00" * 7 + b"\x01"
+                got = await eng.get_payload("capella", fcu.payload_id, types)
+                assert got.block_value == 0x9184E72A000
+                st = await eng.notify_new_payload(
+                    "capella", got.execution_payload
+                )
+                assert st.status is ExecutionPayloadStatus.VALID
+
+            asyncio.run(go())
+        finally:
+            srv.shutdown()
+
+    def test_bad_jwt_rejected(self, types):
+        _MockElHandler.types = types
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockElHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            rpc = JsonRpcHttpClient(
+                url, jwt_secret=b"\xff" * 32, retries=0
+            )
+            from lodestar_tpu.execution.http import EngineApiError
+
+            with pytest.raises(EngineApiError):
+                rpc.call_sync("engine_newPayloadV2", [{}])
+        finally:
+            srv.shutdown()
+
+    def test_jwt_shape(self):
+        tok = jwt_token(b"\x01" * 32, now=1000)
+        h, c, s = tok.split(".")
+        header = json.loads(
+            base64.urlsafe_b64decode(h + "=" * (-len(h) % 4))
+        )
+        claims = json.loads(
+            base64.urlsafe_b64decode(c + "=" * (-len(c) % 4))
+        )
+        assert header == {"alg": "HS256", "typ": "JWT"}
+        assert claims == {"iat": 1000}

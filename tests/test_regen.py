@@ -1,0 +1,100 @@
+"""State regen: rebuild evicted states by replaying hot blocks.
+
+Reference analog: QueuedStateRegenerator (chain/regen/queued.ts:31) —
+VERDICT r1: unknown parent must regen, not hard-error.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.regen import RegenError, StateRegenerator
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class TestRegen:
+    def test_import_after_state_eviction(self, types):
+        node = DevNode(_cfg(), types, N, verify_attestations=False)
+        chain = node.chain
+
+        async def go():
+            for _ in range(6):
+                await node.advance_slot()
+            # evict every non-anchor state (simulates FIFO pressure)
+            for root in list(chain._states):
+                if root != chain.genesis_root:
+                    chain._states.pop(root)
+                    chain._state_order.remove(root)
+            assert chain.get_state(chain.head_root) is None
+            before = chain.regen.replays
+            # next slot's import needs the head post-state -> regen
+            await node.advance_slot()
+            assert chain.regen.replays > before
+            assert chain.get_state(chain.head_root) is not None
+            await node.close()
+
+        asyncio.run(go())
+        head = chain.fork_choice.proto.get_node(chain.head_root)
+        assert head.slot == node.slot
+
+    def test_regen_get_state_returns_cached(self, types):
+        node = DevNode(_cfg(), types, N, verify_attestations=False)
+
+        async def go():
+            await node.advance_slot()
+            st = await node.chain.regen.get_state(node.chain.head_root)
+            assert st is node.chain.get_state(node.chain.head_root)
+            assert node.chain.regen.hits >= 1
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_regen_unknown_root_raises(self, types):
+        node = DevNode(_cfg(), types, N, verify_attestations=False)
+
+        async def go():
+            with pytest.raises(RegenError):
+                await node.chain.regen.get_state(b"\xaa" * 32)
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_replayed_state_matches_original(self, types):
+        """The replayed post-state must hash identically to the one the
+        original import produced."""
+        node = DevNode(_cfg(), types, N, verify_attestations=False)
+        chain = node.chain
+
+        async def go():
+            for _ in range(4):
+                await node.advance_slot()
+            head = chain.head_root
+            original_root = chain.get_state(head).hash_tree_root(types)
+            chain._states.pop(head)
+            chain._state_order.remove(head)
+            st = await chain.regen.get_state(head)
+            assert st.hash_tree_root(types) == original_root
+            await node.close()
+
+        asyncio.run(go())
