@@ -1,0 +1,115 @@
+"""Remote monitoring: periodic client-stats push.
+
+Reference analog: MonitoringService (monitoring/service.ts:37) —
+derives a beaconcha.in-schema JSON snapshot from local metrics and
+POSTs it to a remote endpoint on an interval (properties.ts,
+clientStats.ts define the schema mapping).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+
+CLIENT_NAME = "lodestar-tpu"
+CLIENT_VERSION = "0.2.0"
+
+
+def collect_client_stats(chain=None, verifier_metrics=None, process_start=None):
+    """One snapshot in the client-stats (beaconcha.in) schema — the
+    general + beaconnode sections the reference emits."""
+    now_ms = int(time.time() * 1000)
+    general = {
+        "version": 1,
+        "timestamp": now_ms,
+        "process": "beaconnode",
+        "client_name": CLIENT_NAME,
+        "client_version": CLIENT_VERSION,
+        "sync_eth2_fallback_configured": False,
+        "sync_eth2_fallback_connected": False,
+    }
+    if process_start is not None:
+        general["cpu_process_seconds_total"] = int(
+            time.time() - process_start
+        )
+    if chain is not None:
+        head = chain.fork_choice.proto.get_node(chain.head_root)
+        general.update(
+            {
+                "sync_beacon_head_slot": head.slot if head else 0,
+                "sync_eth2_synced": True,
+                "slasher_active": False,
+            }
+        )
+    if verifier_metrics is not None:
+        general["bls_verifier_sets_verified"] = getattr(
+            verifier_metrics, "sig_sets_total", 0
+        )
+    return general
+
+
+class MonitoringService:
+    """Push loop (service.ts:37): POST stats every `interval_s`."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        chain=None,
+        interval_s: float = 60.0,
+        collect=collect_client_stats,
+    ):
+        self.endpoint = endpoint
+        self.chain = chain
+        self.interval_s = interval_s
+        self._collect = collect
+        self._task = None
+        self._start = time.time()
+        self.pushes_ok = 0
+        self.pushes_failed = 0
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await self.push_once()
+            await asyncio.sleep(self.interval_s)
+
+    async def push_once(self) -> bool:
+        stats = self._collect(
+            chain=self.chain, process_start=self._start
+        )
+        body = json.dumps([stats]).encode()
+
+        def _post():
+            req = urllib.request.Request(
+                self.endpoint,
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return 200 <= resp.status < 300
+
+        try:
+            ok = await asyncio.get_event_loop().run_in_executor(None, _post)
+        except (urllib.error.URLError, OSError):
+            ok = False
+        if ok:
+            self.pushes_ok += 1
+        else:
+            self.pushes_failed += 1
+        return ok

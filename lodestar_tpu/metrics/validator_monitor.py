@@ -1,0 +1,109 @@
+"""Per-validator performance monitor.
+
+Reference analog: createValidatorMonitor
+(metrics/validatorMonitor.ts:255) — the beacon node tracks registered
+local validators' attestation inclusion/correctness and proposals,
+exposing per-epoch summaries and prometheus series so operators see
+liveness/effectiveness without trusting external explorers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..params import preset
+
+
+@dataclass
+class _EpochSummary:
+    attestation_seen: bool = False
+    attestation_inclusion_delay: int | None = None
+    attestation_correct_head: bool = False
+    attestation_correct_target: bool = False
+    blocks_proposed: int = 0
+
+
+@dataclass
+class _MonitoredValidator:
+    index: int
+    summaries: dict[int, _EpochSummary] = field(default_factory=dict)
+
+    def summary(self, epoch: int) -> _EpochSummary:
+        if epoch not in self.summaries:
+            self.summaries[epoch] = _EpochSummary()
+            # bound memory: keep the last few epochs only
+            for old in sorted(self.summaries)[:-4]:
+                del self.summaries[old]
+        return self.summaries[epoch]
+
+
+class ValidatorMonitor:
+    def __init__(self, registry=None):
+        self.validators: dict[int, _MonitoredValidator] = {}
+        if registry is not None:
+            reg = registry
+            self._m_att_hit = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_attester_hit_total",
+                "Attestations included on chain for monitored validators",
+            )
+            self._m_att_miss = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_attester_miss_total",
+                "Missed attestations for monitored validators",
+            )
+            self._m_proposals = reg.counter(
+                "validator_monitor_beacon_block_total",
+                "Blocks proposed by monitored validators",
+            )
+        else:
+            self._m_att_hit = self._m_att_miss = self._m_proposals = None
+
+    def register_local_validator(self, index: int) -> None:
+        self.validators.setdefault(index, _MonitoredValidator(index))
+
+    # -- event feeds (called from block import) ---------------------------
+
+    def on_block_imported(self, block) -> None:
+        idx = int(block.proposer_index)
+        mv = self.validators.get(idx)
+        if mv is None:
+            return
+        epoch = int(block.slot) // preset().SLOTS_PER_EPOCH
+        mv.summary(epoch).blocks_proposed += 1
+        if self._m_proposals is not None:
+            self._m_proposals.inc()
+
+    def on_attestation_included(
+        self,
+        attester_indices,
+        attestation_epoch: int,
+        inclusion_delay: int,
+        correct_head: bool,
+        correct_target: bool,
+    ) -> None:
+        for idx in attester_indices:
+            mv = self.validators.get(int(idx))
+            if mv is None:
+                continue
+            s = mv.summary(attestation_epoch)
+            s.attestation_seen = True
+            if (
+                s.attestation_inclusion_delay is None
+                or inclusion_delay < s.attestation_inclusion_delay
+            ):
+                s.attestation_inclusion_delay = inclusion_delay
+            s.attestation_correct_head |= correct_head
+            s.attestation_correct_target |= correct_target
+
+    def on_epoch_summary(self, prev_epoch: int) -> dict:
+        """Roll up the previous epoch (validatorMonitor's per-epoch
+        processing); returns {index: summary} and bumps counters."""
+        out = {}
+        for idx, mv in self.validators.items():
+            s = mv.summary(prev_epoch)
+            out[idx] = s
+            if self._m_att_hit is not None:
+                if s.attestation_seen:
+                    self._m_att_hit.inc()
+                else:
+                    self._m_att_miss.inc()
+        return out

@@ -6,6 +6,17 @@ Reference analog: packages/beacon-node/src/sync/ — `BeaconSync`
 (range/batch.ts:62) and peer balancing (range/utils/peerBalancer.ts).
 """
 
+from .backfill import BackfillError, BackfillSync
 from .range_sync import Batch, BatchStatus, RangeSync, SyncServer
+from .unknown_block import UnknownBlockSync, UnknownBlockSyncError
 
-__all__ = ["Batch", "BatchStatus", "RangeSync", "SyncServer"]
+__all__ = [
+    "BackfillError",
+    "BackfillSync",
+    "Batch",
+    "BatchStatus",
+    "RangeSync",
+    "SyncServer",
+    "UnknownBlockSync",
+    "UnknownBlockSyncError",
+]

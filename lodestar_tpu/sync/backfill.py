@@ -1,0 +1,141 @@
+"""Backfill sync: fill history backwards from a checkpoint anchor.
+
+Reference analog: BackfillSync (sync/backfill/backfill.ts:103) +
+verifyBlockSequence/verifyBlockProposerSignature (backfill/verify.ts).
+A checkpoint-synced node starts from a finalized anchor state and has
+no history; backfill walks BACKWARD, downloading ranges and verifying
+(a) hash linkage up to the trusted anchor root and (b) proposer
+signatures in bulk through the batch verifier — no state transition.
+Verified blocks land in the block archive; completed spans are recorded
+in the backfilled_ranges bucket for restart resumability.
+"""
+
+from __future__ import annotations
+
+from ..bls.api import SignatureSet
+from ..config.beacon_config import compute_signing_root_from_roots
+from ..network import reqresp as rr
+from ..network.wire_types import BeaconBlocksByRangeRequest
+from ..params import DOMAIN_BEACON_PROPOSER, preset
+
+BACKFILL_BATCH_SLOTS = 64  # backfill.ts batch sizing
+
+
+class BackfillError(Exception):
+    pass
+
+
+class BackfillSync:
+    """Backward history fill below the chain's anchor."""
+
+    def __init__(self, chain, beacon_cfg, types, node: rr.ReqResp, verifier):
+        self.chain = chain
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+        self.node = node
+        self.verifier = verifier
+        self.peers: list[str] = []
+        self.blocks_backfilled = 0
+
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id not in self.peers:
+            self.peers.append(peer_id)
+
+    async def run(
+        self, anchor_parent_root: bytes, anchor_slot: int, to_slot: int = 1
+    ):
+        """Fill slots [to_slot, anchor_slot) below a trusted anchor
+        block: `anchor_parent_root` is the anchor block's parent_root
+        (the newest backfilled block must hash to it) and `anchor_slot`
+        the anchor block's slot."""
+        expected_root = anchor_parent_root
+        hi = anchor_slot  # exclusive upper bound of the next batch
+        while hi > to_slot:
+            lo = max(to_slot, hi - BACKFILL_BATCH_SLOTS)
+            blocks = await self._download(lo, hi - lo)
+            if not blocks:
+                raise BackfillError(f"no blocks served for [{lo},{hi})")
+            expected_root = await self._verify_and_store(
+                blocks, expected_root
+            )
+            hi = int(blocks[0][1].message.slot)
+            if self.chain.db is not None:
+                self.chain.db.meta.put_int("backfilled_to", hi)
+        return self.blocks_backfilled
+
+    async def _download(self, start: int, count: int):
+        req = BeaconBlocksByRangeRequest(
+            start_slot=start, count=count, step=1
+        )
+        payload = BeaconBlocksByRangeRequest.serialize(req)
+        last_err = None
+        for peer in list(self.peers):
+            try:
+                chunks = await self.node.request(
+                    peer, rr.PROTOCOL_BLOCKS_BY_RANGE, payload
+                )
+            except (rr.ReqRespError, TimeoutError) as e:
+                last_err = e
+                continue
+            out = []
+            for ch in chunks:
+                fork = self.beacon_cfg.fork_name_from_digest(ch.context)
+                out.append(
+                    (
+                        fork,
+                        self.types.by_fork[
+                            fork
+                        ].SignedBeaconBlock.deserialize(ch.payload),
+                    )
+                )
+            return out
+        raise BackfillError(f"all peers failed: {last_err}")
+
+    async def _verify_and_store(self, blocks, expected_root: bytes) -> bytes:
+        """Check hash linkage child->parent against expected_root, then
+        batch-verify proposer signatures (backfill/verify.ts). Returns
+        the parent root the next (older) batch must end at."""
+        types = self.types
+        # linkage: walk newest -> oldest
+        anchor_state = self.chain.get_or_regen_state(
+            self.chain.head_root
+        ).state
+        sets = []
+        for fork, block in reversed(blocks):
+            root = types.by_fork[fork].BeaconBlock.hash_tree_root(
+                block.message
+            )
+            if root != expected_root:
+                raise BackfillError(
+                    f"linkage broken at slot {int(block.message.slot)}: "
+                    f"got {root.hex()[:16]}, want {expected_root.hex()[:16]}"
+                )
+            expected_root = bytes(block.message.parent_root)
+            proposer = anchor_state.validators[
+                int(block.message.proposer_index)
+            ]
+            epoch = int(block.message.slot) // preset().SLOTS_PER_EPOCH
+            # full fork schedule, not the anchor state's two versions:
+            # histories span many forks (backfill/verify.ts)
+            domain = self.beacon_cfg.get_domain(
+                DOMAIN_BEACON_PROPOSER, epoch
+            )
+            sets.append(
+                SignatureSet(
+                    bytes(proposer.pubkey),
+                    compute_signing_root_from_roots(root, domain),
+                    bytes(block.signature),
+                )
+            )
+        if not await self.verifier.verify_signature_sets(sets):
+            raise BackfillError("proposer signature batch failed")
+        if self.chain.db is not None:
+            for fork, block in blocks:
+                root = types.by_fork[fork].BeaconBlock.hash_tree_root(
+                    block.message
+                )
+                self.chain.db.block_archive.put(
+                    int(block.message.slot), (fork, block)
+                )
+        self.blocks_backfilled += len(blocks)
+        return expected_root

@@ -65,6 +65,9 @@ class SyncServer:
         node.register_handler(
             rr.PROTOCOL_BLOCKS_BY_RANGE, self.on_blocks_by_range
         )
+        node.register_handler(
+            rr.PROTOCOL_BLOCKS_BY_ROOT, self.on_blocks_by_root
+        )
 
     def local_status(self):
         chain = self.chain
@@ -123,7 +126,37 @@ class SyncServer:
             )
             served += 1
 
+    async def on_blocks_by_root(self, peer, payload):
+        """Serve blocks by root (handlers/beaconBlocksByRoot.ts)."""
+        from ..network.wire_types import BeaconBlocksByRootRequest
+
+        roots = BeaconBlocksByRootRequest.deserialize(payload)
+        spe = preset().SLOTS_PER_EPOCH
+        for root in roots[: rr.MAX_REQUEST_BLOCKS]:
+            got = self._block_by_root(bytes(root))
+            if got is None:
+                continue
+            fork, block = got
+            digest = self.beacon_cfg.fork_digest(
+                int(block.message.slot) // spe
+            )
+            yield (
+                digest,
+                self.types.by_fork[fork].SignedBeaconBlock.serialize(
+                    block
+                ),
+            )
+
     def _block_by_root(self, root: bytes):
+        blk = self.chain.get_block(root)
+        if blk is not None:
+            from ..statetransition.slot import fork_at_epoch
+
+            fork = fork_at_epoch(
+                self.chain.cfg,
+                int(blk.message.slot) // preset().SLOTS_PER_EPOCH,
+            )
+            return (fork, blk)
         if self.chain.db is None:
             return None
         raw = self.chain.db.block.get_binary(root)

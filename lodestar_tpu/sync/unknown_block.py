@@ -1,0 +1,85 @@
+"""Unknown-block sync: repair gossip gaps by fetching ancestors.
+
+Reference analog: UnknownBlockSync (sync/unknownBlock.ts:28) — when an
+attestation or block references a root fork choice doesn't know, fetch
+it (and unknown parents, recursively) over BeaconBlocksByRoot, then
+import the recovered segment child-ward through the full pipeline.
+"""
+
+from __future__ import annotations
+
+from ..network import reqresp as rr
+from ..network.wire_types import BeaconBlocksByRootRequest
+
+MAX_PARENT_CHAIN = 64  # unknownBlock.ts caps ancestor walks
+
+
+class UnknownBlockSyncError(Exception):
+    pass
+
+
+class UnknownBlockSync:
+    def __init__(self, chain, beacon_cfg, node: rr.ReqResp):
+        self.chain = chain
+        self.beacon_cfg = beacon_cfg
+        self.node = node
+        self.peers: list[str] = []
+        self.fetched = 0
+        self.imported = 0
+
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id not in self.peers:
+            self.peers.append(peer_id)
+
+    async def on_unknown_block(self, root: bytes) -> int:
+        """Resolve `root` into fork choice; returns blocks imported."""
+        if self.chain.fork_choice.has_block(root):
+            return 0
+        if not self.peers:
+            raise UnknownBlockSyncError("no peers to fetch from")
+        segment = []  # child-most first
+        want = root
+        for _ in range(MAX_PARENT_CHAIN):
+            block = await self._fetch_by_root(want)
+            if block is None:
+                raise UnknownBlockSyncError(
+                    f"no peer served block {want.hex()[:16]}"
+                )
+            segment.append(block)
+            parent = bytes(block.message.parent_root)
+            if self.chain.fork_choice.has_block(parent):
+                break
+            want = parent
+        else:
+            raise UnknownBlockSyncError("parent chain too long")
+        imported = 0
+        for block in reversed(segment):
+            await self.chain.process_block(block, is_timely=False)
+            imported += 1
+        self.imported += imported
+        return imported
+
+    async def _fetch_by_root(self, root: bytes):
+        payload = BeaconBlocksByRootRequest.serialize([root])
+        for peer in list(self.peers):
+            try:
+                chunks = await self.node.request(
+                    peer, rr.PROTOCOL_BLOCKS_BY_ROOT, payload
+                )
+            except (rr.ReqRespError, TimeoutError):
+                continue
+            for ch in chunks:
+                fork = self.beacon_cfg.fork_name_from_digest(ch.context)
+                block = self.chain.types.by_fork[
+                    fork
+                ].SignedBeaconBlock.deserialize(ch.payload)
+                got_root = self.chain.types.by_fork[
+                    fork
+                ].BeaconBlock.hash_tree_root(block.message)
+                if got_root != root:
+                    # peer served the wrong block: don't let it steer
+                    # which segment gets imported; try the next peer
+                    continue
+                self.fetched += 1
+                return block
+        return None

@@ -1,0 +1,90 @@
+"""Validator monitor + monitoring push service.
+
+Reference analog: metrics/validatorMonitor.ts and monitoring/service.ts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lodestar_tpu.metrics.monitoring import MonitoringService
+from lodestar_tpu.metrics.registry import RegistryMetricCreator
+from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+
+
+class TestValidatorMonitor:
+    def test_attestation_tracking(self):
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(3)
+        vm.register_local_validator(7)
+        vm.on_attestation_included(
+            [3, 99],
+            attestation_epoch=5,
+            inclusion_delay=1,
+            correct_head=True,
+            correct_target=True,
+        )
+        summary = vm.on_epoch_summary(5)
+        assert summary[3].attestation_seen
+        assert summary[3].attestation_inclusion_delay == 1
+        assert not summary[7].attestation_seen
+        text = reg.expose()
+        assert (
+            "validator_monitor_prev_epoch_on_chain_attester_hit_total 1"
+            in text
+        )
+        assert (
+            "validator_monitor_prev_epoch_on_chain_attester_miss_total 1"
+            in text
+        )
+
+    def test_proposal_tracking(self):
+        vm = ValidatorMonitor()
+        vm.register_local_validator(2)
+
+        class Blk:
+            proposer_index = 2
+            slot = 9
+
+        vm.on_block_imported(Blk)
+        assert vm.validators[2].summary(1).blocks_proposed == 1
+
+
+class _StatsSink(BaseHTTPRequestHandler):
+    received: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        type(self).received.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+
+class TestMonitoringService:
+    def test_push_once(self):
+        _StatsSink.received = []
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _StatsSink)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/stats"
+            svc = MonitoringService(url)
+
+            ok = asyncio.run(svc.push_once())
+            assert ok and svc.pushes_ok == 1
+            [batch] = _StatsSink.received
+            assert batch[0]["client_name"] == "lodestar-tpu"
+            assert batch[0]["process"] == "beaconnode"
+        finally:
+            srv.shutdown()
+
+    def test_push_failure_counted(self):
+        svc = MonitoringService("http://127.0.0.1:1/nope")
+        ok = asyncio.run(svc.push_once())
+        assert not ok and svc.pushes_failed == 1

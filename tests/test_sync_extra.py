@@ -1,0 +1,200 @@
+"""Unknown-block sync + backfill sync over the in-process transport.
+
+Reference analog: sync/unknownBlock.ts and sync/backfill/.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.db.beacon import BeaconDb
+from lodestar_tpu.network import reqresp as rr
+from lodestar_tpu.params import preset
+from lodestar_tpu.statetransition import create_interop_genesis_state
+from lodestar_tpu.sync import (
+    BackfillSync,
+    RangeSync,
+    SyncServer,
+    UnknownBlockSync,
+)
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class StubVerifier:
+    def can_accept_work(self):
+        return True
+
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message, **kw):
+        return [True] * len(sets)
+
+    async def close(self):
+        pass
+
+
+def _pair(producer_chain, types, cfg, genesis):
+    gvr = bytes(genesis.state.genesis_validators_root)
+    bc = BeaconConfig(cfg, gvr)
+    tr = rr.InProcessTransport()
+    producer_rr = rr.ReqResp("producer", tr)
+    consumer_rr = rr.ReqResp("consumer", tr)
+    SyncServer(producer_chain, bc, types).register(producer_rr)
+    return bc, consumer_rr
+
+
+class TestUnknownBlockSync:
+    def test_resolves_unknown_parent_chain(self, types):
+        cfg = _cfg()
+
+        async def go():
+            producer = DevNode(
+                cfg,
+                types,
+                N,
+                verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            for _ in range(6):
+                await producer.advance_slot()
+
+            genesis = create_interop_genesis_state(cfg, types, N)
+            consumer = BeaconChain(
+                cfg, types, genesis, verifier=StubVerifier()
+            )
+            bc, consumer_rr = _pair(producer.chain, types, cfg, genesis)
+            ub = UnknownBlockSync(consumer, bc, consumer_rr)
+            ub.add_peer("producer")
+
+            # consumer hears about the producer head out of nowhere
+            imported = await ub.on_unknown_block(producer.chain.head_root)
+            assert imported == 6
+            assert consumer.head_root == producer.chain.head_root
+            # idempotent
+            assert await ub.on_unknown_block(producer.chain.head_root) == 0
+            await producer.close()
+
+        asyncio.run(go())
+
+
+class TestBackfillSync:
+    def test_backfills_history_below_anchor(self, types):
+        """A checkpoint-synced node (anchored mid-chain) fills history
+        backwards and verifies linkage + proposer signatures."""
+        cfg = _cfg()
+        p = preset()
+        target = 2 * p.SLOTS_PER_EPOCH  # 16 blocks under minimal
+
+        async def go():
+            producer = DevNode(
+                cfg,
+                types,
+                N,
+                db=BeaconDb.in_memory(types),
+                verify_attestations=False,
+            )
+            await producer.run_until(target)
+
+            genesis = create_interop_genesis_state(cfg, types, N)
+            # "checkpoint-synced" consumer: anchor at producer head
+            head_view = producer.chain.get_state(
+                producer.chain.head_root
+            )
+            from lodestar_tpu.chain.chain import _clone
+
+            consumer = BeaconChain(
+                cfg,
+                types,
+                _clone(head_view, types),
+                verifier=StubVerifier(),
+                db=BeaconDb.in_memory(types),
+            )
+            bc, consumer_rr = _pair(producer.chain, types, cfg, genesis)
+            bf = BackfillSync(
+                consumer, bc, types, consumer_rr, StubVerifier()
+            )
+            bf.add_peer("producer")
+
+            head_node = producer.chain.fork_choice.proto.get_node(
+                producer.chain.head_root
+            )
+            n = await bf.run(
+                anchor_parent_root=bytes(head_node.parent_root),
+                anchor_slot=head_node.slot,
+            )
+            assert n == target - 1  # every block below the anchor
+            # archive now serves history
+            slots = [
+                s
+                for s, _ in consumer.db.block_archive.entries(
+                    start=1, end=target
+                )
+            ]
+            assert slots == list(range(1, target))
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_backfill_detects_linkage_break(self, types):
+        cfg = _cfg()
+        p = preset()
+
+        async def go():
+            producer = DevNode(
+                cfg,
+                types,
+                N,
+                db=BeaconDb.in_memory(types),
+                verify_attestations=False,
+            )
+            await producer.run_until(p.SLOTS_PER_EPOCH)
+            genesis = create_interop_genesis_state(cfg, types, N)
+            head_view = producer.chain.get_state(
+                producer.chain.head_root
+            )
+            from lodestar_tpu.chain.chain import _clone
+            from lodestar_tpu.sync import BackfillError
+
+            consumer = BeaconChain(
+                cfg, types, _clone(head_view, types),
+                verifier=StubVerifier(),
+            )
+            bc, consumer_rr = _pair(producer.chain, types, cfg, genesis)
+            bf = BackfillSync(
+                consumer, bc, types, consumer_rr, StubVerifier()
+            )
+            bf.add_peer("producer")
+            with pytest.raises(BackfillError, match="linkage"):
+                await bf.run(
+                    anchor_parent_root=b"\x13" * 32,  # wrong trusted root
+                    anchor_slot=p.SLOTS_PER_EPOCH,
+                )
+            await producer.close()
+
+        asyncio.run(go())
