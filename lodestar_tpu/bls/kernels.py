@@ -61,6 +61,19 @@ def _to_affine(ops, p: C.JacPoint):
     return C.FQ2_OPS.norm(x), C.FQ2_OPS.norm(y)
 
 
+# Performance state (round 2, one tunneled v5e chip): 2048-set bucket
+# pipeline ~1.7 s -> ~1,200 sets/s (0.54x the 4-core blst baseline; was
+# 0.05x at round start). Cost model measured on-chip: per-HLO-op cost
+# is flat in batch up to ~2048 (fixed ~40 us/op), then bandwidth-bound
+# on the (batch, 40, 79) banded-matrix materialization inside each limb
+# conv (~12.6 KB/element-mul). Roadmap to 10x, in order: (a) a Pallas
+# conv kernel that keeps the band implicit in VMEM (kills ~10x traffic;
+# first attempts were shuffle-bound — needs a lane-shift-free inner
+# loop); (b) slot-stacked tower muls (all 18 fq muls of an fq12_mul as
+# one conv) to amortize fixed op cost; (c) an RNS/Montgomery limb
+# system whose base-extension matmuls are batch-shared constants and
+# therefore MXU-eligible (measured 30 TOP/s int32 matmul headroom).
+#
 # --- staged device programs ------------------------------------------------
 #
 # Round-1 ran six jitted stages with EAGER glue between them (concats,

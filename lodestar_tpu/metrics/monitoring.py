@@ -85,14 +85,22 @@ class MonitoringService:
 
     async def _loop(self) -> None:
         while True:
-            await self.push_once()
+            try:
+                await self.push_once()
+            except Exception:
+                # a single bad round must not kill the push task
+                self.pushes_failed += 1
             await asyncio.sleep(self.interval_s)
 
     async def push_once(self) -> bool:
-        stats = self._collect(
-            chain=self.chain, process_start=self._start
-        )
-        body = json.dumps([stats]).encode()
+        try:
+            stats = self._collect(
+                chain=self.chain, process_start=self._start
+            )
+            body = json.dumps([stats]).encode()
+        except Exception:
+            self.pushes_failed += 1
+            return False
 
         def _post():
             req = urllib.request.Request(

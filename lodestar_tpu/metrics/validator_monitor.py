@@ -29,12 +29,16 @@ class _MonitoredValidator:
     summaries: dict[int, _EpochSummary] = field(default_factory=dict)
 
     def summary(self, epoch: int) -> _EpochSummary:
-        if epoch not in self.summaries:
-            self.summaries[epoch] = _EpochSummary()
-            # bound memory: keep the last few epochs only
+        s = self.summaries.get(epoch)
+        if s is None:
+            s = self.summaries[epoch] = _EpochSummary()
+            # bound memory: keep the newest few epochs, but never the
+            # one just requested (old-epoch events arrive via reorg /
+            # unknown-block imports)
             for old in sorted(self.summaries)[:-4]:
-                del self.summaries[old]
-        return self.summaries[epoch]
+                if old != epoch:
+                    del self.summaries[old]
+        return s
 
 
 class ValidatorMonitor:

@@ -1,0 +1,184 @@
+"""UDP peer discovery: node records, PING/PONG, FINDNODE random walks.
+
+Reference analog: Discv5Worker (network/discv5/index.ts:27) over
+@chainsafe/discv5 — the node advertises a signed record (ENR analog)
+and learns peers by querying neighbors. This is a compact discv5-
+shaped protocol (not wire-compatible — interop is a non-goal here):
+JSON datagrams {t: ping|pong|findnode|nodes, record(s)}, records
+carrying (peer_id, host, tcp_port, udp_port, fork_digest, seq) and an
+HMAC-ish integrity tag derived from the peer id (a stand-in for the
+secp256k1 ENR signature, which needs a curve this framework does not
+ship).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass
+from hashlib import sha256
+
+MAX_KNOWN = 1024
+RECORD_TTL_S = 3600.0
+
+
+@dataclass
+class NodeRecord:
+    peer_id: str
+    host: str
+    tcp_port: int
+    udp_port: int
+    fork_digest: str
+    seq: int = 1
+
+    def to_json(self) -> dict:
+        d = self.__dict__.copy()
+        d["tag"] = self.tag()
+        return d
+
+    def tag(self) -> str:
+        raw = (
+            f"{self.peer_id}|{self.host}|{self.tcp_port}|"
+            f"{self.udp_port}|{self.fork_digest}|{self.seq}"
+        )
+        return sha256(raw.encode()).hexdigest()[:16]
+
+    @classmethod
+    def from_json(cls, d: dict):
+        rec = cls(
+            peer_id=d["peer_id"],
+            host=d["host"],
+            tcp_port=int(d["tcp_port"]),
+            udp_port=int(d["udp_port"]),
+            fork_digest=d.get("fork_digest", ""),
+            seq=int(d.get("seq", 1)),
+        )
+        if d.get("tag") != rec.tag():
+            raise ValueError("bad record tag")
+        return rec
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, disc):
+        self.disc = disc
+
+    def datagram_received(self, data, addr):
+        try:
+            msg = json.loads(data)
+        except ValueError:
+            return
+        asyncio.ensure_future(self.disc._on_message(msg, addr))
+
+
+class Discovery:
+    """One node's discovery service."""
+
+    def __init__(self, record: NodeRecord):
+        self.record = record
+        self.known: dict[str, tuple[NodeRecord, float]] = {}
+        self._transport = None
+        self._task = None
+        self.queries_sent = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def listen(self) -> int:
+        loop = asyncio.get_event_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(self),
+            local_addr=(self.record.host, self.record.udp_port),
+        )
+        sock = self._transport.get_extra_info("sockname")
+        self.record.udp_port = sock[1]
+        return sock[1]
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self._transport is not None:
+            self._transport.close()
+
+    def start_random_walk(self, interval_s: float = 3.0) -> None:
+        self._task = asyncio.ensure_future(self._walk_loop(interval_s))
+
+    async def _walk_loop(self, interval_s: float) -> None:
+        while True:
+            await self.query_round()
+            await asyncio.sleep(interval_s)
+
+    # -- protocol --------------------------------------------------------
+
+    def _send(self, msg: dict, addr) -> None:
+        if self._transport is not None:
+            self._transport.sendto(json.dumps(msg).encode(), addr)
+
+    async def _on_message(self, msg: dict, addr) -> None:
+        t = msg.get("t")
+        if t == "ping":
+            self._learn(msg.get("record"))
+            self._send(
+                {"t": "pong", "record": self.record.to_json()}, addr
+            )
+        elif t == "pong":
+            self._learn(msg.get("record"))
+        elif t == "findnode":
+            self._learn(msg.get("record"))
+            records = [
+                rec.to_json()
+                for rec, _ in list(self.known.values())[:16]
+            ] + [self.record.to_json()]
+            self._send({"t": "nodes", "records": records}, addr)
+        elif t == "nodes":
+            for rd in msg.get("records", []):
+                self._learn(rd)
+
+    def _learn(self, rd) -> None:
+        if not rd:
+            return
+        try:
+            rec = NodeRecord.from_json(rd)
+        except (ValueError, KeyError):
+            return
+        if rec.peer_id == self.record.peer_id:
+            return
+        old = self.known.get(rec.peer_id)
+        if old is not None and old[0].seq > rec.seq:
+            return
+        self.known[rec.peer_id] = (rec, time.monotonic())
+        if len(self.known) > MAX_KNOWN:
+            oldest = min(self.known.items(), key=lambda kv: kv[1][1])
+            del self.known[oldest[0]]
+
+    # -- API -------------------------------------------------------------
+
+    def add_bootnode(self, host: str, udp_port: int) -> None:
+        self._send(
+            {"t": "ping", "record": self.record.to_json()},
+            (host, udp_port),
+        )
+
+    async def query_round(self) -> None:
+        """Ask known peers for their neighbors (random-walk FINDNODE)."""
+        self.queries_sent += 1
+        now = time.monotonic()
+        self.known = {
+            k: v
+            for k, v in self.known.items()
+            if now - v[1] < RECORD_TTL_S
+        }
+        for rec, _ in list(self.known.values())[:8]:
+            self._send(
+                {"t": "findnode", "record": self.record.to_json()},
+                (rec.host, rec.udp_port),
+            )
+
+    def candidates(self, n: int) -> list[NodeRecord]:
+        """Dial candidates matching our fork digest."""
+        out = []
+        for rec, _ in self.known.values():
+            if rec.fork_digest == self.record.fork_digest:
+                out.append(rec)
+            if len(out) >= n:
+                break
+        return out

@@ -1,0 +1,207 @@
+"""Network facade: one node's wire stack bound to its chain.
+
+Reference analog: Network (network/network.ts:86) + NetworkCore
+(core/networkCore.ts:85) — owns the host (TCP here, libp2p there), the
+gossip engine, peer manager, discovery, and the reqresp engine; exposes
+publish/subscribe for beacon objects and wires inbound gossip into the
+chain's validation/import paths. Subnet topic windows follow
+AttnetsService (subnets/attnetsService.ts:43) in simplified form.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+
+from ..params import preset
+from . import reqresp as rr
+from .discovery import Discovery, NodeRecord
+from .gossip import GossipNode, ValidationResult, topic_name
+from .peers import PeerManager
+from .transport import TcpHost
+
+ATTESTATION_SUBNET_COUNT = 64
+
+
+class TcpReqRespTransport:
+    """Adapts the framed TCP host to the ReqResp engine's transport
+    interface (reqresp expects register()/request_raw())."""
+
+    def __init__(self, host: TcpHost):
+        self.host = host
+        self._local: rr.ReqResp | None = None
+        host.on_request = self._serve
+
+    def register(self, peer_id: str, node: rr.ReqResp) -> None:
+        self._local = node
+
+    async def _serve(self, peer_id: str, protocol: str, data: bytes):
+        if self._local is None:
+            return b""
+        return await self._local._serve_raw(peer_id, protocol, data)
+
+    async def request_raw(
+        self, from_peer: str, to_peer: str, protocol: str, data: bytes
+    ) -> bytes:
+        conn = self.host.conns.get(to_peer)
+        if conn is None:
+            raise rr.ReqRespError(
+                rr.RESP_SERVER_ERROR, f"not connected to {to_peer}"
+            )
+        return await conn.request(protocol, data)
+
+
+class Network:
+    """Everything between this node's chain and its peers."""
+
+    def __init__(
+        self,
+        chain,
+        beacon_cfg,
+        types,
+        processor=None,
+        host_addr: str = "127.0.0.1",
+        peer_id: str | None = None,
+        target_peers: int = 25,
+    ):
+        self.chain = chain
+        self.beacon_cfg = beacon_cfg
+        self.types = types
+        self.processor = processor
+        self.peer_id = peer_id or secrets.token_hex(8)
+        head_epoch = 0
+        self.fork_digest = beacon_cfg.fork_digest(head_epoch)
+        self.host = TcpHost(self.peer_id, self.fork_digest, host_addr)
+        self.gossip = GossipNode(self.host, on_penalize=self._penalize)
+        self.discovery: Discovery | None = None
+        self.peer_manager = PeerManager(
+            self.host, None, target_peers=target_peers
+        )
+        self.reqresp_transport = TcpReqRespTransport(self.host)
+        self.reqresp = rr.ReqResp(self.peer_id, self.reqresp_transport)
+        self.subscribed_subnets: set[int] = set()
+        self.blocks_received = 0
+        self.blocks_published = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self, tcp_port: int = 0, udp_port: int = 0) -> None:
+        port = await self.host.listen(tcp_port)
+        self.discovery = Discovery(
+            NodeRecord(
+                peer_id=self.peer_id,
+                host=self.host.host,
+                tcp_port=port,
+                udp_port=udp_port,
+                fork_digest=self.fork_digest.hex(),
+            )
+        )
+        await self.discovery.listen()
+        self.peer_manager.discovery = self.discovery
+        self._subscribe_core_topics()
+
+    async def stop(self) -> None:
+        await self.peer_manager.stop()
+        if self.discovery is not None:
+            await self.discovery.close()
+        await self.host.close()
+
+    def _penalize(self, peer_id: str, reason: str) -> None:
+        self.peer_manager.penalize(peer_id, reason)
+
+    # -- topics ----------------------------------------------------------
+
+    def _t(self, name: str) -> str:
+        return topic_name(self.fork_digest, name)
+
+    def _subscribe_core_topics(self) -> None:
+        self.gossip.subscribe(self._t("beacon_block"), self._on_block)
+        self.gossip.subscribe(
+            self._t("beacon_aggregate_and_proof"), self._on_aggregate
+        )
+
+    def subscribe_att_subnet(self, subnet: int) -> None:
+        """AttnetsService subscribe window (attnetsService.ts:43)."""
+        self.subscribed_subnets.add(subnet)
+        self.gossip.subscribe(
+            self._t(f"beacon_attestation_{subnet}"),
+            self._make_attestation_handler(subnet),
+        )
+
+    def unsubscribe_att_subnet(self, subnet: int) -> None:
+        self.subscribed_subnets.discard(subnet)
+        self.gossip.unsubscribe(self._t(f"beacon_attestation_{subnet}"))
+
+    # -- inbound handlers -------------------------------------------------
+
+    async def _on_block(self, peer_id: str, ssz_bytes: bytes):
+        from ..statetransition.slot import fork_at_epoch
+
+        try:
+            # fork from the digest-scoped topic == our digest's fork
+            head = self.chain.head_state
+            block = self.types.by_fork[
+                head.fork
+            ].SignedBeaconBlock.deserialize(ssz_bytes)
+        except Exception:
+            return ValidationResult.REJECT
+        try:
+            await self.chain.process_block(block)
+            self.blocks_received += 1
+            return ValidationResult.ACCEPT
+        except Exception as e:
+            if "unknown parent" in str(e):
+                return ValidationResult.IGNORE
+            return ValidationResult.REJECT
+
+    def _make_attestation_handler(self, subnet: int):
+        from .processor import GossipTopic
+
+        async def handler(peer_id: str, ssz_bytes: bytes):
+            try:
+                att = self.types.Attestation.deserialize(ssz_bytes)
+            except Exception:
+                return ValidationResult.REJECT
+            if self.processor is not None:
+                self.processor.on_gossip_message(
+                    GossipTopic.beacon_attestation, att
+                )
+                return ValidationResult.ACCEPT
+            return ValidationResult.IGNORE
+
+        return handler
+
+    async def _on_aggregate(self, peer_id: str, ssz_bytes: bytes):
+        from .processor import GossipTopic
+
+        try:
+            agg = self.types.SignedAggregateAndProof.deserialize(ssz_bytes)
+        except Exception:
+            return ValidationResult.REJECT
+        if self.processor is not None:
+            self.processor.on_gossip_message(
+                GossipTopic.beacon_aggregate_and_proof, agg
+            )
+            return ValidationResult.ACCEPT
+        return ValidationResult.IGNORE
+
+    # -- outbound ---------------------------------------------------------
+
+    async def publish_block(self, fork: str, signed_block) -> int:
+        data = self.types.by_fork[fork].SignedBeaconBlock.serialize(
+            signed_block
+        )
+        self.blocks_published += 1
+        return await self.gossip.publish(self._t("beacon_block"), data)
+
+    async def publish_attestation(self, att, subnet: int | None = None) -> int:
+        if subnet is None:
+            subnet = int(att.data.index) % ATTESTATION_SUBNET_COUNT
+        return await self.gossip.publish(
+            self._t(f"beacon_attestation_{subnet}"),
+            self.types.Attestation.serialize(att),
+        )
+
+    async def connect(self, host: str, port: int) -> str:
+        conn = await self.host.dial(host, port)
+        return conn.peer_id

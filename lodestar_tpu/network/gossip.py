@@ -1,0 +1,140 @@
+"""Gossip mesh pubsub over the TCP host.
+
+Reference analog: Eth2Gossipsub (network/gossip/gossipsub.ts:74) over
+@chainsafe/libp2p-gossipsub — mesh-based topic pubsub with message-id
+dedup, peer scoring, and snappy payload compression
+(DataTransformSnappy, gossip/encoding.ts:69). Topic names follow the
+spec shape `/eth2/{fork_digest}/{name}/ssz_snappy`; message ids are
+sha256 prefixes of the (compressed) payload like the phase0 spec's
+compute_message_id.
+
+The mesh logic is a compact gossipsub: every subscribed peer is mesh-
+eligible; publishes go to up to D mesh peers; received messages are
+validated through the registered handler (ACCEPT -> forward to the
+rest of the mesh, IGNORE/REJECT -> drop, REJECT -> penalize via the
+peer-score hook).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from enum import Enum
+from hashlib import sha256
+
+from ..utils import snappy
+from .transport import TcpHost
+
+D_MESH = 8  # gossipsub D
+SEEN_TTL = 120.0  # seconds a message id stays deduped
+
+
+class ValidationResult(str, Enum):
+    ACCEPT = "ACCEPT"
+    IGNORE = "IGNORE"
+    REJECT = "REJECT"
+
+
+def topic_name(fork_digest: bytes, name: str) -> str:
+    return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
+
+
+def message_id(data: bytes) -> bytes:
+    # spec-shaped: sha256(MESSAGE_DOMAIN_VALID_SNAPPY ++ data)[:20]
+    return sha256(b"\x01\x00\x00\x00" + data).digest()[:20]
+
+
+class GossipNode:
+    """One node's gossip engine bound to a TcpHost."""
+
+    def __init__(self, host: TcpHost, on_penalize=None):
+        self.host = host
+        host.on_gossip = self._on_gossip
+        self.subscriptions: dict[str, object] = {}  # topic -> handler
+        self.peer_topics: dict[str, set[str]] = {}  # peer -> topics
+        self._seen: dict[bytes, float] = {}
+        self.on_penalize = on_penalize  # fn(peer_id, reason)
+        self.messages_received = 0
+        self.messages_forwarded = 0
+        self.messages_published = 0
+
+    # -- subscription management ----------------------------------------
+    #
+    # Topic interest rides the hello metadata in full gossipsub; here
+    # peers learn interest lazily: every connected peer is a forward
+    # candidate, and uninterested peers drop (IGNORE) on receipt. The
+    # subnet services prune with subscribe/unsubscribe windows.
+
+    def subscribe(self, topic: str, handler) -> None:
+        """handler: async fn(peer_id, raw_ssz_bytes) -> ValidationResult"""
+        self.subscriptions[topic] = handler
+
+    def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.pop(topic, None)
+
+    # -- publish / receive ----------------------------------------------
+
+    def _mesh_peers(self, exclude: str | None = None) -> list[str]:
+        peers = [p for p in self.host.conns if p != exclude]
+        return peers[:D_MESH]
+
+    async def publish(self, topic: str, ssz_bytes: bytes) -> int:
+        data = snappy.frame_compress(ssz_bytes)
+        mid = message_id(data)
+        self._mark_seen(mid)
+        self.messages_published += 1
+        return await self._fanout(topic, data, exclude=None)
+
+    async def _fanout(self, topic: str, data: bytes, exclude) -> int:
+        import struct
+
+        payload = (
+            struct.pack(">H", len(topic.encode()))
+            + topic.encode()
+            + data
+        )
+        n = 0
+        for peer in self._mesh_peers(exclude):
+            conn = self.host.conns.get(peer)
+            if conn is None:
+                continue
+            try:
+                await conn.send_frame(1, payload)  # K_GOSSIP
+                n += 1
+            except Exception:
+                pass
+        return n
+
+    async def _on_gossip(self, peer_id: str, topic: str, data: bytes):
+        mid = message_id(data)
+        if mid in self._seen:
+            return
+        self._mark_seen(mid)
+        handler = self.subscriptions.get(topic)
+        if handler is None:
+            return  # not subscribed: ignore silently
+        self.messages_received += 1
+        try:
+            ssz_bytes = snappy.frame_uncompress(data)
+        except snappy.SnappyError:
+            self._penalize(peer_id, "bad snappy frame")
+            return
+        result = await handler(peer_id, ssz_bytes)
+        if result is ValidationResult.ACCEPT:
+            self.messages_forwarded += 1
+            await self._fanout(topic, data, exclude=peer_id)
+        elif result is ValidationResult.REJECT:
+            self._penalize(peer_id, f"rejected message on {topic}")
+
+    def _penalize(self, peer_id: str, reason: str) -> None:
+        if self.on_penalize is not None:
+            self.on_penalize(peer_id, reason)
+
+    def _mark_seen(self, mid: bytes) -> None:
+        now = time.monotonic()
+        self._seen[mid] = now
+        if len(self._seen) > 1 << 16:
+            cutoff = now - SEEN_TTL
+            self._seen = {
+                k: v for k, v in self._seen.items() if v > cutoff
+            }

@@ -1,0 +1,140 @@
+"""Peer manager: scoring, heartbeat, dial targets, pruning.
+
+Reference analog: PeerManager (network/peers/peerManager.ts:128) with
+PeerRpcScoreStore/RealScore (peers/score/store.ts:29, score.ts:17) —
+maintains a target peer count from discovered candidates, pings on a
+heartbeat, decays scores toward zero, and disconnects/bans peers whose
+score falls below thresholds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+
+from .transport import TcpHost
+
+TARGET_PEERS = 25
+HEARTBEAT_S = 5.0
+SCORE_DECAY_HALF_LIFE_S = 600.0
+MIN_SCORE_BEFORE_DISCONNECT = -20.0
+MIN_SCORE_BEFORE_BAN = -50.0
+
+# penalty weights (score/score.ts action weights)
+PENALTIES = {
+    "bad snappy frame": -10.0,
+    "invalid block": -20.0,
+    "invalid attestation": -5.0,
+    "reqresp error": -2.0,
+    "rejected message": -5.0,
+}
+
+
+class PeerScore:
+    def __init__(self):
+        self.score = 0.0
+        self.last_update = time.monotonic()
+
+    def apply(self, delta: float) -> float:
+        self._decay()
+        self.score = max(-100.0, min(100.0, self.score + delta))
+        return self.score
+
+    def value(self) -> float:
+        self._decay()
+        return self.score
+
+    def _decay(self) -> None:
+        now = time.monotonic()
+        dt = now - self.last_update
+        if dt > 0:
+            self.score *= 0.5 ** (dt / SCORE_DECAY_HALF_LIFE_S)
+            self.last_update = now
+
+
+class PeerManager:
+    def __init__(
+        self,
+        host: TcpHost,
+        discovery=None,
+        target_peers: int = TARGET_PEERS,
+    ):
+        self.host = host
+        self.discovery = discovery
+        self.target_peers = target_peers
+        self.scores: dict[str, PeerScore] = {}
+        self.banned: set[str] = set()
+        self._task = None
+        self.on_new_peer = None  # hook: fn(peer_id) e.g. status handshake
+        host.on_peer_connected = self._connected
+        host.on_peer_lost = self._lost
+
+    # -- events ----------------------------------------------------------
+
+    def _connected(self, peer_id: str) -> None:
+        if peer_id in self.banned:
+            conn = self.host.conns.get(peer_id)
+            if conn is not None:
+                asyncio.ensure_future(conn.close())
+            return
+        self.scores.setdefault(peer_id, PeerScore())
+        if self.on_new_peer is not None:
+            self.on_new_peer(peer_id)
+
+    def _lost(self, peer_id: str) -> None:
+        pass  # score store persists across reconnects
+
+    def penalize(self, peer_id: str, reason: str) -> None:
+        delta = PENALTIES.get(reason)
+        if delta is None:
+            delta = PENALTIES.get(reason.split(" on ")[0], -2.0)
+        score = self.scores.setdefault(peer_id, PeerScore()).apply(delta)
+        if score <= MIN_SCORE_BEFORE_BAN:
+            self.banned.add(peer_id)
+        if score <= MIN_SCORE_BEFORE_DISCONNECT:
+            conn = self.host.conns.get(peer_id)
+            if conn is not None:
+                asyncio.ensure_future(conn.close())
+
+    # -- heartbeat --------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await self.heartbeat()
+            await asyncio.sleep(HEARTBEAT_S)
+
+    async def heartbeat(self) -> None:
+        """One maintenance round: ping live peers, dial new candidates
+        below target (peerManager.ts heartbeat)."""
+        for conn in list(self.host.conns.values()):
+            try:
+                await conn.send_frame(4, secrets.token_bytes(8))  # PING
+            except Exception:
+                pass
+        deficit = self.target_peers - len(self.host.conns)
+        if deficit > 0 and self.discovery is not None:
+            for cand in self.discovery.candidates(deficit * 2):
+                if len(self.host.conns) >= self.target_peers:
+                    break
+                if (
+                    cand.peer_id in self.host.conns
+                    or cand.peer_id in self.banned
+                    or cand.peer_id == self.host.peer_id
+                ):
+                    continue
+                try:
+                    await self.host.dial(cand.host, cand.tcp_port)
+                except OSError:
+                    continue

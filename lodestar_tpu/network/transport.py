@@ -1,0 +1,256 @@
+"""TCP peer transport: framed, multiplexed peer connections.
+
+Reference analog: the libp2p host stack (TCP + noise + mplex,
+network/libp2p/index.ts). This framework's wire is deliberately its
+own lightweight protocol (building an interoperable libp2p is a
+non-goal of the TPU port — SURVEY.md §5.8 keeps the internet-facing
+stack host/CPU): length-prefixed frames over TCP, a hello handshake
+carrying (peer_id, fork_digest), and two multiplexed lanes — gossip
+pushes and reqresp request/response streams — that the gossip mesh and
+the reqresp engine share one socket through.
+
+Frame layout: 4B big-endian length | 1B kind | payload.
+  kind 0 HELLO      payload = json {peer_id, fork_digest, tcp_port}
+  kind 1 GOSSIP     payload = topic_len(2B) | topic | data
+  kind 2 REQ        payload = req_id(4B) | proto_len(2B) | proto | data
+  kind 3 RESP       payload = req_id(4B) | data
+  kind 4 PING       payload = nonce(8B)
+  kind 5 PONG       payload = nonce(8B)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+MAX_FRAME = 32 * 1024 * 1024
+
+K_HELLO, K_GOSSIP, K_REQ, K_RESP, K_PING, K_PONG = range(6)
+
+
+class TransportError(Exception):
+    pass
+
+
+class PeerConnection:
+    """One live TCP connection to a peer (post-handshake)."""
+
+    def __init__(self, reader, writer, peer_id: str, hello: dict):
+        self.reader = reader
+        self.writer = writer
+        self.peer_id = peer_id
+        self.hello = hello
+        self._send_lock = asyncio.Lock()
+        self._req_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self.closed = False
+
+    async def send_frame(self, kind: int, payload: bytes) -> None:
+        if self.closed:
+            raise TransportError(f"connection to {self.peer_id} closed")
+        frame = struct.pack(">IB", len(payload) + 1, kind) + payload
+        async with self._send_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+    async def request(
+        self, protocol: str, data: bytes, timeout: float = 10.0
+    ) -> bytes:
+        self._req_id += 1
+        rid = self._req_id
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[rid] = fut
+        proto = protocol.encode()
+        try:
+            await self.send_frame(
+                K_REQ,
+                struct.pack(">IH", rid, len(proto)) + proto + data,
+            )
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    def resolve(self, rid: int, data: bytes) -> None:
+        fut = self._pending.get(rid)
+        if fut is not None and not fut.done():
+            fut.set_result(data)
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.writer.close()
+            # wait_closed can stall when both ends race to close; bound it
+            await asyncio.wait_for(self.writer.wait_closed(), 1.0)
+        except Exception:
+            pass
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(TransportError("connection closed"))
+
+
+async def read_frame(reader) -> tuple[int, bytes]:
+    head = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", head)
+    if not 1 <= length <= MAX_FRAME:
+        raise TransportError(f"bad frame length {length}")
+    body = await reader.readexactly(length)
+    return body[0], body[1:]
+
+
+class TcpHost:
+    """Listens, dials, handshakes; delivers frames to the Network.
+
+    on_gossip(peer_id, topic, data); on_request(peer_id, protocol,
+    data) -> bytes; on_peer_connected(peer_id)/on_peer_lost(peer_id)
+    are event hooks the network facade installs.
+    """
+
+    def __init__(self, peer_id: str, fork_digest: bytes, host="127.0.0.1"):
+        self.peer_id = peer_id
+        self.fork_digest = fork_digest
+        self.host = host
+        self.port: int | None = None
+        self.conns: dict[str, PeerConnection] = {}
+        self._server = None
+        self._tasks: set[asyncio.Task] = set()
+        # hooks
+        self.on_gossip = None
+        self.on_request = None
+        self.on_peer_connected = None
+        self.on_peer_lost = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def listen(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._accept, self.host, port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def close(self) -> None:
+        # connections first: on 3.12 Server.wait_closed() blocks until
+        # every accepted handler's streams are closed
+        for conn in list(self.conns.values()):
+            await conn.close()
+        for t in list(self._tasks):
+            t.cancel()
+        self.conns.clear()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _hello_payload(self) -> bytes:
+        return json.dumps(
+            {
+                "peer_id": self.peer_id,
+                "fork_digest": self.fork_digest.hex(),
+                "tcp_port": self.port or 0,
+            }
+        ).encode()
+
+    # -- dialing / accepting --------------------------------------------
+
+    async def dial(self, host: str, port: int) -> PeerConnection:
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            struct.pack(">IB", len(self._hello_payload()) + 1, K_HELLO)
+            + self._hello_payload()
+        )
+        await writer.drain()
+        kind, payload = await read_frame(reader)
+        if kind != K_HELLO:
+            writer.close()
+            raise TransportError("expected HELLO")
+        hello = json.loads(payload)
+        conn = PeerConnection(reader, writer, hello["peer_id"], hello)
+        self._install(conn)
+        return conn
+
+    async def _accept(self, reader, writer) -> None:
+        try:
+            kind, payload = await read_frame(reader)
+            if kind != K_HELLO:
+                writer.close()
+                return
+            hello = json.loads(payload)
+            writer.write(
+                struct.pack(
+                    ">IB", len(self._hello_payload()) + 1, K_HELLO
+                )
+                + self._hello_payload()
+            )
+            await writer.drain()
+        except (asyncio.IncompleteReadError, TransportError, OSError):
+            writer.close()
+            return
+        conn = PeerConnection(reader, writer, hello["peer_id"], hello)
+        self._install(conn)
+
+    def _install(self, conn: PeerConnection) -> None:
+        old = self.conns.get(conn.peer_id)
+        if old is not None:
+            # keep one connection per peer: tie-break on peer id so
+            # both sides drop the same duplicate
+            if min(self.peer_id, conn.peer_id) == self.peer_id:
+                asyncio.ensure_future(old.close())
+            else:
+                asyncio.ensure_future(conn.close())
+                return
+        self.conns[conn.peer_id] = conn
+        task = asyncio.ensure_future(self._read_loop(conn))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        if self.on_peer_connected is not None:
+            self.on_peer_connected(conn.peer_id)
+
+    # -- frame pump ------------------------------------------------------
+
+    async def _read_loop(self, conn: PeerConnection) -> None:
+        try:
+            while not conn.closed:
+                kind, payload = await read_frame(conn.reader)
+                if kind == K_GOSSIP:
+                    (tlen,) = struct.unpack(">H", payload[:2])
+                    topic = payload[2 : 2 + tlen].decode()
+                    data = payload[2 + tlen :]
+                    if self.on_gossip is not None:
+                        await self.on_gossip(conn.peer_id, topic, data)
+                elif kind == K_REQ:
+                    rid, plen = struct.unpack(">IH", payload[:6])
+                    proto = payload[6 : 6 + plen].decode()
+                    data = payload[6 + plen :]
+                    resp = b""
+                    if self.on_request is not None:
+                        resp = await self.on_request(
+                            conn.peer_id, proto, data
+                        )
+                    await conn.send_frame(
+                        K_RESP, struct.pack(">I", rid) + resp
+                    )
+                elif kind == K_RESP:
+                    (rid,) = struct.unpack(">I", payload[:4])
+                    conn.resolve(rid, payload[4:])
+                elif kind == K_PING:
+                    await conn.send_frame(K_PONG, payload)
+                elif kind == K_PONG:
+                    pass  # PeerManager tracks liveness by any traffic
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            TransportError,
+            OSError,
+        ):
+            pass
+        finally:
+            await conn.close()
+            if self.conns.get(conn.peer_id) is conn:
+                del self.conns[conn.peer_id]
+                if self.on_peer_lost is not None:
+                    self.on_peer_lost(conn.peer_id)

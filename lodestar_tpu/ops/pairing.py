@@ -54,13 +54,6 @@ _U = -BLS_X  # positive |x|, low hamming weight
 _U_BITS = np.asarray([int(b) for b in bin(_U)[3:]], dtype=bool)
 
 
-def _sparse_line(l0, l2, l3, batch):
-    """Assemble (l0 + l2*w^2 + l3*w^3) as a full Fq12 element: slots
-    w^0 -> b0.c0, w^2 = v -> b0.c1, w^3 = v*w -> b1.c1."""
-    z2 = tower.fq2_const((0, 0), batch)
-    return ((l0, l2, z2), (z2, l3, z2))
-
-
 def _dbl_step(T: JacPoint, px, py):
     """Double T and return the tangent-line slots evaluated at (px, py).
     Shares intermediates between the line and dbl-2009-l."""
@@ -144,14 +137,10 @@ def miller_loop(px, py, qx, qy):
         T, f = carry
         T2, (d0, d2, d3) = _dbl_step(T, px, py)
         f2 = _norm12(
-            tower.fq12_mul(
-                tower.fq12_sqr(f), _sparse_line(d0, d2, d3, batch)
-            )
+            tower.fq12_mul_sparse_line(tower.fq12_sqr(f), d0, d2, d3)
         )
         T3, (a0, a2, a3) = _add_step(T2, qx, qy, px, py)
-        f3 = _norm12(
-            tower.fq12_mul(f2, _sparse_line(a0, a2, a3, batch))
-        )
+        f3 = _norm12(tower.fq12_mul_sparse_line(f2, a0, a2, a3))
         T_next = jac_select(FQ2_OPS, bit, T3, T2)
         f_next = tower.fq12_select(bit, f3, f2)
         return (T_next, f_next), None

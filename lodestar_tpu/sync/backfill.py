@@ -54,7 +54,14 @@ class BackfillSync:
             lo = max(to_slot, hi - BACKFILL_BATCH_SLOTS)
             blocks = await self._download(lo, hi - lo)
             if not blocks:
-                raise BackfillError(f"no blocks served for [{lo},{hi})")
+                # [lo, hi) can legitimately hold only skipped slots:
+                # widen the window downward before giving up
+                if lo == to_slot:
+                    raise BackfillError(
+                        f"no blocks served for [{lo},{hi})"
+                    )
+                hi = lo
+                continue
             expected_root = await self._verify_and_store(
                 blocks, expected_root
             )
@@ -64,11 +71,14 @@ class BackfillSync:
         return self.blocks_backfilled
 
     async def _download(self, start: int, count: int):
+        from .range_sync import decode_block_chunks
+
         req = BeaconBlocksByRangeRequest(
             start_slot=start, count=count, step=1
         )
         payload = BeaconBlocksByRangeRequest.serialize(req)
         last_err = None
+        any_ok = False
         for peer in list(self.peers):
             try:
                 chunks = await self.node.request(
@@ -77,19 +87,13 @@ class BackfillSync:
             except (rr.ReqRespError, TimeoutError) as e:
                 last_err = e
                 continue
-            out = []
-            for ch in chunks:
-                fork = self.beacon_cfg.fork_name_from_digest(ch.context)
-                out.append(
-                    (
-                        fork,
-                        self.types.by_fork[
-                            fork
-                        ].SignedBeaconBlock.deserialize(ch.payload),
-                    )
-                )
-            return out
-        raise BackfillError(f"all peers failed: {last_err}")
+            any_ok = True
+            if not chunks:
+                continue  # peer may lack this span: try the next one
+            return decode_block_chunks(self.beacon_cfg, self.types, chunks)
+        if not any_ok:
+            raise BackfillError(f"all peers failed: {last_err}")
+        return []  # every responding peer served an empty span
 
     async def _verify_and_store(self, blocks, expected_root: bytes) -> bytes:
         """Check hash linkage child->parent against expected_root, then
@@ -131,9 +135,6 @@ class BackfillSync:
             raise BackfillError("proposer signature batch failed")
         if self.chain.db is not None:
             for fork, block in blocks:
-                root = types.by_fork[fork].BeaconBlock.hash_tree_root(
-                    block.message
-                )
                 self.chain.db.block_archive.put(
                     int(block.message.slot), (fork, block)
                 )

@@ -29,6 +29,24 @@ MAX_BATCH_DOWNLOAD_ATTEMPTS = 5
 MAX_BATCH_PROCESSING_ATTEMPTS = 3
 
 
+def decode_block_chunks(beacon_cfg, types, chunks):
+    """reqresp response chunks -> [(fork, SignedBeaconBlock)] using the
+    per-chunk fork-digest context (shared by range/backfill/unknown
+    sync)."""
+    out = []
+    for ch in chunks:
+        fork = beacon_cfg.fork_name_from_digest(ch.context)
+        out.append(
+            (
+                fork,
+                types.by_fork[fork].SignedBeaconBlock.deserialize(
+                    ch.payload
+                ),
+            )
+        )
+    return out
+
+
 class BatchStatus(str, Enum):
     awaiting_download = "AwaitingDownload"
     downloading = "Downloading"
@@ -268,15 +286,12 @@ class RangeSync:
             rr.PROTOCOL_BLOCKS_BY_RANGE,
             BeaconBlocksByRangeRequest.serialize(req),
         )
-        blocks = []
-        for ch in chunks:
-            fork = self.beacon_cfg.fork_name_from_digest(ch.context)
-            blocks.append(
-                self.types.by_fork[fork].SignedBeaconBlock.deserialize(
-                    ch.payload
-                )
+        return [
+            block
+            for _, block in decode_block_chunks(
+                self.beacon_cfg, self.types, chunks
             )
-        return blocks
+        ]
 
     async def _process(self, batch: Batch) -> None:
         """chain.processChainSegment analog: sequential import; each

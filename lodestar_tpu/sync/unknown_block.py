@@ -68,11 +68,11 @@ class UnknownBlockSync:
                 )
             except (rr.ReqRespError, TimeoutError):
                 continue
-            for ch in chunks:
-                fork = self.beacon_cfg.fork_name_from_digest(ch.context)
-                block = self.chain.types.by_fork[
-                    fork
-                ].SignedBeaconBlock.deserialize(ch.payload)
+            from .range_sync import decode_block_chunks
+
+            for fork, block in decode_block_chunks(
+                self.beacon_cfg, self.chain.types, chunks
+            ):
                 got_root = self.chain.types.by_fork[
                     fork
                 ].BeaconBlock.hash_tree_root(block.message)

@@ -42,6 +42,16 @@ class TestValidatorMonitor:
             in text
         )
 
+    def test_old_epoch_summary_after_prune(self):
+        """Reorg/unknown-block imports feed old epochs; the memory
+        bound must never evict the epoch just requested (KeyError)."""
+        vm = ValidatorMonitor()
+        vm.register_local_validator(1)
+        mv = vm.validators[1]
+        for e in (5, 6, 7, 8):
+            mv.summary(e)
+        assert mv.summary(4) is not None
+
     def test_proposal_tracking(self):
         vm = ValidatorMonitor()
         vm.register_local_validator(2)

@@ -18,7 +18,7 @@ from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2  # noqa: E402
 from lodestar_tpu.ops import curve as C  # noqa: E402
 from lodestar_tpu.params import BLS_DST_SIG  # noqa: E402
 
-N = 128
+N = 2048
 
 
 def main() -> None:
