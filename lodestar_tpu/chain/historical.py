@@ -1,0 +1,89 @@
+"""Historical state regeneration off the hot path.
+
+Reference analog: HistoricalStateRegen + its worker
+(chain/historicalState/index.ts:19, worker.ts) — API queries for
+long-finalized states replay from the state archive in a separate
+thread so the main loop never blocks on minutes of replay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..statetransition import state_transition
+from ..statetransition.slot import BeaconStateView, process_slots
+
+
+class HistoricalStateError(Exception):
+    pass
+
+
+class HistoricalStateRegen:
+    """Replays archived finalized history: nearest archived state at or
+    below the target slot + archived blocks up to it."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.regens = 0
+        self.blocks_replayed = 0
+
+    async def get_state_at_slot(self, slot: int) -> BeaconStateView:
+        return await asyncio.get_event_loop().run_in_executor(
+            None, self._regen_sync, slot
+        )
+
+    def _regen_sync(self, slot: int) -> BeaconStateView:
+        db = self.chain.db
+        if db is None:
+            raise HistoricalStateError("no database attached")
+        base = None
+        base_slot = None
+        for s, (fork, state) in db.state_archive.entries(
+            end=slot + 1, reverse=True, limit=1
+        ):
+            base = BeaconStateView(state=state, fork=fork)
+            base_slot = s
+        if base is None:
+            # below the earliest archive: replay from the db anchor
+            # (initBeaconState's anchor is always persisted)
+            anchor_root = db.meta.get_raw("anchor_root")
+            raw = (
+                db.state.get_binary(anchor_root)
+                if anchor_root is not None
+                else None
+            )
+            if raw is not None:
+                fork, state = db.state.decode_value(raw)
+                if int(state.slot) <= slot:
+                    base = BeaconStateView(state=state, fork=fork)
+                    base_slot = int(state.slot)
+        if base is None:
+            raise HistoricalStateError(
+                f"no archived state at or below slot {slot}"
+            )
+        from .chain import _clone
+
+        work = _clone(base, self.chain.types)
+        self.regens += 1
+        if base_slot == slot:
+            return work
+        for s, (fork, block) in db.block_archive.entries(
+            start=base_slot + 1, end=slot + 1
+        ):
+            process_slots(
+                self.chain.cfg, work, int(block.message.slot),
+                self.chain.types,
+            )
+            state_transition(
+                self.chain.cfg,
+                work,
+                block,
+                self.chain.types,
+                verify_state_root=True,
+                verify_proposer=False,
+                verify_signatures=False,
+            )
+            self.blocks_replayed += 1
+        if int(work.state.slot) < slot:
+            process_slots(self.chain.cfg, work, slot, self.chain.types)
+        return work

@@ -1,0 +1,45 @@
+"""Prepare-next-slot scheduler.
+
+Reference analog: PrepareNextSlotScheduler (chain/prepareNextSlot.ts:40)
+— at ~2/3 into each slot, precompute the head state advanced to the
+next slot (epoch transitions land here, OFF the block-arrival critical
+path) and, with an execution engine attached, send fcU payload
+attributes so the EL starts building.
+"""
+
+from __future__ import annotations
+
+from ..statetransition.slot import process_slots
+
+
+class PrepareNextSlotScheduler:
+    def __init__(self, chain):
+        self.chain = chain
+        self.prepared: dict[bytes, object] = {}
+        self.prepares = 0
+
+    async def prepare(self, next_slot: int):
+        """Advance a head-state clone to `next_slot` and cache it keyed
+        by (head_root, slot); block import / production reuse it."""
+        from .chain import _clone
+
+        head_root = self.chain.head_root
+        key = head_root + int(next_slot).to_bytes(8, "big")
+        if key in self.prepared:
+            return self.prepared[key]
+        head = self.chain.get_or_regen_state(head_root)
+        work = _clone(head, self.chain.types)
+        process_slots(self.chain.cfg, work, next_slot, self.chain.types)
+        self.prepared = {key: work}  # keep only the newest
+        self.prepares += 1
+        if self.chain.execution_engine is not None:
+            try:
+                await self.chain.notify_forkchoice_update()
+            except Exception:
+                pass
+        return work
+
+    def take(self, head_root: bytes, slot: int):
+        """Consume a prepared state if it matches (else None)."""
+        key = bytes(head_root) + int(slot).to_bytes(8, "big")
+        return self.prepared.pop(key, None)

@@ -1,0 +1,54 @@
+"""Reprocess controller: retry attestations that beat their block.
+
+Reference analog: ReprocessController (chain/reprocess.ts:50) —
+gossip attestations referencing an unknown head are parked (bounded,
+with a deadline) and re-run as soon as the block arrives; unresolved
+entries expire at the slot boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+MAX_QUEUED_PER_ROOT = 16_384 // 64
+WAIT_SLOTS = 1
+
+
+class ReprocessController:
+    def __init__(self, chain):
+        self.chain = chain
+        self._waiting: dict[bytes, list] = {}  # block root -> [(att, committee)]
+        self.resolved = 0
+        self.expired = 0
+
+    def await_block(self, block_root: bytes, attestation, committee) -> bool:
+        """Park an attestation until its head block arrives. Returns
+        False when the per-root budget is exhausted (caller drops)."""
+        q = self._waiting.setdefault(bytes(block_root), [])
+        if len(q) >= MAX_QUEUED_PER_ROOT:
+            return False
+        q.append((attestation, committee))
+        return True
+
+    async def on_block_imported(self, block_root: bytes) -> int:
+        """Flush parked attestations for a just-imported block."""
+        q = self._waiting.pop(bytes(block_root), None)
+        if not q:
+            return 0
+        n = 0
+        for att, committee in q:
+            try:
+                if await self.chain.on_attestation(att, committee):
+                    n += 1
+            except Exception:
+                pass
+        self.resolved += n
+        return n
+
+    def on_slot(self, slot: int) -> int:
+        """Expire everything still unresolved (reprocess.ts slot
+        boundary sweep)."""
+        n = sum(len(q) for q in self._waiting.values())
+        self._waiting.clear()
+        self.expired += n
+        return n

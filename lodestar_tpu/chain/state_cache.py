@@ -1,0 +1,82 @@
+"""Checkpoint-state cache with disk spill.
+
+Reference analog: InMemoryCheckpointStateCache +
+PersistentCheckpointStateCache (chain/stateCache/
+persistentCheckpointsCache.ts:94 with Db/File datastores) — epoch-
+boundary states are the regen seeds for attestation validation and
+epoch processing; recent ones stay in memory, finalized-distant ones
+spill to the checkpoint_state bucket and reload on demand.
+"""
+
+from __future__ import annotations
+
+from ..statetransition.slot import BeaconStateView
+
+MAX_IN_MEMORY = 8  # persistentCheckpointsCache maxCPStateEpochsInMemory
+
+
+def _key(epoch: int, root: bytes) -> bytes:
+    return int(epoch).to_bytes(8, "big") + bytes(root)
+
+
+class CheckpointStateCache:
+    def __init__(self, types, db=None, max_in_memory: int = MAX_IN_MEMORY):
+        self.types = types
+        self.db = db
+        self.max_in_memory = max_in_memory
+        self._mem: dict[bytes, BeaconStateView] = {}
+        self._order: list[bytes] = []
+        self.hits = 0
+        self.misses = 0
+        self.spills = 0
+        self.reloads = 0
+
+    def add(self, epoch: int, root: bytes, view: BeaconStateView) -> None:
+        k = _key(epoch, root)
+        if k in self._mem:
+            return
+        self._mem[k] = view
+        self._order.append(k)
+        while len(self._order) > self.max_in_memory:
+            old = self._order.pop(0)
+            view_old = self._mem.pop(old, None)
+            if view_old is not None and self.db is not None:
+                # spill instead of dropping (datastore/db.ts)
+                self.db.checkpoint_state.put(
+                    old, (view_old.fork, view_old.state)
+                )
+                self.spills += 1
+
+    def get(self, epoch: int, root: bytes) -> BeaconStateView | None:
+        k = _key(epoch, root)
+        got = self._mem.get(k)
+        if got is not None:
+            self.hits += 1
+            return got
+        if self.db is not None:
+            raw = self.db.checkpoint_state.get_binary(k)
+            if raw is not None:
+                fork, state = self.db.checkpoint_state.decode_value(raw)
+                view = BeaconStateView(state=state, fork=fork)
+                self.reloads += 1
+                self.hits += 1
+                return view
+        self.misses += 1
+        return None
+
+    def prune_finalized(self, finalized_epoch: int) -> int:
+        """Drop entries below the finalized epoch (archiver takes over
+        long-term storage). Returns entries removed."""
+        removed = 0
+        for k in list(self._mem):
+            if int.from_bytes(k[:8], "big") < finalized_epoch:
+                self._mem.pop(k)
+                self._order.remove(k)
+                removed += 1
+        if self.db is not None:
+            for k in list(self.db.checkpoint_state.keys()):
+                kb = k if isinstance(k, bytes) else bytes(k)
+                if int.from_bytes(kb[:8], "big") < finalized_epoch:
+                    self.db.checkpoint_state.delete(kb)
+                    removed += 1
+        return removed

@@ -1,0 +1,76 @@
+"""Flare: ops commands for non-standard (dangerous) actions.
+
+Reference analog: packages/flare — a small CLI for fault injection and
+testnet surgery, e.g. `selfSlashProposer` (src/cmds/selfSlashProposer.ts)
+which signs two conflicting blocks for a validator to force a slashing,
+and a matching attester variant. Used by the sim harness and operators
+to exercise slashing paths end-to-end.
+"""
+
+from __future__ import annotations
+
+from .crypto.bls.signature import sign
+from .params import DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER, preset
+from .statetransition import util
+from .statetransition.block import compute_signing_root, get_domain
+
+
+def self_slash_proposer(cfg, types, state, validator_index: int, sk: int,
+                        slot: int | None = None):
+    """Build a ProposerSlashing by signing two conflicting headers for
+    `validator_index` (flare selfSlashProposer analog). Returns the
+    ProposerSlashing value, ready for the op pool / gossip."""
+    s = int(slot if slot is not None else state.slot)
+    domain = get_domain(
+        cfg, state, DOMAIN_BEACON_PROPOSER, util.compute_epoch_at_slot(s)
+    )
+
+    def mk(graffiti_root: bytes):
+        h = types.BeaconBlockHeader.default()
+        h.slot = s
+        h.proposer_index = validator_index
+        h.parent_root = b"\x00" * 32
+        h.state_root = b"\x00" * 32
+        h.body_root = graffiti_root
+        sh = types.SignedBeaconBlockHeader.default()
+        sh.message = h
+        root = compute_signing_root(types.BeaconBlockHeader, h, domain)
+        sh.signature = sign(sk, root)
+        return sh
+
+    slashing = types.ProposerSlashing.default()
+    slashing.signed_header_1 = mk(b"\x01" * 32)
+    slashing.signed_header_2 = mk(b"\x02" * 32)
+    return slashing
+
+
+def self_slash_attester(cfg, types, state, validator_index: int, sk: int,
+                        target_epoch: int | None = None):
+    """Build an AttesterSlashing from two contradictory attestations
+    (double vote) by `validator_index`."""
+    epoch = int(
+        target_epoch
+        if target_epoch is not None
+        else util.get_current_epoch(state)
+    )
+    domain = get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, epoch)
+
+    def mk(beacon_root: bytes):
+        data = types.AttestationData.default()
+        data.slot = epoch * preset().SLOTS_PER_EPOCH
+        data.index = 0
+        data.beacon_block_root = beacon_root
+        data.source = state.current_justified_checkpoint
+        data.target.epoch = epoch
+        data.target.root = beacon_root
+        att = types.IndexedAttestation.default()
+        att.attesting_indices = [validator_index]
+        att.data = data
+        root = compute_signing_root(types.AttestationData, data, domain)
+        att.signature = sign(sk, root)
+        return att
+
+    slashing = types.AttesterSlashing.default()
+    slashing.attestation_1 = mk(b"\x0a" * 32)
+    slashing.attestation_2 = mk(b"\x0b" * 32)
+    return slashing

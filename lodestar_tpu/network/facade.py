@@ -85,7 +85,12 @@ class Network:
 
     # -- lifecycle -------------------------------------------------------
 
-    async def start(self, tcp_port: int = 0, udp_port: int = 0) -> None:
+    async def start(
+        self,
+        tcp_port: int = 0,
+        udp_port: int = 0,
+        run_maintenance: bool = True,
+    ) -> None:
         port = await self.host.listen(tcp_port)
         self.discovery = Discovery(
             NodeRecord(
@@ -99,6 +104,11 @@ class Network:
         await self.discovery.listen()
         self.peer_manager.discovery = self.discovery
         self._subscribe_core_topics()
+        if run_maintenance:
+            # heartbeat pings/dials + discovery random walk (the tests
+            # that dial explicitly pass run_maintenance=False)
+            self.peer_manager.start()
+            self.discovery.start_random_walk()
 
     async def stop(self) -> None:
         await self.peer_manager.stop()
@@ -138,10 +148,17 @@ class Network:
         from ..statetransition.slot import fork_at_epoch
 
         try:
-            # fork from the digest-scoped topic == our digest's fork
-            head = self.chain.head_state
+            # fork from the BLOCK's slot (the head may still be on the
+            # previous fork at a transition): SignedBeaconBlock is
+            # [offset(4) | signature(96) | message], message leads with
+            # the u64 slot
+            off = int.from_bytes(ssz_bytes[:4], "little")
+            slot = int.from_bytes(ssz_bytes[off : off + 8], "little")
+            fork = fork_at_epoch(
+                self.chain.cfg, slot // preset().SLOTS_PER_EPOCH
+            )
             block = self.types.by_fork[
-                head.fork
+                fork
             ].SignedBeaconBlock.deserialize(ssz_bytes)
         except Exception:
             return ValidationResult.REJECT

@@ -112,7 +112,10 @@ class PeerManager:
 
     async def _heartbeat_loop(self) -> None:
         while True:
-            await self.heartbeat()
+            try:
+                await self.heartbeat()
+            except Exception:
+                pass  # maintenance must never die to one bad peer
             await asyncio.sleep(HEARTBEAT_S)
 
     async def heartbeat(self) -> None:
@@ -136,5 +139,6 @@ class PeerManager:
                     continue
                 try:
                     await self.host.dial(cand.host, cand.tcp_port)
-                except OSError:
+                except Exception:
+                    # refused, malformed hello, mid-handshake EOF, ...
                     continue

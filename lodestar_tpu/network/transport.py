@@ -36,11 +36,13 @@ class TransportError(Exception):
 class PeerConnection:
     """One live TCP connection to a peer (post-handshake)."""
 
-    def __init__(self, reader, writer, peer_id: str, hello: dict):
+    def __init__(self, reader, writer, peer_id: str, hello: dict,
+                 outbound: bool = False):
         self.reader = reader
         self.writer = writer
         self.peer_id = peer_id
         self.hello = hello
+        self.outbound = outbound
         self._send_lock = asyncio.Lock()
         self._req_id = 0
         self._pending: dict[int, asyncio.Future] = {}
@@ -169,7 +171,9 @@ class TcpHost:
             writer.close()
             raise TransportError("expected HELLO")
         hello = json.loads(payload)
-        conn = PeerConnection(reader, writer, hello["peer_id"], hello)
+        conn = PeerConnection(
+            reader, writer, hello["peer_id"], hello, outbound=True
+        )
         self._install(conn)
         return conn
 
@@ -180,6 +184,7 @@ class TcpHost:
                 writer.close()
                 return
             hello = json.loads(payload)
+            peer_id = hello["peer_id"]
             writer.write(
                 struct.pack(
                     ">IB", len(self._hello_payload()) + 1, K_HELLO
@@ -187,18 +192,31 @@ class TcpHost:
                 + self._hello_payload()
             )
             await writer.drain()
-        except (asyncio.IncompleteReadError, TransportError, OSError):
+        except (
+            asyncio.IncompleteReadError,
+            TransportError,
+            OSError,
+            ValueError,  # malformed hello JSON
+            KeyError,  # hello missing fields
+        ):
             writer.close()
             return
-        conn = PeerConnection(reader, writer, hello["peer_id"], hello)
+        conn = PeerConnection(reader, writer, peer_id, hello)
         self._install(conn)
+
+    def _initiator(self, conn: PeerConnection) -> str:
+        return self.peer_id if conn.outbound else conn.peer_id
 
     def _install(self, conn: PeerConnection) -> None:
         old = self.conns.get(conn.peer_id)
         if old is not None:
-            # keep one connection per peer: tie-break on peer id so
-            # both sides drop the same duplicate
-            if min(self.peer_id, conn.peer_id) == self.peer_id:
+            # simultaneous-dial dedup: BOTH sides must keep the same
+            # underlying TCP connection, so tie-break on the connection
+            # INITIATOR (the dial from the smaller peer id wins) — an
+            # install-order rule would let each side keep the one the
+            # other closed
+            winner = min(self.peer_id, conn.peer_id)
+            if self._initiator(conn) == winner:
                 asyncio.ensure_future(old.close())
             else:
                 asyncio.ensure_future(conn.close())
@@ -212,28 +230,46 @@ class TcpHost:
 
     # -- frame pump ------------------------------------------------------
 
+    def _spawn(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _handle_gossip(self, conn, payload: bytes) -> None:
+        (tlen,) = struct.unpack(">H", payload[:2])
+        topic = payload[2 : 2 + tlen].decode()
+        data = payload[2 + tlen :]
+        if self.on_gossip is not None:
+            try:
+                await self.on_gossip(conn.peer_id, topic, data)
+            except Exception:
+                pass  # a bad message must not kill the socket
+
+    async def _handle_request(self, conn, payload: bytes) -> None:
+        rid, plen = struct.unpack(">IH", payload[:6])
+        proto = payload[6 : 6 + plen].decode()
+        data = payload[6 + plen :]
+        resp = b""
+        if self.on_request is not None:
+            try:
+                resp = await self.on_request(conn.peer_id, proto, data)
+            except Exception:
+                resp = b""
+        try:
+            await conn.send_frame(K_RESP, struct.pack(">I", rid) + resp)
+        except TransportError:
+            pass
+
     async def _read_loop(self, conn: PeerConnection) -> None:
         try:
             while not conn.closed:
                 kind, payload = await read_frame(conn.reader)
+                # handlers run as tasks: a slow block import must not
+                # head-of-line-block RESP frames on the same socket
                 if kind == K_GOSSIP:
-                    (tlen,) = struct.unpack(">H", payload[:2])
-                    topic = payload[2 : 2 + tlen].decode()
-                    data = payload[2 + tlen :]
-                    if self.on_gossip is not None:
-                        await self.on_gossip(conn.peer_id, topic, data)
+                    self._spawn(self._handle_gossip(conn, payload))
                 elif kind == K_REQ:
-                    rid, plen = struct.unpack(">IH", payload[:6])
-                    proto = payload[6 : 6 + plen].decode()
-                    data = payload[6 + plen :]
-                    resp = b""
-                    if self.on_request is not None:
-                        resp = await self.on_request(
-                            conn.peer_id, proto, data
-                        )
-                    await conn.send_frame(
-                        K_RESP, struct.pack(">I", rid) + resp
-                    )
+                    self._spawn(self._handle_request(conn, payload))
                 elif kind == K_RESP:
                     (rid,) = struct.unpack(">I", payload[:4])
                     conn.resolve(rid, payload[4:])

@@ -1,0 +1,19 @@
+"""Prover: verified execution-layer access through beacon light-client
+roots.
+
+Reference analog: packages/prover — `createVerifiedExecutionProvider`
+(web3_provider.ts) wraps an eth JSON-RPC endpoint and verifies the
+responses (balances, nonces, code, storage) against execution state
+roots obtained from light-client-verified beacon headers, via
+eth_getProof merkle-patricia proofs (verified_requests/).
+"""
+
+from .mpt import verify_account_proof, verify_storage_proof
+from .provider import ProofProvider, VerifiedExecutionProvider
+
+__all__ = [
+    "ProofProvider",
+    "VerifiedExecutionProvider",
+    "verify_account_proof",
+    "verify_storage_proof",
+]

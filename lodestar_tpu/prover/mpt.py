@@ -1,0 +1,162 @@
+"""Merkle-Patricia trie proof verification (eth_getProof).
+
+Reference analog: the account/storage verification inside
+packages/prover's verified_requests/ (eth_getBalance etc. are answered
+only after the returned proof checks out against the execution state
+root taken from a light-client-verified header).
+
+A proof is the list of RLP-encoded trie nodes from the root to the
+key's leaf (or to the divergence showing exclusion). Node types:
+branch (17 items), extension/leaf (2 items, hex-prefix encoded path).
+"""
+
+from __future__ import annotations
+
+from . import rlp
+from .keccak import keccak256
+
+
+class ProofError(ValueError):
+    pass
+
+
+def _nibbles(b: bytes) -> list[int]:
+    out = []
+    for byte in b:
+        out.append(byte >> 4)
+        out.append(byte & 0x0F)
+    return out
+
+
+def _decode_path(hp: bytes) -> tuple[list[int], bool]:
+    """Hex-prefix decode -> (nibbles, is_leaf)."""
+    ns = _nibbles(hp)
+    flag = ns[0]
+    is_leaf = flag >= 2
+    odd = flag % 2 == 1
+    return (ns[1:] if odd else ns[2:]), is_leaf
+
+
+def verify_proof(
+    root: bytes, key: bytes, proof: list[bytes]
+) -> bytes | None:
+    """Verify an MPT proof; returns the RLP value at `key`, or None if
+    the proof shows exclusion. Raises ProofError on any inconsistency."""
+    if not proof:
+        raise ProofError("empty proof")
+    path = _nibbles(keccak256(key))
+    expected = root
+    i = 0
+    node_idx = 0
+    while True:
+        if node_idx >= len(proof):
+            raise ProofError("proof exhausted before terminal node")
+        raw = proof[node_idx]
+        node_idx += 1
+        if keccak256(raw) != expected:
+            raise ProofError("node hash mismatch")
+        node = rlp.decode(raw)
+        if not isinstance(node, list):
+            raise ProofError("node is not a list")
+        if len(node) == 17:  # branch
+            if i == len(path):
+                v = node[16]
+                return bytes(v) if v else None
+            nxt = node[path[i]]
+            i += 1
+            if nxt == b"":
+                return None  # exclusion: empty slot
+            if isinstance(nxt, list):
+                # embedded (<32B) node appears inline in its parent
+                return _walk_inline(nxt, path, i)
+            expected = bytes(nxt)
+            continue
+        if len(node) == 2:  # extension or leaf
+            nibs, is_leaf = _decode_path(bytes(node[0]))
+            if is_leaf:
+                if path[i:] == nibs:
+                    return bytes(node[1])
+                return None  # different leaf proves exclusion
+            if path[i : i + len(nibs)] != nibs:
+                return None  # divergent extension: exclusion
+            i += len(nibs)
+            nxt = node[1]
+            if isinstance(nxt, list):
+                return _walk_inline(nxt, path, i)
+            expected = bytes(nxt)
+            continue
+        raise ProofError(f"bad node arity {len(node)}")
+
+
+def _relist(x):
+    if isinstance(x, list):
+        return [_relist(v) for v in x]
+    return bytes(x)
+
+
+def _walk_inline(node, path, i):
+    """Embedded nodes (RLP < 32 bytes) appear inline in their parent."""
+    while True:
+        if len(node) == 17:
+            if i == len(path):
+                return bytes(node[16]) or None
+            nxt = node[path[i]]
+            i += 1
+            if nxt == b"":
+                return None
+            if isinstance(nxt, list):
+                node = nxt
+                continue
+            raise ProofError("inline node references hash")
+        if len(node) == 2:
+            nibs, is_leaf = _decode_path(bytes(node[0]))
+            if is_leaf:
+                return bytes(node[1]) if path[i:] == nibs else None
+            if path[i : i + len(nibs)] != nibs:
+                return None
+            i += len(nibs)
+            nxt = node[1]
+            if isinstance(nxt, list):
+                node = nxt
+                continue
+            raise ProofError("inline node references hash")
+        raise ProofError("bad inline node")
+
+
+EMPTY_CODE_HASH = keccak256(b"")
+EMPTY_TRIE_ROOT = keccak256(rlp.encode(b""))
+
+
+def verify_account_proof(
+    state_root: bytes, address: bytes, account_proof: list[bytes]
+) -> dict:
+    """Verify an eth_getProof accountProof; returns the account fields
+    {nonce, balance, storage_root, code_hash} (zeroed when excluded)."""
+    value = verify_proof(state_root, address, account_proof)
+    if value is None:
+        return {
+            "nonce": 0,
+            "balance": 0,
+            "storage_root": EMPTY_TRIE_ROOT,
+            "code_hash": EMPTY_CODE_HASH,
+        }
+    fields = rlp.decode(value)
+    if not isinstance(fields, list) or len(fields) != 4:
+        raise ProofError("bad account leaf")
+    return {
+        "nonce": int.from_bytes(fields[0], "big"),
+        "balance": int.from_bytes(fields[1], "big"),
+        "storage_root": bytes(fields[2]),
+        "code_hash": bytes(fields[3]),
+    }
+
+
+def verify_storage_proof(
+    storage_root: bytes, slot: bytes, proof: list[bytes]
+) -> int:
+    """Verify one eth_getProof storageProof entry; returns the slot
+    value (0 when excluded)."""
+    value = verify_proof(storage_root, slot, proof)
+    if value is None:
+        return 0
+    return int.from_bytes(rlp.decode(value), "big")

@@ -1,0 +1,101 @@
+"""Verified execution provider.
+
+Reference analog: createVerifiedExecutionProvider (prover/src/
+web3_provider.ts) + ProofProvider/PayloadStore (proof_provider/):
+execution responses are only returned after verifying an eth_getProof
+against the execution state root of a light-client-verified beacon
+header. The ProofProvider tracks those verified roots (fed by the
+light client's finality/optimistic updates).
+"""
+
+from __future__ import annotations
+
+from .keccak import keccak256
+from .mpt import ProofError, verify_account_proof, verify_storage_proof
+
+
+class VerificationError(Exception):
+    pass
+
+
+class ProofProvider:
+    """Verified execution (block_hash -> state_root) anchors, fed from
+    light-client updates (proof_provider/payload_store.ts)."""
+
+    def __init__(self):
+        self._roots: dict[bytes, bytes] = {}  # block_hash -> state_root
+        self.latest_block_hash: bytes | None = None
+
+    def on_verified_header(
+        self, block_hash: bytes, state_root: bytes
+    ) -> None:
+        self._roots[bytes(block_hash)] = bytes(state_root)
+        self.latest_block_hash = bytes(block_hash)
+
+    def state_root(self, block_hash: bytes | None = None) -> bytes:
+        bh = block_hash or self.latest_block_hash
+        if bh is None or bh not in self._roots:
+            raise VerificationError("no verified execution header")
+        return self._roots[bh]
+
+
+class VerifiedExecutionProvider:
+    """eth_* facade that proves every answer (web3_provider.ts).
+
+    rpc: object with async call(method, params) (e.g.
+    execution.http.JsonRpcHttpClient)."""
+
+    def __init__(self, rpc, proof_provider: ProofProvider):
+        self.rpc = rpc
+        self.proofs = proof_provider
+
+    async def _account(self, address: bytes, slots=()):
+        state_root = self.proofs.state_root()
+        out = await self.rpc.call(
+            "eth_getProof",
+            [
+                "0x" + address.hex(),
+                ["0x" + bytes(s).hex() for s in slots],
+                "latest",
+            ],
+        )
+        proof = [
+            bytes.fromhex(n.removeprefix("0x"))
+            for n in out["accountProof"]
+        ]
+        try:
+            account = verify_account_proof(state_root, address, proof)
+        except ProofError as e:
+            raise VerificationError(f"account proof invalid: {e}") from e
+        return account, out
+
+    async def get_balance(self, address: bytes) -> int:
+        account, _ = await self._account(address)
+        return account["balance"]
+
+    async def get_transaction_count(self, address: bytes) -> int:
+        account, _ = await self._account(address)
+        return account["nonce"]
+
+    async def get_code(self, address: bytes) -> bytes:
+        account, _ = await self._account(address)
+        code_hex = await self.rpc.call(
+            "eth_getCode", ["0x" + address.hex(), "latest"]
+        )
+        code = bytes.fromhex(code_hex.removeprefix("0x"))
+        if keccak256(code) != account["code_hash"]:
+            raise VerificationError("code hash mismatch")
+        return code
+
+    async def get_storage_at(self, address: bytes, slot: bytes) -> int:
+        account, out = await self._account(address, slots=[slot])
+        entry = out["storageProof"][0]
+        proof = [
+            bytes.fromhex(n.removeprefix("0x")) for n in entry["proof"]
+        ]
+        try:
+            return verify_storage_proof(
+                account["storage_root"], bytes(slot), proof
+            )
+        except ProofError as e:
+            raise VerificationError(f"storage proof invalid: {e}") from e

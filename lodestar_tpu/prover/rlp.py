@@ -1,0 +1,90 @@
+"""RLP encoding/decoding (execution-layer serialization).
+
+Needed by the merkle-patricia proof verifier: account leaves, trie
+nodes, and storage values are all RLP.
+"""
+
+from __future__ import annotations
+
+
+class RlpError(ValueError):
+    pass
+
+
+def encode(item) -> bytes:
+    """item: bytes | int | list (nested)."""
+    if isinstance(item, int):
+        if item == 0:
+            payload = b""
+        else:
+            payload = item.to_bytes((item.bit_length() + 7) // 8, "big")
+        return encode(payload)
+    if isinstance(item, (bytes, bytearray)):
+        b = bytes(item)
+        if len(b) == 1 and b[0] < 0x80:
+            return b
+        return _len_prefix(len(b), 0x80) + b
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(x) for x in item)
+        return _len_prefix(len(payload), 0xC0) + payload
+    raise RlpError(f"cannot RLP-encode {type(item)}")
+
+
+def _len_prefix(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    nb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(nb)]) + nb
+
+
+def decode(data: bytes):
+    item, rest = _decode_one(bytes(data))
+    if rest:
+        raise RlpError("trailing bytes after RLP item")
+    return item
+
+
+def _decode_one(data: bytes):
+    if not data:
+        raise RlpError("empty input")
+    b0 = data[0]
+    if b0 < 0x80:
+        return data[:1], data[1:]
+    if b0 < 0xB8:  # short string
+        n = b0 - 0x80
+        _check(data, 1 + n)
+        if n == 1 and data[1] < 0x80:
+            raise RlpError("non-canonical single byte")
+        return data[1 : 1 + n], data[1 + n :]
+    if b0 < 0xC0:  # long string
+        ln = b0 - 0xB7
+        _check(data, 1 + ln)
+        n = int.from_bytes(data[1 : 1 + ln], "big")
+        if n < 56 or data[1] == 0:
+            raise RlpError("non-canonical length")
+        _check(data, 1 + ln + n)
+        return data[1 + ln : 1 + ln + n], data[1 + ln + n :]
+    if b0 < 0xF8:  # short list
+        n = b0 - 0xC0
+        _check(data, 1 + n)
+        return _decode_list(data[1 : 1 + n]), data[1 + n :]
+    ln = b0 - 0xF7
+    _check(data, 1 + ln)
+    n = int.from_bytes(data[1 : 1 + ln], "big")
+    if n < 56 or data[1] == 0:
+        raise RlpError("non-canonical length")
+    _check(data, 1 + ln + n)
+    return _decode_list(data[1 + ln : 1 + ln + n]), data[1 + ln + n :]
+
+
+def _decode_list(payload: bytes) -> list:
+    out = []
+    while payload:
+        item, payload = _decode_one(payload)
+        out.append(item)
+    return out
+
+
+def _check(data: bytes, n: int) -> None:
+    if len(data) < n:
+        raise RlpError("truncated RLP")

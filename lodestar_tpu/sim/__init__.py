@@ -1,0 +1,23 @@
+"""Multi-node simulation harness.
+
+Reference analog: the "crucible" sim framework
+(cli/test/utils/crucible/simulation.ts + assertions/defaults) — spawn
+N nodes as one process-local network, drive an epoch clock, and assert
+whole-network behavior: finality advancing, head consistency across
+nodes, attestation participation.
+"""
+
+from .simulation import Simulation, SimNode
+from .assertions import (
+    assert_finalized,
+    assert_heads_consistent,
+    assert_participation,
+)
+
+__all__ = [
+    "Simulation",
+    "SimNode",
+    "assert_finalized",
+    "assert_heads_consistent",
+    "assert_participation",
+]

@@ -1,0 +1,50 @@
+"""Default sim assertions.
+
+Reference analog: crucible's default assertions
+(cli/test/utils/crucible/assertions/defaults/): finalized checkpoint,
+head consistency across nodes, attestation participation.
+"""
+
+from __future__ import annotations
+
+from ..params import preset
+
+
+def assert_heads_consistent(sim) -> None:
+    heads = {node.chain.head_root for node in sim.nodes}
+    assert len(heads) == 1, (
+        "heads diverged: "
+        + ", ".join(
+            f"{n.name}={n.chain.head_root.hex()[:12]}" for n in sim.nodes
+        )
+    )
+
+
+def assert_finalized(sim, min_epoch: int) -> None:
+    for node in sim.nodes:
+        got = node.chain.finalized_checkpoint.epoch
+        assert got >= min_epoch, (
+            f"{node.name} finalized epoch {got} < {min_epoch}"
+        )
+
+
+def assert_participation(sim, min_ratio: float) -> None:
+    """Previous-epoch target participation on every node's head state
+    (crucible's attestationParticipation assertion)."""
+    from ..statetransition.util import TIMELY_TARGET_FLAG_INDEX
+
+    for node in sim.nodes:
+        st = node.chain.get_or_regen_state(node.chain.head_root).state
+        part = getattr(st, "previous_epoch_participation", None)
+        if part is None:
+            continue  # phase0: justification progress covers it
+        n = len(part)
+        hit = sum(
+            1
+            for f in part
+            if (int(f) >> TIMELY_TARGET_FLAG_INDEX) & 1
+        )
+        ratio = hit / max(1, n)
+        assert ratio >= min_ratio, (
+            f"{node.name} participation {ratio:.2f} < {min_ratio}"
+        )

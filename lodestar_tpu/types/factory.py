@@ -670,6 +670,40 @@ def create_ssz_types(p: BeaconPreset) -> SszTypes:  # noqa: PLR0915
         "deneb": deneb,
         "electra": electra,
     }
+
+    # blinded blocks (builder flow, bellatrix+): the body carries the
+    # ExecutionPayloadHeader in the payload's field position
+    # (reference: types/src/<fork>/sszTypes.ts BlindedBeaconBlockBody)
+    for _fork in ("bellatrix", "capella", "deneb", "electra"):
+        ns = t.by_fork[_fork]
+        _hdr = getattr(ns, "ExecutionPayloadHeader", None) or getattr(
+            deneb, "ExecutionPayloadHeader"
+        )  # electra reuses deneb's payload/header
+        blinded_fields = [
+            (
+                ("execution_payload_header", _hdr)
+                if n == "execution_payload"
+                else (n, ty)
+            )
+            for n, ty in ns.BeaconBlockBody.fields
+        ]
+        ns.BlindedBeaconBlockBody = _C(
+            f"BlindedBeaconBlockBody{_fork.capitalize()}", blinded_fields
+        )
+        ns.BlindedBeaconBlock = _C(
+            f"BlindedBeaconBlock{_fork.capitalize()}",
+            [
+                (n, ns.BlindedBeaconBlockBody if n == "body" else ty)
+                for n, ty in ns.BeaconBlock.fields
+            ],
+        )
+        ns.SignedBlindedBeaconBlock = _C(
+            f"SignedBlindedBeaconBlock{_fork.capitalize()}",
+            [
+                ("message", ns.BlindedBeaconBlock),
+                ("signature", BLSSignature),
+            ],
+        )
     return t
 
 

@@ -1,0 +1,106 @@
+"""Remote (external) signer client.
+
+Reference analog: externalSignerClient
+(validator/src/util/externalSignerClient.ts) — the web3signer-style
+REST API: GET /upcheck, GET /api/v1/eth2/publicKeys, and
+POST /api/v1/eth2/sign/{pubkey} with a typed signing request carrying
+the signing root and fork info; the signer owns the keys.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+
+class ExternalSignerError(Exception):
+    pass
+
+
+class ExternalSignerClient:
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    async def _call(self, method: str, path: str, body=None):
+        def _do():
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                self.base_url + path,
+                data=data,
+                method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                raw = r.read()
+                return json.loads(raw) if raw else None
+
+        try:
+            return await asyncio.get_event_loop().run_in_executor(
+                None, _do
+            )
+        except urllib.error.HTTPError as e:
+            raise ExternalSignerError(
+                f"{path}: HTTP {e.code} {e.read()[:200]!r}"
+            ) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ExternalSignerError(f"{path}: {e}") from e
+
+    async def upcheck(self) -> bool:
+        try:
+            await self._call("GET", "/upcheck")
+            return True
+        except ExternalSignerError:
+            return False
+
+    async def public_keys(self) -> list[bytes]:
+        out = await self._call("GET", "/api/v1/eth2/publicKeys")
+        return [bytes.fromhex(pk.removeprefix("0x")) for pk in out]
+
+    async def sign(
+        self,
+        pubkey: bytes,
+        signing_root: bytes,
+        sign_type: str = "BEACON_BLOCK",
+        extra: dict | None = None,
+    ) -> bytes:
+        body = {
+            "type": sign_type,
+            "signingRoot": "0x" + bytes(signing_root).hex(),
+        }
+        if extra:
+            body.update(extra)
+        out = await self._call(
+            "POST", f"/api/v1/eth2/sign/0x{bytes(pubkey).hex()}", body
+        )
+        sig = out["signature"] if isinstance(out, dict) else out
+        return bytes.fromhex(sig.removeprefix("0x"))
+
+
+class MockExternalSigner:
+    """In-process web3signer double backed by local secret keys (the
+    reference tests run a mocked signer server the same way)."""
+
+    def __init__(self, sks: dict[bytes, int]):
+        # pubkey bytes -> sk int
+        self.sks = dict(sks)
+        self.requests: list = []
+
+    async def upcheck(self) -> bool:
+        return True
+
+    async def public_keys(self) -> list[bytes]:
+        return list(self.sks)
+
+    async def sign(self, pubkey, signing_root, sign_type="BEACON_BLOCK",
+                   extra=None) -> bytes:
+        from ..crypto.bls.signature import sign as bls_sign
+
+        sk = self.sks.get(bytes(pubkey))
+        if sk is None:
+            raise ExternalSignerError("unknown pubkey")
+        self.requests.append((sign_type, bytes(signing_root)))
+        # web3signer signs the 32-byte signing root directly
+        return bls_sign(sk, bytes(signing_root))

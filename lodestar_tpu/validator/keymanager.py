@@ -1,0 +1,204 @@
+"""Keymanager: EIP-2335 keystores + the keymanager REST API surface.
+
+Reference analog: the keymanager API served by the validator client
+(cli/src/cmds/validator keymanager server; api/src/keymanager routes):
+list/import/delete local keystores, with slashing-protection data
+riding delete/import (EIP-3076). Keystore crypto is EIP-2335:
+scrypt or pbkdf2 KDF + AES-128-CTR... the baked environment has no AES
+primitive, so the cipher stage uses the checksum-equivalent stream
+construction documented below — keystores are interoperable in
+structure and KDF, flagged with cipher function "xor-sha256" (a
+documented deviation; importing c-kzg-era keystores requires AES and is
+gated).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+from hashlib import pbkdf2_hmac, scrypt, sha256
+
+from ..crypto.bls.signature import sk_from_bytes, sk_to_bytes, sk_to_pk
+
+
+class KeystoreError(ValueError):
+    pass
+
+
+def _stream(key16: bytes, iv: bytes, n: int) -> bytes:
+    """Keystream for the xor cipher stage: SHA-256 counter mode over
+    (key, iv). NOT AES-128-CTR — see module docstring."""
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        out += sha256(
+            key16 + iv + counter.to_bytes(8, "big")
+        ).digest()
+        counter += 1
+    return bytes(out[:n])
+
+
+def _derive(kdf: dict, password: bytes) -> bytes:
+    params = kdf["params"]
+    salt = bytes.fromhex(params["salt"])
+    if kdf["function"] == "scrypt":
+        return scrypt(
+            password,
+            salt=salt,
+            n=params["n"],
+            r=params["r"],
+            p=params["p"],
+            dklen=params["dklen"],
+            maxmem=256 * 1024 * 1024,
+        )
+    if kdf["function"] == "pbkdf2":
+        return pbkdf2_hmac(
+            "sha256", password, salt, params["c"], params["dklen"]
+        )
+    raise KeystoreError(f"unsupported kdf {kdf['function']}")
+
+
+def create_keystore(
+    sk: int, password: str, path: str = "m/12381/3600/0/0/0",
+    kdf: str = "pbkdf2",
+) -> dict:
+    """EIP-2335-shaped keystore json for a BLS secret key."""
+    salt = secrets.token_bytes(32)
+    iv = secrets.token_bytes(16)
+    if kdf == "scrypt":
+        kdf_mod = {
+            "function": "scrypt",
+            "params": {
+                "dklen": 32, "n": 2**14, "r": 8, "p": 1,
+                "salt": salt.hex(),
+            },
+            "message": "",
+        }
+    else:
+        kdf_mod = {
+            "function": "pbkdf2",
+            "params": {
+                "dklen": 32, "c": 2**15, "prf": "hmac-sha256",
+                "salt": salt.hex(),
+            },
+            "message": "",
+        }
+    dk = _derive(kdf_mod, password.encode())
+    secret = sk_to_bytes(sk)
+    cipher_text = bytes(
+        a ^ b for a, b in zip(secret, _stream(dk[:16], iv, len(secret)))
+    )
+    checksum = sha256(dk[16:32] + cipher_text).digest()
+    return {
+        "version": 4,
+        "uuid": secrets.token_hex(16),
+        "path": path,
+        "pubkey": sk_to_pk(sk).hex(),
+        "crypto": {
+            "kdf": kdf_mod,
+            "checksum": {
+                "function": "sha256",
+                "params": {},
+                "message": checksum.hex(),
+            },
+            "cipher": {
+                "function": "xor-sha256",
+                "params": {"iv": iv.hex()},
+                "message": cipher_text.hex(),
+            },
+        },
+    }
+
+
+def decrypt_keystore(keystore: dict, password: str) -> int:
+    crypto = keystore["crypto"]
+    if crypto["cipher"]["function"] != "xor-sha256":
+        raise KeystoreError(
+            f"unsupported cipher {crypto['cipher']['function']}"
+        )
+    dk = _derive(crypto["kdf"], password.encode())
+    cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = sha256(dk[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("wrong password (checksum mismatch)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    secret = bytes(
+        a ^ b
+        for a, b in zip(
+            cipher_text, _stream(dk[:16], iv, len(cipher_text))
+        )
+    )
+    return sk_from_bytes(secret)
+
+
+class Keymanager:
+    """The keymanager API's business logic (list/import/delete),
+    bound to a ValidatorStore and a slashing-protection db."""
+
+    def __init__(self, store, slashing_protection=None):
+        self.store = store
+        self.slashing = slashing_protection
+
+    def list_keys(self) -> list[dict]:
+        out = []
+        for idx in self.store.indices():
+            out.append(
+                {
+                    "validating_pubkey": "0x"
+                    + sk_to_pk(self.store.sks[idx]).hex(),
+                    "derivation_path": "",
+                    "readonly": False,
+                }
+            )
+        return out
+
+    def import_keystores(
+        self, keystores: list[dict], passwords: list[str],
+        pubkey_to_index,
+    ) -> list[dict]:
+        """pubkey_to_index: fn(pubkey bytes) -> validator index | None
+        (the registry binding)."""
+        results = []
+        for ks, pw in zip(keystores, passwords):
+            try:
+                sk = decrypt_keystore(ks, pw)
+                pk = sk_to_pk(sk)
+                idx = pubkey_to_index(pk)
+                if idx is None:
+                    results.append(
+                        {"status": "error", "message": "unknown pubkey"}
+                    )
+                    continue
+                dup = idx in self.store.sks
+                self.store.sks[idx] = sk
+                self.store.pubkeys[idx] = pk
+                results.append(
+                    {"status": "duplicate" if dup else "imported"}
+                )
+            except KeystoreError as e:
+                results.append({"status": "error", "message": str(e)})
+        return results
+
+    def delete_keys(self, pubkeys: list[bytes]) -> list[dict]:
+        """Returns per-key status + the EIP-3076 interchange for the
+        deleted keys (the caller MUST persist it before re-importing
+        elsewhere — reference: keymanager deleteKeystores)."""
+        by_pk = {
+            sk_to_pk(sk): idx for idx, sk in self.store.sks.items()
+        }
+        results = []
+        for pk in pubkeys:
+            idx = by_pk.pop(bytes(pk), None)  # pop: dup requests -> not_found
+            if idx is None:
+                results.append({"status": "not_found"})
+                continue
+            del self.store.sks[idx]
+            self.store.pubkeys.pop(idx, None)
+            entry = {"status": "deleted"}
+            if self.slashing is not None:
+                entry["slashing_protection"] = (
+                    self.slashing.export_interchange()
+                )
+            results.append(entry)
+        return results

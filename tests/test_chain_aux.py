@@ -1,0 +1,179 @@
+"""Chain auxiliaries: checkpoint-state cache spill, historical regen,
+reprocess controller, prepare-next-slot.
+
+Reference analog: stateCache/, historicalState/, reprocess.ts,
+prepareNextSlot.ts unit tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import _clone
+from lodestar_tpu.chain.historical import (
+    HistoricalStateError,
+    HistoricalStateRegen,
+)
+from lodestar_tpu.chain.prepare_next_slot import PrepareNextSlotScheduler
+from lodestar_tpu.chain.reprocess import ReprocessController
+from lodestar_tpu.chain.state_cache import CheckpointStateCache
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.db.beacon import BeaconDb
+from lodestar_tpu.params import preset
+from lodestar_tpu.statetransition import create_interop_genesis_state
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class TestCheckpointStateCache:
+    def test_spill_and_reload(self, types):
+        db = BeaconDb.in_memory(types)
+        cache = CheckpointStateCache(types, db=db, max_in_memory=2)
+        views = []
+        for e in range(4):
+            v = create_interop_genesis_state(_cfg(), types, 4)
+            v.state.slot = e * preset().SLOTS_PER_EPOCH
+            views.append(v)
+            cache.add(e, bytes([e]) * 32, v)
+        assert cache.spills == 2  # epochs 0,1 spilled to db
+        got = cache.get(0, bytes([0]) * 32)  # reload from disk
+        assert got is not None
+        assert int(got.state.slot) == 0
+        assert cache.reloads == 1
+        # in-memory hit
+        assert cache.get(3, bytes([3]) * 32) is not None
+        assert cache.get(2, bytes([9]) * 32) is None  # wrong root
+
+    def test_prune_finalized(self, types):
+        db = BeaconDb.in_memory(types)
+        cache = CheckpointStateCache(types, db=db, max_in_memory=1)
+        for e in range(3):
+            v = create_interop_genesis_state(_cfg(), types, 4)
+            cache.add(e, bytes([e]) * 32, v)
+        removed = cache.prune_finalized(2)
+        assert removed >= 2
+        assert cache.get(0, bytes([0]) * 32) is None
+
+
+class TestHistoricalRegen:
+    def test_regen_archived_slot(self, types):
+        cfg = _cfg()
+        p = preset()
+        node = DevNode(
+            cfg, types, N, db=BeaconDb.in_memory(types),
+            verify_attestations=False,
+        )
+
+        async def go():
+            # 4 epochs -> finality -> archiver populates the archives
+            await node.run_until(4 * p.SLOTS_PER_EPOCH + 1)
+            hist = HistoricalStateRegen(node.chain)
+            target = p.SLOTS_PER_EPOCH + 3  # long-finalized slot
+            view = await hist.get_state_at_slot(target)
+            assert int(view.state.slot) == target
+            assert hist.regens == 1
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_no_db_raises(self, types):
+        node = DevNode(_cfg(), types, N, verify_attestations=False)
+        hist = HistoricalStateRegen(node.chain)
+        with pytest.raises(HistoricalStateError):
+            asyncio.run(hist.get_state_at_slot(1))
+
+
+class TestReprocess:
+    def test_park_and_flush(self, types):
+        cfg = _cfg()
+        node = DevNode(cfg, types, N, verify_attestations=False)
+        rp = ReprocessController(node.chain)
+
+        async def go():
+            root1 = await node.advance_slot()
+            # simulate an attestation arriving before its block: park
+            # one targeting the NEXT block root
+            head = node.chain.get_state(root1)
+            from lodestar_tpu.statetransition import util
+
+            sh = util.get_shuffling(head.state, 0)
+            committee = sh.committees_at_slot(2)[0]
+            att = types.Attestation.default()
+            att.data.slot = 2
+            att.aggregation_bits = [True] * len(committee)
+            fake_future_root = b"\x77" * 32
+            att.data.beacon_block_root = fake_future_root
+            assert rp.await_block(fake_future_root, att, committee)
+            # block never arrives: slot sweep expires it
+            assert rp.on_slot(3) == 1
+            # park again, then "import" resolves it -> fork choice sees
+            # it only if the block exists; use a real root
+            root2 = await node.advance_slot()
+            att2 = types.Attestation.default()
+            att2.data.slot = 2
+            att2.data.beacon_block_root = root2
+            att2.data.target.root = root1
+            att2.aggregation_bits = [True] * len(committee)
+            assert rp.await_block(root2, att2, committee)
+            n = await rp.on_block_imported(root2)
+            assert n == 1 and rp.resolved == 1
+            await node.close()
+
+        asyncio.run(go())
+
+
+class TestPrepareNextSlot:
+    def test_prepare_and_take(self, types):
+        cfg = _cfg()
+        node = DevNode(cfg, types, N, verify_attestations=False)
+        sched = PrepareNextSlotScheduler(node.chain)
+
+        async def go():
+            await node.advance_slot()
+            head = node.chain.head_root
+            prepared = await sched.prepare(2)
+            assert int(prepared.state.slot) == 2
+            got = sched.take(head, 2)
+            assert got is prepared
+            assert sched.take(head, 2) is None  # consumed
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_epoch_boundary_precompute(self, types):
+        """The expensive epoch transition runs in prepare, off the
+        block path (prepareNextSlot.ts's whole point)."""
+        cfg = _cfg()
+        p = preset()
+        node = DevNode(cfg, types, N, verify_attestations=False)
+        sched = PrepareNextSlotScheduler(node.chain)
+
+        async def go():
+            await node.run_until(p.SLOTS_PER_EPOCH - 1)
+            prepared = await sched.prepare(p.SLOTS_PER_EPOCH)
+            # crossed the boundary: epoch transition already applied
+            assert int(prepared.state.slot) == p.SLOTS_PER_EPOCH
+            await node.close()
+
+        asyncio.run(go())

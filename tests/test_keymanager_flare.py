@@ -1,0 +1,153 @@
+"""Keymanager (EIP-2335 keystores), external signer, flare slashings.
+
+Reference analog: validator keymanager tests, externalSignerClient
+e2e, flare selfSlashProposer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.signature import sign, sk_to_pk, verify
+from lodestar_tpu.flare import self_slash_attester, self_slash_proposer
+from lodestar_tpu.statetransition import (
+    create_interop_genesis_state,
+    interop_secret_key,
+)
+from lodestar_tpu.statetransition.block import (
+    BlockCtx,
+    process_attester_slashing,
+    process_proposer_slashing,
+)
+from lodestar_tpu.types import ssz_types
+from lodestar_tpu.validator.external_signer import (
+    ExternalSignerError,
+    MockExternalSigner,
+)
+from lodestar_tpu.validator.keymanager import (
+    Keymanager,
+    KeystoreError,
+    create_keystore,
+    decrypt_keystore,
+)
+from lodestar_tpu.validator.store import ValidatorStore
+
+FAR = 2**64 - 1
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class TestKeystores:
+    def test_roundtrip_pbkdf2_and_scrypt(self):
+        sk = interop_secret_key(3)
+        for kdf in ("pbkdf2", "scrypt"):
+            ks = create_keystore(sk, "hunter2", kdf=kdf)
+            assert ks["pubkey"] == sk_to_pk(sk).hex()
+            assert decrypt_keystore(ks, "hunter2") == sk
+
+    def test_wrong_password_rejected(self):
+        ks = create_keystore(interop_secret_key(1), "right")
+        with pytest.raises(KeystoreError, match="checksum"):
+            decrypt_keystore(ks, "wrong")
+
+    def test_keymanager_lifecycle(self, types):
+        cfg = _cfg()
+        genesis = create_interop_genesis_state(cfg, types, 8)
+        bc = BeaconConfig(
+            cfg, bytes(genesis.state.genesis_validators_root)
+        )
+        sks = {i: interop_secret_key(i) for i in range(2)}
+        store = ValidatorStore(bc, types, sks)
+        km = Keymanager(store, store.slashing_protection)
+        assert len(km.list_keys()) == 2
+
+        new_sk = interop_secret_key(5)
+        ks = create_keystore(new_sk, "pw")
+        pk2idx = {sk_to_pk(interop_secret_key(i)): i for i in range(8)}
+        res = km.import_keystores([ks], ["pw"], pk2idx.get)
+        assert res == [{"status": "imported"}]
+        assert 5 in store.sks
+
+        res = km.delete_keys([sk_to_pk(new_sk)])
+        assert res[0]["status"] == "deleted"
+        assert "slashing_protection" in res[0]
+        assert 5 not in store.sks
+        assert km.delete_keys([sk_to_pk(new_sk)]) == [
+            {"status": "not_found"}
+        ]
+
+    def test_delete_same_key_twice_in_one_request(self, types):
+        cfg = _cfg()
+        genesis = create_interop_genesis_state(cfg, types, 4)
+        bc = BeaconConfig(
+            cfg, bytes(genesis.state.genesis_validators_root)
+        )
+        store = ValidatorStore(bc, types, {0: interop_secret_key(0)})
+        km = Keymanager(store)
+        pk = sk_to_pk(interop_secret_key(0))
+        res = km.delete_keys([pk, pk])
+        assert res[0]["status"] == "deleted"
+        assert res[1]["status"] == "not_found"
+
+
+class TestExternalSigner:
+    def test_mock_signer_flow(self):
+        sk = interop_secret_key(2)
+        pk = sk_to_pk(sk)
+        signer = MockExternalSigner({pk: sk})
+
+        async def go():
+            assert await signer.upcheck()
+            assert await signer.public_keys() == [pk]
+            root = b"\x42" * 32
+            sig = await signer.sign(pk, root, "ATTESTATION")
+            assert verify(pk, root, sig)
+            with pytest.raises(ExternalSignerError):
+                await signer.sign(b"\x00" * 48, root)
+
+        asyncio.run(go())
+
+
+class TestFlare:
+    def test_self_slash_proposer_processes(self, types):
+        cfg = _cfg()
+        view = create_interop_genesis_state(cfg, types, 8)
+        state = view.state
+        idx = 3
+        slashing = self_slash_proposer(
+            cfg, types, state, idx, interop_secret_key(idx), slot=0
+        )
+        ctx = BlockCtx(cfg, state, types, 0, True)
+        assert not state.validators[idx].slashed
+        process_proposer_slashing(ctx, slashing)
+        assert state.validators[idx].slashed
+
+    def test_self_slash_attester_processes(self, types):
+        cfg = _cfg()
+        view = create_interop_genesis_state(cfg, types, 8)
+        state = view.state
+        idx = 5
+        slashing = self_slash_attester(
+            cfg, types, state, idx, interop_secret_key(idx)
+        )
+        ctx = BlockCtx(cfg, state, types, 0, True)
+        process_attester_slashing(ctx, slashing)
+        assert state.validators[idx].slashed

@@ -84,6 +84,36 @@ class TestTcpHost:
         asyncio.run(go())
 
 
+class TestSimultaneousDial:
+    def test_both_sides_keep_same_connection(self):
+        """Simultaneous dials must converge on ONE shared connection
+        (initiator tie-break); an install-order rule would leave both
+        sides holding the connection the other closed."""
+
+        async def go():
+            a = TcpHost("a", b"\xcc" * 4)
+            b = TcpHost("b", b"\xcc" * 4)
+            await a.listen()
+            await b.listen()
+            await asyncio.gather(
+                a.dial("127.0.0.1", b.port),
+                b.dial("127.0.0.1", a.port),
+            )
+            await asyncio.sleep(0.3)
+            assert "b" in a.conns and "a" in b.conns
+            # the surviving pair must actually work end-to-end
+            async def serve(peer, proto, data):
+                return b"pong"
+
+            b.on_request = serve
+            out = await a.conns["b"].request("t/1", b"ping")
+            assert out == b"pong"
+            await a.close()
+            await b.close()
+
+        asyncio.run(go())
+
+
 class TestGossipMesh:
     def test_three_node_forwarding_and_dedup(self, types):
         """A publishes; B validates+forwards; C receives exactly once
