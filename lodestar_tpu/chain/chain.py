@@ -492,10 +492,9 @@ class BeaconChain:
         )
         return resp.payload_id
 
-    async def prepare_execution_payload(self, slot: int, work):
-        """fcU with attributes + getPayload for block production
-        (reference: prepareExecutionPayload, produceBlockBody.ts:373).
-        Returns (payload, blobs_bundle|None)."""
+    async def send_payload_attributes(self, slot: int, work):
+        """fcU with payload attributes only — tells the EL to start
+        building (the prepareNextSlot path). Returns payload_id."""
         from ..execution.engine import PayloadAttributes
 
         st = work.state
@@ -519,7 +518,13 @@ class BeaconChain:
                 self.head_root if work.fork_seq >= ForkSeq.deneb else None
             ),
         )
-        payload_id = await self.notify_forkchoice_update(attrs)
+        return await self.notify_forkchoice_update(attrs)
+
+    async def prepare_execution_payload(self, slot: int, work):
+        """fcU with attributes + getPayload for block production
+        (reference: prepareExecutionPayload, produceBlockBody.ts:373).
+        Returns (payload, blobs_bundle|None)."""
+        payload_id = await self.send_payload_attributes(slot, work)
         if payload_id is None:
             return None, None
         got = await self.execution_engine.get_payload(work.fork, payload_id)

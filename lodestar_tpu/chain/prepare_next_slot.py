@@ -33,10 +33,17 @@ class PrepareNextSlotScheduler:
         self.prepared = {key: work}  # keep only the newest
         self.prepares += 1
         if self.chain.execution_engine is not None:
+            # fcU WITH payload attributes so the EL starts building the
+            # next payload now (produceBlockBody then only getPayloads)
             try:
-                await self.chain.notify_forkchoice_update()
+                from ..params import ForkSeq
+
+                if work.fork_seq >= ForkSeq.bellatrix:
+                    await self.chain.send_payload_attributes(
+                        next_slot, work
+                    )
             except Exception:
-                pass
+                pass  # EL hiccups must not break slot processing
         return work
 
     def take(self, head_root: bytes, slot: int):

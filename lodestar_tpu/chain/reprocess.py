@@ -17,7 +17,9 @@ WAIT_SLOTS = 1
 class ReprocessController:
     def __init__(self, chain):
         self.chain = chain
-        self._waiting: dict[bytes, list] = {}  # block root -> [(att, committee)]
+        # block root -> [(att, committee, parked_at_slot)]
+        self._waiting: dict[bytes, list] = {}
+        self._slot = 0
         self.resolved = 0
         self.expired = 0
 
@@ -27,7 +29,7 @@ class ReprocessController:
         q = self._waiting.setdefault(bytes(block_root), [])
         if len(q) >= MAX_QUEUED_PER_ROOT:
             return False
-        q.append((attestation, committee))
+        q.append((attestation, committee, self._slot))
         return True
 
     async def on_block_imported(self, block_root: bytes) -> int:
@@ -36,7 +38,7 @@ class ReprocessController:
         if not q:
             return 0
         n = 0
-        for att, committee in q:
+        for att, committee, _parked in q:
             try:
                 if await self.chain.on_attestation(att, committee):
                     n += 1
@@ -46,9 +48,21 @@ class ReprocessController:
         return n
 
     def on_slot(self, slot: int) -> int:
-        """Expire everything still unresolved (reprocess.ts slot
-        boundary sweep)."""
-        n = sum(len(q) for q in self._waiting.values())
-        self._waiting.clear()
+        """Expire entries that have waited >= WAIT_SLOTS boundaries —
+        NOT everything: an attestation parked just before the tick must
+        survive into the next slot (reprocess.ts deadline semantics)."""
+        self._slot = slot
+        n = 0
+        for root in list(self._waiting):
+            kept = [
+                e
+                for e in self._waiting[root]
+                if slot - e[2] <= WAIT_SLOTS
+            ]
+            n += len(self._waiting[root]) - len(kept)
+            if kept:
+                self._waiting[root] = kept
+            else:
+                del self._waiting[root]
         self.expired += n
         return n

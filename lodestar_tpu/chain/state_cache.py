@@ -60,6 +60,9 @@ class CheckpointStateCache:
                 view = BeaconStateView(state=state, fork=fork)
                 self.reloads += 1
                 self.hits += 1
+                # promote back into memory: bursts of validations for a
+                # spilled checkpoint must not re-deserialize each time
+                self.add(epoch, root, view)
                 return view
         self.misses += 1
         return None

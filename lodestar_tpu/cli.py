@@ -47,6 +47,36 @@ def _build_parser() -> argparse.ArgumentParser:
     beacon.add_argument("--db", required=True)
     beacon.add_argument("--api-port", type=int, default=9596)
     beacon.add_argument("--metrics-port", type=int, default=None)
+    beacon.add_argument(
+        "--port", type=int, default=None,
+        help="TCP listen port for the p2p network (0 = ephemeral; "
+        "omit to run without networking)",
+    )
+    beacon.add_argument("--discovery-port", type=int, default=0)
+    beacon.add_argument(
+        "--bootnodes", default=None,
+        help="comma-separated host:udp_port discovery bootstrap list",
+    )
+    beacon.add_argument(
+        "--execution-url", default=None,
+        help="engine API endpoint of the execution client",
+    )
+    beacon.add_argument(
+        "--jwt-secret", default=None,
+        help="hex file with the engine API JWT secret",
+    )
+    beacon.add_argument(
+        "--builder-url", default=None, help="MEV-boost relay endpoint"
+    )
+    beacon.add_argument(
+        "--trusted-setup", default=None,
+        help="KZG trusted setup JSON (ceremony output); dev setup "
+        "otherwise",
+    )
+    beacon.add_argument(
+        "--monitoring-endpoint", default=None,
+        help="push client-stats to this URL",
+    )
 
     vc = sub.add_parser("validator", help="validator client utilities")
     vc.add_argument(
@@ -158,12 +188,32 @@ async def _run_beacon(args) -> int:
         print("error: db has no chain_config metadata", file=sys.stderr)
         return 1
     cfg = chain_config_from_json(raw_cfg.decode())
+    jwt_secret = None
+    if args.jwt_secret:
+        from pathlib import Path
+
+        jwt_secret = bytes.fromhex(
+            Path(args.jwt_secret).read_text().strip().removeprefix("0x")
+        )
+    bootnodes = []
+    if args.bootnodes:
+        for entry in args.bootnodes.split(","):
+            host, _, port = entry.strip().rpartition(":")
+            bootnodes.append((host, int(port)))
     node = await BeaconNode.init(
         cfg=cfg,
         types=types,
         db=db,
         api_port=args.api_port,
         metrics_port=args.metrics_port,
+        tcp_port=args.port,
+        udp_port=args.discovery_port,
+        bootnodes=bootnodes,
+        execution_url=args.execution_url,
+        jwt_secret=jwt_secret,
+        builder_url=args.builder_url,
+        trusted_setup_path=args.trusted_setup,
+        monitoring_endpoint=args.monitoring_endpoint,
     )
     node.notify_status()
     try:

@@ -41,6 +41,11 @@ class ExecutionBuilderHttp:
         self.max_faults = max_faults
 
     async def _call(self, method: str, path: str, body=None):
+        if not self.enabled:
+            raise BuilderError(
+                "builder circuit-broken after repeated faults"
+            )
+
         def _do():
             data = json.dumps(body).encode() if body is not None else None
             req = urllib.request.Request(

@@ -46,6 +46,11 @@ class PeerConnection:
         self._send_lock = asyncio.Lock()
         self._req_id = 0
         self._pending: dict[int, asyncio.Future] = {}
+        # bound concurrent inbound handlers per connection: the read
+        # loop stops pulling frames when this saturates, restoring the
+        # backpressure inline handling had without its head-of-line
+        # blocking of RESP frames
+        self.handler_slots = asyncio.Semaphore(64)
         self.closed = False
 
     async def send_frame(self, kind: int, payload: bytes) -> None:
@@ -235,6 +240,13 @@ class TcpHost:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    @staticmethod
+    async def _with_slot(conn: PeerConnection, coro) -> None:
+        try:
+            await coro
+        finally:
+            conn.handler_slots.release()
+
     async def _handle_gossip(self, conn, payload: bytes) -> None:
         (tlen,) = struct.unpack(">H", payload[:2])
         topic = payload[2 : 2 + tlen].decode()
@@ -265,11 +277,22 @@ class TcpHost:
             while not conn.closed:
                 kind, payload = await read_frame(conn.reader)
                 # handlers run as tasks: a slow block import must not
-                # head-of-line-block RESP frames on the same socket
+                # head-of-line-block RESP frames on the same socket.
+                # The semaphore caps tasks per connection.
                 if kind == K_GOSSIP:
-                    self._spawn(self._handle_gossip(conn, payload))
+                    await conn.handler_slots.acquire()
+                    self._spawn(
+                        self._with_slot(
+                            conn, self._handle_gossip(conn, payload)
+                        )
+                    )
                 elif kind == K_REQ:
-                    self._spawn(self._handle_request(conn, payload))
+                    await conn.handler_slots.acquire()
+                    self._spawn(
+                        self._with_slot(
+                            conn, self._handle_request(conn, payload)
+                        )
+                    )
                 elif kind == K_RESP:
                     (rid,) = struct.unpack(">I", payload[:4])
                     conn.resolve(rid, payload[4:])

@@ -43,6 +43,20 @@ class BeaconNode:
         peer_id: str = "node",
         transport: InProcessTransport | None = None,
         logger=None,
+        # -- wire stack (None = in-process transport only) --
+        tcp_port: int | None = None,
+        udp_port: int = 0,
+        bootnodes: list[tuple[str, int]] | None = None,
+        # -- execution layer --
+        execution_url: str | None = None,
+        jwt_secret: bytes | None = None,
+        eth1_provider=None,
+        builder_url: str | None = None,
+        # -- kzg --
+        trusted_setup_path: str | None = None,
+        # -- monitoring --
+        monitoring_endpoint: str | None = None,
+        monitored_validators: list[int] | None = None,
     ):
         self.cfg = cfg
         self.types = types
@@ -63,6 +77,25 @@ class BeaconNode:
         self.range_sync = None
         self.att_pool = None
         self.op_pool = None
+        self.tcp_port = tcp_port
+        self.udp_port = udp_port
+        self.bootnodes = bootnodes or []
+        self.execution_url = execution_url
+        self.jwt_secret = jwt_secret
+        self.eth1_provider = eth1_provider
+        self.builder_url = builder_url
+        self.trusted_setup_path = trusted_setup_path
+        self.monitoring_endpoint = monitoring_endpoint
+        self.monitored_validators = monitored_validators or []
+        self.network = None
+        self.builder = None
+        self.monitoring = None
+        self.unknown_block_sync = None
+        self.backfill = None
+        self.historical = None
+        self.reprocess = None
+        self.prepare_next_slot = None
+        self.checkpoint_states = None
 
     @classmethod
     async def init(cls, **kwargs) -> "BeaconNode":
@@ -92,6 +125,64 @@ class BeaconNode:
         node.chain.light_client_server = LightClientServer(
             node.cfg, node.types, node.chain
         )
+        # kzg trusted setup (initCKZG + loadEthereumTrustedSetup,
+        # nodejs.ts:162-165): dev setup unless a ceremony file is given
+        from .crypto import kzg as _kzg
+
+        if node.trusted_setup_path is not None:
+            _kzg.load_trusted_setup(node.trusted_setup_path)
+            log.info("trusted setup loaded",
+                     {"path": str(node.trusted_setup_path)})
+        # execution engine (engine API over JSON-RPC + JWT)
+        if node.execution_url is not None:
+            from .execution.http import ExecutionEngineHttp
+
+            node.chain.execution_engine = ExecutionEngineHttp.connect(
+                node.execution_url,
+                jwt_secret=node.jwt_secret,
+                types=node.types,
+            )
+            node.chain.trusted_execution = False
+            log.info("execution engine attached",
+                     {"url": node.execution_url})
+        # eth1 deposit tracker
+        if node.eth1_provider is not None:
+            from .eth1 import Eth1DepositDataTracker
+
+            node.chain.eth1 = Eth1DepositDataTracker(
+                node.cfg, node.types, node.eth1_provider
+            )
+        # external builder (MEV-boost relay)
+        if node.builder_url is not None:
+            from .execution.builder import ExecutionBuilderHttp
+
+            node.builder = ExecutionBuilderHttp(
+                node.builder_url, node.types
+            )
+        # chain auxiliaries
+        from .chain.historical import HistoricalStateRegen
+        from .chain.prepare_next_slot import PrepareNextSlotScheduler
+        from .chain.reprocess import ReprocessController
+        from .chain.state_cache import CheckpointStateCache
+        from .metrics.validator_monitor import ValidatorMonitor
+
+        node.checkpoint_states = CheckpointStateCache(
+            node.types, db=node.db
+        )
+        node.historical = HistoricalStateRegen(node.chain)
+        node.reprocess = ReprocessController(node.chain)
+        node.prepare_next_slot = PrepareNextSlotScheduler(node.chain)
+        vm = ValidatorMonitor(node.metrics_registry)
+        for idx in node.monitored_validators:
+            vm.register_local_validator(idx)
+        node.chain.validator_monitor = vm
+        if node.monitoring_endpoint is not None:
+            from .metrics.monitoring import MonitoringService
+
+            node.monitoring = MonitoringService(
+                node.monitoring_endpoint, chain=node.chain
+            )
+            node.monitoring.start()
         node.att_pool = AggregatedAttestationPool(node.types)
         node.op_pool = OpPool(node.types)
         # gossip ingest
@@ -107,14 +198,61 @@ class BeaconNode:
             metrics=node.metrics,
         )
         node.processor.start()
-        # reqresp server + range sync client
-        node.reqresp = ReqResp(node.peer_id, node.transport)
+        # wire stack: real TCP/UDP network when a port is requested,
+        # else the in-process transport (tests, embedded use)
+        if node.tcp_port is not None:
+            from .network.facade import Network
+            from .sync import BackfillSync, UnknownBlockSync
+
+            node.network = Network(
+                node.chain,
+                node.beacon_cfg,
+                node.types,
+                processor=node.processor,
+                peer_id=node.peer_id,
+            )
+            await node.network.start(
+                tcp_port=node.tcp_port, udp_port=node.udp_port
+            )
+            for host, port in node.bootnodes:
+                node.network.discovery.add_bootnode(host, port)
+            node.reqresp = node.network.reqresp
+            node.unknown_block_sync = UnknownBlockSync(
+                node.chain, node.beacon_cfg, node.network.reqresp
+            )
+            node.backfill = BackfillSync(
+                node.chain,
+                node.beacon_cfg,
+                node.types,
+                node.network.reqresp,
+                node.chain.verifier,
+            )
+            log.info(
+                "network listening",
+                {
+                    "tcp": node.network.host.port,
+                    "udp": node.network.discovery.record.udp_port,
+                },
+            )
+        else:
+            node.reqresp = ReqResp(node.peer_id, node.transport)
         SyncServer(node.chain, node.beacon_cfg, node.types).register(
             node.reqresp
         )
         node.range_sync = RangeSync(
             node.chain, node.beacon_cfg, node.types, node.reqresp
         )
+        if node.network is not None:
+            # feed every connected peer into the sync components and
+            # head-check it (BeaconSync's status-driven mode switch,
+            # sync.ts:19): behind a peer -> range sync toward its head
+            def _on_new_peer(peer_id: str) -> None:
+                node.range_sync.add_peer(peer_id)
+                node.unknown_block_sync.add_peer(peer_id)
+                node.backfill.add_peer(peer_id)
+                asyncio.ensure_future(node._head_check(peer_id))
+
+            node.network.peer_manager.on_new_peer = _on_new_peer
         # REST API
         impl = BeaconApiImpl(node.cfg, node.types, node.chain, node)
         node.api_server = BeaconRestApiServer(
@@ -140,6 +278,22 @@ class BeaconNode:
         )
         return node
 
+    async def _head_check(self, peer_id: str) -> None:
+        """Status handshake a fresh peer; range-sync toward its head
+        when we're behind (sync.ts head/range mode switch)."""
+        try:
+            remote = await self.range_sync.status_handshake(peer_id)
+            local = self.chain.fork_choice.proto.get_node(
+                self.chain.head_root
+            )
+            local_slot = local.slot if local else 0
+            if int(remote.head_slot) > local_slot:
+                await self.range_sync.sync_to(int(remote.head_slot))
+        except Exception:
+            self.network.peer_manager.penalize(
+                peer_id, "reqresp error"
+            )
+
     def notify_status(self) -> None:
         """NodeNotifier one-liner (notifier.ts)."""
         head = self.chain.fork_choice.proto.get_node(self.chain.head_root)
@@ -164,10 +318,14 @@ class BeaconNode:
 
     async def close(self) -> None:
         """Reverse-order shutdown (graceful SIGINT path)."""
+        if self.monitoring is not None:
+            await self.monitoring.stop()
         if self.api_server is not None:
             self.api_server.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if self.network is not None:
+            await self.network.stop()
         if self.processor is not None:
             await self.processor.stop()
         if self.chain is not None:

@@ -155,7 +155,11 @@ def verify_storage_proof(
     storage_root: bytes, slot: bytes, proof: list[bytes]
 ) -> int:
     """Verify one eth_getProof storageProof entry; returns the slot
-    value (0 when excluded)."""
+    value (0 when excluded). The slot is left-padded to the 32 bytes
+    the trie actually keys on (short keys would silently 'prove' 0)."""
+    slot = bytes(slot).rjust(32, b"\x00")
+    if len(slot) != 32:
+        raise ProofError("storage slot longer than 32 bytes")
     value = verify_proof(storage_root, slot, proof)
     if value is None:
         return 0

@@ -23,20 +23,36 @@ class ProofProvider:
     light-client updates (proof_provider/payload_store.ts)."""
 
     def __init__(self):
-        self._roots: dict[bytes, bytes] = {}  # block_hash -> state_root
+        # block_hash -> (state_root, block_number)
+        self._roots: dict[bytes, tuple[bytes, int | None]] = {}
         self.latest_block_hash: bytes | None = None
 
     def on_verified_header(
-        self, block_hash: bytes, state_root: bytes
+        self,
+        block_hash: bytes,
+        state_root: bytes,
+        block_number: int | None = None,
     ) -> None:
-        self._roots[bytes(block_hash)] = bytes(state_root)
+        self._roots[bytes(block_hash)] = (
+            bytes(state_root),
+            block_number,
+        )
         self.latest_block_hash = bytes(block_hash)
 
-    def state_root(self, block_hash: bytes | None = None) -> bytes:
+    def anchor(self, block_hash: bytes | None = None):
+        """(state_root, rpc block tag) of a verified header. Proof
+        queries must pin THIS block — 'latest' on the RPC side races
+        ahead of light-client verification and every proof would
+        mismatch."""
         bh = block_hash or self.latest_block_hash
         if bh is None or bh not in self._roots:
             raise VerificationError("no verified execution header")
-        return self._roots[bh]
+        state_root, number = self._roots[bh]
+        tag = hex(number) if number is not None else "0x" + bh.hex()
+        return state_root, tag
+
+    def state_root(self, block_hash: bytes | None = None) -> bytes:
+        return self.anchor(block_hash)[0]
 
 
 class VerifiedExecutionProvider:
@@ -50,13 +66,16 @@ class VerifiedExecutionProvider:
         self.proofs = proof_provider
 
     async def _account(self, address: bytes, slots=()):
-        state_root = self.proofs.state_root()
+        state_root, block_tag = self.proofs.anchor()
         out = await self.rpc.call(
             "eth_getProof",
             [
                 "0x" + address.hex(),
-                ["0x" + bytes(s).hex() for s in slots],
-                "latest",
+                [
+                    "0x" + bytes(s).rjust(32, b"\x00").hex()
+                    for s in slots
+                ],
+                block_tag,
             ],
         )
         proof = [
@@ -79,8 +98,9 @@ class VerifiedExecutionProvider:
 
     async def get_code(self, address: bytes) -> bytes:
         account, _ = await self._account(address)
+        _, block_tag = self.proofs.anchor()
         code_hex = await self.rpc.call(
-            "eth_getCode", ["0x" + address.hex(), "latest"]
+            "eth_getCode", ["0x" + address.hex(), block_tag]
         )
         code = bytes.fromhex(code_hex.removeprefix("0x"))
         if keccak256(code) != account["code_hash"]:
