@@ -26,8 +26,18 @@ class ApiClient:
     def call(self, operation_id: str, params=None, body=None):
         route = self._routes[operation_id]
         path = route.path
+        query = []
         for k, v in (params or {}).items():
-            path = path.replace("{" + k + "}", str(v))
+            if "{" + k + "}" in path:
+                path = path.replace("{" + k + "}", str(v))
+            else:
+                # params not in the path template go to the query
+                # string (the server fills route.query_params from it)
+                from urllib.parse import quote
+
+                query.append(f"{quote(str(k))}={quote(str(v))}")
+        if query:
+            path += "?" + "&".join(query)
         data = json.dumps(body).encode() if body is not None else None
         last_err = None
         for base in self.base_urls:  # fallback URLs (httpClient.ts)

@@ -50,7 +50,8 @@ class BeaconApiImpl:
             "genesis_fork_version": _hex(self.cfg.GENESIS_FORK_VERSION),
         }
 
-    def _resolve_state(self, state_id: str):
+    def _resolve_state(self, state_id):
+        state_id = str(state_id)  # numeric path params arrive as ints
         chain = self.chain
         if state_id == "head":
             return chain.head_state
@@ -137,7 +138,8 @@ class BeaconApiImpl:
             },
         }
 
-    def _resolve_block_root(self, block_id: str) -> bytes:
+    def _resolve_block_root(self, block_id) -> bytes:
+        block_id = str(block_id)  # numeric path params arrive as ints
         chain = self.chain
         if block_id == "head":
             return chain.head_root
@@ -161,6 +163,266 @@ class BeaconApiImpl:
     async def publish_block(self, signed_block) -> dict:
         await self.chain.process_block(signed_block)
         return {}
+
+    def _block_with_fork(self, block_id: str):
+        root = self._resolve_block_root(block_id)
+        blk = self.chain.get_block(root)
+        fork = None
+        if blk is not None:
+            from ..statetransition.slot import fork_at_epoch
+
+            fork = fork_at_epoch(
+                self.cfg,
+                int(blk.message.slot) // preset().SLOTS_PER_EPOCH,
+            )
+        elif self.chain.db is not None:
+            raw = self.chain.db.block.get_binary(root)
+            if raw is not None:
+                fork, blk = self.chain.db.block.decode_value(raw)
+        if blk is None:
+            raise ApiError(404, f"block {block_id} not found")
+        return root, fork, blk
+
+    def get_block_v2(self, block_id: str) -> dict:
+        from .json_codec import to_json
+
+        _, fork, blk = self._block_with_fork(block_id)
+        t = self.types.by_fork[fork].SignedBeaconBlock
+        # v2 responses carry the fork version at the top level
+        return {
+            "version": fork,
+            "execution_optimistic": False,
+            "data": to_json(t, blk),
+        }
+
+    def get_block_root(self, block_id: str) -> dict:
+        return {"root": _hex(self._resolve_block_root(block_id))}
+
+    async def publish_block_json(self, body: dict) -> dict:
+        """POST /eth/v1/beacon/blocks with a JSON SignedBeaconBlock
+        (fork inferred from the slot)."""
+        from ..statetransition.slot import fork_at_epoch
+        from .json_codec import from_json
+
+        try:
+            slot = int(body["message"]["slot"])
+            fork = fork_at_epoch(
+                self.cfg, slot // preset().SLOTS_PER_EPOCH
+            )
+            block = from_json(
+                self.types.by_fork[fork].SignedBeaconBlock, body
+            )
+        except (KeyError, ValueError, TypeError) as e:
+            raise ApiError(400, f"malformed block: {e}") from e
+        await self.chain.process_block(block)
+        return {}
+
+    # -- pool namespace ---------------------------------------------------
+
+    def _pools(self):
+        if self.node is None or self.node.op_pool is None:
+            raise ApiError(503, "op pool not available")
+        return self.node.op_pool
+
+    async def submit_pool_attestations(self, body: list) -> dict:
+        from .json_codec import from_json
+
+        if self.node is None or self.node.att_pool is None:
+            raise ApiError(503, "attestation pool not available")
+        errors = []
+        for i, obj in enumerate(body):
+            try:
+                att = from_json(self.types.Attestation, obj)
+                self.node.att_pool.add(att)
+            except Exception as e:
+                errors.append({"index": i, "message": repr(e)})
+        if errors:
+            raise ApiError(400, f"failures: {errors}")
+        return {}
+
+    def get_pool_attestations(self) -> list:
+        from .json_codec import to_json
+
+        if self.node is None or self.node.att_pool is None:
+            raise ApiError(503, "attestation pool not available")
+        st = self.chain.head_state.state
+        atts = self.node.att_pool.get_attestations_for_block(
+            int(st.slot) + 1
+        )
+        return [to_json(self.types.Attestation, a) for a in atts]
+
+    def submit_pool_voluntary_exit(self, body: dict) -> dict:
+        from .json_codec import from_json
+
+        self._pools().add_voluntary_exit(
+            from_json(self.types.SignedVoluntaryExit, body)
+        )
+        return {}
+
+    def submit_pool_attester_slashing(self, body: dict) -> dict:
+        from .json_codec import from_json
+
+        self._pools().add_attester_slashing(
+            from_json(self.types.AttesterSlashing, body)
+        )
+        return {}
+
+    def submit_pool_proposer_slashing(self, body: dict) -> dict:
+        from .json_codec import from_json
+
+        self._pools().add_proposer_slashing(
+            from_json(self.types.ProposerSlashing, body)
+        )
+        return {}
+
+    # -- debug / light client ---------------------------------------------
+
+    def get_debug_fork_choice(self) -> dict:
+        """Proto-array dump (debug/fork_choice route)."""
+        proto = self.chain.fork_choice.proto
+        nodes = []
+        for n in proto.nodes:
+            if n is None:
+                continue
+            nodes.append(
+                {
+                    "slot": str(n.slot),
+                    "block_root": _hex(n.block_root),
+                    "parent_root": _hex(n.parent_root)
+                    if n.parent_root
+                    else None,
+                    "justified_epoch": str(n.justified_epoch),
+                    "finalized_epoch": str(n.finalized_epoch),
+                    "weight": str(n.weight),
+                    "execution_status": str(n.execution_status.name)
+                    if n.execution_status is not None
+                    else "pre_merge",
+                }
+            )
+        return {
+            "justified_checkpoint": _cp(self.chain.justified_checkpoint),
+            "finalized_checkpoint": _cp(self.chain.finalized_checkpoint),
+            "fork_choice_nodes": nodes,
+        }
+
+    def _lc_server(self):
+        lc = self.chain.light_client_server
+        if lc is None:
+            raise ApiError(503, "light client server not enabled")
+        return lc
+
+    def get_light_client_bootstrap(self, block_root: str) -> dict:
+        from .json_codec import to_json
+
+        lc = self._lc_server()
+        root = bytes.fromhex(block_root.removeprefix("0x"))
+        boot = lc.get_bootstrap(root)
+        if boot is None:
+            raise ApiError(404, "no bootstrap for that root")
+        return to_json(self.types.LightClientBootstrap, boot)
+
+    def get_light_client_finality_update(self) -> dict:
+        from .json_codec import to_json
+
+        lc = self._lc_server()
+        if lc.latest_finality_update is None:
+            raise ApiError(404, "no finality update yet")
+        return to_json(
+            self.types.LightClientFinalityUpdate,
+            lc.latest_finality_update,
+        )
+
+    def get_light_client_optimistic_update(self) -> dict:
+        from .json_codec import to_json
+
+        lc = self._lc_server()
+        if lc.latest_optimistic_update is None:
+            raise ApiError(404, "no optimistic update yet")
+        return to_json(
+            self.types.LightClientOptimisticUpdate,
+            lc.latest_optimistic_update,
+        )
+
+    # -- validator production ---------------------------------------------
+
+    def produce_attestation_data(
+        self, slot: str, committee_index: str
+    ) -> dict:
+        from .json_codec import to_json
+
+        data = self._attestation_data(int(slot), int(committee_index))
+        return to_json(self.types.AttestationData, data)
+
+    def _attestation_data(self, slot: int, committee_index: int):
+        chain = self.chain
+        st = chain.head_state.state
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        data = self.types.AttestationData.default()
+        data.slot = slot
+        data.index = committee_index
+        data.beacon_block_root = chain.head_root
+        data.source = st.current_justified_checkpoint
+        try:
+            target_root = bytes(util.get_block_root(st, epoch))
+        except ValueError:
+            target_root = chain.head_root
+        data.target.epoch = epoch
+        data.target.root = target_root
+        return data
+
+    def produce_block_v2(
+        self, slot: str, randao_reveal: str, graffiti: str = ""
+    ) -> dict:
+        from .json_codec import to_json
+
+        block, post = self.chain.produce_block(
+            int(slot),
+            bytes.fromhex(randao_reveal.removeprefix("0x")),
+            graffiti=(
+                bytes.fromhex(graffiti.removeprefix("0x")).ljust(32, b"\x00")
+                if graffiti
+                else b"\x00" * 32
+            ),
+        )
+        t = self.types.by_fork[post.fork].BeaconBlock
+        return {"version": post.fork, **{"data": to_json(t, block)}}
+
+    # -- node: identity / peers -------------------------------------------
+
+    def get_identity(self) -> dict:
+        net = getattr(self.node, "network", None) if self.node else None
+        if net is None:
+            return {"peer_id": "", "enr": "", "p2p_addresses": []}
+        rec = net.discovery.record if net.discovery else None
+        return {
+            "peer_id": net.peer_id,
+            "enr": rec.tag() if rec else "",
+            "p2p_addresses": [
+                f"/ip4/{net.host.host}/tcp/{net.host.port}"
+            ],
+            "discovery_addresses": [
+                f"/ip4/{rec.host}/udp/{rec.udp_port}" if rec else ""
+            ],
+        }
+
+    def get_peers(self) -> list:
+        net = getattr(self.node, "network", None) if self.node else None
+        if net is None:
+            return []
+        out = []
+        for pid, conn in net.host.conns.items():
+            score = net.peer_manager.scores.get(pid)
+            out.append(
+                {
+                    "peer_id": pid,
+                    "state": "connected",
+                    "direction": "outbound"
+                    if conn.outbound
+                    else "inbound",
+                    "score": score.value() if score else 0.0,
+                }
+            )
+        return out
 
     # -- validator namespace --------------------------------------------
 

@@ -1,0 +1,61 @@
+"""Generic SSZ value <-> beacon-API JSON codec.
+
+Reference analog: the per-type toJson/fromJson codecs @chainsafe/ssz
+attaches to every type (used by every REST route). Conventions follow
+the beacon-api spec: uints as decimal strings, byte blobs as 0x-hex,
+bitfields as 0x-hex of their SSZ encoding, containers as snake_case
+objects.
+"""
+
+from __future__ import annotations
+
+from ..ssz.basic import BooleanType, UintType
+from ..ssz.composite import (
+    BitlistType,
+    BitvectorType,
+    ByteListType,
+    ByteVectorType,
+    ContainerType,
+    ListType,
+    VectorType,
+)
+
+
+def to_json(t, v):
+    if isinstance(t, UintType):
+        return str(int(v))
+    if isinstance(t, BooleanType):
+        return bool(v)
+    if isinstance(t, (ByteVectorType, ByteListType)):
+        return "0x" + bytes(v).hex()
+    if isinstance(t, (BitvectorType, BitlistType)):
+        return "0x" + t.serialize(v).hex()
+    if isinstance(t, (ListType, VectorType)):
+        return [to_json(t.element_type, e) for e in v]
+    if isinstance(t, ContainerType):
+        return {
+            name: to_json(ft, getattr(v, name)) for name, ft in t.fields
+        }
+    raise TypeError(f"no JSON codec for {t!r}")
+
+
+def from_json(t, obj):
+    if isinstance(t, UintType):
+        return int(obj)
+    if isinstance(t, BooleanType):
+        return bool(obj)
+    if isinstance(t, (ByteVectorType, ByteListType)):
+        return bytes.fromhex(str(obj).removeprefix("0x"))
+    if isinstance(t, (BitvectorType, BitlistType)):
+        return t.deserialize(bytes.fromhex(str(obj).removeprefix("0x")))
+    if isinstance(t, (ListType, VectorType)):
+        return [from_json(t.element_type, e) for e in obj]
+    if isinstance(t, ContainerType):
+        return t(
+            **{
+                name: from_json(ft, obj[name])
+                for name, ft in t.fields
+                if name in obj
+            }
+        )
+    raise TypeError(f"no JSON codec for {t!r}")

@@ -20,6 +20,8 @@ class Route:
     path: str  # template with {param} segments
     impl_name: str  # method on BeaconApiImpl
     wrap_data: bool = True  # beacon-api {"data": ...} envelope
+    raw_body: bool = False  # pass the parsed JSON body through as-is
+    query_params: tuple = ()  # query-string params appended in order
 
 
 ROUTES: list[Route] = [
@@ -62,10 +64,110 @@ ROUTES: list[Route] = [
         "/eth/v1/validator/duties/attester/{epoch}",
         "get_attester_duties",
     ),
+    Route(
+        "getBlockV2",
+        "GET",
+        "/eth/v2/beacon/blocks/{block_id}",
+        "get_block_v2",
+        wrap_data=False,  # impl returns the {version, data} envelope
+    ),
+    Route(
+        "getBlockRoot",
+        "GET",
+        "/eth/v1/beacon/blocks/{block_id}/root",
+        "get_block_root",
+    ),
+    Route(
+        "publishBlock",
+        "POST",
+        "/eth/v1/beacon/blocks",
+        "publish_block_json",
+        raw_body=True,
+    ),
+    # pools
+    Route(
+        "submitPoolAttestations",
+        "POST",
+        "/eth/v1/beacon/pool/attestations",
+        "submit_pool_attestations",
+        raw_body=True,
+    ),
+    Route(
+        "getPoolAttestations",
+        "GET",
+        "/eth/v1/beacon/pool/attestations",
+        "get_pool_attestations",
+    ),
+    Route(
+        "submitPoolVoluntaryExit",
+        "POST",
+        "/eth/v1/beacon/pool/voluntary_exits",
+        "submit_pool_voluntary_exit",
+        raw_body=True,
+    ),
+    Route(
+        "submitPoolAttesterSlashings",
+        "POST",
+        "/eth/v1/beacon/pool/attester_slashings",
+        "submit_pool_attester_slashing",
+        raw_body=True,
+    ),
+    Route(
+        "submitPoolProposerSlashings",
+        "POST",
+        "/eth/v1/beacon/pool/proposer_slashings",
+        "submit_pool_proposer_slashing",
+        raw_body=True,
+    ),
+    # validator (continued)
+    Route(
+        "produceAttestationData",
+        "GET",
+        "/eth/v1/validator/attestation_data",
+        "produce_attestation_data",
+        query_params=("slot", "committee_index"),
+    ),
+    Route(
+        "produceBlockV2",
+        "GET",
+        "/eth/v2/validator/blocks/{slot}",
+        "produce_block_v2",
+        wrap_data=False,  # impl returns the {version, data} envelope
+        query_params=("randao_reveal", "graffiti"),
+    ),
+    # debug
+    Route(
+        "getDebugForkChoice",
+        "GET",
+        "/eth/v1/debug/fork_choice",
+        "get_debug_fork_choice",
+        wrap_data=False,
+    ),
+    # light client
+    Route(
+        "getLightClientBootstrap",
+        "GET",
+        "/eth/v1/beacon/light_client/bootstrap/{block_root}",
+        "get_light_client_bootstrap",
+    ),
+    Route(
+        "getLightClientFinalityUpdate",
+        "GET",
+        "/eth/v1/beacon/light_client/finality_update",
+        "get_light_client_finality_update",
+    ),
+    Route(
+        "getLightClientOptimisticUpdate",
+        "GET",
+        "/eth/v1/beacon/light_client/optimistic_update",
+        "get_light_client_optimistic_update",
+    ),
     # node
     Route("getHealth", "GET", "/eth/v1/node/health", "get_health", wrap_data=False),
     Route("getNodeVersion", "GET", "/eth/v1/node/version", "get_version"),
     Route("getSyncingStatus", "GET", "/eth/v1/node/syncing", "get_syncing"),
+    Route("getNetworkIdentity", "GET", "/eth/v1/node/identity", "get_identity"),
+    Route("getPeers", "GET", "/eth/v1/node/peers", "get_peers"),
     # config
     Route("getSpec", "GET", "/eth/v1/config/spec", "get_spec"),
 ]

@@ -33,6 +33,7 @@ class BeaconRestApiServer:
         self.loop = loop
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self._closing = False
 
     def start(self) -> int:
         impl = self.impl
@@ -42,9 +43,14 @@ class BeaconRestApiServer:
             protocol_version = "HTTP/1.1"
 
             def _run(self):
-                m = match_route(
-                    self.command, self.path.split("?")[0]
-                )
+                from urllib.parse import parse_qs
+
+                path, _, qs = self.path.partition("?")
+                query = parse_qs(qs)
+                if self.command == "GET" and path == "/eth/v1/events":
+                    self._sse(query)
+                    return
+                m = match_route(self.command, path)
                 if m is None:
                     self._json(404, {"code": 404, "message": "route not found"})
                     return
@@ -60,12 +66,18 @@ class BeaconRestApiServer:
                     args = [
                         int(a) if a.isdigit() else a for a in args
                     ]
+                    for qp in route.query_params:
+                        vals = query.get(qp)
+                        args.append(vals[0] if vals else "")
                     if body is not None:
-                        args.append(
-                            [int(x) for x in body]
-                            if isinstance(body, list)
-                            else body
-                        )
+                        if route.raw_body:
+                            args.append(body)
+                        else:
+                            args.append(
+                                [int(x) for x in body]
+                                if isinstance(body, list)
+                                else body
+                            )
                     fn = getattr(impl, route.impl_name)
                     result = fn(*args)
                     if inspect.iscoroutine(result):
@@ -91,6 +103,57 @@ class BeaconRestApiServer:
                     self._json(200, result)
                     return
                 self._json(200, {"data": result})
+
+            def _sse(self, query) -> None:
+                """Server-sent events stream (api/impl/events; topics
+                via ?topics=head,block&topics=...)."""
+                import queue as _queue
+
+                topics = []
+                for entry in query.get("topics", []):
+                    topics += [t for t in entry.split(",") if t]
+                if not topics:
+                    self._json(
+                        400, {"code": 400, "message": "topics required"}
+                    )
+                    return
+                emitter = getattr(impl.chain, "events", None)
+                if emitter is None:
+                    self._json(
+                        503, {"code": 503, "message": "events unavailable"}
+                    )
+                    return
+                q = emitter.subscribe(topics)
+                try:
+                    # the stream has no Content-Length: close the
+                    # connection when it ends or a keep-alive client
+                    # wedges waiting for the unterminated body
+                    self.close_connection = True
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/event-stream"
+                    )
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    while not server._closing:
+                        try:
+                            topic, data = q.get(timeout=1.0)
+                        except _queue.Empty:
+                            # keep-alive comment frame
+                            self.wfile.write(b":\n\n")
+                            self.wfile.flush()
+                            continue
+                        frame = (
+                            f"event: {topic}\n"
+                            f"data: {json.dumps(data)}\n\n"
+                        ).encode()
+                        self.wfile.write(frame)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    emitter.unsubscribe(q)
 
             def _json(self, status: int, obj) -> None:
                 data = json.dumps(obj).encode()
@@ -118,6 +181,7 @@ class BeaconRestApiServer:
         return self.port
 
     def stop(self) -> None:
+        self._closing = True  # ends SSE streams at their next tick
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

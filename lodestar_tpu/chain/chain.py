@@ -79,6 +79,10 @@ class BeaconChain:
         self.eth1 = None
         # optional ValidatorMonitor (metrics/validator_monitor.py)
         self.validator_monitor = None
+        # chain events -> SSE (api events route)
+        from .events import ChainEventEmitter
+
+        self.events = ChainEventEmitter()
         # Dev chains have no execution engine: self-built mock payloads
         # are trusted (valid). With a real engine attached this must be
         # False so payload blocks import optimistically (syncing) until
@@ -400,7 +404,53 @@ class BeaconChain:
             ),
         )
         self._refresh_justified_balances()
+        prev_head = self.head_root
         self.head_root = self.fork_choice.update_head()
+        # events (importBlock.ts ChainEvent emissions)
+        self.events.emit(
+            "block",
+            {
+                "slot": str(int(block.slot)),
+                "block": "0x" + block_root.hex(),
+            },
+        )
+        if self.head_root != prev_head:
+            head_node = self.fork_choice.proto.get_node(self.head_root)
+            self.events.emit(
+                "head",
+                {
+                    "slot": str(head_node.slot if head_node else 0),
+                    "block": "0x" + self.head_root.hex(),
+                    "state": "0x"
+                    + (
+                        head_node.state_root.hex()
+                        if head_node
+                        else "00" * 32
+                    ),
+                },
+            )
+            if (
+                head_node is not None
+                and prev_head != bytes(block.parent_root)
+                and self.fork_choice.has_block(prev_head)
+            ):
+                self.events.emit(
+                    "chain_reorg",
+                    {
+                        "slot": str(head_node.slot),
+                        "old_head_block": "0x" + prev_head.hex(),
+                        "new_head_block": "0x" + self.head_root.hex(),
+                    },
+                )
+        fin = self.fork_choice.finalized_checkpoint
+        if fin.epoch > prev_finalized:
+            self.events.emit(
+                "finalized_checkpoint",
+                {
+                    "epoch": str(fin.epoch),
+                    "block": "0x" + fin.root.hex(),
+                },
+            )
         if self.db is not None:
             self._persist_import(block_root, signed_block, work)
             if self.fork_choice.finalized_checkpoint.epoch > prev_finalized:

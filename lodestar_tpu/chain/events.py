@@ -1,0 +1,51 @@
+"""Chain event bus feeding the beacon events API (SSE).
+
+Reference analog: ChainEventEmitter + the events route
+(api/impl/events) — block import / head update / finality emit typed
+events that SSE subscribers stream.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+TOPICS = (
+    "head",
+    "block",
+    "finalized_checkpoint",
+    "chain_reorg",
+    "attestation",
+)
+
+
+class ChainEventEmitter:
+    """Thread-safe fan-out: the chain emits on the asyncio loop; SSE
+    handlers consume from server threads via per-subscriber queues."""
+
+    def __init__(self, max_queued: int = 256):
+        self._subs: list[tuple[set, queue.Queue]] = []
+        self._lock = threading.Lock()
+        self.max_queued = max_queued
+        self.emitted = 0
+
+    def subscribe(self, topics) -> queue.Queue:
+        q: queue.Queue = queue.Queue(self.max_queued)
+        with self._lock:
+            self._subs.append((set(topics), q))
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._subs = [(t, s) for t, s in self._subs if s is not q]
+
+    def emit(self, topic: str, data: dict) -> None:
+        self.emitted += 1
+        with self._lock:
+            subs = list(self._subs)
+        for topics, q in subs:
+            if topic in topics:
+                try:
+                    q.put_nowait((topic, data))
+                except queue.Full:
+                    pass  # slow consumer: drop (SSE is lossy by design)
