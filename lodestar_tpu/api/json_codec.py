@@ -51,11 +51,14 @@ def from_json(t, obj):
     if isinstance(t, (ListType, VectorType)):
         return [from_json(t.element_type, e) for e in obj]
     if isinstance(t, ContainerType):
+        missing = [name for name, _ in t.fields if name not in obj]
+        if missing:
+            # silent defaults would mask malformed bodies (typos,
+            # dropped signatures) until deep in the state transition
+            raise KeyError(
+                f"{t.name} JSON missing fields: {', '.join(missing)}"
+            )
         return t(
-            **{
-                name: from_json(ft, obj[name])
-                for name, ft in t.fields
-                if name in obj
-            }
+            **{name: from_json(ft, obj[name]) for name, ft in t.fields}
         )
     raise TypeError(f"no JSON codec for {t!r}")

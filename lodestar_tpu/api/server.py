@@ -91,6 +91,10 @@ class BeaconRestApiServer:
                         e.status, {"code": e.status, "message": e.message}
                     )
                     return
+                except (ValueError, TypeError, KeyError) as e:
+                    # malformed params/bodies are the client's fault
+                    self._json(400, {"code": 400, "message": repr(e)})
+                    return
                 except Exception as e:
                     self._json(500, {"code": 500, "message": repr(e)})
                     return
@@ -109,12 +113,24 @@ class BeaconRestApiServer:
                 via ?topics=head,block&topics=...)."""
                 import queue as _queue
 
+                from ..chain.events import TOPICS
+
                 topics = []
                 for entry in query.get("topics", []):
                     topics += [t for t in entry.split(",") if t]
                 if not topics:
                     self._json(
                         400, {"code": 400, "message": "topics required"}
+                    )
+                    return
+                unknown = [t for t in topics if t not in TOPICS]
+                if unknown:
+                    self._json(
+                        400,
+                        {
+                            "code": 400,
+                            "message": f"unknown topics: {unknown}",
+                        },
                     )
                     return
                 emitter = getattr(impl.chain, "events", None)

@@ -80,6 +80,12 @@ class Network:
         self.reqresp_transport = TcpReqRespTransport(self.host)
         self.reqresp = rr.ReqResp(self.peer_id, self.reqresp_transport)
         self.subscribed_subnets: set[int] = set()
+        from collections import deque
+
+        self.op_pool = None  # wired by the node assembly
+        # recent verified sidecars for block-import DA lookup; bounded
+        # (~131 KB each — an unbounded buffer is an OOM)
+        self.seen_blob_sidecars: deque = deque(maxlen=64)
         self.blocks_received = 0
         self.blocks_published = 0
 
@@ -129,6 +135,85 @@ class Network:
         self.gossip.subscribe(
             self._t("beacon_aggregate_and_proof"), self._on_aggregate
         )
+        # operation topics feed the op pool (gossip/interface.ts topic
+        # table; handlers at network/processor/gossipHandlers.ts)
+        self.gossip.subscribe(
+            self._t("voluntary_exit"),
+            self._op_handler("SignedVoluntaryExit", "add_voluntary_exit"),
+        )
+        self.gossip.subscribe(
+            self._t("proposer_slashing"),
+            self._op_handler("ProposerSlashing", "add_proposer_slashing"),
+        )
+        self.gossip.subscribe(
+            self._t("attester_slashing"),
+            self._op_handler("AttesterSlashing", "add_attester_slashing"),
+        )
+        self.gossip.subscribe(
+            self._t("bls_to_execution_change"),
+            self._op_handler("SignedBLSToExecutionChange", "add_bls_change"),
+        )
+
+    def _op_handler(self, type_name: str, pool_method: str):
+        async def handler(peer_id: str, ssz_bytes: bytes):
+            t = getattr(self.types, type_name, None)
+            if t is None:
+                return ValidationResult.IGNORE
+            try:
+                value = t.deserialize(ssz_bytes)
+            except Exception:
+                return ValidationResult.REJECT
+            pool = getattr(self.op_pool, pool_method, None) if (
+                self.op_pool is not None
+            ) else None
+            if pool is None:
+                return ValidationResult.IGNORE
+            try:
+                pool(value)
+            except Exception:
+                return ValidationResult.IGNORE
+            return ValidationResult.ACCEPT
+
+        return handler
+
+    def subscribe_blob_sidecars(self, fork: str, n_subnets: int = 6) -> None:
+        """Deneb blob sidecar topics: validate inclusion proof + KZG
+        before forwarding (validation/blobSidecar.ts gossip path)."""
+        from ..chain.blobs import verify_blob_sidecar_inclusion_proof
+        from ..crypto import kzg
+
+        def mk(subnet: int):
+            async def handler(peer_id: str, ssz_bytes: bytes):
+                try:
+                    sc = self.types.by_fork[
+                        fork
+                    ].BlobSidecar.deserialize(ssz_bytes)
+                except Exception:
+                    return ValidationResult.REJECT
+                if int(sc.index) % n_subnets != subnet:
+                    return ValidationResult.REJECT
+                try:
+                    # bad points / out-of-range field elements raise:
+                    # that's a REJECT + penalty, not a silent drop
+                    if not verify_blob_sidecar_inclusion_proof(
+                        self.types, fork, sc
+                    ) or not kzg.verify_blob_kzg_proof(
+                        bytes(sc.blob),
+                        bytes(sc.kzg_commitment),
+                        bytes(sc.kzg_proof),
+                    ):
+                        return ValidationResult.REJECT
+                except Exception:
+                    return ValidationResult.REJECT
+                self.seen_blob_sidecars.append(sc)
+                return ValidationResult.ACCEPT
+
+            return handler
+
+        for subnet in range(n_subnets):
+            self.gossip.subscribe(
+                self._t(f"blob_sidecar_{subnet}"), mk(subnet)
+            )
 
     def subscribe_att_subnet(self, subnet: int) -> None:
         """AttnetsService subscribe window (attnetsService.ts:43)."""
@@ -141,6 +226,39 @@ class Network:
     def unsubscribe_att_subnet(self, subnet: int) -> None:
         self.subscribed_subnets.discard(subnet)
         self.gossip.unsubscribe(self._t(f"beacon_attestation_{subnet}"))
+
+    def compute_long_lived_subnets(
+        self, epoch: int, n: int = 2
+    ) -> list[int]:
+        """Deterministic long-lived subnet assignment, rotated every
+        EPOCHS_PER_SUBNET_SUBSCRIPTION (attnetsService.ts
+        computeSubscribedSubnet analog, keyed on the node id)."""
+        from hashlib import sha256
+
+        epochs_per_subscription = 256
+        period = epoch // epochs_per_subscription
+        out = []
+        for i in range(n):
+            digest = sha256(
+                self.peer_id.encode()
+                + period.to_bytes(8, "little")
+                + i.to_bytes(1, "little")
+            ).digest()
+            out.append(
+                int.from_bytes(digest[:8], "little")
+                % ATTESTATION_SUBNET_COUNT
+            )
+        return out
+
+    def rotate_long_lived_subnets(self, epoch: int) -> None:
+        """Apply the deterministic assignment for this epoch: subscribe
+        the new window, drop subnets no longer assigned."""
+        want = set(self.compute_long_lived_subnets(epoch))
+        for subnet in list(self.subscribed_subnets):
+            if subnet not in want:
+                self.unsubscribe_att_subnet(subnet)
+        for subnet in want - self.subscribed_subnets:
+            self.subscribe_att_subnet(subnet)
 
     # -- inbound handlers -------------------------------------------------
 

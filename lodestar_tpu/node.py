@@ -211,6 +211,7 @@ class BeaconNode:
                 processor=node.processor,
                 peer_id=node.peer_id,
             )
+            node.network.op_pool = node.op_pool
             await node.network.start(
                 tcp_port=node.tcp_port, udp_port=node.udp_port
             )

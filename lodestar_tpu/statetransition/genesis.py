@@ -38,6 +38,132 @@ def bls_withdrawal_credentials(pubkey: bytes) -> bytes:
     return BLS_WITHDRAWAL_PREFIX + sha256(pubkey).digest()[1:]
 
 
+class GenesisBuilder:
+    """Build genesis from real eth1 deposits.
+
+    Reference analog: GenesisBuilder (chain/genesis/genesis.ts:40) +
+    spec initialize_beacon_state_from_eth1 / is_valid_genesis_state:
+    deposits stream in (from the eth1 tracker), each applied through
+    the spec deposit path with an incremental deposit root; genesis
+    triggers once MIN_GENESIS_ACTIVE_VALIDATOR_COUNT active validators
+    exist at MIN_GENESIS_TIME.
+    """
+
+    def __init__(self, cfg, types):
+        from ..eth1.deposit_tree import DepositTree
+
+        self.cfg = cfg
+        self.types = types
+        p = preset()
+        fork = fork_at_epoch(cfg, GENESIS_EPOCH)
+        if fork != "phase0":
+            # post-phase0 genesis needs participation/sync-committee/
+            # payload-header seeding this builder doesn't do (mainnet
+            # genesis was phase0; later-fork genesis uses
+            # create_interop_genesis_state for dev nets)
+            raise NotImplementedError(
+                f"eth1 genesis builder supports phase0 genesis only "
+                f"(config puts genesis at {fork})"
+            )
+        self.fork = fork
+        self.state = types.by_fork[fork].BeaconState.default()
+        self.state.fork = _genesis_fork(cfg, types, fork)
+        header = types.BeaconBlockHeader.default()
+        ns = types.by_fork[fork]
+        header.body_root = ns.BeaconBlockBody.hash_tree_root(
+            ns.BeaconBlockBody.default()
+        )
+        self.state.latest_block_header = header
+        self.tree = DepositTree()
+        self.deposits_applied = 0
+
+    def apply_eth1_block(self, block_hash: bytes, timestamp: int) -> None:
+        """Candidate genesis eth1 block (genesis.ts onBlock)."""
+        p = preset()
+        self.state.eth1_data.block_hash = bytes(block_hash)
+        self.state.genesis_time = (
+            int(timestamp) + self.cfg.GENESIS_DELAY
+        )
+        self.state.randao_mixes = SszVec(
+            [bytes(block_hash)] * p.EPOCHS_PER_HISTORICAL_VECTOR
+        )
+
+    def apply_deposits(self, deposit_datas) -> None:
+        """Spec: each deposit is processed against the tree root of the
+        deposits applied SO FAR (incremental eth1_data during genesis)."""
+        from .block import BlockCtx, process_deposit
+
+        for dd in deposit_datas:
+            self.tree.push(
+                self.types.DepositData.hash_tree_root(dd)
+            )
+            count = len(self.tree)
+            self.state.eth1_data.deposit_root = self.tree.root
+            self.state.eth1_data.deposit_count = count
+            dep = self.types.Deposit.default()
+            dep.data = dd
+            dep.proof = self.tree.branch(count - 1, count)
+            ctx = BlockCtx(
+                self.cfg, self.state, self.types,
+                int(ForkSeq[self.fork]), True,
+            )
+            process_deposit(ctx, dep)
+            self.deposits_applied += 1
+        self._activate_genesis_validators()
+
+    def _activate_genesis_validators(self) -> None:
+        from .util import mut
+
+        p = preset()
+        for i, v in enumerate(self.state.validators):
+            if (
+                v.activation_epoch == FAR_FUTURE_EPOCH
+                and v.effective_balance == p.MAX_EFFECTIVE_BALANCE
+            ):
+                w = mut(self.state.validators, i)
+                w.activation_eligibility_epoch = GENESIS_EPOCH
+                w.activation_epoch = GENESIS_EPOCH
+
+    def is_valid_genesis(self) -> bool:
+        """Spec is_valid_genesis_state."""
+        if self.state.genesis_time < self.cfg.MIN_GENESIS_TIME:
+            return False
+        active = sum(
+            1
+            for v in self.state.validators
+            if v.activation_epoch == GENESIS_EPOCH
+        )
+        return active >= self.cfg.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+
+    def finalize(self):
+        """Seal genesis_validators_root; returns the BeaconStateView."""
+        p = preset()
+        from ..ssz import ListType
+
+        validators_t = ListType(
+            self.types.Validator, p.VALIDATOR_REGISTRY_LIMIT
+        )
+        self.state.genesis_validators_root = validators_t.hash_tree_root(
+            list(self.state.validators)
+        )
+        return BeaconStateView(state=self.state, fork=self.fork)
+
+
+def _genesis_fork(cfg, types, fork: str):
+    f = types.Fork.default()
+    versions = {
+        "phase0": (cfg.GENESIS_FORK_VERSION, cfg.GENESIS_FORK_VERSION),
+        "altair": (cfg.GENESIS_FORK_VERSION, cfg.ALTAIR_FORK_VERSION),
+        "bellatrix": (cfg.ALTAIR_FORK_VERSION, cfg.BELLATRIX_FORK_VERSION),
+        "capella": (cfg.BELLATRIX_FORK_VERSION, cfg.CAPELLA_FORK_VERSION),
+        "deneb": (cfg.CAPELLA_FORK_VERSION, cfg.DENEB_FORK_VERSION),
+        "electra": (cfg.DENEB_FORK_VERSION, cfg.ELECTRA_FORK_VERSION),
+    }
+    f.previous_version, f.current_version = versions[fork]
+    f.epoch = GENESIS_EPOCH
+    return f
+
+
 def create_interop_genesis_state(
     cfg,
     types,
@@ -57,19 +183,7 @@ def create_interop_genesis_state(
     state = ns.BeaconState.default()
 
     state.genesis_time = genesis_time
-    f = types.Fork.default()
-    # genesis states start at the genesis fork's version pair
-    versions = {
-        "phase0": (cfg.GENESIS_FORK_VERSION, cfg.GENESIS_FORK_VERSION),
-        "altair": (cfg.GENESIS_FORK_VERSION, cfg.ALTAIR_FORK_VERSION),
-        "bellatrix": (cfg.ALTAIR_FORK_VERSION, cfg.BELLATRIX_FORK_VERSION),
-        "capella": (cfg.BELLATRIX_FORK_VERSION, cfg.CAPELLA_FORK_VERSION),
-        "deneb": (cfg.CAPELLA_FORK_VERSION, cfg.DENEB_FORK_VERSION),
-        "electra": (cfg.DENEB_FORK_VERSION, cfg.ELECTRA_FORK_VERSION),
-    }
-    f.previous_version, f.current_version = versions[fork]
-    f.epoch = GENESIS_EPOCH
-    state.fork = f
+    state.fork = _genesis_fork(cfg, types, fork)
 
     if pubkeys is None:
         pubkeys = interop_pubkeys(n_validators)

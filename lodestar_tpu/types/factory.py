@@ -627,39 +627,6 @@ def create_ssz_types(p: BeaconPreset) -> SszTypes:  # noqa: PLR0915
     t.electra = electra
 
     # -- light client (altair+, capella header form kept simple for now) ----
-    lc = SimpleNamespace()
-    lc.LightClientHeader = _C("LightClientHeader", [
-        ("beacon", t.BeaconBlockHeader),
-    ])
-    SyncCommitteeBranch = VectorType(Bytes32, 5)
-    FinalityBranch = VectorType(Bytes32, 6)
-    lc.LightClientBootstrap = _C("LightClientBootstrap", [
-        ("header", lc.LightClientHeader),
-        ("current_sync_committee", t.SyncCommittee),
-        ("current_sync_committee_branch", SyncCommitteeBranch),
-    ])
-    lc.LightClientUpdate = _C("LightClientUpdate", [
-        ("attested_header", lc.LightClientHeader),
-        ("next_sync_committee", t.SyncCommittee),
-        ("next_sync_committee_branch", SyncCommitteeBranch),
-        ("finalized_header", lc.LightClientHeader),
-        ("finality_branch", FinalityBranch),
-        ("sync_aggregate", t.SyncAggregate),
-        ("signature_slot", Slot),
-    ])
-    lc.LightClientFinalityUpdate = _C("LightClientFinalityUpdate", [
-        ("attested_header", lc.LightClientHeader),
-        ("finalized_header", lc.LightClientHeader),
-        ("finality_branch", FinalityBranch),
-        ("sync_aggregate", t.SyncAggregate),
-        ("signature_slot", Slot),
-    ])
-    lc.LightClientOptimisticUpdate = _C("LightClientOptimisticUpdate", [
-        ("attested_header", lc.LightClientHeader),
-        ("sync_aggregate", t.SyncAggregate),
-        ("signature_slot", Slot),
-    ])
-    t.lightclient = lc
 
     # fork name -> namespace
     t.by_fork = {

@@ -1,0 +1,112 @@
+"""Eth1-deposit genesis builder.
+
+Reference analog: GenesisBuilder (chain/genesis/genesis.ts:40) tests —
+deposits stream in, genesis triggers at the spec thresholds, and a
+chain boots from the built state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config.beacon_config import compute_domain
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.signature import sign, sk_to_pk
+from lodestar_tpu.params import DOMAIN_DEPOSIT, preset
+from lodestar_tpu.statetransition import interop_secret_key
+from lodestar_tpu.statetransition.block import compute_signing_root
+from lodestar_tpu.statetransition.genesis import GenesisBuilder
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 8
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=N,
+        MIN_GENESIS_TIME=1_000_000,
+        GENESIS_DELAY=100,
+    )
+
+
+def _deposit_data(types, cfg, i: int):
+    sk = interop_secret_key(i)
+    pk = sk_to_pk(sk)
+    from hashlib import sha256
+
+    wc = b"\x00" + sha256(pk).digest()[1:]
+    dd = types.DepositData.default()
+    dd.pubkey = pk
+    dd.withdrawal_credentials = wc
+    dd.amount = preset().MAX_EFFECTIVE_BALANCE
+    msg = types.DepositMessage.default()
+    msg.pubkey = pk
+    msg.withdrawal_credentials = wc
+    msg.amount = dd.amount
+    domain = compute_domain(
+        DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, b"\x00" * 32
+    )
+    dd.signature = sign(
+        sk, compute_signing_root(types.DepositMessage, msg, domain)
+    )
+    return dd
+
+
+class TestGenesisBuilder:
+    def test_builds_valid_genesis_and_chain_boots(self, types):
+        cfg = _cfg()
+        b = GenesisBuilder(cfg, types)
+        b.apply_eth1_block(b"\x07" * 32, timestamp=1_500_000)
+        assert not b.is_valid_genesis()  # no validators yet
+        b.apply_deposits([_deposit_data(types, cfg, i) for i in range(N)])
+        assert b.deposits_applied == N
+        assert b.is_valid_genesis()
+        view = b.finalize()
+        st = view.state
+        assert len(st.validators) == N
+        assert int(st.eth1_data.deposit_count) == N
+        assert all(
+            int(v.activation_epoch) == 0 for v in st.validators
+        )
+        assert bytes(st.genesis_validators_root) != b"\x00" * 32
+
+        # the built state anchors a working chain
+        chain = BeaconChain(cfg, types, view)
+        assert chain.head_root == chain.genesis_root
+
+        async def close():
+            await chain.close()
+
+        asyncio.run(close())
+
+    def test_too_few_validators_not_valid(self, types):
+        cfg = _cfg()
+        b = GenesisBuilder(cfg, types)
+        b.apply_eth1_block(b"\x07" * 32, timestamp=1_500_000)
+        b.apply_deposits(
+            [_deposit_data(types, cfg, i) for i in range(N - 2)]
+        )
+        assert not b.is_valid_genesis()
+
+    def test_bad_signature_deposit_skipped(self, types):
+        cfg = _cfg()
+        b = GenesisBuilder(cfg, types)
+        b.apply_eth1_block(b"\x07" * 32, timestamp=1_500_000)
+        dd = _deposit_data(types, cfg, 0)
+        dd.signature = b"\xc0" + b"\x00" * 95  # invalid
+        b.apply_deposits([dd])
+        assert len(b.state.validators) == 0  # spec: skip, don't fail

@@ -1,0 +1,131 @@
+"""Operation gossip topics, subnet rotation, and blob sidecar gossip.
+
+Reference analog: gossip topic table (network/gossip/interface.ts) and
+per-type handlers (processor/gossipHandlers.ts); AttnetsService
+rotation; blobSidecar gossip validation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.oppools import OpPool
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.flare import self_slash_proposer
+from lodestar_tpu.network.facade import Network
+from lodestar_tpu.statetransition import (
+    create_interop_genesis_state,
+    interop_secret_key,
+)
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class StubVerifier:
+    def can_accept_work(self):
+        return True
+
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message, **kw):
+        return [True] * len(sets)
+
+    async def close(self):
+        pass
+
+
+class TestOperationGossip:
+    def test_slashing_propagates_into_peer_pool(self, types):
+        """A gossiped proposer slashing lands in the remote op pool."""
+        cfg = _cfg()
+
+        async def go():
+            a = DevNode(
+                cfg, types, N, verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            genesis = create_interop_genesis_state(cfg, types, N)
+            from lodestar_tpu.chain.chain import BeaconChain
+
+            b_chain = BeaconChain(
+                cfg, types, genesis, verifier=StubVerifier()
+            )
+            bc = BeaconConfig(
+                cfg, bytes(genesis.state.genesis_validators_root)
+            )
+            n1 = Network(a.chain, bc, types, peer_id="a")
+            n2 = Network(b_chain, bc, types, peer_id="b")
+            n2.op_pool = OpPool(types)
+            n2._subscribe_core_topics()  # re-run with the pool attached
+            await n1.start(run_maintenance=False)
+            await n2.start(run_maintenance=False)
+            await n1.connect("127.0.0.1", n2.host.port)
+            await asyncio.sleep(0.05)
+
+            head = a.chain.get_state(a.chain.head_root)
+            slashing = self_slash_proposer(
+                cfg, types, head.state, 3, interop_secret_key(3)
+            )
+            await n1.gossip.publish(
+                n1._t("proposer_slashing"),
+                types.ProposerSlashing.serialize(slashing),
+            )
+            await asyncio.sleep(0.2)
+            slashings, _, _, _ = n2.op_pool.get_for_block(head.state)
+            assert len(slashings) == 1
+            await n1.stop()
+            await n2.stop()
+            await a.close()
+
+        asyncio.run(go())
+
+
+class TestSubnetRotation:
+    def test_deterministic_rotation(self, types):
+        cfg = _cfg()
+        genesis = create_interop_genesis_state(cfg, types, N)
+        from lodestar_tpu.chain.chain import BeaconChain
+
+        chain = BeaconChain(cfg, types, genesis, verifier=StubVerifier())
+        bc = BeaconConfig(
+            cfg, bytes(genesis.state.genesis_validators_root)
+        )
+        net = Network(chain, bc, types, peer_id="x")
+        a = net.compute_long_lived_subnets(epoch=10)
+        assert a == net.compute_long_lived_subnets(epoch=10)
+        assert a == net.compute_long_lived_subnets(epoch=200)  # same period
+        b = net.compute_long_lived_subnets(epoch=300)  # next period
+        # different period -> (almost surely) different assignment, and
+        # rotation updates the live subscription set
+        net.rotate_long_lived_subnets(10)
+        assert net.subscribed_subnets == set(a)
+        net.rotate_long_lived_subnets(300)
+        assert net.subscribed_subnets == set(b)
+
+        async def close():
+            await chain.close()
+
+        asyncio.run(close())
