@@ -1,0 +1,59 @@
+"""Gossip validation for operation messages (exits, slashings,
+bls-to-execution changes).
+
+Reference analog: chain/validation/{voluntaryExit,proposerSlashing,
+attesterSlashing,blsToExecutionChange}.ts — each op is fully validated
+(structure, slashability, signatures) BEFORE entering the op pool or
+being forwarded. Validation runs the spec processor against a clone of
+the head state: exact spec semantics (including signature checks) at
+the cost of one state clone per op — fine for these rare message types
+(the hot attestation path has its own batched validator).
+"""
+
+from __future__ import annotations
+
+from ...statetransition.block import BlockCtx, BlockProcessError
+
+
+class OpValidationError(ValueError):
+    pass
+
+
+def _check(chain, fn, op) -> None:
+    from ..chain import _clone
+
+    head = chain.get_or_regen_state(chain.head_root)
+    work = _clone(head, chain.types)
+    ctx = BlockCtx(
+        chain.cfg, work.state, chain.types, work.fork_seq, True
+    )
+    try:
+        fn(ctx, op)
+    except BlockProcessError as e:
+        raise OpValidationError(str(e)) from e
+    except (IndexError, KeyError, ValueError) as e:
+        raise OpValidationError(f"malformed operation: {e!r}") from e
+
+
+def validate_proposer_slashing(chain, slashing) -> None:
+    from ...statetransition.block import process_proposer_slashing
+
+    _check(chain, process_proposer_slashing, slashing)
+
+
+def validate_attester_slashing(chain, slashing) -> None:
+    from ...statetransition.block import process_attester_slashing
+
+    _check(chain, process_attester_slashing, slashing)
+
+
+def validate_voluntary_exit(chain, signed_exit) -> None:
+    from ...statetransition.block import process_voluntary_exit
+
+    _check(chain, process_voluntary_exit, signed_exit)
+
+
+def validate_bls_change(chain, signed_change) -> None:
+    from ...statetransition.block import process_bls_to_execution_change
+
+    _check(chain, process_bls_to_execution_change, signed_change)

@@ -79,7 +79,8 @@ class Network:
         )
         self.reqresp_transport = TcpReqRespTransport(self.host)
         self.reqresp = rr.ReqResp(self.peer_id, self.reqresp_transport)
-        self.subscribed_subnets: set[int] = set()
+        self.subscribed_subnets: set[int] = set()  # duty windows
+        self.long_lived_subnets: set[int] = set()  # rotation schedule
         from collections import deque
 
         self.op_pool = None  # wired by the node assembly
@@ -137,24 +138,49 @@ class Network:
         )
         # operation topics feed the op pool (gossip/interface.ts topic
         # table; handlers at network/processor/gossipHandlers.ts)
+        from ..chain.validation.operations import (
+            validate_attester_slashing,
+            validate_bls_change,
+            validate_proposer_slashing,
+            validate_voluntary_exit,
+        )
+
         self.gossip.subscribe(
             self._t("voluntary_exit"),
-            self._op_handler("SignedVoluntaryExit", "add_voluntary_exit"),
+            self._op_handler(
+                "SignedVoluntaryExit",
+                "add_voluntary_exit",
+                validate_voluntary_exit,
+            ),
         )
         self.gossip.subscribe(
             self._t("proposer_slashing"),
-            self._op_handler("ProposerSlashing", "add_proposer_slashing"),
+            self._op_handler(
+                "ProposerSlashing",
+                "add_proposer_slashing",
+                validate_proposer_slashing,
+            ),
         )
         self.gossip.subscribe(
             self._t("attester_slashing"),
-            self._op_handler("AttesterSlashing", "add_attester_slashing"),
+            self._op_handler(
+                "AttesterSlashing",
+                "add_attester_slashing",
+                validate_attester_slashing,
+            ),
         )
         self.gossip.subscribe(
             self._t("bls_to_execution_change"),
-            self._op_handler("SignedBLSToExecutionChange", "add_bls_change"),
+            self._op_handler(
+                "SignedBLSToExecutionChange",
+                "add_bls_change",
+                validate_bls_change,
+            ),
         )
 
-    def _op_handler(self, type_name: str, pool_method: str):
+    def _op_handler(self, type_name: str, pool_method: str, validate):
+        from ..chain.validation.operations import OpValidationError
+
         async def handler(peer_id: str, ssz_bytes: bytes):
             t = getattr(self.types, type_name, None)
             if t is None:
@@ -163,6 +189,14 @@ class Network:
                 value = t.deserialize(ssz_bytes)
             except Exception:
                 return ValidationResult.REJECT
+            # full spec validation (incl. signatures) before the pool
+            # or any forwarding (chain/validation/*.ts contract)
+            try:
+                validate(self.chain, value)
+            except OpValidationError:
+                return ValidationResult.REJECT
+            except Exception:
+                return ValidationResult.IGNORE
             pool = getattr(self.op_pool, pool_method, None) if (
                 self.op_pool is not None
             ) else None
@@ -225,7 +259,10 @@ class Network:
 
     def unsubscribe_att_subnet(self, subnet: int) -> None:
         self.subscribed_subnets.discard(subnet)
-        self.gossip.unsubscribe(self._t(f"beacon_attestation_{subnet}"))
+        if subnet not in self.long_lived_subnets:
+            self.gossip.unsubscribe(
+                self._t(f"beacon_attestation_{subnet}")
+            )
 
     def compute_long_lived_subnets(
         self, epoch: int, n: int = 2
@@ -251,14 +288,24 @@ class Network:
         return out
 
     def rotate_long_lived_subnets(self, epoch: int) -> None:
-        """Apply the deterministic assignment for this epoch: subscribe
-        the new window, drop subnets no longer assigned."""
+        """Apply the deterministic assignment for this epoch. Tracks
+        long-lived subnets separately from short-lived duty windows
+        (subscribe_att_subnet): rotation must never tear down a subnet
+        a duty window still needs."""
         want = set(self.compute_long_lived_subnets(epoch))
-        for subnet in list(self.subscribed_subnets):
+        for subnet in list(self.long_lived_subnets):
             if subnet not in want:
-                self.unsubscribe_att_subnet(subnet)
-        for subnet in want - self.subscribed_subnets:
-            self.subscribe_att_subnet(subnet)
+                self.long_lived_subnets.discard(subnet)
+                if subnet not in self.subscribed_subnets:
+                    self.gossip.unsubscribe(
+                        self._t(f"beacon_attestation_{subnet}")
+                    )
+        for subnet in want - self.long_lived_subnets:
+            self.long_lived_subnets.add(subnet)
+            self.gossip.subscribe(
+                self._t(f"beacon_attestation_{subnet}"),
+                self._make_attestation_handler(subnet),
+            )
 
     # -- inbound handlers -------------------------------------------------
 

@@ -116,6 +116,19 @@ class GenesisBuilder:
 
         p = preset()
         for i, v in enumerate(self.state.validators):
+            # spec initialize_beacon_state_from_eth1 recomputes the
+            # effective balance from the FINAL balance (split deposits
+            # top up plain balance only) before the activation check
+            balance = int(self.state.balances[i])
+            effective = min(
+                balance - balance % p.EFFECTIVE_BALANCE_INCREMENT,
+                p.MAX_EFFECTIVE_BALANCE,
+            )
+            if int(v.effective_balance) != effective:
+                mut(self.state.validators, i).effective_balance = (
+                    effective
+                )
+                v = self.state.validators[i]
             if (
                 v.activation_epoch == FAR_FUTURE_EPOCH
                 and v.effective_balance == p.MAX_EFFECTIVE_BALANCE

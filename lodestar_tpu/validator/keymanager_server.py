@@ -1,0 +1,134 @@
+"""Keymanager REST API server (validator client side).
+
+Reference analog: the keymanager server the validator command hosts
+(cli/src/cmds/validator keymanager flags; routes from
+api/src/keymanager): bearer-token-authenticated
+GET/POST/DELETE /eth/v1/keystores backed by the Keymanager logic.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .keymanager import Keymanager
+
+
+class KeymanagerServer:
+    def __init__(
+        self,
+        keymanager: Keymanager,
+        pubkey_to_index,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: str | None = None,
+    ):
+        self.km = keymanager
+        self.pubkey_to_index = pubkey_to_index
+        self.host = host
+        self.port = port
+        # the reference writes an api-token file the operator passes to
+        # clients; same contract here
+        self.token = token or secrets.token_hex(32)
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _authed(self) -> bool:
+                import hmac
+
+                auth = self.headers.get("Authorization", "")
+                return hmac.compare_digest(
+                    auth.encode(), f"Bearer {server.token}".encode()
+                )
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else {}
+
+            def do_GET(self):
+                if not self._authed():
+                    self._json(401, {"message": "missing bearer token"})
+                    return
+                if self.path == "/eth/v1/keystores":
+                    self._json(200, {"data": server.km.list_keys()})
+                    return
+                self._json(404, {"message": "not found"})
+
+            def do_POST(self):
+                if not self._authed():
+                    self._json(401, {"message": "missing bearer token"})
+                    return
+                if self.path == "/eth/v1/keystores":
+                    try:
+                        body = self._body()
+                        keystores = [
+                            json.loads(k) if isinstance(k, str) else k
+                            for k in body["keystores"]
+                        ]
+                        res = server.km.import_keystores(
+                            keystores,
+                            body["passwords"],
+                            server.pubkey_to_index,
+                        )
+                    except (KeyError, ValueError, TypeError) as e:
+                        self._json(400, {"message": repr(e)})
+                        return
+                    self._json(200, {"data": res})
+                    return
+                self._json(404, {"message": "not found"})
+
+            def do_DELETE(self):
+                if not self._authed():
+                    self._json(401, {"message": "missing bearer token"})
+                    return
+                if self.path == "/eth/v1/keystores":
+                    try:
+                        body = self._body()
+                        pubkeys = [
+                            bytes.fromhex(
+                                str(p).removeprefix("0x")
+                            )
+                            for p in body["pubkeys"]
+                        ]
+                    except (KeyError, ValueError, TypeError) as e:
+                        self._json(400, {"message": repr(e)})
+                        return
+                    self._json(
+                        200, {"data": server.km.delete_keys(pubkeys)}
+                    )
+                    return
+                self._json(404, {"message": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

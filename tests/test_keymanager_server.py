@@ -1,0 +1,100 @@
+"""Keymanager REST server: auth, list/import/delete over HTTP.
+
+Reference analog: keymanager API e2e (validator keymanager server).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.signature import sk_to_pk
+from lodestar_tpu.statetransition import (
+    create_interop_genesis_state,
+    interop_secret_key,
+)
+from lodestar_tpu.types import ssz_types
+from lodestar_tpu.validator.keymanager import Keymanager, create_keystore
+from lodestar_tpu.validator.keymanager_server import KeymanagerServer
+from lodestar_tpu.validator.store import ValidatorStore
+
+FAR = 2**64 - 1
+
+
+def _req(base, path, method="GET", token=None, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={
+            "Content-Type": "application/json",
+            **(
+                {"Authorization": f"Bearer {token}"} if token else {}
+            ),
+        },
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestKeymanagerServer:
+    def test_lifecycle_over_http(self):
+        types = ssz_types()
+        cfg = ChainConfig(
+            ALTAIR_FORK_EPOCH=FAR,
+            BELLATRIX_FORK_EPOCH=FAR,
+            CAPELLA_FORK_EPOCH=FAR,
+            DENEB_FORK_EPOCH=FAR,
+            ELECTRA_FORK_EPOCH=FAR,
+        )
+        genesis = create_interop_genesis_state(cfg, types, 8)
+        bc = BeaconConfig(
+            cfg, bytes(genesis.state.genesis_validators_root)
+        )
+        store = ValidatorStore(bc, types, {0: interop_secret_key(0)})
+        km = Keymanager(store, store.slashing_protection)
+        pk2idx = {
+            sk_to_pk(interop_secret_key(i)): i for i in range(8)
+        }
+        srv = KeymanagerServer(km, pk2idx.get)
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # no token -> 401
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _req(base, "/eth/v1/keystores")
+            assert e.value.code == 401
+
+            keys = _req(base, "/eth/v1/keystores", token=srv.token)
+            assert len(keys["data"]) == 1
+
+            ks = create_keystore(interop_secret_key(3), "pw")
+            res = _req(
+                base,
+                "/eth/v1/keystores",
+                method="POST",
+                token=srv.token,
+                body={
+                    "keystores": [json.dumps(ks)],
+                    "passwords": ["pw"],
+                },
+            )
+            assert res["data"] == [{"status": "imported"}]
+            assert 3 in store.sks
+
+            res = _req(
+                base,
+                "/eth/v1/keystores",
+                method="DELETE",
+                token=srv.token,
+                body={"pubkeys": ["0x" + ks["pubkey"]]},
+            )
+            assert res["data"][0]["status"] == "deleted"
+            assert 3 not in store.sks
+        finally:
+            srv.stop()
