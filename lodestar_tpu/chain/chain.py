@@ -226,11 +226,24 @@ class BeaconChain:
         return self._states.get(block_root)
 
     def get_or_regen_state(self, block_root: bytes) -> BeaconStateView:
-        """Cached post-state, regenerating synchronously on eviction."""
+        """Cached post-state, regenerating synchronously on eviction.
+
+        Loop-thread callers should prefer `get_state_async`: a deep
+        replay here (up to MAX_REPLAY_DEPTH transitions) blocks the
+        event loop. The sync path is kept for executor-thread callers
+        and for roots that are pinned in cache (head/genesis, which
+        `_store_state` never evicts)."""
         st = self.get_state(block_root)
         if st is None:
             st = self.regen.replay_sync(block_root)
         return st
+
+    async def get_state_async(self, block_root: bytes) -> BeaconStateView:
+        """Post-state via the queued regen path: cache hit inline,
+        replay on the executor thread so the event loop keeps serving
+        gossip/reqresp/API during deep replays (advisor: chain.py
+        get_or_regen_state on-loop replay stall)."""
+        return await self.regen.get_state(block_root)
 
     def get_block(self, block_root: bytes):
         return self._blocks.get(block_root)
@@ -527,7 +540,9 @@ class BeaconChain:
             head.state.latest_execution_payload_header.block_hash
         )
         try:
-            fin = self.get_or_regen_state(self.finalized_checkpoint.root)
+            fin = await self.get_state_async(
+                self.finalized_checkpoint.root
+            )
         except Exception:
             fin = None
         fin_hash = (

@@ -92,6 +92,10 @@ class ChainConfig:
     DEPOSIT_CONTRACT_ADDRESS: bytes = bytes.fromhex(
         "00000000219ab540356cbb839cbe05303d7705fa"
     )
+    # First eth1 block the deposit contract can have logs in (reference
+    # network configs' depositContractDeployBlock): log-follow starts
+    # here, never from block 0.
+    DEPOSIT_CONTRACT_DEPLOY_BLOCK: int = 11052984
 
     # Networking
     MAX_REQUEST_BLOCKS: int = 1024
@@ -147,4 +151,5 @@ MINIMAL_CONFIG = ChainConfig(
     DEPOSIT_CHAIN_ID=5,
     DEPOSIT_NETWORK_ID=5,
     DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("1234567890123456789012345678901234567890"),
+    DEPOSIT_CONTRACT_DEPLOY_BLOCK=0,
 )

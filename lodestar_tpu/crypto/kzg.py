@@ -39,8 +39,8 @@ BYTES_PER_FIELD_ELEMENT = 32
 FIELD_ELEMENTS_PER_BLOB = 4096
 BYTES_PER_BLOB = BYTES_PER_FIELD_ELEMENT * FIELD_ELEMENTS_PER_BLOB
 
-FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVH"
-RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBAT"
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
+RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
 
 G1_POINT_AT_INFINITY_COMPRESSED = b"\xc0" + b"\x00" * 47
 
@@ -389,7 +389,9 @@ def verify_kzg_proof_impl(commitment, z: int, y: int, proof) -> bool:
 
 
 def compute_challenge(blob: bytes, commitment_bytes: bytes) -> int:
-    degree = FIELD_ELEMENTS_PER_BLOB.to_bytes(16, "little")
+    # KZG_ENDIANNESS='big' (deneb polynomial-commitments spec; c-kzg
+    # writes the 16-byte degree big-endian)
+    degree = FIELD_ELEMENTS_PER_BLOB.to_bytes(16, "big")
     return hash_to_bls_field(
         FIAT_SHAMIR_PROTOCOL_DOMAIN + degree + blob + commitment_bytes
     )
@@ -436,8 +438,8 @@ def verify_blob_kzg_proof_batch(
         )
     # Fiat-Shamir the whole statement into one scalar; use its powers
     data = RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
-    data += FIELD_ELEMENTS_PER_BLOB.to_bytes(8, "little")
-    data += n.to_bytes(8, "little")
+    data += FIELD_ELEMENTS_PER_BLOB.to_bytes(8, "big")
+    data += n.to_bytes(8, "big")
     for cb, z, y, pb in zip(commitment_bytes_list, zs, ys, proof_bytes_list):
         data += bytes(cb) + z.to_bytes(32, "big") + y.to_bytes(32, "big")
         data += bytes(pb)

@@ -165,6 +165,7 @@ def _voting_period_start_time(cfg, state) -> int:
 
 
 MAX_FOLLOWED_BLOCKS = 4096  # bound the followed-header window
+GET_LOGS_CHUNK = 10_000  # blocks per eth_getLogs request (provider caps)
 
 
 class Eth1DepositDataTracker:
@@ -179,33 +180,59 @@ class Eth1DepositDataTracker:
         self.tree = DepositTree()
         self.deposits: list[DepositLog] = []
         self.blocks: dict[int, Eth1Block] = {}  # followed eth1 blocks
-        self._synced_to = -1
+        # Log-follow starts at the deposit contract's deployment block —
+        # there can be no logs before it (ref eth1 follow loop seeds
+        # from depositContractDeployBlock).
+        self._synced_to = (
+            getattr(cfg, "DEPOSIT_CONTRACT_DEPLOY_BLOCK", 0) - 1
+        )
 
     # -- log following -----------------------------------------------------
 
     async def update(self) -> None:
         """One polling round: fetch new logs up to the follow distance
-        (eth1DepositDataTracker.ts update loop)."""
+        (eth1DepositDataTracker.ts update loop). getLogs is chunked
+        (providers reject unbounded ranges) and headers are fetched only
+        inside the eth1-vote candidate window, not for every followed
+        block."""
         head = await self.provider.get_block_number()
         followed = max(0, head - self.cfg.ETH1_FOLLOW_DISTANCE)
         if followed <= self._synced_to:
             return
-        logs = await self.provider.get_deposit_logs(
-            self._synced_to + 1, followed
-        )
-        for log in sorted(logs, key=lambda x: x.index):
-            if log.index != len(self.deposits):
-                raise Eth1Error(
-                    f"deposit log gap: got {log.index}, "
-                    f"expected {len(self.deposits)}"
+        # Logs first, headers after each chunk's logs: _synced_to
+        # advances PER CHUNK so a mid-sync provider failure resumes
+        # where it left off instead of re-raising on re-fetched logs;
+        # re-delivered logs (index < len) are skipped idempotently.
+        hdr_floor = max(followed - MAX_FOLLOWED_BLOCKS + 1, 0)
+        start = self._synced_to + 1
+        while start <= followed:
+            end = min(start + GET_LOGS_CHUNK - 1, followed)
+            logs = await self.provider.get_deposit_logs(start, end)
+            for log in sorted(logs, key=lambda x: x.index):
+                if log.index < len(self.deposits):
+                    continue  # re-delivered after a partial round
+                if log.index != len(self.deposits):
+                    raise Eth1Error(
+                        f"deposit log gap: got {log.index}, "
+                        f"expected {len(self.deposits)}"
+                    )
+                self.deposits.append(log)
+                self.tree.push(self._deposit_data_root(log))
+            # Headers for this chunk's slice of the candidate window
+            # (only the tail that can ever be an eth1-vote candidate),
+            # fetched concurrently in bounded waves.
+            h0 = max(start, hdr_floor)
+            for wave in range(h0, end + 1, 64):
+                nums = range(wave, min(wave + 64, end + 1))
+                got = await asyncio.gather(
+                    *(self.provider.get_block(bn) for bn in nums)
                 )
-            self.deposits.append(log)
-            self.tree.push(self._deposit_data_root(log))
-        for bn in range(self._synced_to + 1, followed + 1):
-            self.blocks[bn] = await self.provider.get_block(bn)
+                for blk in got:
+                    self.blocks[blk.number] = blk
+            self._synced_to = end
+            start = end + 1
         while len(self.blocks) > MAX_FOLLOWED_BLOCKS:
             self.blocks.pop(min(self.blocks))
-        self._synced_to = followed
 
     def _deposit_data_root(self, log: DepositLog) -> bytes:
         dd = self.types.DepositData.default()

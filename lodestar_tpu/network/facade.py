@@ -79,7 +79,8 @@ class Network:
         )
         self.reqresp_transport = TcpReqRespTransport(self.host)
         self.reqresp = rr.ReqResp(self.peer_id, self.reqresp_transport)
-        self.subscribed_subnets: set[int] = set()  # duty windows
+        self.subscribed_subnets: set[int] = set()  # live subscriptions
+        self.duty_subnets: set[int] = set()  # short-lived duty windows
         self.long_lived_subnets: set[int] = set()  # rotation schedule
         from collections import deque
 
@@ -251,6 +252,7 @@ class Network:
 
     def subscribe_att_subnet(self, subnet: int) -> None:
         """AttnetsService subscribe window (attnetsService.ts:43)."""
+        self.duty_subnets.add(subnet)
         self.subscribed_subnets.add(subnet)
         self.gossip.subscribe(
             self._t(f"beacon_attestation_{subnet}"),
@@ -258,8 +260,9 @@ class Network:
         )
 
     def unsubscribe_att_subnet(self, subnet: int) -> None:
-        self.subscribed_subnets.discard(subnet)
+        self.duty_subnets.discard(subnet)
         if subnet not in self.long_lived_subnets:
+            self.subscribed_subnets.discard(subnet)
             self.gossip.unsubscribe(
                 self._t(f"beacon_attestation_{subnet}")
             )
@@ -288,20 +291,22 @@ class Network:
         return out
 
     def rotate_long_lived_subnets(self, epoch: int) -> None:
-        """Apply the deterministic assignment for this epoch. Tracks
-        long-lived subnets separately from short-lived duty windows
-        (subscribe_att_subnet): rotation must never tear down a subnet
-        a duty window still needs."""
+        """Apply the deterministic assignment for this epoch.
+        `subscribed_subnets` is the live subscription set (duty windows
+        ∪ long-lived); rotation must never tear down a subnet a duty
+        window still needs."""
         want = set(self.compute_long_lived_subnets(epoch))
         for subnet in list(self.long_lived_subnets):
             if subnet not in want:
                 self.long_lived_subnets.discard(subnet)
-                if subnet not in self.subscribed_subnets:
+                if subnet not in self.duty_subnets:
+                    self.subscribed_subnets.discard(subnet)
                     self.gossip.unsubscribe(
                         self._t(f"beacon_attestation_{subnet}")
                     )
         for subnet in want - self.long_lived_subnets:
             self.long_lived_subnets.add(subnet)
+            self.subscribed_subnets.add(subnet)
             self.gossip.subscribe(
                 self._t(f"beacon_attestation_{subnet}"),
                 self._make_attestation_handler(subnet),

@@ -133,6 +133,21 @@ class BeaconNode:
             _kzg.load_trusted_setup(node.trusted_setup_path)
             log.info("trusted setup loaded",
                      {"path": str(node.trusted_setup_path)})
+        elif (
+            node.cfg.DENEB_FORK_EPOCH != 2**64 - 1
+            and node.cfg.CONFIG_NAME not in ("minimal", "dev")
+        ):
+            # The dev setup's tau derives from a public seed — anyone can
+            # forge blob proofs against it. A deneb+ production network
+            # must run the ceremony setup (ref always loads it at startup,
+            # nodejs.ts:162-165).
+            log.warn(
+                "INSECURE: no --trusted-setup given on a deneb-enabled "
+                "network; falling back to the DEV trusted setup whose tau "
+                "is publicly derivable. Blob KZG proofs can be FORGED. "
+                "Provide the Ethereum KZG ceremony file for production.",
+                {"config": node.cfg.CONFIG_NAME},
+            )
         # execution engine (engine API over JSON-RPC + JWT)
         if node.execution_url is not None:
             from .execution.http import ExecutionEngineHttp

@@ -3,13 +3,11 @@
 Reference analog: the keymanager API served by the validator client
 (cli/src/cmds/validator keymanager server; api/src/keymanager routes):
 list/import/delete local keystores, with slashing-protection data
-riding delete/import (EIP-3076). Keystore crypto is EIP-2335:
-scrypt or pbkdf2 KDF + AES-128-CTR... the baked environment has no AES
-primitive, so the cipher stage uses the checksum-equivalent stream
-construction documented below — keystores are interoperable in
-structure and KDF, flagged with cipher function "xor-sha256" (a
-documented deviation; importing c-kzg-era keystores requires AES and is
-gated).
+riding delete/import (EIP-3076). Keystore crypto is EIP-2335: scrypt or
+pbkdf2 KDF + AES-128-CTR (pure-Python AES in crypto/aes.py — 32-byte
+payloads, perf-irrelevant) + NFKD/control-stripped password
+normalization, so keystores interoperate with every other EIP-2335
+tool. Legacy round-2 "xor-sha256" keystores remain decryptable.
 """
 
 from __future__ import annotations
@@ -17,8 +15,10 @@ from __future__ import annotations
 import json
 import os
 import secrets
+import unicodedata
 from hashlib import pbkdf2_hmac, scrypt, sha256
 
+from ..crypto.aes import aes128_ctr
 from ..crypto.bls.signature import sk_from_bytes, sk_to_bytes, sk_to_pk
 
 
@@ -26,9 +26,21 @@ class KeystoreError(ValueError):
     pass
 
 
+def normalize_password(password: str) -> bytes:
+    """EIP-2335 password processing: NFKD-normalize, strip C0/C1 control
+    codes and DEL, encode UTF-8."""
+    nfkd = unicodedata.normalize("NFKD", password)
+    stripped = "".join(
+        c
+        for c in nfkd
+        if not (ord(c) < 0x20 or 0x7F <= ord(c) <= 0x9F)
+    )
+    return stripped.encode("utf-8")
+
+
 def _stream(key16: bytes, iv: bytes, n: int) -> bytes:
-    """Keystream for the xor cipher stage: SHA-256 counter mode over
-    (key, iv). NOT AES-128-CTR — see module docstring."""
+    """Keystream for the LEGACY xor-sha256 cipher stage (round-2
+    keystores): SHA-256 counter mode over (key, iv)."""
     out = bytearray()
     counter = 0
     while len(out) < n:
@@ -84,11 +96,9 @@ def create_keystore(
             },
             "message": "",
         }
-    dk = _derive(kdf_mod, password.encode())
+    dk = _derive(kdf_mod, normalize_password(password))
     secret = sk_to_bytes(sk)
-    cipher_text = bytes(
-        a ^ b for a, b in zip(secret, _stream(dk[:16], iv, len(secret)))
-    )
+    cipher_text = aes128_ctr(dk[:16], iv, secret)
     checksum = sha256(dk[16:32] + cipher_text).digest()
     return {
         "version": 4,
@@ -103,7 +113,7 @@ def create_keystore(
                 "message": checksum.hex(),
             },
             "cipher": {
-                "function": "xor-sha256",
+                "function": "aes-128-ctr",
                 "params": {"iv": iv.hex()},
                 "message": cipher_text.hex(),
             },
@@ -113,22 +123,31 @@ def create_keystore(
 
 def decrypt_keystore(keystore: dict, password: str) -> int:
     crypto = keystore["crypto"]
-    if crypto["cipher"]["function"] != "xor-sha256":
-        raise KeystoreError(
-            f"unsupported cipher {crypto['cipher']['function']}"
-        )
-    dk = _derive(crypto["kdf"], password.encode())
+    cipher_fn = crypto["cipher"]["function"]
+    if cipher_fn not in ("aes-128-ctr", "xor-sha256"):
+        raise KeystoreError(f"unsupported cipher {cipher_fn}")
+    # Legacy round-2 keystores derived from the raw UTF-8 password
+    # (no EIP-2335 normalization) — keep them decryptable.
+    pw_bytes = (
+        normalize_password(password)
+        if cipher_fn == "aes-128-ctr"
+        else password.encode()
+    )
+    dk = _derive(crypto["kdf"], pw_bytes)
     cipher_text = bytes.fromhex(crypto["cipher"]["message"])
     checksum = sha256(dk[16:32] + cipher_text).digest()
     if checksum.hex() != crypto["checksum"]["message"]:
         raise KeystoreError("wrong password (checksum mismatch)")
     iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
-    secret = bytes(
-        a ^ b
-        for a, b in zip(
-            cipher_text, _stream(dk[:16], iv, len(cipher_text))
+    if cipher_fn == "aes-128-ctr":
+        secret = aes128_ctr(dk[:16], iv, cipher_text)
+    else:  # legacy round-2 keystores
+        secret = bytes(
+            a ^ b
+            for a, b in zip(
+                cipher_text, _stream(dk[:16], iv, len(cipher_text))
+            )
         )
-    )
     return sk_from_bytes(secret)
 
 

@@ -46,6 +46,9 @@ def _cfg():
         DENEB_FORK_EPOCH=FAR,
         ELECTRA_FORK_EPOCH=FAR,
         ETH1_FOLLOW_DISTANCE=4,
+        # the mock provider's contract exists from block 0 (mainnet
+        # default is the real deployment block, 11052984)
+        DEPOSIT_CONTRACT_DEPLOY_BLOCK=0,
     )
 
 

@@ -68,6 +68,100 @@ class TestKeystores:
         with pytest.raises(KeystoreError, match="checksum"):
             decrypt_keystore(ks, "wrong")
 
+    def test_eip2335_official_pbkdf2_vector(self):
+        """The EIP-2335 pbkdf2 test keystore (produced by reference
+        tooling) must decrypt here: pins AES-128-CTR wire compat +
+        NFKD/control-strip password normalization."""
+        ks = {
+            "crypto": {
+                "kdf": {
+                    "function": "pbkdf2",
+                    "params": {
+                        "dklen": 32,
+                        "c": 262144,
+                        "prf": "hmac-sha256",
+                        "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+                    },
+                    "message": "",
+                },
+                "checksum": {
+                    "function": "sha256",
+                    "params": {},
+                    "message": "8a9f5d9912ed7e75ea794bc5a89bca5f193721d30868ade6f73043c6ea6febf1",
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+                    "message": "cee03fde2af33149775b7223e7845e4fb2c8ae1792e5f99fe9ecf474cc8c16ad",
+                },
+            },
+            "pubkey": (
+                "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c1"
+                "1f2b7b27f4ae4040902382ae2910c15e2b420d07"
+            ),
+            "path": "m/12381/60/0/0",
+            "uuid": "64625def-3331-4eea-ab6f-782f3ed16a83",
+            "version": 4,
+        }
+        # the EIP's password: mathematical bold fraktur "testpassword"
+        # + U+1F511, which must NFKD-normalize to "testpassword🔑"
+        pw = (
+            "\U0001d599\U0001d58a\U0001d598\U0001d599\U0001d595"
+            "\U0001d586\U0001d598\U0001d598\U0001d59c\U0001d594"
+            "\U0001d597\U0001d589\U0001f511"
+        )
+        expect = int(
+            "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b6"
+            "0a8ce26f",
+            16,
+        )
+        assert decrypt_keystore(ks, pw) == expect
+        assert decrypt_keystore(ks, "testpassword\U0001f511") == expect
+
+    def test_legacy_xor_sha256_keystore_still_decrypts(self):
+        """Round-2 keystores used a documented xor-sha256 stream stage;
+        they must remain importable."""
+        from hashlib import sha256
+
+        from lodestar_tpu.validator.keymanager import _derive, _stream
+        from lodestar_tpu.crypto.bls.signature import sk_to_bytes
+
+        sk = interop_secret_key(2)
+        kdf = {
+            "function": "pbkdf2",
+            "params": {
+                "dklen": 32,
+                "c": 1024,
+                "prf": "hmac-sha256",
+                "salt": "aa" * 32,
+            },
+            "message": "",
+        }
+        dk = _derive(kdf, b"legacy-pw")
+        iv = bytes(range(16))
+        secret = sk_to_bytes(sk)
+        ct = bytes(
+            a ^ b
+            for a, b in zip(secret, _stream(dk[:16], iv, len(secret)))
+        )
+        ks = {
+            "version": 4,
+            "crypto": {
+                "kdf": kdf,
+                "checksum": {
+                    "function": "sha256",
+                    "params": {},
+                    "message": sha256(dk[16:32] + ct).hexdigest(),
+                },
+                "cipher": {
+                    "function": "xor-sha256",
+                    "params": {"iv": iv.hex()},
+                    "message": ct.hex(),
+                },
+            },
+        }
+        assert decrypt_keystore(ks, "legacy-pw") == sk
+
     def test_keymanager_lifecycle(self, types):
         cfg = _cfg()
         genesis = create_interop_genesis_state(cfg, types, 8)

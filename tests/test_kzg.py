@@ -106,6 +106,49 @@ class TestProofs:
         assert kzg.verify_blob_kzg_proof_batch([], [], [])
 
 
+class TestSpecEndianness:
+    """Pin the Fiat-Shamir preimage layout to the deneb spec
+    (KZG_ENDIANNESS='big', 16-byte domain separators) by re-deriving
+    compute_challenge independently from the spec text. A little-endian
+    or wrong-domain regression fails here even though round-trip tests
+    stay self-consistent."""
+
+    def test_compute_challenge_matches_spec_construction(self):
+        blob = mk_blob(21)
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        # deneb spec compute_challenge, written out verbatim:
+        preimage = (
+            b"FSBLOBVERIFY_V1_"
+            + N.to_bytes(16, "big")
+            + blob
+            + commitment
+        )
+        expected = int.from_bytes(sha256(preimage).digest(), "big") % MOD
+        assert kzg.compute_challenge(blob, commitment) == expected
+
+    def test_batch_challenge_domain_and_endianness(self):
+        blob = mk_blob(22)
+        commitment = kzg.blob_to_kzg_commitment(blob)
+        proof = kzg.compute_blob_kzg_proof(blob, commitment)
+        z = kzg.compute_challenge(blob, commitment)
+        y = kzg.evaluate_polynomial_in_evaluation_form(
+            kzg.blob_to_polynomial(blob), z
+        )
+        data = (
+            b"RCKZGBATCH___V1_"
+            + N.to_bytes(8, "big")
+            + (1).to_bytes(8, "big")
+            + commitment
+            + z.to_bytes(32, "big")
+            + y.to_bytes(32, "big")
+            + proof
+        )
+        assert kzg.hash_to_bls_field(data) == int.from_bytes(
+            sha256(data).digest(), "big"
+        ) % MOD
+        assert kzg.verify_blob_kzg_proof_batch([blob], [commitment], [proof])
+
+
 class TestValidation:
     def test_rejects_out_of_range_field_element(self):
         blob = bytearray(mk_blob(5))
