@@ -689,16 +689,225 @@ static int g2_on_curve_affine(const fp2_t *x, const fp2_t *y) {
   return fp2_eq(&l, &r);
 }
 
+/* ------------------------------------------------------------------ */
+/* Fast subgroup checks + cofactor clearing via the psi endomorphism   */
+/* (untwist-Frobenius-twist; Bowe, "Faster subgroup checks for        */
+/* BLS12-381"; RFC 9380 App. G.4). On G2, psi acts as [x]; checking   */
+/* psi(Q) == [x]Q costs one 64-bit ladder instead of a 255-bit        */
+/* order multiplication. The psi/phi coefficients are DERIVED AT      */
+/* LOAD TIME from field exponentiations and validated against the     */
+/* generators; if validation fails the slow order-multiplication      */
+/* paths stay in force, so correctness never depends on the derive.   */
+/* ------------------------------------------------------------------ */
+
+static fp2_t PSI_CX, PSI_CY; /* psi: (x,y) -> (CX*conj(x), CY*conj(y)) */
+static fp_t G1_BETA;         /* phi: (x,y) -> (BETA*x, y) */
+static int PSI_READY = 0;
+static int G1_PHI_READY = 0;
+static int G1_PHI_NEG = 0; /* 1: phi(P) == -[x^2]P; 0: phi(P) == [x^2-1]P */
+
+static void fp2_pow_limbs(fp2_t *o, const fp2_t *a, const uint64_t *e,
+                          int nlimbs) {
+  fp2_t acc, base = *a;
+  memset(&acc, 0, sizeof(acc));
+  acc.c0 = FP_ONE_M;
+  int top = nlimbs * 64 - 1;
+  while (top >= 0 && !((e[top / 64] >> (top % 64)) & 1)) top--;
+  for (int i = top; i >= 0; i--) {
+    fp2_sqr(&acc, &acc);
+    if ((e[i / 64] >> (i % 64)) & 1) fp2_mul(&acc, &acc, &base);
+  }
+  *o = acc;
+}
+
+static void g1_neg_pt(g1_t *o, const g1_t *p) {
+  *o = *p;
+  fp_neg(&o->y, &p->y);
+}
+
+static void g2_neg_pt(g2_t *o, const g2_t *p) {
+  *o = *p;
+  fp2_neg(&o->y, &p->y);
+}
+
+static int g1_eq_jac(const g1_t *a, const g1_t *b) {
+  if (a->inf || b->inf) return a->inf && b->inf;
+  fp_t za2, zb2, l, r, za3, zb3;
+  fp_sqr(&za2, &a->z);
+  fp_sqr(&zb2, &b->z);
+  fp_mul(&l, &a->x, &zb2);
+  fp_mul(&r, &b->x, &za2);
+  if (!fp_eq(&l, &r)) return 0;
+  fp_mul(&za3, &za2, &a->z);
+  fp_mul(&zb3, &zb2, &b->z);
+  fp_mul(&l, &a->y, &zb3);
+  fp_mul(&r, &b->y, &za3);
+  return fp_eq(&l, &r);
+}
+
+static int g2_eq_jac(const g2_t *a, const g2_t *b) {
+  if (a->inf || b->inf) return a->inf && b->inf;
+  fp2_t za2, zb2, l, r, za3, zb3;
+  fp2_sqr(&za2, &a->z);
+  fp2_sqr(&zb2, &b->z);
+  fp2_mul(&l, &a->x, &zb2);
+  fp2_mul(&r, &b->x, &za2);
+  if (!fp2_eq(&l, &r)) return 0;
+  fp2_mul(&za3, &za2, &a->z);
+  fp2_mul(&zb3, &zb2, &b->z);
+  fp2_mul(&l, &a->y, &zb3);
+  fp2_mul(&r, &b->y, &za3);
+  return fp2_eq(&l, &r);
+}
+
+/* psi on Jacobian coords: affine x = X/Z^2, so (X,Y,Z) ->
+   (CX*conj(X), CY*conj(Y), conj(Z)) represents (CX*conj(x), CY*conj(y)). */
+static void g2_psi(g2_t *o, const g2_t *p) {
+  fp2_conj(&o->x, &p->x);
+  fp2_conj(&o->y, &p->y);
+  fp2_conj(&o->z, &p->z);
+  fp2_mul(&o->x, &o->x, &PSI_CX);
+  fp2_mul(&o->y, &o->y, &PSI_CY);
+  o->inf = p->inf;
+}
+
+/* [x]P for the (negative) BLS parameter x: |x| ladder then negate. */
+static void g2_mul_x(g2_t *o, const g2_t *p) {
+  g2_mul_limbs(o, p, &BLS_X_ABS, 1);
+  g2_neg_pt(o, o);
+}
+
+static void psi_init(void) {
+  /* exponents (p-1)/2 and (p-1)/3 from FP_P (p-1 is even; p = 1 mod 3) */
+  uint64_t pm1[6], e2[6], e3[6];
+  uint64_t borrow = 1;
+  for (int i = 0; i < 6; i++) {
+    pm1[i] = FP_P.l[i] - borrow;
+    borrow = (borrow && FP_P.l[i] == 0) ? 1 : 0;
+  }
+  for (int i = 0; i < 6; i++)
+    e2[i] = (pm1[i] >> 1) | (i + 1 < 6 ? pm1[i + 1] << 63 : 0);
+  u128 rem = 0;
+  for (int i = 5; i >= 0; i--) {
+    u128 cur = (rem << 64) | pm1[i];
+    e3[i] = (uint64_t)(cur / 3);
+    rem = cur % 3;
+  }
+  /* candidate coefficients from xi = 1+u */
+  fp2_t xi, a3, a2, i3, i2;
+  xi.c0 = FP_ONE_M;
+  xi.c1 = FP_ONE_M;
+  fp2_pow_limbs(&a3, &xi, e3, 6); /* (1+u)^((p-1)/3) */
+  fp2_pow_limbs(&a2, &xi, e2, 6); /* (1+u)^((p-1)/2) */
+  fp2_inv(&i3, &a3);
+  fp2_inv(&i2, &a2);
+  /* select the pair that satisfies psi(G2_GEN) == [x]G2_GEN */
+  g2_t gen, xg, pg;
+  g2_set_affine(&gen, &G2_GEN_X, &G2_GEN_Y);
+  g2_mul_x(&xg, &gen);
+  const fp2_t *cx[4] = {&i3, &i3, &a3, &a3};
+  const fp2_t *cy[4] = {&i2, &a2, &i2, &a2};
+  for (int k = 0; k < 4; k++) {
+    PSI_CX = *cx[k];
+    PSI_CY = *cy[k];
+    g2_psi(&pg, &gen);
+    if (g2_eq_jac(&pg, &xg)) {
+      PSI_READY = 1;
+      break;
+    }
+  }
+  /* G1 phi: beta = nontrivial cube root of unity; eigenvalue is
+     x^2-1 or -x^2 depending on which root — select on the generator. */
+  fp_t two, beta, cand;
+  fp_add(&two, &FP_ONE_M, &FP_ONE_M);
+  fp_pow(&beta, &two, e3, 6); /* 2^((p-1)/3) */
+  if (fp_eq(&beta, &FP_ONE_M)) {
+    fp_t three;
+    fp_add(&three, &two, &FP_ONE_M);
+    fp_pow(&beta, &three, e3, 6);
+  }
+  g1_t g1gen, t, x2g, r, phi;
+  g1_set_affine(&g1gen, &G1_GEN_X, &G1_GEN_Y);
+  g1_mul_limbs(&t, &g1gen, &BLS_X_ABS, 1);
+  g1_mul_limbs(&x2g, &t, &BLS_X_ABS, 1); /* [x^2]gen (sign squares away) */
+  cand = beta;
+  for (int k = 0; k < 2 && !G1_PHI_READY; k++) {
+    phi = g1gen;
+    fp_mul(&phi.x, &phi.x, &cand);
+    g1_t ng, res;
+    g1_neg_pt(&ng, &g1gen);
+    g1_add(&res, &x2g, &ng); /* [x^2-1]gen */
+    if (g1_eq_jac(&phi, &res)) {
+      G1_BETA = cand;
+      G1_PHI_READY = 1;
+      G1_PHI_NEG = 0;
+      break;
+    }
+    g1_neg_pt(&res, &x2g); /* -[x^2]gen */
+    if (g1_eq_jac(&phi, &res)) {
+      G1_BETA = cand;
+      G1_PHI_READY = 1;
+      G1_PHI_NEG = 1;
+      break;
+    }
+    fp_sqr(&cand, &beta); /* the other root */
+  }
+}
+
+__attribute__((constructor)) static void blsn_init(void) { psi_init(); }
+
 static int g1_in_subgroup(const g1_t *p) {
-  g1_t t;
-  g1_mul_limbs(&t, p, BLS_R, 4);
-  return t.inf;
+  if (p->inf) return 1;
+  if (!G1_PHI_READY) {
+    g1_t t;
+    g1_mul_limbs(&t, p, BLS_R, 4);
+    return t.inf;
+  }
+  g1_t t, x2p, r, phi;
+  g1_mul_limbs(&t, p, &BLS_X_ABS, 1);
+  g1_mul_limbs(&x2p, &t, &BLS_X_ABS, 1);
+  if (G1_PHI_NEG) {
+    g1_neg_pt(&r, &x2p);
+  } else {
+    g1_t np;
+    g1_neg_pt(&np, p);
+    g1_add(&r, &x2p, &np);
+  }
+  phi = *p;
+  fp_mul(&phi.x, &phi.x, &G1_BETA);
+  return g1_eq_jac(&phi, &r);
 }
 
 static int g2_in_subgroup(const g2_t *p) {
-  g2_t t;
-  g2_mul_limbs(&t, p, BLS_R, 4);
-  return t.inf;
+  if (p->inf) return 1;
+  if (!PSI_READY) {
+    g2_t t;
+    g2_mul_limbs(&t, p, BLS_R, 4);
+    return t.inf;
+  }
+  g2_t xp, pg;
+  g2_mul_x(&xp, p);
+  g2_psi(&pg, p);
+  return g2_eq_jac(&pg, &xp);
+}
+
+/* RFC 9380 App. G.4: h_eff*P as (x^2-x-1)P + (x-1)psi(P) + psi^2(2P) */
+static void g2_clear_cofactor_fast(g2_t *o, const g2_t *p) {
+  g2_t t1, t2, t3, tmp;
+  g2_mul_x(&t1, p);  /* t1 = [x]P */
+  g2_psi(&t2, p);    /* t2 = psi(P) */
+  g2_dbl(&t3, p);
+  g2_psi(&t3, &t3);
+  g2_psi(&t3, &t3);  /* t3 = psi^2(2P) */
+  g2_neg_pt(&tmp, &t2);
+  g2_add(&t3, &t3, &tmp); /* t3 -= t2 */
+  g2_add(&t2, &t1, &t2);  /* t2 = t1 + psi(P) */
+  g2_mul_x(&t2, &t2);     /* t2 = [x^2]P + [x]psi(P) */
+  g2_add(&t3, &t3, &t2);
+  g2_neg_pt(&tmp, &t1);
+  g2_add(&t3, &t3, &tmp); /* t3 -= t1 */
+  g2_neg_pt(&tmp, p);
+  g2_add(o, &t3, &tmp); /* Q = t3 - P */
 }
 
 /* ------------------------------------------------------------------ */
@@ -1093,7 +1302,11 @@ static void hash_to_g2_point(g2_t *o, const uint8_t *msg, uint32_t msg_len,
   iso_map_g2(&q0m, &q0);
   iso_map_g2(&q1m, &q1);
   g2_add(&sum, &q0m, &q1m);
-  g2_mul_limbs(o, &sum, G2_H_EFF, G2_H_EFF_LIMBS);
+  if (PSI_READY) {
+    g2_clear_cofactor_fast(o, &sum);
+  } else {
+    g2_mul_limbs(o, &sum, G2_H_EFF, G2_H_EFF_LIMBS);
+  }
 }
 
 /* ------------------------------------------------------------------ */
