@@ -1,102 +1,124 @@
-"""Headline benchmark: BLS signature-set batch verification throughput.
+"""Headline benchmark: BLS batch verification through the PRODUCTION path.
 
-Measures the device pipeline behind `IBlsVerifier.verify_signature_sets`
-(BASELINE.json config #2: batch-verify 128 attestation SignatureSets) —
-random-weighted scalar ladders, masked aggregation, batched Miller loop,
-one shared final exponentiation — end-to-end on the default JAX platform
-(the real TPU under the driver; CPU elsewhere).
+Drives `TpuBlsVerifier.verify_signature_sets` (the IBlsVerifier seam,
+chain/bls/multithread/index.ts:113) exactly the way block import does:
+concurrent jobs of <=128 compressed signature sets (BASELINE.json
+config #3 shape), verified end-to-end — host decompression + hash-to-G2
+on the prep thread pool, wave packing into 2048-set device buckets,
+async dispatch, one verdict readback per wave, mesh-sharded when more
+than one device is visible. Unlike rounds 1-2 this measures the same
+code path production runs (VERDICT r2 weak #2).
 
-Baseline: the reference verifies ~100 signature sets in ~45 ms on its CPU
-blst worker pool (chain/blocks/verifyBlocksSignatures.ts:45; BASELINE.md)
-= ~2,222 sets/sec. vs_baseline = our sets/sec / 2222.
+Baseline: the reference verifies ~100 signature sets in ~45 ms on its
+CPU blst worker pool (chain/blocks/verifyBlocksSignatures.ts:45;
+BASELINE.md) = ~2,222 sets/sec. vs_baseline = our sets/sec / 2222.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import sys
 import time
 
-# Device bucket: the verifier packs <=128-set jobs into one big device
-# batch (the analog of prepareWork's 128-set packing, scaled to what
-# one chip absorbs: per-op device cost is batch-flat up to ~2048, so
-# large buckets are nearly free throughput).
-N_SETS = 2048
-ITERS = 8
+N_JOBS = 16  # concurrent verify jobs per wave (block-import shaped)
+SETS_PER_JOB = 128  # reference MAX_SIGNATURE_SETS_PER_JOB
+WAVES = 4  # measured waves (+1 warmup)
+KEY_POOL = 2048  # distinct validator keys (pubkey cache is production-warm)
 BASELINE_SETS_PER_SEC = 100 / 0.045  # reference: ~100 sigs / 45 ms
+
+
+def _build_sets(n: int, tag: int):
+    """n valid compressed SignatureSets with distinct messages. Small
+    secret scalars keep setup time sane; verification cost does not
+    depend on the scalar. Pure benchmark fixture construction — NOT
+    part of the measured path."""
+    from lodestar_tpu.bls import SignatureSet
+    from lodestar_tpu.crypto.bls import native
+    from lodestar_tpu.crypto.bls import curve as oc
+    from lodestar_tpu.params import BLS_DST_SIG
+
+    dst = bytes(BLS_DST_SIG)
+    out = []
+    for i in range(n):
+        sk = 3 + (tag * n + i) % KEY_POOL
+        msg = (tag * n + i).to_bytes(32, "little")
+        h = native.hash_to_g2(msg, dst)
+        pk = oc.g1_to_bytes(native.g1_mul(oc.G1_GEN, sk))
+        sig = oc.g2_to_bytes(native.g2_mul(h, sk))
+        out.append(SignatureSet(pk, msg, sig))
+    return out
+
+
+def _build_all_waves():
+    """Fixture sets for warmup + measured waves, built in parallel on a
+    thread pool (the native C calls release the GIL)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    tags = range((1 + WAVES) * N_JOBS)
+    with ThreadPoolExecutor(8) as pool:
+        jobs = list(
+            pool.map(lambda t: _build_sets(SETS_PER_JOB, t), tags)
+        )
+    return [
+        jobs[w * N_JOBS : (w + 1) * N_JOBS] for w in range(1 + WAVES)
+    ]
+
+
+async def _run() -> float:
+    from lodestar_tpu.bls import TpuBlsVerifier
+
+    waves = _build_all_waves()
+    v = TpuBlsVerifier()
+
+    async def run_wave(jobs) -> bool:
+        results = await asyncio.gather(
+            *(v.verify_signature_sets(job) for job in jobs)
+        )
+        return all(results)
+
+    # Warmup: compiles the 2048-set bucket pipeline (persistent-cached)
+    # and checks correctness through the full production path.
+    if not await run_wave(waves[0]):
+        raise RuntimeError("verifier returned False on valid sets")
+
+    t0 = time.perf_counter()
+    # All waves' jobs enqueued concurrently: the verifier drains the
+    # queue into 2048-set buckets and pipelines host prep of wave k+1
+    # under device execution of wave k.
+    oks = await asyncio.gather(*(run_wave(w) for w in waves[1:]))
+    dt = time.perf_counter() - t0
+    await v.close()
+    if not all(oks):
+        raise RuntimeError("verifier returned False on valid sets")
+    return N_JOBS * SETS_PER_JOB * WAVES / dt
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from lodestar_tpu.bls import kernels
-    from lodestar_tpu.bls.verifier import _rand_scalars
-    from lodestar_tpu.crypto.bls import curve as oc
-    from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
-    from lodestar_tpu.ops import curve as C
-    from lodestar_tpu.params import BLS_DST_SIG
-
-    print(f"# platform: {jax.default_backend()}, devices: {len(jax.devices())}",
-          file=sys.stderr)
-
-    # Build valid (pk, H(msg), sig) sets with the (native-backed)
-    # oracle; distinct keys/messages per set.
-    pks, hs, sigs = [], [], []
-    for i in range(N_SETS):
-        sk = 10_000 + i
-        msg = i.to_bytes(32, "little")
-        h = hash_to_g2(msg, BLS_DST_SIG)
-        pks.append(oc.g1_mul(oc.G1_GEN, sk))
-        hs.append(h)
-        sigs.append(oc.g2_mul(h, sk))
-
-    pk_dev = C.g1_batch_from_ints(pks)
-    h_dev = C.g2_batch_from_ints(hs)
-    sig_dev = C.g2_batch_from_ints(sigs)
-    mask = jnp.ones(N_SETS, dtype=bool)
-
-    all_true = jax.jit(lambda xs: jnp.stack(xs).all())
-
-    def submit():
-        bits = C.scalars_to_bits(_rand_scalars(N_SETS), kernels.RAND_BITS)
-        return kernels.run_verify_batch_async(
-            pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
-        )
-
-    # Warmup: compile the pipeline + reduce, and verify correctness
-    # with a blocking call.
-    ok = kernels.run_verify_batch(
-        pk_dev,
-        (h_dev.x, h_dev.y),
-        sig_dev,
-        C.scalars_to_bits(_rand_scalars(N_SETS), kernels.RAND_BITS),
-        mask,
+    print(
+        f"# platform: {jax.default_backend()}, devices: {len(jax.devices())}",
+        file=sys.stderr,
     )
-    if not ok:
-        raise RuntimeError("batch verify returned False on valid sets")
-    bool(all_true([submit(), submit()]))
-
-    # Measured run: ITERS verifies submitted asynchronously, verdicts
-    # reduced on device, ONE readback — the production shape: the
-    # verifier service batches verdict readbacks inside the reference's
-    # own 100 ms gossip window (a fresh-result readback through the
-    # tunnel costs ~100 ms; dispatches are ~0.1 ms).
-    t0 = time.perf_counter()
-    oks = [submit() for _ in range(ITERS)]
-    if not bool(all_true(oks)):
-        raise RuntimeError("batch verify returned False on valid sets")
-    dt = time.perf_counter() - t0
-
-    sets_per_sec = N_SETS * ITERS / dt
-    print(json.dumps({
-        "metric": "bls_batch_verify_sets_per_sec",
-        "value": round(sets_per_sec, 2),
-        "unit": f"sets/sec (random-lincomb batch verify, {N_SETS}-set device bucket)",
-        "vs_baseline": round(sets_per_sec / BASELINE_SETS_PER_SEC, 4),
-    }))
+    sets_per_sec = asyncio.run(_run())
+    print(
+        json.dumps(
+            {
+                "metric": "bls_verify_sets_per_sec_production",
+                "value": round(sets_per_sec, 2),
+                "unit": (
+                    "sets/sec (TpuBlsVerifier.verify_signature_sets, "
+                    f"{N_JOBS}x{SETS_PER_JOB}-set jobs/wave, compressed in)"
+                ),
+                "vs_baseline": round(
+                    sets_per_sec / BASELINE_SETS_PER_SEC, 4
+                ),
+            }
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -223,9 +223,13 @@ def _cat_fq2(a, b):
     return (_cat_fq(a[0], b[0]), _cat_fq(a[1], b[1]))
 
 
-def bucket_size(n: int, buckets=(4, 8, 16, 32, 64, 128)) -> int:
-    """Smallest bucket >= n (reference chunks at <=128 sets/job,
-    chain/bls/multithread/index.ts:48-56)."""
+def bucket_size(n: int, buckets=(4, 8, 16, 32, 64, 128, 2048)) -> int:
+    """Smallest bucket >= n. Small sizes mirror the reference's <=128
+    sets/job chunks (chain/bls/multithread/index.ts:48-56); above that
+    the verifier packs whole waves into one 2048-set device bucket
+    (per-op device cost is batch-flat to ~2048, so the padding is
+    nearly free — and each extra bucket size is an extra multi-minute
+    XLA compile, so the table jumps straight to the max)."""
     for b in buckets:
         if n <= b:
             return b

@@ -6,9 +6,8 @@ SURVEY.md §2.3). The pool's contract is kept exactly:
 
   - `verify_signature_sets(sets, batchable, priority)` — batchable sets
     are buffered up to MAX_BUFFER_WAIT_MS / MAX_BUFFERED_SIGS and merged
-    with other callers' work (index.ts:59-74, 320-339); jobs are packed
-    to <= MAX_SIGNATURE_SETS_PER_JOB sets (index.ts:48-56, 519-534);
-    a failed batch is re-verified set-by-set so one bad signature only
+    with other callers' work (index.ts:59-74, 320-339); a failed batch
+    is re-verified job-by-job then set-by-set so one bad signature only
     fails its own caller (interface.ts:4-12, worker.ts:88-103).
   - `verify_signature_sets_same_message(sets, message)` — random-
     weighted aggregation + one pairing check; on failure, per-signature
@@ -16,18 +15,28 @@ SURVEY.md §2.3). The pool's contract is kept exactly:
   - `can_accept_work()` — backpressure for the gossip processor
     (index.ts:149-155, network/processor/index.ts).
 
-What changes vs the reference: the N-1 worker threads and their 5 ms
-postMessage round-trip are replaced by one async dispatch queue in
-front of jitted TPU kernels (bls/kernels.py); `aggregateWithRandomness`
-— the reference's measured main-thread bottleneck (jobItem.ts:60-70) —
-runs inside the device program instead of on the host.
+What changes vs the reference's worker pool (index.ts:183-199,
+519-534): instead of ≤128-set chunks round-robined to N-1 CPU threads,
+each drain of the queue becomes a WAVE — every queued job's sets packed
+into device buckets of up to DEVICE_BUCKET_MAX (per-op device cost is
+batch-flat to ~2048, so big buckets are nearly free), all buckets
+dispatched asynchronously, and ONE stacked verdict readback per wave
+(a fresh readback through the TPU tunnel costs ~100 ms; dispatches
+~0.1 ms). Host-side set preparation (decompression, hash-to-G2 — C
+calls that release the GIL) runs on a thread pool and overlaps the
+device's execution of the previous wave. With more than one device the
+bucket batch axis is sharded over a `jax.sharding.Mesh`
+(lodestar_tpu/parallel) — the SPMD replacement for the reference's
+worker fan-out.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import jax.numpy as jnp
@@ -38,7 +47,8 @@ from . import api, kernels
 
 MAX_BUFFER_WAIT_MS = 100  # index.ts:74
 MAX_BUFFERED_SIGS = 32  # index.ts:65
-MAX_SIGNATURE_SETS_PER_JOB = 128  # index.ts:56
+MAX_SIGNATURE_SETS_PER_JOB = 128  # index.ts:56 (job granularity for retries)
+DEVICE_BUCKET_MAX = 2048  # sets per device dispatch (batch-flat cost)
 QUEUE_MAX_LENGTH = 512  # canAcceptWork threshold, index.ts:149-155
 
 
@@ -56,10 +66,11 @@ class _PreparedSet:
 
 @dataclass
 class _Job:
-    sets: list
+    sets: list  # raw api.SignatureSet list
     future: asyncio.Future
     batchable: bool
     enqueued_at: float = 0.0
+    prepared: list | None = None
 
 
 class BlsVerifierMetrics:
@@ -76,16 +87,25 @@ class BlsVerifierMetrics:
         self.queue_length = 0
         self.total_job_wait_s = 0.0
         self.total_device_time_s = 0.0
+        self.waves = 0
+        self.buckets_dispatched = 0
 
 
 class TpuBlsVerifier:
-    """`IBlsVerifier` over TPU pairing kernels."""
+    """`IBlsVerifier` over TPU pairing kernels.
+
+    mesh: None = auto (make a Mesh over all local devices when more
+    than one is visible); pass an explicit `jax.sharding.Mesh` to pin
+    (tests use the 8-device CPU mesh), or `False` to force single-device.
+    """
 
     def __init__(
         self,
         max_buffer_wait_ms: int = MAX_BUFFER_WAIT_MS,
         max_buffered_sigs: int = MAX_BUFFERED_SIGS,
         queue_max: int = QUEUE_MAX_LENGTH,
+        mesh=None,
+        prep_workers: int | None = None,
     ):
         self.metrics = BlsVerifierMetrics()
         self._max_wait = max_buffer_wait_ms / 1000.0
@@ -100,7 +120,28 @@ class TpuBlsVerifier:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = 0
         self._runner: asyncio.Task | None = None
+        self._finalizers: set[asyncio.Task] = set()
         self._closed = False
+        if mesh is None:
+            import jax
+
+            devs = jax.devices()
+            if len(devs) > 1:
+                from .. import parallel
+
+                mesh = parallel.make_mesh()
+            else:
+                mesh = False
+        self._mesh = mesh or None
+        # Host prep (decompression + hash-to-G2) is ctypes C that
+        # releases the GIL — a pool genuinely parallelizes it across
+        # cores and overlaps it with device execution.
+        self._prep_pool = ThreadPoolExecutor(
+            prep_workers
+            if prep_workers is not None
+            else min(8, os.cpu_count() or 4),
+            thread_name_prefix="bls-prep",
+        )
 
     # -- IBlsVerifier surface ------------------------------------------
 
@@ -117,18 +158,14 @@ class TpuBlsVerifier:
         priority: bool = False,
     ) -> bool:
         """True iff every set verifies. Malformed points -> False
-        (maybeBatch.ts:17-44 semantics)."""
+        (maybeBatch.ts:17-44 semantics). Decompression/hashing is
+        deferred to the wave's prep stage (thread pool), keeping the
+        event loop free."""
         self._ensure_runner()
-        try:
-            prepared = [self._prepare(s) for s in sets]
-        except api.InvalidPointError:
-            return False
-        if any(p.sig is None for p in prepared):
-            return False
         fut = asyncio.get_event_loop().create_future()
-        job = _Job(prepared, fut, batchable)
-        self.metrics.sig_sets_started += len(prepared)
-        if batchable and len(prepared) < self._max_buffered:
+        job = _Job(list(sets), fut, batchable)
+        self.metrics.sig_sets_started += len(job.sets)
+        if batchable and len(job.sets) < self._max_buffered:
             self._buffer.append(job)
             buffered = sum(len(j.sets) for j in self._buffer)
             if buffered >= self._max_buffered:
@@ -147,17 +184,24 @@ class TpuBlsVerifier:
         """Per-set verdicts for k (pubkey, signature) pairs on one
         message (jobItem.ts:50-92)."""
         self._ensure_runner()
-        h = api.message_to_g2(message)
-        prepared = []
-        valid = []
-        for s in sets:
-            try:
-                pk = api.decompress_pubkey(s.pubkey)
-                sig = api.decompress_signature(s.signature)
-            except api.InvalidPointError:
-                pk, sig = None, None
-            prepared.append((pk, sig))
-            valid.append(pk is not None and sig is not None)
+        loop = asyncio.get_event_loop()
+
+        def prep():
+            h = api.message_to_g2(message)
+            out = []
+            for s in sets:
+                try:
+                    pk = api.decompress_pubkey(s.pubkey)
+                    sig = api.decompress_signature(s.signature)
+                except api.InvalidPointError:
+                    pk, sig = None, None
+                out.append((pk, sig))
+            return h, out
+
+        h, prepared = await loop.run_in_executor(self._prep_pool, prep)
+        valid = [
+            p is not None and s is not None for p, s in prepared
+        ]
         live = [i for i, v in enumerate(valid) if v]
         if not live:
             return [False] * len(sets)
@@ -171,13 +215,11 @@ class TpuBlsVerifier:
             return results
         # batch failed: per-signature retry fan-out (index.ts:552-563)
         self.metrics.same_message_retries += 1
-        singles = await asyncio.gather(
-            *(
-                self._run_batch(
-                    [_PreparedSet(prepared[i][0], h, prepared[i][1])]
-                )
+        singles = await self._verdict_wave(
+            [
+                [_PreparedSet(prepared[i][0], h, prepared[i][1])]
                 for i in live
-            )
+            ]
         )
         for i, r in zip(live, singles):
             results[i] = r
@@ -203,6 +245,9 @@ class TpuBlsVerifier:
         if self._runner:
             self._runner.cancel()
             self._runner = None
+        for t in list(self._finalizers):
+            t.cancel()
+        self._prep_pool.shutdown(wait=False)
 
     # -- internals ------------------------------------------------------
 
@@ -245,80 +290,183 @@ class TpuBlsVerifier:
         self._flush_buffer()
 
     async def _run_loop(self):
+        """Drain-everything wave loop. Each iteration collects ALL
+        queued job groups, preps + dispatches them as one wave, then
+        finalizes (readback + retries) in a separate task so the next
+        wave's host prep overlaps this wave's device execution — the
+        TPU analog of prepareWork re-filling idle workers
+        (index.ts:357-534)."""
         while not self._closed:
             _, _, jobs = await self._queue.get()
+            jobs = list(jobs)
+            while True:
+                try:
+                    _, _, more = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                jobs.extend(more)
             self.metrics.queue_length = self._queue.qsize()
+            self.metrics.waves += 1
             t0 = time.monotonic()
             for j in jobs:
                 self.metrics.total_job_wait_s += t0 - j.enqueued_at
             try:
-                await self._execute_job_group(jobs)
+                wave = await self._prep_and_dispatch(jobs)
             except asyncio.CancelledError:
-                err = RuntimeError("BLS verifier closed")
-                for j in jobs:
-                    if not j.future.done():
-                        j.future.set_exception(err)
+                self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
                 raise
             except Exception as e:  # defensive: fail the waiters
-                for j in jobs:
-                    if not j.future.done():
-                        j.future.set_exception(e)
-            self.metrics.total_device_time_s += time.monotonic() - t0
+                self._fail_jobs(jobs, e)
+                continue
+            task = asyncio.ensure_future(self._finalize_wave(wave, t0))
+            self._finalizers.add(task)
+            task.add_done_callback(self._finalizers.discard)
 
-    async def _execute_job_group(self, jobs: list[_Job]):
-        """Pack jobs into <=128-set chunks; verify each chunk as one
-        random-lincomb batch; failed chunks retry per set
-        (prepareWork/runJob, index.ts:357-534)."""
-        # greedy packing preserving job boundaries
-        chunks: list[list[_Job]] = []
-        cur: list[_Job] = []
-        cur_n = 0
+    def _fail_jobs(self, jobs, err):
         for j in jobs:
-            n = len(j.sets)
-            if cur and cur_n + n > self._max_sets_per_job:
-                chunks.append(cur)
-                cur, cur_n = [], 0
-            cur.append(j)
-            cur_n += n
+            if not j.future.done():
+                j.future.set_exception(err)
+
+    async def _prep_and_dispatch(self, jobs: list[_Job]):
+        """Host prep (thread pool, parallel per job) + bucket packing +
+        async device dispatch. Returns (buckets, device verdicts)."""
+        loop = asyncio.get_event_loop()
+
+        def prep_job(j: _Job):
+            try:
+                prepared = [self._prepare(s) for s in j.sets]
+            except api.InvalidPointError:
+                return None
+            if any(p.sig is None for p in prepared):
+                return None
+            return prepared
+
+        prepped = await asyncio.gather(
+            *(
+                loop.run_in_executor(self._prep_pool, prep_job, j)
+                for j in jobs
+            )
+        )
+        live: list[_Job] = []
+        for j, p in zip(jobs, prepped):
+            if p is None:
+                if not j.future.done():
+                    j.future.set_result(False)
+            else:
+                j.prepared = p
+                live.append(j)
+        # pack into device buckets, preserving job boundaries; a job
+        # larger than one bucket (a 64-block sync segment carries
+        # ~8,000 sets, index.ts:51) is split into parts whose verdicts
+        # AND together
+        buckets: list[list[tuple[_Job, list]]] = []
+        cur: list[tuple[_Job, list]] = []
+        cur_n = 0
+        for j in live:
+            sets = j.prepared
+            off = 0
+            while off < len(sets):
+                take = min(
+                    len(sets) - off, DEVICE_BUCKET_MAX - cur_n
+                )
+                if take == 0:
+                    buckets.append(cur)
+                    cur, cur_n = [], 0
+                    continue
+                cur.append((j, sets[off : off + take]))
+                cur_n += take
+                off += take
+                if cur_n >= DEVICE_BUCKET_MAX:
+                    buckets.append(cur)
+                    cur, cur_n = [], 0
         if cur:
-            chunks.append(cur)
-        for chunk in chunks:
-            self.metrics.jobs_started += 1
-            all_sets = [s for j in chunk for s in j.sets]
-            ok = await self._run_batch(all_sets)
-            if ok:
-                self.metrics.batch_sigs_success += len(all_sets)
-                for j in chunk:
+            buckets.append(cur)
+        self.metrics.jobs_started += len(live)
+        self.metrics.buckets_dispatched += len(buckets)
+
+        def dispatch():
+            return [
+                self._submit_bucket(
+                    [s for _, part in b for s in part]
+                )
+                for b in buckets
+            ]
+
+        oks = await loop.run_in_executor(None, dispatch)
+        return buckets, oks
+
+    async def _finalize_wave(self, wave, t0: float):
+        """One readback for the whole wave; failed buckets retry
+        per job, then per set (worker.ts:88-103 isolation)."""
+        buckets, oks = wave
+        try:
+            verdicts = await self._readback(oks)
+            # a job's direct verdict is the AND over every bucket part
+            # that carried its sets
+            job_ok: dict[int, bool] = {}
+            job_of: dict[int, _Job] = {}
+            for b, ok in zip(buckets, verdicts):
+                for j, _part in b:
+                    jid = id(j)
+                    job_of[jid] = j
+                    job_ok[jid] = job_ok.get(jid, True) and ok
+            retry: list[_Job] = []
+            for jid, ok in job_ok.items():
+                j = job_of[jid]
+                if ok:
+                    self.metrics.batch_sigs_success += len(j.prepared)
                     if not j.future.done():
                         j.future.set_result(True)
-                continue
-            if len(chunk) == 1 and len(all_sets) == 1:
-                if not chunk[0].future.done():
-                    chunk[0].future.set_result(False)
-                continue
-            # batch failed: isolate per job, then per set (worker.ts:88-103)
-            self.metrics.batch_retries += 1
-            for j in chunk:
-                verdicts = await asyncio.gather(
-                    *(self._run_batch([s]) for s in j.sets)
+                elif len(j.prepared) == 1:
+                    if not j.future.done():
+                        j.future.set_result(False)
+                else:
+                    retry.append(j)
+            if retry:
+                self.metrics.batch_retries += 1
+                verdicts = await self._verdict_wave(
+                    [j.prepared for j in retry]
                 )
-                if not j.future.done():
-                    j.future.set_result(all(verdicts))
-
-    async def _run_batch(self, sets: list[_PreparedSet]) -> bool:
-        """Verify a list of sets as random-lincomb batches. Lists larger
-        than one device bucket are split and AND-ed — a single job may
-        legitimately exceed the per-call cap (e.g. a 64-block sync batch
-        carries ~8,000 sets, index.ts:51)."""
-        cap = self._max_sets_per_job
-        if len(sets) > cap:
-            parts = [
-                sets[i : i + cap] for i in range(0, len(sets), cap)
-            ]
-            verdicts = await asyncio.gather(
-                *(self._run_batch(p) for p in parts)
+                per_set: list[_Job] = []
+                for j, ok in zip(retry, verdicts):
+                    if ok:
+                        if not j.future.done():
+                            j.future.set_result(True)
+                    elif len(j.prepared) == 1:
+                        if not j.future.done():
+                            j.future.set_result(False)
+                    else:
+                        per_set.append(j)
+                if per_set:
+                    flat = [
+                        [s]
+                        for j in per_set
+                        for s in j.prepared
+                    ]
+                    singles = await self._verdict_wave(flat)
+                    i = 0
+                    for j in per_set:
+                        n = len(j.prepared)
+                        if not j.future.done():
+                            j.future.set_result(
+                                all(singles[i : i + n])
+                            )
+                        i += n
+        except asyncio.CancelledError:
+            self._fail_jobs(
+                [j for b in buckets for j, _ in b],
+                RuntimeError("BLS verifier closed"),
             )
-            return all(verdicts)
+            raise
+        except Exception as e:
+            self._fail_jobs([j for b in buckets for j, _ in b], e)
+        finally:
+            self.metrics.total_device_time_s += time.monotonic() - t0
+
+    def _submit_bucket(self, sets: list[_PreparedSet]):
+        """Pad to a bucket size, build device arrays (sharded over the
+        mesh when even), dispatch WITHOUT readback. Returns the device
+        () bool."""
         n = len(sets)
         b = kernels.bucket_size(n)
         pad = b - n
@@ -331,18 +479,76 @@ class TpuBlsVerifier:
         sig_dev = C.g2_batch_from_ints(sigs)
         bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
         mask = jnp.asarray([True] * n + [False] * pad)
-        ok = await asyncio.get_event_loop().run_in_executor(
-            None,
-            lambda: kernels.run_verify_batch(
-                pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
-            ),
+        h = (h_dev.x, h_dev.y)
+        mesh = self._mesh
+        if mesh is not None and b % mesh.devices.size == 0:
+            from .. import parallel
+
+            pk_dev = parallel.shard_batch(mesh, pk_dev)
+            h = parallel.shard_batch(mesh, h)
+            sig_dev = parallel.shard_batch(mesh, sig_dev)
+            bits = parallel.shard_batch(mesh, bits)
+            mask = parallel.shard_batch(mesh, mask)
+        return kernels.run_verify_batch_async(
+            pk_dev, h, sig_dev, bits, mask
         )
-        return ok
+
+    async def _readback(self, oks) -> list[bool]:
+        """ONE host transfer for a wave of device verdicts."""
+        loop = asyncio.get_event_loop()
+
+        def read():
+            import numpy as np
+
+            if len(oks) == 1:
+                return [bool(oks[0])]
+            return [bool(v) for v in np.asarray(jnp.stack(oks))]
+
+        return await loop.run_in_executor(None, read)
+
+    async def _verdict_wave(
+        self, groups: list[list[_PreparedSet]]
+    ) -> list[bool]:
+        """Verify each group as its own bucket; all dispatched before
+        one readback."""
+        loop = asyncio.get_event_loop()
+        out: list[bool] = []
+        # split oversized groups (a 64-block sync segment can carry
+        # ~8,000 sets, index.ts:51) into AND-ed device buckets
+        plan: list[tuple[int, int]] = []  # (group idx, n buckets)
+        buckets: list[list[_PreparedSet]] = []
+        for gi, g in enumerate(groups):
+            parts = [
+                g[i : i + DEVICE_BUCKET_MAX]
+                for i in range(0, len(g), DEVICE_BUCKET_MAX)
+            ] or [[]]
+            plan.append((gi, len(parts)))
+            buckets.extend(parts)
+
+        def dispatch():
+            return [
+                self._submit_bucket(b) if b else None
+                for b in buckets
+            ]
+
+        oks = await loop.run_in_executor(None, dispatch)
+        live = [o for o in oks if o is not None]
+        verdicts_flat = await self._readback(live) if live else []
+        it = iter(verdicts_flat)
+        flat = [True if o is None else next(it) for o in oks]
+        i = 0
+        for _, nparts in plan:
+            out.append(all(flat[i : i + nparts]))
+            i += nparts
+        return out
+
+    async def _run_batch(self, sets: list[_PreparedSet]) -> bool:
+        return (await self._verdict_wave([sets]))[0]
 
     async def _run_same_message(self, pairs, h) -> bool:
         """One fused aggregate+pairing check; splits above the device
         cap and ANDs (random weights keep each part sound)."""
-        cap = self._max_sets_per_job
+        cap = DEVICE_BUCKET_MAX
         if len(pairs) > cap:
             parts = [
                 pairs[i : i + cap] for i in range(0, len(pairs), cap)
@@ -351,24 +557,25 @@ class TpuBlsVerifier:
                 *(self._run_same_message(p, h) for p in parts)
             )
             return all(verdicts)
-        n = len(pairs)
-        b = kernels.bucket_size(n)
-        pad = b - n
-        pks = [p for p, _ in pairs] + [oc.G1_GEN] * pad
-        sigs = [s for _, s in pairs] + [oc.G2_GEN] * pad
-        rand = _rand_scalars(b)
-        pk_dev = C.g1_batch_from_ints(pks)
-        sig_dev = C.g2_batch_from_ints(sigs)
-        h_dev = C.g2_batch_from_ints([h])  # batch (1,)
-        bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
-        mask = jnp.asarray([True] * n + [False] * pad)
-        ok = await asyncio.get_event_loop().run_in_executor(
-            None,
-            lambda: kernels.run_verify_same_message(
+        loop = asyncio.get_event_loop()
+
+        def dispatch():
+            n = len(pairs)
+            b = kernels.bucket_size(n)
+            pad = b - n
+            pks = [p for p, _ in pairs] + [oc.G1_GEN] * pad
+            sigs = [s for _, s in pairs] + [oc.G2_GEN] * pad
+            rand = _rand_scalars(b)
+            pk_dev = C.g1_batch_from_ints(pks)
+            sig_dev = C.g2_batch_from_ints(sigs)
+            h_dev = C.g2_batch_from_ints([h])  # batch (1,)
+            bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
+            mask = jnp.asarray([True] * n + [False] * pad)
+            return kernels.run_verify_same_message(
                 pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
-            ),
-        )
-        return ok
+            )
+
+        return bool(await loop.run_in_executor(None, dispatch))
 
 
 class OracleBlsVerifier:

@@ -672,10 +672,15 @@ class BeaconChain:
         voluntary_exits=(),
         bls_to_execution_changes=(),
         execution_payload=None,
+        blobs=None,
     ):
         """Assemble + run the unsigned block, returning (block, post_view).
         Reference: produceBlockWrapper/produceBlockBody (chain.ts:648,
-        produceBlockBody.ts)."""
+        produceBlockBody.ts). deneb+: `blobs` (list of BYTES_PER_BLOB
+        strings) get committed into body.blob_kzg_commitments; the
+        caller wraps them into sidecars after signing
+        (chain/blobs.blob_sidecars_from_block — the reference returns
+        block contents from produceBlockV3 the same way)."""
         types = self.types
         head = self.get_or_regen_state(self.head_root)
         work = _clone(head, types)
@@ -715,6 +720,12 @@ class BeaconChain:
                 if execution_payload is not None
                 else self._build_dev_payload(work, slot)
             )
+        if work.fork_seq >= ForkSeq.deneb and blobs:
+            from ..crypto import kzg as _kzg
+
+            body.blob_kzg_commitments = [
+                _kzg.blob_to_kzg_commitment(b) for b in blobs
+            ]
         block.body = body
 
         signed = ns.SignedBeaconBlock.default()

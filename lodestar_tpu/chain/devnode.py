@@ -37,6 +37,7 @@ class DevNode:
         genesis_time: int = 0,
         verify_attestations: bool = True,
         db=None,
+        blobs_per_block: int = 0,
     ):
         self.cfg = cfg
         self.types = types
@@ -53,6 +54,36 @@ class DevNode:
         self.att_pool = AggregatedAttestationPool(types)
         self.slot = genesis.state.slot
         self.verify_attestations = verify_attestations
+        # deneb dev chains: commit this many deterministic blobs per
+        # block (requires an active KZG trusted setup)
+        self.blobs_per_block = blobs_per_block
+
+    def _make_blobs(self, slot: int, scratch) -> list[bytes] | None:
+        """Deterministic blobs for deneb+ dev blocks."""
+        if not self.blobs_per_block or scratch.fork_seq < ForkSeq.deneb:
+            return None
+        from hashlib import sha256
+
+        from ..crypto.kzg import BLS_MODULUS, FIELD_ELEMENTS_PER_BLOB
+
+        out = []
+        for bi in range(self.blobs_per_block):
+            blob = bytearray()
+            for i in range(FIELD_ELEMENTS_PER_BLOB):
+                v = (
+                    int.from_bytes(
+                        sha256(
+                            slot.to_bytes(8, "little")
+                            + bi.to_bytes(4, "little")
+                            + i.to_bytes(4, "little")
+                        ).digest(),
+                        "big",
+                    )
+                    % BLS_MODULUS
+                )
+                blob += v.to_bytes(32, "big")
+            out.append(bytes(blob))
+        return out
 
     # -- duties ----------------------------------------------------------
 
@@ -177,11 +208,13 @@ class DevNode:
         attestations = self.att_pool.get_attestations_for_block(slot)
         sync_aggregate = self._sync_aggregate_for(scratch, slot)
 
+        blobs = self._make_blobs(slot, scratch)
         block, post = self.chain.produce_block(
             slot,
             randao_reveal,
             attestations=attestations,
             sync_aggregate=sync_aggregate,
+            blobs=blobs,
         )
         ns = types.by_fork[post.fork]
         signed = ns.SignedBeaconBlock.default()
@@ -194,8 +227,26 @@ class DevNode:
                 get_domain(self.cfg, post.state, DOMAIN_BEACON_PROPOSER),
             ),
         )
+        sidecars = None
+        if blobs:
+            from ..crypto import kzg as _kzg
+            from .blobs import blob_sidecars_from_block
+
+            proofs = [
+                _kzg.compute_blob_kzg_proof(
+                    b, bytes(c)
+                )
+                for b, c in zip(
+                    blobs, block.body.blob_kzg_commitments
+                )
+            ]
+            sidecars = blob_sidecars_from_block(
+                types, post.fork, signed, blobs, proofs
+            )
         # simulated clock: every self-produced block is at its slot start
-        root = await self.chain.process_block(signed, is_timely=True)
+        root = await self.chain.process_block(
+            signed, is_timely=True, blob_sidecars=sidecars
+        )
         await self._attest_head()
         self.att_pool.prune(slot)
         return root

@@ -82,6 +82,10 @@ class Network:
         self.subscribed_subnets: set[int] = set()  # live subscriptions
         self.duty_subnets: set[int] = set()  # short-lived duty windows
         self.long_lived_subnets: set[int] = set()  # rotation schedule
+        # monotonic metadata sequence number: bumps on EVERY subnet
+        # change incl. equal-size rotations (MetadataController,
+        # network/metadata.ts:34)
+        self.metadata_seq = 0
         from collections import deque
 
         self.op_pool = None  # wired by the node assembly
@@ -119,6 +123,7 @@ class Network:
             self.discovery.start_random_walk()
 
     async def stop(self) -> None:
+        await self.gossip.stop()
         await self.peer_manager.stop()
         if self.discovery is not None:
             await self.discovery.close()
@@ -253,6 +258,8 @@ class Network:
     def subscribe_att_subnet(self, subnet: int) -> None:
         """AttnetsService subscribe window (attnetsService.ts:43)."""
         self.duty_subnets.add(subnet)
+        if subnet not in self.subscribed_subnets:
+            self.metadata_seq += 1
         self.subscribed_subnets.add(subnet)
         self.gossip.subscribe(
             self._t(f"beacon_attestation_{subnet}"),
@@ -262,6 +269,8 @@ class Network:
     def unsubscribe_att_subnet(self, subnet: int) -> None:
         self.duty_subnets.discard(subnet)
         if subnet not in self.long_lived_subnets:
+            if subnet in self.subscribed_subnets:
+                self.metadata_seq += 1
             self.subscribed_subnets.discard(subnet)
             self.gossip.unsubscribe(
                 self._t(f"beacon_attestation_{subnet}")
@@ -301,11 +310,14 @@ class Network:
                 self.long_lived_subnets.discard(subnet)
                 if subnet not in self.duty_subnets:
                     self.subscribed_subnets.discard(subnet)
+                    self.metadata_seq += 1
                     self.gossip.unsubscribe(
                         self._t(f"beacon_attestation_{subnet}")
                     )
         for subnet in want - self.long_lived_subnets:
             self.long_lived_subnets.add(subnet)
+            if subnet not in self.subscribed_subnets:
+                self.metadata_seq += 1
             self.subscribed_subnets.add(subnet)
             self.gossip.subscribe(
                 self._t(f"beacon_attestation_{subnet}"),

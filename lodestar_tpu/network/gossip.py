@@ -1,32 +1,62 @@
-"""Gossip mesh pubsub over the TCP host.
+"""Gossipsub v1.1-shaped mesh pubsub over the TCP host.
 
 Reference analog: Eth2Gossipsub (network/gossip/gossipsub.ts:74) over
-@chainsafe/libp2p-gossipsub — mesh-based topic pubsub with message-id
-dedup, peer scoring, and snappy payload compression
-(DataTransformSnappy, gossip/encoding.ts:69). Topic names follow the
-spec shape `/eth2/{fork_digest}/{name}/ssz_snappy`; message ids are
-sha256 prefixes of the (compressed) payload like the phase0 spec's
+@chainsafe/libp2p-gossipsub — D-degree mesh pubsub with GRAFT/PRUNE
+mesh maintenance, IHAVE/IWANT lazy gossip, topic-parameterized peer
+scoring driving mesh membership (scoringParameters.ts), message-id
+dedup, and snappy payload compression (DataTransformSnappy,
+gossip/encoding.ts:69). Topic names follow the spec shape
+`/eth2/{fork_digest}/{name}/ssz_snappy`; message ids are sha256
+prefixes of the (compressed) payload like the phase0 spec's
 compute_message_id.
 
-The mesh logic is a compact gossipsub: every subscribed peer is mesh-
-eligible; publishes go to up to D mesh peers; received messages are
-validated through the registered handler (ACCEPT -> forward to the
-rest of the mesh, IGNORE/REJECT -> drop, REJECT -> penalize via the
-peer-score hook).
+What this keeps from gossipsub 1.1 (and what it drops): per-topic
+meshes bounded by [D_LOW, D_HIGH] with heartbeat fill/trim, eager
+graft on subscription exchange (so first publishes don't wait a
+heartbeat), fanout sets for unsubscribed topics, a windowed message
+cache serving IWANT, score components P2 (first deliveries), P4
+(invalid messages) and P7 (behaviour penalty) with per-heartbeat
+decay, score thresholds gating GRAFT acceptance / gossip emission /
+mesh retention. Dropped: opportunistic grafting, PX peer exchange,
+flood-publish option, per-topic score caps — scope noted vs
+scoringParameters.ts.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+import random
+import struct
 import time
+from dataclasses import dataclass, field
 from enum import Enum
 from hashlib import sha256
 
 from ..utils import snappy
-from .transport import TcpHost
+from .transport import K_CONTROL, K_GOSSIP, TcpHost
 
-D_MESH = 8  # gossipsub D
+# mesh degree params (gossipsub defaults; gossipsub.ts uses D=8)
+D_MESH = 8
+D_LOW = 6
+D_HIGH = 12
+D_LAZY = 6  # IHAVE targets per topic per heartbeat
+
+HEARTBEAT_S = 0.7  # reference heartbeat interval
+MCACHE_HISTORY = 6  # windows kept for IWANT serving
+MCACHE_GOSSIP = 3  # windows advertised in IHAVE
 SEEN_TTL = 120.0  # seconds a message id stays deduped
+MAX_IWANT_PER_HEARTBEAT = 512
+
+# score weights (compact rendition of computeGossipPeerScoreParams)
+W_FIRST_DELIVERY = 1.0
+FIRST_DELIVERY_CAP = 100.0
+W_INVALID = 10.0
+W_BEHAVIOUR = 5.0
+DECAY = 0.9  # per heartbeat
+GRAFT_THRESHOLD = 0.0  # accept/keep mesh links at score >= 0
+GOSSIP_THRESHOLD = -40.0  # stop IHAVE below
+GREYLIST_THRESHOLD = -80.0  # ignore all messages below
 
 
 class ValidationResult(str, Enum):
@@ -44,70 +74,168 @@ def message_id(data: bytes) -> bytes:
     return sha256(b"\x01\x00\x00\x00" + data).digest()[:20]
 
 
+@dataclass
+class GossipPeerScore:
+    """Per-peer gossip score (P2/P4/P7 of the gossipsub score fn)."""
+
+    first_deliveries: float = 0.0
+    invalid: float = 0.0
+    behaviour: float = 0.0
+
+    @property
+    def value(self) -> float:
+        return (
+            W_FIRST_DELIVERY
+            * min(self.first_deliveries, FIRST_DELIVERY_CAP)
+            - W_INVALID * self.invalid * self.invalid
+            - W_BEHAVIOUR * self.behaviour * self.behaviour
+        )
+
+    def decay(self) -> None:
+        self.first_deliveries *= DECAY
+        self.invalid *= DECAY
+        self.behaviour *= DECAY
+
+
 class GossipNode:
-    """One node's gossip engine bound to a TcpHost."""
+    """One node's gossipsub engine bound to a TcpHost."""
 
     def __init__(self, host: TcpHost, on_penalize=None):
         self.host = host
         host.on_gossip = self._on_gossip
+        host.on_control = self._on_control
+        host.peer_connected_hooks.append(self._peer_connected)
+        host.peer_lost_hooks.append(self._peer_lost)
         self.subscriptions: dict[str, object] = {}  # topic -> handler
         self.peer_topics: dict[str, set[str]] = {}  # peer -> topics
+        self.mesh: dict[str, set[str]] = {}  # topic -> mesh peers
+        self.fanout: dict[str, set[str]] = {}  # unsubscribed publishes
+        self.scores: dict[str, GossipPeerScore] = {}
         self._seen: dict[bytes, float] = {}
+        self._mcache: list[dict[bytes, tuple[str, bytes]]] = [{}]
+        self._iwant_budget: dict[str, int] = {}
         self.on_penalize = on_penalize  # fn(peer_id, reason)
         self.messages_received = 0
         self.messages_forwarded = 0
         self.messages_published = 0
+        self.frames_sent = 0  # gossip data frames (fan-out accounting)
+        self._hb_task: asyncio.Task | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the heartbeat (idempotent; no-op without a running
+        loop — callers constructing engines synchronously get the
+        heartbeat lazily on first publish/subscribe inside the loop)."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        if self._hb_task is None or self._hb_task.done():
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
 
     # -- subscription management ----------------------------------------
-    #
-    # Topic interest rides the hello metadata in full gossipsub; here
-    # peers learn interest lazily: every connected peer is a forward
-    # candidate, and uninterested peers drop (IGNORE) on receipt. The
-    # subnet services prune with subscribe/unsubscribe windows.
 
     def subscribe(self, topic: str, handler) -> None:
         """handler: async fn(peer_id, raw_ssz_bytes) -> ValidationResult"""
+        new = topic not in self.subscriptions
         self.subscriptions[topic] = handler
+        if not new:
+            return
+        self.mesh.setdefault(topic, set())
+        self.fanout.pop(topic, None)
+        self.start()
+        self._broadcast_control({"t": "sub", "topics": [topic]})
+        # eager graft: known interested peers join the mesh now so the
+        # next publish has somewhere to go before the first heartbeat
+        for peer, topics in self.peer_topics.items():
+            if topic in topics and len(self.mesh[topic]) < D_MESH:
+                self._graft(topic, peer)
 
     def unsubscribe(self, topic: str) -> None:
-        self.subscriptions.pop(topic, None)
+        if topic not in self.subscriptions:
+            return
+        del self.subscriptions[topic]
+        for peer in self.mesh.pop(topic, set()):
+            self._send_control(peer, {"t": "prune", "topic": topic})
+        self._broadcast_control({"t": "unsub", "topics": [topic]})
 
     # -- publish / receive ----------------------------------------------
-
-    def _mesh_peers(self, exclude: str | None = None) -> list[str]:
-        peers = [p for p in self.host.conns if p != exclude]
-        return peers[:D_MESH]
 
     async def publish(self, topic: str, ssz_bytes: bytes) -> int:
         data = snappy.frame_compress(ssz_bytes)
         mid = message_id(data)
         self._mark_seen(mid)
+        self._mcache[-1][mid] = (topic, data)
         self.messages_published += 1
-        return await self._fanout(topic, data, exclude=None)
+        self.start()  # IHAVE backstop for fanout publishes
+        n = await self._send_to_mesh(topic, data, exclude=None)
+        # Subscription control frames propagate asynchronously; a
+        # publish racing them would find an empty mesh. Briefly wait
+        # for at least one target (the reference throws
+        # InsufficientPeers and callers retry; here the retry is
+        # internal), with heartbeat IHAVE as the long-tail backstop.
+        for _ in range(6):
+            if n > 0 or not self.host.conns:
+                break
+            await asyncio.sleep(0.05)
+            n = await self._send_to_mesh(topic, data, exclude=None)
+        return n
 
-    async def _fanout(self, topic: str, data: bytes, exclude) -> int:
-        import struct
+    def _topic_send_targets(self, topic: str, exclude) -> list[str]:
+        """Mesh members for subscribed topics; a fanout set otherwise
+        (gossipsub fanout semantics for publish-only topics)."""
+        if topic in self.subscriptions:
+            peers = self.mesh.get(topic, set())
+        else:
+            fan = self.fanout.setdefault(topic, set())
+            fan &= set(self.host.conns)  # drop dead
+            if len(fan) < D_MESH:
+                for p in self._topic_peers(topic):
+                    if len(fan) >= D_MESH:
+                        break
+                    if self._score(p) >= GRAFT_THRESHOLD:
+                        fan.add(p)
+            peers = fan
+        return [
+            p
+            for p in peers
+            if p != exclude and p in self.host.conns
+        ][:D_HIGH]
 
-        payload = (
-            struct.pack(">H", len(topic.encode()))
-            + topic.encode()
-            + data
-        )
+    @staticmethod
+    def _frame(topic: str, data: bytes) -> bytes:
+        """Gossip data-frame wire format: u16 topic length + topic +
+        compressed payload (shared by mesh push and IWANT serving)."""
+        enc = topic.encode()
+        return struct.pack(">H", len(enc)) + enc + data
+
+    async def _send_to_mesh(self, topic: str, data: bytes, exclude) -> int:
+        payload = self._frame(topic, data)
         n = 0
-        for peer in self._mesh_peers(exclude):
+        for peer in self._topic_send_targets(topic, exclude):
             conn = self.host.conns.get(peer)
             if conn is None:
                 continue
             try:
-                await conn.send_frame(1, payload)  # K_GOSSIP
+                await conn.send_frame(K_GOSSIP, payload)
+                self.frames_sent += 1
                 n += 1
             except Exception:
                 pass
         return n
 
     async def _on_gossip(self, peer_id: str, topic: str, data: bytes):
+        if self._score(peer_id) < GREYLIST_THRESHOLD:
+            return
         mid = message_id(data)
-        if mid in self._seen:
+        first = mid not in self._seen
+        if not first:
             return
         self._mark_seen(mid)
         handler = self.subscriptions.get(topic)
@@ -117,14 +245,240 @@ class GossipNode:
         try:
             ssz_bytes = snappy.frame_uncompress(data)
         except snappy.SnappyError:
-            self._penalize(peer_id, "bad snappy frame")
+            self._invalid(peer_id, "bad snappy frame")
             return
         result = await handler(peer_id, ssz_bytes)
         if result is ValidationResult.ACCEPT:
+            sc = self.scores.setdefault(peer_id, GossipPeerScore())
+            sc.first_deliveries += 1.0
+            self._mcache[-1][mid] = (topic, data)
             self.messages_forwarded += 1
-            await self._fanout(topic, data, exclude=peer_id)
+            await self._send_to_mesh(topic, data, exclude=peer_id)
         elif result is ValidationResult.REJECT:
-            self._penalize(peer_id, f"rejected message on {topic}")
+            self._invalid(peer_id, f"rejected message on {topic}")
+
+    # -- control plane ---------------------------------------------------
+
+    def _peer_connected(self, peer_id: str) -> None:
+        if self.subscriptions:
+            self._send_control(
+                peer_id,
+                {"t": "sub", "topics": sorted(self.subscriptions)},
+            )
+
+    def _peer_lost(self, peer_id: str) -> None:
+        self.peer_topics.pop(peer_id, None)
+        for members in self.mesh.values():
+            members.discard(peer_id)
+        for fan in self.fanout.values():
+            fan.discard(peer_id)
+
+    def _send_control(self, peer_id: str, msg: dict) -> None:
+        conn = self.host.conns.get(peer_id)
+        if conn is None:
+            return
+        payload = json.dumps(msg).encode()
+
+        async def send():
+            try:
+                await conn.send_frame(K_CONTROL, payload)
+            except Exception:
+                pass
+
+        try:
+            asyncio.ensure_future(send())
+        except RuntimeError:
+            pass  # no running loop (synchronous construction paths)
+
+    def _broadcast_control(self, msg: dict) -> None:
+        for peer in list(self.host.conns):
+            self._send_control(peer, msg)
+
+    async def _on_control(self, peer_id: str, payload: bytes) -> None:
+        msg = json.loads(payload)
+        t = msg.get("t")
+        if t == "sub":
+            topics = self.peer_topics.setdefault(peer_id, set())
+            for topic in msg.get("topics", []):
+                topics.add(topic)
+                # eager graft from our side too (symmetric join)
+                members = self.mesh.get(topic)
+                if (
+                    members is not None
+                    and len(members) < D_MESH
+                    and self._score(peer_id) >= GRAFT_THRESHOLD
+                ):
+                    self._graft(topic, peer_id)
+        elif t == "unsub":
+            topics = self.peer_topics.get(peer_id, set())
+            for topic in msg.get("topics", []):
+                topics.discard(topic)
+                members = self.mesh.get(topic)
+                if members:
+                    members.discard(peer_id)
+        elif t == "graft":
+            topic = msg.get("topic")
+            members = self.mesh.get(topic)
+            if members is None:
+                # GRAFT for a topic we're not in: behaviour penalty
+                # (gossipsub v1.1 penalizes graft misbehaviour)
+                self._behaviour(peer_id)
+                self._send_control(
+                    peer_id, {"t": "prune", "topic": topic}
+                )
+            elif self._score(peer_id) < GRAFT_THRESHOLD:
+                self._send_control(
+                    peer_id, {"t": "prune", "topic": topic}
+                )
+            else:
+                members.add(peer_id)
+                self.peer_topics.setdefault(peer_id, set()).add(topic)
+        elif t == "prune":
+            members = self.mesh.get(msg.get("topic"))
+            if members:
+                members.discard(peer_id)
+        elif t == "ihave":
+            if self._score(peer_id) < GOSSIP_THRESHOLD:
+                return
+            budget = self._iwant_budget.get(
+                peer_id, MAX_IWANT_PER_HEARTBEAT
+            )
+            want = []
+            for h in msg.get("mids", []):
+                if budget <= 0:
+                    break
+                mid = bytes.fromhex(h)
+                if mid not in self._seen and self.subscriptions.get(
+                    msg.get("topic")
+                ):
+                    want.append(h)
+                    budget -= 1
+            self._iwant_budget[peer_id] = budget
+            if want:
+                self._send_control(
+                    peer_id, {"t": "iwant", "mids": want}
+                )
+        elif t == "iwant":
+            conn = self.host.conns.get(peer_id)
+            if conn is None:
+                return
+            for h in msg.get("mids", [])[:MAX_IWANT_PER_HEARTBEAT]:
+                mid = bytes.fromhex(h)
+                for window in reversed(self._mcache):
+                    hit = window.get(mid)
+                    if hit is None:
+                        continue
+                    topic, data = hit
+                    try:
+                        await conn.send_frame(
+                            K_GOSSIP, self._frame(topic, data)
+                        )
+                        self.frames_sent += 1
+                    except Exception:
+                        pass
+                    break
+
+    def _graft(self, topic: str, peer_id: str) -> None:
+        self.mesh.setdefault(topic, set()).add(peer_id)
+        self._send_control(peer_id, {"t": "graft", "topic": topic})
+
+    # -- heartbeat --------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(HEARTBEAT_S)
+                self._heartbeat()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # the mesh must survive a bad heartbeat
+
+    def _topic_peers(self, topic: str) -> list[str]:
+        return [
+            p
+            for p, topics in self.peer_topics.items()
+            if topic in topics and p in self.host.conns
+        ]
+
+    def _heartbeat(self) -> None:
+        self._iwant_budget = {}
+        for sc in self.scores.values():
+            sc.decay()
+        for topic in list(self.mesh):
+            members = self.mesh[topic]
+            members &= set(self.host.conns)
+            # drop mesh members whose score fell below the graft bar
+            for p in [
+                p
+                for p in members
+                if self._score(p) < GRAFT_THRESHOLD
+            ]:
+                members.discard(p)
+                self._send_control(p, {"t": "prune", "topic": topic})
+            # fill to D from known good topic peers
+            if len(members) < D_LOW:
+                cands = [
+                    p
+                    for p in self._topic_peers(topic)
+                    if p not in members
+                    and self._score(p) >= GRAFT_THRESHOLD
+                ]
+                random.shuffle(cands)
+                for p in cands[: D_MESH - len(members)]:
+                    self._graft(topic, p)
+            # trim to D (keep the highest-scored members)
+            if len(members) > D_HIGH:
+                ranked = sorted(
+                    members, key=self._score, reverse=True
+                )
+                for p in ranked[D_MESH:]:
+                    members.discard(p)
+                    self._send_control(
+                        p, {"t": "prune", "topic": topic}
+                    )
+        # IHAVE gossip: advertise the recent windows to non-mesh peers
+        ads: dict[str, list[bytes]] = {}
+        for window in self._mcache[-MCACHE_GOSSIP:]:
+            for mid, (topic, _) in window.items():
+                ads.setdefault(topic, []).append(mid)
+        for topic, mids in ads.items():
+            members = self.mesh.get(topic, set())
+            targets = [
+                p
+                for p in self._topic_peers(topic)
+                if p not in members
+                and self._score(p) >= GOSSIP_THRESHOLD
+            ]
+            random.shuffle(targets)
+            for p in targets[:D_LAZY]:
+                self._send_control(
+                    p,
+                    {
+                        "t": "ihave",
+                        "topic": topic,
+                        "mids": [m.hex() for m in mids[:512]],
+                    },
+                )
+        # advance the message-cache window
+        self._mcache.append({})
+        if len(self._mcache) > MCACHE_HISTORY:
+            self._mcache.pop(0)
+
+    # -- scoring ----------------------------------------------------------
+
+    def _score(self, peer_id: str) -> float:
+        sc = self.scores.get(peer_id)
+        return sc.value if sc is not None else 0.0
+
+    def _invalid(self, peer_id: str, reason: str) -> None:
+        sc = self.scores.setdefault(peer_id, GossipPeerScore())
+        sc.invalid += 1.0
+        self._penalize(peer_id, reason)
+
+    def _behaviour(self, peer_id: str) -> None:
+        sc = self.scores.setdefault(peer_id, GossipPeerScore())
+        sc.behaviour += 1.0
 
     def _penalize(self, peer_id: str, reason: str) -> None:
         if self.on_penalize is not None:

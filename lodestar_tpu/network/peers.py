@@ -67,8 +67,8 @@ class PeerManager:
         self.banned: set[str] = set()
         self._task = None
         self.on_new_peer = None  # hook: fn(peer_id) e.g. status handshake
-        host.on_peer_connected = self._connected
-        host.on_peer_lost = self._lost
+        host.peer_connected_hooks.append(self._connected)
+        host.peer_lost_hooks.append(self._lost)
 
     # -- events ----------------------------------------------------------
 

@@ -29,8 +29,15 @@ from ..utils import snappy
 PROTOCOL_STATUS = "status/1"
 PROTOCOL_GOODBYE = "goodbye/1"
 PROTOCOL_PING = "ping/1"
+PROTOCOL_METADATA = "metadata/2"
 PROTOCOL_BLOCKS_BY_RANGE = "beacon_blocks_by_range/2"
 PROTOCOL_BLOCKS_BY_ROOT = "beacon_blocks_by_root/2"
+PROTOCOL_BLOB_SIDECARS_BY_RANGE = "blob_sidecars_by_range/1"
+PROTOCOL_BLOB_SIDECARS_BY_ROOT = "blob_sidecars_by_root/1"
+PROTOCOL_LC_BOOTSTRAP = "light_client_bootstrap/1"
+PROTOCOL_LC_FINALITY_UPDATE = "light_client_finality_update/1"
+PROTOCOL_LC_OPTIMISTIC_UPDATE = "light_client_optimistic_update/1"
+PROTOCOL_LC_UPDATES_BY_RANGE = "light_client_updates_by_range/1"
 
 RESP_SUCCESS = 0
 RESP_INVALID_REQUEST = 1
@@ -172,8 +179,24 @@ class ReqResp:
         return bytes(out)
 
 
+# protocols whose response chunks carry a 4-byte fork-digest context
+# (protocols.ts contextBytes: ContextBytesType.ForkDigest)
+_FORK_CONTEXT_PROTOCOLS = frozenset(
+    {
+        PROTOCOL_BLOCKS_BY_RANGE,
+        PROTOCOL_BLOCKS_BY_ROOT,
+        PROTOCOL_BLOB_SIDECARS_BY_RANGE,
+        PROTOCOL_BLOB_SIDECARS_BY_ROOT,
+        PROTOCOL_LC_BOOTSTRAP,
+        PROTOCOL_LC_FINALITY_UPDATE,
+        PROTOCOL_LC_OPTIMISTIC_UPDATE,
+        PROTOCOL_LC_UPDATES_BY_RANGE,
+    }
+)
+
+
 def _context_len(protocol: str) -> int:
-    return 4 if protocol in (PROTOCOL_BLOCKS_BY_RANGE, PROTOCOL_BLOCKS_BY_ROOT) else 0
+    return 4 if protocol in _FORK_CONTEXT_PROTOCOLS else 0
 
 
 def _varint(v: int) -> bytes:

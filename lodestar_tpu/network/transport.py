@@ -26,7 +26,7 @@ import struct
 
 MAX_FRAME = 32 * 1024 * 1024
 
-K_HELLO, K_GOSSIP, K_REQ, K_RESP, K_PING, K_PONG = range(6)
+K_HELLO, K_GOSSIP, K_REQ, K_RESP, K_PING, K_PONG, K_CONTROL = range(7)
 
 
 class TransportError(Exception):
@@ -126,8 +126,12 @@ class TcpHost:
         # hooks
         self.on_gossip = None
         self.on_request = None
-        self.on_peer_connected = None
-        self.on_peer_lost = None
+        self.on_control = None  # gossipsub control frames
+        # peer lifecycle hooks are MULTI-listener lists (gossipsub
+        # announces subscriptions on connect, the peer manager tracks
+        # scores): append to register, remove/clear to detach.
+        self.peer_connected_hooks: list = []
+        self.peer_lost_hooks: list = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -230,8 +234,8 @@ class TcpHost:
         task = asyncio.ensure_future(self._read_loop(conn))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
-        if self.on_peer_connected is not None:
-            self.on_peer_connected(conn.peer_id)
+        for hook in self.peer_connected_hooks:
+            hook(conn.peer_id)
 
     # -- frame pump ------------------------------------------------------
 
@@ -256,6 +260,12 @@ class TcpHost:
                 await self.on_gossip(conn.peer_id, topic, data)
             except Exception:
                 pass  # a bad message must not kill the socket
+
+    async def _handle_control(self, conn, payload: bytes) -> None:
+        try:
+            await self.on_control(conn.peer_id, payload)
+        except Exception:
+            pass  # malformed control must not kill the socket
 
     async def _handle_request(self, conn, payload: bytes) -> None:
         rid, plen = struct.unpack(">IH", payload[:6])
@@ -300,6 +310,11 @@ class TcpHost:
                     await conn.send_frame(K_PONG, payload)
                 elif kind == K_PONG:
                     pass  # PeerManager tracks liveness by any traffic
+                elif kind == K_CONTROL:
+                    if self.on_control is not None:
+                        self._spawn(
+                            self._handle_control(conn, payload)
+                        )
         except (
             asyncio.IncompleteReadError,
             ConnectionError,
@@ -311,5 +326,5 @@ class TcpHost:
             await conn.close()
             if self.conns.get(conn.peer_id) is conn:
                 del self.conns[conn.peer_id]
-                if self.on_peer_lost is not None:
-                    self.on_peer_lost(conn.peer_id)
+                for hook in self.peer_lost_hooks:
+                    hook(conn.peer_id)

@@ -1,13 +1,16 @@
 """ReqResp wire message SSZ types.
 
-Reference analog: the request/response types of the 13 protocols
-(network/reqresp/protocols.ts:7-95): Status, Goodbye, Ping, Metadata,
-BeaconBlocksByRangeRequest, BeaconBlocksByRootRequest.
+Reference analog: the request/response types of the protocol table
+(network/reqresp/protocols.ts:7-95): Status, Goodbye, Ping, Metadata
+v2, BeaconBlocksByRange/Root, BlobSidecarsByRange/Root, and the
+LightClient protocols.
 """
 
 from ..ssz import Bytes4, Root, uint64
-from ..ssz.composite import ContainerType, ListType
+from ..ssz.composite import BitvectorType, ContainerType, ListType
 from .reqresp import MAX_REQUEST_BLOCKS
+
+MAX_REQUEST_BLOB_SIDECARS = 768  # MAX_REQUEST_BLOCKS_DENEB * max blobs
 
 Status = ContainerType(
     "Status",
@@ -38,6 +41,35 @@ Metadata = ContainerType(
     "Metadata",
     [
         ("seq_number", uint64),
-        # attnets/syncnets bitvectors omitted until subnet services land
+        ("attnets", BitvectorType(64)),
+        ("syncnets", BitvectorType(4)),
+    ],
+)
+
+BlobSidecarsByRangeRequest = ContainerType(
+    "BlobSidecarsByRangeRequest",
+    [
+        ("start_slot", uint64),
+        ("count", uint64),
+    ],
+)
+
+BlobIdentifier = ContainerType(
+    "BlobIdentifier",
+    [
+        ("block_root", Root),
+        ("index", uint64),
+    ],
+)
+
+BlobSidecarsByRootRequest = ListType(
+    BlobIdentifier, MAX_REQUEST_BLOB_SIDECARS
+)
+
+LightClientUpdatesByRangeRequest = ContainerType(
+    "LightClientUpdatesByRangeRequest",
+    [
+        ("start_period", uint64),
+        ("count", uint64),
     ],
 )

@@ -252,9 +252,24 @@ class BeaconNode:
             )
         else:
             node.reqresp = ReqResp(node.peer_id, node.transport)
-        SyncServer(node.chain, node.beacon_cfg, node.types).register(
-            node.reqresp
-        )
+        def _metadata():
+            # seq_number bumps on subnet changes (MetadataController,
+            # network/metadata.ts:34); attnets = live subscription set
+            net = node.network
+            if net is None:
+                return (0, set(), set())
+            return (
+                net.metadata_seq,
+                set(net.subscribed_subnets),
+                set(),
+            )
+
+        SyncServer(
+            node.chain,
+            node.beacon_cfg,
+            node.types,
+            metadata_fn=_metadata,
+        ).register(node.reqresp)
         node.range_sync = RangeSync(
             node.chain, node.beacon_cfg, node.types, node.reqresp
         )

@@ -64,27 +64,61 @@ class Batch:
         self.count = count
         self.status = BatchStatus.awaiting_download
         self.blocks: list = []
+        self.blobs_by_root: dict[bytes, list] = {}
         self.download_attempts = 0
         self.processing_attempts = 0
         self.failed_peers: set[str] = set()
 
 
 class SyncServer:
-    """Server-side reqresp handlers backed by a chain + db."""
+    """Server-side reqresp handlers backed by a chain + db.
 
-    def __init__(self, chain, beacon_cfg, types):
+    Protocol coverage mirrors the reference table (protocols.ts:7-95):
+    Status, Goodbye, Ping, Metadata v2, BeaconBlocksByRange/Root,
+    BlobSidecarsByRange/Root, LightClientBootstrap / FinalityUpdate /
+    OptimisticUpdate / UpdatesByRange.
+    """
+
+    def __init__(self, chain, beacon_cfg, types, metadata_fn=None):
         self.chain = chain
         self.beacon_cfg = beacon_cfg
         self.types = types
+        # metadata_fn() -> (seq_number, attnets set[int], syncnets
+        # set[int]); the network facade supplies the live subnet state
+        self.metadata_fn = metadata_fn
+        self.goodbyes_received: list[tuple[str, int]] = []
 
     def register(self, node: rr.ReqResp) -> None:
         node.register_handler(rr.PROTOCOL_STATUS, self.on_status)
+        node.register_handler(rr.PROTOCOL_GOODBYE, self.on_goodbye)
         node.register_handler(rr.PROTOCOL_PING, self.on_ping)
+        node.register_handler(rr.PROTOCOL_METADATA, self.on_metadata)
         node.register_handler(
             rr.PROTOCOL_BLOCKS_BY_RANGE, self.on_blocks_by_range
         )
         node.register_handler(
             rr.PROTOCOL_BLOCKS_BY_ROOT, self.on_blocks_by_root
+        )
+        node.register_handler(
+            rr.PROTOCOL_BLOB_SIDECARS_BY_RANGE,
+            self.on_blob_sidecars_by_range,
+        )
+        node.register_handler(
+            rr.PROTOCOL_BLOB_SIDECARS_BY_ROOT,
+            self.on_blob_sidecars_by_root,
+        )
+        node.register_handler(
+            rr.PROTOCOL_LC_BOOTSTRAP, self.on_lc_bootstrap
+        )
+        node.register_handler(
+            rr.PROTOCOL_LC_FINALITY_UPDATE, self.on_lc_finality_update
+        )
+        node.register_handler(
+            rr.PROTOCOL_LC_OPTIMISTIC_UPDATE,
+            self.on_lc_optimistic_update,
+        )
+        node.register_handler(
+            rr.PROTOCOL_LC_UPDATES_BY_RANGE, self.on_lc_updates_by_range
         )
 
     def local_status(self):
@@ -104,10 +138,176 @@ class SyncServer:
     async def on_status(self, peer, payload):
         yield (b"", Status.serialize(self.local_status()))
 
+    async def on_goodbye(self, peer, payload):
+        from ..ssz import uint64
+
+        self.goodbyes_received.append(
+            (peer, int(uint64.deserialize(payload)))
+        )
+        yield (b"", uint64.serialize(0))
+
     async def on_ping(self, peer, payload):
         from ..ssz import uint64
 
         yield (b"", uint64.serialize(0))
+
+    async def on_metadata(self, peer, payload):
+        """Serve local metadata v2 (handlers, metadata.ts:34)."""
+        from ..network.wire_types import Metadata
+
+        seq, attnets, syncnets = (
+            self.metadata_fn() if self.metadata_fn else (0, set(), set())
+        )
+        md = Metadata.default()
+        md.seq_number = seq
+        for i in attnets:
+            md.attnets[i] = True
+        for i in syncnets:
+            md.syncnets[i] = True
+        yield (b"", Metadata.serialize(md))
+
+    def _blobs_for_root(self, block_root: bytes):
+        if self.chain.db is None:
+            return None
+        return self.chain.db.blob_sidecars.get(block_root)
+
+    async def on_blob_sidecars_by_range(self, peer, payload):
+        """Stream sidecars of canonical deneb+ blocks in slot-then-index
+        order (handlers/blobSidecarsByRange.ts)."""
+        from ..network.wire_types import BlobSidecarsByRangeRequest
+
+        req = BlobSidecarsByRangeRequest.deserialize(payload)
+        start = int(req.start_slot)
+        count = min(int(req.count), rr.MAX_REQUEST_BLOCKS)
+        chain = self.chain
+        spe = preset().SLOTS_PER_EPOCH
+        roots_by_slot: dict[int, bytes] = {}
+        for n in chain.fork_choice.proto.iter_chain(chain.head_root):
+            if start <= n.slot < start + count:
+                roots_by_slot[n.slot] = n.block_root
+        if chain.db is not None:
+            for slot, (fork, block) in chain.db.block_archive.entries(
+                start=start, end=start + count
+            ):
+                ns = self.types.by_fork[fork]
+                roots_by_slot.setdefault(
+                    slot, ns.BeaconBlock.hash_tree_root(block.message)
+                )
+        from ..network.wire_types import MAX_REQUEST_BLOB_SIDECARS
+
+        served = 0
+        for slot in sorted(roots_by_slot):
+            got = self._blobs_for_root(roots_by_slot[slot])
+            if not got:
+                continue
+            fork, sidecars = got
+            ns = self.types.by_fork[fork]
+            if not hasattr(ns, "BlobSidecar"):
+                continue
+            digest = self.beacon_cfg.fork_digest(slot // spe)
+            for sc in sidecars:
+                if served >= MAX_REQUEST_BLOB_SIDECARS:
+                    return
+                yield (digest, ns.BlobSidecar.serialize(sc))
+                served += 1
+
+    async def on_blob_sidecars_by_root(self, peer, payload):
+        """Serve sidecars by (block_root, index) identifier
+        (handlers/blobSidecarsByRoot.ts)."""
+        from ..network.wire_types import BlobSidecarsByRootRequest
+
+        spe = preset().SLOTS_PER_EPOCH
+        ids = BlobSidecarsByRootRequest.deserialize(payload)
+        for ident in ids:
+            got = self._blobs_for_root(bytes(ident.block_root))
+            if not got:
+                continue
+            fork, sidecars = got
+            ns = self.types.by_fork[fork]
+            for sc in sidecars:
+                if int(sc.index) != int(ident.index):
+                    continue
+                slot = int(sc.signed_block_header.message.slot)
+                digest = self.beacon_cfg.fork_digest(slot // spe)
+                yield (digest, ns.BlobSidecar.serialize(sc))
+
+    def _lc_server(self):
+        lc = getattr(self.chain, "light_client_server", None)
+        if lc is None:
+            raise rr.ReqRespError(
+                rr.RESP_RESOURCE_UNAVAILABLE, "no light client server"
+            )
+        return lc
+
+    def _lc_digest_for(self, obj, slot_attr) -> bytes:
+        spe = preset().SLOTS_PER_EPOCH
+        slot = int(slot_attr)
+        return self.beacon_cfg.fork_digest(slot // spe)
+
+    async def on_lc_bootstrap(self, peer, payload):
+        """LightClientBootstrap by trusted block root
+        (handlers, lightClientBootstrap.ts)."""
+        from ..ssz import Root
+
+        root = bytes(Root.deserialize(payload))
+        lc = self._lc_server()
+        boot = lc.get_bootstrap(root)
+        if boot is None:
+            raise rr.ReqRespError(
+                rr.RESP_RESOURCE_UNAVAILABLE, "bootstrap unavailable"
+            )
+        slot = int(boot.header.beacon.slot)
+        yield (
+            self.beacon_cfg.fork_digest(slot // preset().SLOTS_PER_EPOCH),
+            self.types.LightClientBootstrap.serialize(boot),
+        )
+
+    async def on_lc_finality_update(self, peer, payload):
+        lc = self._lc_server()
+        upd = lc.latest_finality_update
+        if upd is None:
+            raise rr.ReqRespError(
+                rr.RESP_RESOURCE_UNAVAILABLE, "no finality update"
+            )
+        slot = int(upd.attested_header.beacon.slot)
+        yield (
+            self.beacon_cfg.fork_digest(slot // preset().SLOTS_PER_EPOCH),
+            self.types.LightClientFinalityUpdate.serialize(upd),
+        )
+
+    async def on_lc_optimistic_update(self, peer, payload):
+        lc = self._lc_server()
+        upd = lc.latest_optimistic_update
+        if upd is None:
+            raise rr.ReqRespError(
+                rr.RESP_RESOURCE_UNAVAILABLE, "no optimistic update"
+            )
+        slot = int(upd.attested_header.beacon.slot)
+        yield (
+            self.beacon_cfg.fork_digest(slot // preset().SLOTS_PER_EPOCH),
+            self.types.LightClientOptimisticUpdate.serialize(upd),
+        )
+
+    async def on_lc_updates_by_range(self, peer, payload):
+        """LightClientUpdatesByRange: one best update per sync-committee
+        period (handlers, lightClientUpdatesByRange.ts)."""
+        from ..network.wire_types import LightClientUpdatesByRangeRequest
+
+        req = LightClientUpdatesByRangeRequest.deserialize(payload)
+        lc = self._lc_server()
+        start = int(req.start_period)
+        count = min(int(req.count), 128)
+        for period in range(start, start + count):
+            upd = lc.best_update_by_period.get(period)
+            if upd is None:
+                continue
+            slot = int(upd.attested_header.beacon.slot)
+            yield (
+                self.beacon_cfg.fork_digest(
+                    slot // preset().SLOTS_PER_EPOCH
+                ),
+                self.types.LightClientUpdate.serialize(upd),
+            )
 
     async def on_blocks_by_range(self, peer, payload):
         """Stream canonical blocks in [start, start+count) slot order
@@ -286,16 +486,72 @@ class RangeSync:
             rr.PROTOCOL_BLOCKS_BY_RANGE,
             BeaconBlocksByRangeRequest.serialize(req),
         )
-        return [
-            block
-            for _, block in decode_block_chunks(
-                self.beacon_cfg, self.types, chunks
-            )
-        ]
+        pairs = decode_block_chunks(self.beacon_cfg, self.types, chunks)
+        batch.blobs_by_root = await self._download_blobs(
+            peer, batch, pairs
+        )
+        return [block for _, block in pairs]
+
+    async def _download_blobs(
+        self, peer: str, batch: Batch, pairs
+    ) -> dict[bytes, list]:
+        """Fetch the span's blob sidecars when any block commits blobs
+        (network/reqresp/beaconBlocksMaybeBlobsByRange.ts): blocks and
+        sidecars ride the same peer + span, grouped by block root for
+        the DA check at import."""
+        from ..network.wire_types import BlobSidecarsByRangeRequest
+
+        needs = False
+        for fork, block in pairs:
+            body = block.message.body
+            comms = getattr(body, "blob_kzg_commitments", None)
+            if comms is not None and len(comms) > 0:
+                needs = True
+                break
+        if not needs:
+            return {}
+        req = BlobSidecarsByRangeRequest(
+            start_slot=batch.start_slot, count=batch.count
+        )
+        chunks = await self.node.request(
+            peer,
+            rr.PROTOCOL_BLOB_SIDECARS_BY_RANGE,
+            BlobSidecarsByRangeRequest.serialize(req),
+        )
+        out: dict[bytes, list] = {}
+        for ch in chunks:
+            fork = self.beacon_cfg.fork_name_from_digest(ch.context)
+            ns = self.types.by_fork[fork]
+            sc = ns.BlobSidecar.deserialize(ch.payload)
+            hdr = sc.signed_block_header.message
+            root = self.types.BeaconBlockHeader.hash_tree_root(hdr)
+            out.setdefault(bytes(root), []).append(sc)
+        return out
 
     async def _process(self, batch: Batch) -> None:
         """chain.processChainSegment analog: sequential import; each
-        block's signature sets go through the batch verifier."""
+        block's signature sets go through the batch verifier; deneb
+        blocks carry their sidecars into the DA check."""
         for block in batch.blocks:
-            await self.chain.process_block(block, is_timely=False)
+            root = None
+            if batch.blobs_by_root:
+                hdr_root = self.types.by_fork[
+                    self._fork_of(block)
+                ].BeaconBlock.hash_tree_root(block.message)
+                root = bytes(hdr_root)
+            await self.chain.process_block(
+                block,
+                is_timely=False,
+                blob_sidecars=batch.blobs_by_root.get(root)
+                if root is not None
+                else None,
+            )
             self.blocks_imported += 1
+
+    def _fork_of(self, block):
+        from ..statetransition.slot import fork_at_epoch
+
+        return fork_at_epoch(
+            self.chain.cfg,
+            int(block.message.slot) // preset().SLOTS_PER_EPOCH,
+        )

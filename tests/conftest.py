@@ -20,3 +20,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The ambient sitecustomize may import jax at interpreter startup
+# (before this conftest), so the env override alone can be too late.
+# Backends initialize lazily, so forcing the platform through the
+# config API still wins as long as no device query has happened.
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

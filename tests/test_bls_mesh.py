@@ -1,0 +1,99 @@
+"""Production verifier on a multi-device mesh.
+
+VERDICT r2 #3: the normal `TpuBlsVerifier` path must shard its device
+buckets over a `jax.sharding.Mesh` — the SPMD analog of the reference's
+worker fan-out (chain/bls/multithread/index.ts:183-199) — not just the
+driver's dryrun. conftest forces 8 virtual CPU devices; these tests pin
+an explicit 8-device mesh and assert mixed-validity verdicts through
+the sharded wave pipeline.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from lodestar_tpu import parallel
+from lodestar_tpu.bls import SameMessageSet, SignatureSet, TpuBlsVerifier
+from lodestar_tpu.crypto.bls import signature as sig
+
+
+def _mk_set(sk: int, tag: int, tamper: bool = False) -> SignatureSet:
+    msg = bytes([tag]) + b"\x11" * 31
+    s = sig.sign(sk, msg)
+    if tamper:
+        b = bytearray(s)
+        b[20] ^= 0xFF
+        s = bytes(b)
+    return SignatureSet(sig.sk_to_pk(sk), msg, s)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return parallel.make_mesh(8)
+
+
+def test_bucket_arrays_are_sharded_over_mesh(mesh):
+    """shard_batch places the leading batch axis across all 8 devices."""
+    import jax.numpy as jnp
+
+    arr = parallel.shard_batch(mesh, jnp.zeros((16, 40)))
+    assert len(arr.sharding.device_set) == 8
+
+
+def test_auto_mesh_is_created_with_multiple_devices():
+    v = TpuBlsVerifier()
+    try:
+        assert v._mesh is not None
+        assert v._mesh.devices.size == 8
+    finally:
+        asyncio.run(v.close())
+
+
+def test_mixed_validity_jobs_on_mesh(mesh):
+    """Two concurrent jobs — one fully valid, one with a tampered sig —
+    packed into one sharded wave; retry isolation must fail only the
+    bad job (worker.ts:88-103 semantics, here across chips)."""
+    good = [_mk_set(2000 + i, i) for i in range(8)]
+    bad = [_mk_set(3000 + i, 64 + i, tamper=(i == 3)) for i in range(8)]
+
+    async def go():
+        v = TpuBlsVerifier(mesh=mesh)
+        a, b = await asyncio.gather(
+            v.verify_signature_sets(good),
+            v.verify_signature_sets(bad),
+        )
+        waves = v.metrics.waves
+        await v.close()
+        return a, b, waves
+
+    a, b, waves = asyncio.run(go())
+    assert a is True
+    assert b is False
+    assert waves >= 1
+
+
+def test_same_message_retry_fanout_on_mesh(mesh):
+    """Same-message batch with one invalid pair: the aggregate check
+    fails, the per-signature retry wave must isolate it."""
+    msg = b"\x42" * 32
+    sks = [4000 + i for i in range(8)]
+    pairs = []
+    for i, sk in enumerate(sks):
+        # index 5 carries a VALID G2 point that is the wrong signature
+        # (signed by another key): decompression succeeds, the batch
+        # check fails, and only the per-signature retry can isolate it
+        s = sig.sign(sk + 1 if i == 5 else sk, msg)
+        pairs.append(SameMessageSet(sig.sk_to_pk(sk), s))
+
+    async def go():
+        v = TpuBlsVerifier(mesh=mesh)
+        out = await v.verify_signature_sets_same_message(pairs, msg)
+        retries = v.metrics.same_message_retries
+        await v.close()
+        return out, retries
+
+    out, retries = asyncio.run(go())
+    assert out == [i != 5 for i in range(8)]
+    assert retries == 1

@@ -156,3 +156,50 @@ class TestTpuVerifier:
                 await fut
 
         _run(go())
+
+
+class TestOversizedJobSplitting:
+    def test_job_larger_than_device_bucket_splits_and_verifies(
+        self, monkeypatch
+    ):
+        """A single job above DEVICE_BUCKET_MAX must split across
+        buckets with its verdict AND-ed (a 64-block sync segment
+        carries ~8,000 sets, index.ts:51). Patched bucket cap keeps
+        the CPU test fast."""
+        from lodestar_tpu.bls import verifier as V
+
+        monkeypatch.setattr(V, "DEVICE_BUCKET_MAX", 4)
+        sets = _mk_sets(10)
+
+        async def go():
+            v = V.TpuBlsVerifier()
+            ok = await v.verify_signature_sets(sets)
+            buckets = v.metrics.buckets_dispatched
+            await v.close()
+            return ok, buckets
+
+        ok, buckets = _run(go())
+        assert ok is True
+        assert buckets == 3  # 4 + 4 + 2
+
+    def test_oversized_job_with_bad_set_fails_only_itself(
+        self, monkeypatch
+    ):
+        from lodestar_tpu.bls import verifier as V
+
+        monkeypatch.setattr(V, "DEVICE_BUCKET_MAX", 4)
+        bad = _mk_sets(6, good=False)
+        good = _mk_sets(3, msg_prefix=b"oth")
+
+        async def go():
+            v = V.TpuBlsVerifier()
+            a, b = await asyncio.gather(
+                v.verify_signature_sets(bad),
+                v.verify_signature_sets(good),
+            )
+            await v.close()
+            return a, b
+
+        a, b = _run(go())
+        assert a is False
+        assert b is True

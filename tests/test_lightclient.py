@@ -201,3 +201,71 @@ class TestLightClientFlow:
         tampered.current_sync_committee_branch = branch
         with pytest.raises(LightClientError):
             LightClient(bc, types, tampered, fin_root)
+
+
+class TestLightClientReqResp:
+    """LightClient protocols over reqresp (protocols.ts LightClient*):
+    bootstrap, finality/optimistic updates, updates-by-range."""
+
+    def test_lc_protocols_served(self, types, lc_chain):
+        from lodestar_tpu.network import reqresp as rr
+        from lodestar_tpu.network.wire_types import (
+            LightClientUpdatesByRangeRequest,
+        )
+        from lodestar_tpu.ssz import Root
+        from lodestar_tpu.sync import SyncServer
+
+        cfg, node, server = lc_chain
+        gvr = bytes(
+            node.chain.head_state.state.genesis_validators_root
+        )
+        bc = BeaconConfig(cfg, gvr)
+
+        async def go():
+            tr = rr.InProcessTransport()
+            server_rr = rr.ReqResp("server", tr)
+            client = rr.ReqResp("client", tr)
+            SyncServer(node.chain, bc, types).register(server_rr)
+            ns = types
+
+            fin_root = node.chain.finalized_checkpoint.root
+            chunks = await client.request(
+                "server",
+                rr.PROTOCOL_LC_BOOTSTRAP,
+                Root.serialize(fin_root),
+            )
+            boot = ns.LightClientBootstrap.deserialize(chunks[0].payload)
+            want = server.get_bootstrap(fin_root)
+            assert ns.LightClientBootstrap.serialize(
+                boot
+            ) == ns.LightClientBootstrap.serialize(want)
+
+            chunks = await client.request(
+                "server", rr.PROTOCOL_LC_FINALITY_UPDATE, b""
+            )
+            fu = ns.LightClientFinalityUpdate.deserialize(
+                chunks[0].payload
+            )
+            assert int(fu.attested_header.beacon.slot) > 0
+
+            chunks = await client.request(
+                "server", rr.PROTOCOL_LC_OPTIMISTIC_UPDATE, b""
+            )
+            ou = ns.LightClientOptimisticUpdate.deserialize(
+                chunks[0].payload
+            )
+            assert int(ou.attested_header.beacon.slot) > 0
+
+            req = LightClientUpdatesByRangeRequest(
+                start_period=0, count=8
+            )
+            chunks = await client.request(
+                "server",
+                rr.PROTOCOL_LC_UPDATES_BY_RANGE,
+                LightClientUpdatesByRangeRequest.serialize(req),
+            )
+            assert len(chunks) == len(server.best_update_by_period)
+            upd = ns.LightClientUpdate.deserialize(chunks[0].payload)
+            assert int(upd.attested_header.beacon.slot) >= 0
+
+        asyncio.run(go())
