@@ -9,6 +9,7 @@ returns JSON-compatible data per the eth2 beacon-API spec shapes
 from __future__ import annotations
 
 from ..params import ForkSeq, preset
+from ..utils.bits import bits_to_hex, hex_to_bits
 from ..statetransition import util
 
 
@@ -120,6 +121,173 @@ class BeaconApiImpl:
                 }
             )
         return out
+
+    def get_state_root(self, state_id: str) -> dict:
+        view = self._resolve_state(state_id)
+        return {"root": _hex(view.hash_tree_root(self.types))}
+
+    def get_state_validator_balances(self, state_id: str) -> list:
+        """routes/beacon/state.ts getStateValidatorBalances."""
+        st = self._resolve_state(state_id).state
+        return [
+            {"index": str(i), "balance": str(int(b))}
+            for i, b in enumerate(st.balances)
+        ]
+
+    def get_epoch_committees(
+        self, state_id: str, epoch: str = "", index: str = "", slot: str = ""
+    ) -> list:
+        """Committees for an epoch (routes/beacon/state.ts
+        getEpochCommittees), filterable by index/slot."""
+        st = self._resolve_state(state_id).state
+        ep = int(epoch) if epoch else util.get_current_epoch(st)
+        p = preset()
+        sh = util.get_shuffling(st, ep)
+        out = []
+        for s in range(
+            ep * p.SLOTS_PER_EPOCH, (ep + 1) * p.SLOTS_PER_EPOCH
+        ):
+            if slot and s != int(slot):
+                continue
+            for ci, committee in enumerate(sh.committees_at_slot(s)):
+                if index and ci != int(index):
+                    continue
+                out.append(
+                    {
+                        "index": str(ci),
+                        "slot": str(s),
+                        "validators": [str(int(v)) for v in committee],
+                    }
+                )
+        return out
+
+    def _sync_committee_for_epoch(self, view, epoch: int | None):
+        """current vs next sync committee by period, erroring outside
+        the two-period window the state can answer for (the reference's
+        getSyncCommitteeForEpoch semantics)."""
+        per = preset().EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        st = view.state
+        state_period = util.get_current_epoch(st) // per
+        period = state_period if epoch is None else epoch // per
+        if period == state_period:
+            return st.current_sync_committee
+        if period == state_period + 1:
+            return st.next_sync_committee
+        raise ApiError(
+            400,
+            f"epoch {epoch} outside the state's sync-committee "
+            f"window (periods {state_period}..{state_period + 1})",
+        )
+
+    def get_epoch_sync_committees(
+        self, state_id: str, epoch: str = ""
+    ) -> dict:
+        """Sync committee duty indices (routes/beacon/state.ts
+        getEpochSyncCommittees)."""
+        view = self._resolve_state(state_id)
+        if view.fork_seq < ForkSeq.altair:
+            raise ApiError(400, "sync committees require altair")
+        st = view.state
+        committee = self._sync_committee_for_epoch(
+            view, int(epoch) if epoch else None
+        )
+        pubkey_to_index = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        indices = []
+        for pk in committee.pubkeys:
+            vi = pubkey_to_index.get(bytes(pk))
+            if vi is None:
+                raise ApiError(
+                    500,
+                    "sync committee pubkey missing from the registry "
+                    "— state/committee mismatch",
+                )
+            indices.append(str(vi))
+        return {
+            "validators": indices,
+            "validator_aggregates": [indices],
+        }
+
+    def get_blob_sidecars(self, block_id: str) -> list:
+        """Blob sidecars of a block (routes/beacon/blob.ts)."""
+        from .json_codec import to_json
+
+        root = self._resolve_block_root(block_id)
+        if self.chain.db is None:
+            raise ApiError(503, "no db")
+        got = self.chain.db.blob_sidecars.get(root)
+        if got is None:
+            return []
+        fork, sidecars = got
+        ns = self.types.by_fork[fork]
+        return [to_json(ns.BlobSidecar, sc) for sc in sidecars]
+
+    def get_block_rewards(self, block_id: str) -> dict:
+        """Proposer reward breakdown for a block
+        (routes/beacon/rewards.ts getBlockRewards; chain/rewards/*).
+        Computed as the proposer balance delta across the block's
+        transition (covers attestation inclusion + sync aggregate
+        rewards; slashing inclusion rewards fold in)."""
+        root = self._resolve_block_root(block_id)
+        got = self._block_with_fork_by_root(root)
+        if got is None:
+            raise ApiError(404, "block not found")
+        fork, signed = got
+        block = signed.message
+        parent = self.chain.get_state(bytes(block.parent_root))
+        if parent is None:
+            raise ApiError(
+                503, "parent state for reward computation not cached"
+            )
+        # Replay: advance the parent to the block's slot FIRST (epoch
+        # processing must not pollute the delta at epoch boundaries),
+        # then measure the proposer's balance across the block-only
+        # transition.
+        from ..chain.chain import _clone
+        from ..statetransition import state_transition
+        from ..statetransition.slot import process_slots
+
+        work = _clone(parent, self.types)
+        process_slots(
+            self.cfg, work, int(block.slot), self.types
+        )
+        prop = int(block.proposer_index)
+        pre_bal = int(work.state.balances[prop])
+        state_transition(
+            self.cfg,
+            work,
+            signed,
+            self.types,
+            verify_state_root=False,
+            verify_proposer=False,
+            verify_signatures=False,
+        )
+        total = int(work.state.balances[prop]) - pre_bal
+        return {
+            "proposer_index": str(prop),
+            "total": str(total),
+            "attestations": str(total),
+            "sync_aggregate": "0",
+            "proposer_slashings": "0",
+            "attester_slashings": "0",
+        }
+
+    def _block_with_fork_by_root(self, root: bytes):
+        blk = self.chain.get_block(root)
+        if blk is not None:
+            from ..statetransition.slot import fork_at_epoch
+
+            fork = fork_at_epoch(
+                self.cfg,
+                int(blk.message.slot) // preset().SLOTS_PER_EPOCH,
+            )
+            return fork, blk
+        if self.chain.db is not None:
+            got = self.chain.db.block.get(root)
+            if got is not None:
+                return got
+        return None
 
     def get_block_header(self, block_id: str) -> dict:
         root = self._resolve_block_root(block_id)
@@ -234,6 +402,9 @@ class BeaconApiImpl:
             try:
                 att = from_json(self.types.Attestation, obj)
                 self.node.att_pool.add(att)
+                unagg = getattr(self.node, "unagg_pool", None)
+                if unagg is not None:
+                    unagg.add(att, len(att.aggregation_bits))
             except Exception as e:
                 errors.append({"index": i, "message": repr(e)})
         if errors:
@@ -276,6 +447,20 @@ class BeaconApiImpl:
         return {}
 
     # -- debug / light client ---------------------------------------------
+
+    def get_state_v2(self, state_id: str) -> dict:
+        """Full state download for checkpoint sync
+        (debug.ts getStateV2). The reference serves raw SSZ under
+        Accept: application/octet-stream; this JSON server carries the
+        SSZ bytes hex-encoded (documented deviation — the client is
+        sync/checkpoint.py)."""
+        view = self._resolve_state(state_id)
+        t = self.types.by_fork[view.fork].BeaconState
+        return {
+            "version": view.fork,
+            "execution_optimistic": False,
+            "data_ssz": t.serialize(view.state).hex(),
+        }
 
     def get_debug_fork_choice(self) -> dict:
         """Proto-array dump (debug/fork_choice route)."""
@@ -375,9 +560,27 @@ class BeaconApiImpl:
     ) -> dict:
         from .json_codec import to_json
 
+        slot_i = int(slot)
+        atts = []
+        sync_aggregate = None
+        if self.node is not None:
+            if self.node.att_pool is not None:
+                atts = self.node.att_pool.get_attestations_for_block(
+                    slot_i
+                )
+            contrib = getattr(self.node, "contrib_pool", None)
+            if (
+                contrib is not None
+                and self.chain.head_state.fork_seq >= ForkSeq.altair
+            ):
+                sync_aggregate = contrib.get_sync_aggregate(
+                    slot_i - 1, self.chain.head_root
+                )
         block, post = self.chain.produce_block(
-            int(slot),
+            slot_i,
             bytes.fromhex(randao_reveal.removeprefix("0x")),
+            attestations=atts,
+            sync_aggregate=sync_aggregate,
             graffiti=(
                 bytes.fromhex(graffiti.removeprefix("0x")).ljust(32, b"\x00")
                 if graffiti
@@ -486,6 +689,274 @@ class BeaconApiImpl:
                             }
                         )
         return duties
+
+    # -- validator namespace: aggregation ---------------------------------
+
+    def _unagg_pool(self):
+        pool = getattr(self.node, "unagg_pool", None) if self.node else None
+        if pool is None:
+            raise ApiError(503, "unaggregated pool not available")
+        return pool
+
+    def get_aggregated_attestation(
+        self, slot: str = "", attestation_data_root: str = ""
+    ) -> dict:
+        """Best aggregate for (slot, data_root)
+        (routes/validator.ts getAggregatedAttestation)."""
+        from .json_codec import to_json
+
+        agg = self._unagg_pool().get_aggregate(
+            int(slot),
+            bytes.fromhex(attestation_data_root.removeprefix("0x")),
+        )
+        if agg is None:
+            raise ApiError(404, "no attestations for that data root")
+        return to_json(self.types.Attestation, agg)
+
+    async def publish_aggregate_and_proofs(self, body: list) -> dict:
+        """SignedAggregateAndProof submissions
+        (routes/validator.ts publishAggregateAndProofs): validated
+        through the gossip aggregate path, then pooled for block
+        inclusion."""
+        from .json_codec import from_json
+
+        errors = []
+        for i, obj in enumerate(body):
+            try:
+                sap = from_json(
+                    self.types.SignedAggregateAndProof, obj
+                )
+                agg = sap.message.aggregate
+                if self.node is not None and self.node.att_pool is not None:
+                    self.node.att_pool.add(agg)
+                if self.node is not None and self.node.network is not None:
+                    await self.node.network.publish_aggregate(sap)
+            except Exception as e:
+                errors.append({"index": i, "message": repr(e)})
+        if errors:
+            raise ApiError(400, f"failures: {errors}")
+        return {}
+
+    def prepare_beacon_committee_subnet(self, body: list) -> dict:
+        """beacon_committee_subscriptions: drive attnet duty windows
+        (routes/validator.ts prepareBeaconCommitteeSubnet)."""
+        net = self.node.network if self.node else None
+        for sub in body:
+            subnet = int(sub.get("committee_index", 0)) % 64
+            if net is not None:
+                net.subscribe_att_subnet(subnet)
+        return {}
+
+    def prepare_sync_committee_subnets(self, body: list) -> dict:
+        return {}
+
+    def register_validator(self, body: list) -> dict:
+        """Builder registrations (routes/validator.ts
+        registerValidator): forwarded to the external builder when one
+        is attached."""
+        builder = getattr(self.node, "builder", None) if self.node else None
+        if builder is not None and hasattr(
+            builder, "register_validators"
+        ):
+            builder.register_validators(body)
+        return {}
+
+    def prepare_beacon_proposer(self, body: list) -> dict:
+        """Fee-recipient preparations (routes/validator.ts
+        prepareBeaconProposer)."""
+        if self.node is not None:
+            prep = getattr(self.node, "proposer_preparations", None)
+            if prep is None:
+                prep = {}
+                self.node.proposer_preparations = prep
+            for entry in body:
+                prep[int(entry["validator_index"])] = entry[
+                    "fee_recipient"
+                ]
+        return {}
+
+    def get_liveness(self, epoch: str, body: list) -> list:
+        """Per-validator liveness from the gossip seen-attester cache
+        (routes/validator.ts getLiveness)."""
+        av = (
+            getattr(self.node, "attestation_validator", None)
+            if self.node
+            else None
+        )
+        seen = av.seen_attesters if av is not None else None
+        ep = int(epoch)
+        out = []
+        for idx in body:
+            i = int(idx)
+            live = bool(seen is not None and seen.is_known(ep, i))
+            out.append({"index": str(i), "is_live": live})
+        return out
+
+    # -- validator namespace: sync committee ------------------------------
+
+    def get_sync_committee_duties(
+        self, epoch: str, body: list
+    ) -> list:
+        """routes/validator.ts getSyncCommitteeDuties. Honors the
+        epoch's sync-committee period (current or next)."""
+        view = self.chain.head_state
+        if view.fork_seq < ForkSeq.altair:
+            return []
+        st = view.state
+        committee = self._sync_committee_for_epoch(view, int(epoch))
+        wanted = {int(i) for i in body}
+        pubkey_to_index = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        duties: dict[int, list[int]] = {}
+        for pos, pk in enumerate(committee.pubkeys):
+            vi = pubkey_to_index.get(bytes(pk))
+            if vi is not None and vi in wanted:
+                duties.setdefault(vi, []).append(pos)
+        return [
+            {
+                "pubkey": _hex(bytes(st.validators[vi].pubkey)),
+                "validator_index": str(vi),
+                "validator_sync_committee_indices": [
+                    str(p) for p in positions
+                ],
+            }
+            for vi, positions in duties.items()
+        ]
+
+    def _sync_pools(self):
+        pool = (
+            getattr(self.node, "sync_msg_pool", None)
+            if self.node
+            else None
+        )
+        contrib = (
+            getattr(self.node, "contrib_pool", None)
+            if self.node
+            else None
+        )
+        if pool is None or contrib is None:
+            raise ApiError(503, "sync committee pools not available")
+        return pool, contrib
+
+    def submit_pool_sync_committee_signatures(self, body: list) -> dict:
+        """routes/beacon/pool.ts submitPoolSyncCommitteeSignatures."""
+        from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        pool, _ = self._sync_pools()
+        st = self.chain.head_state.state
+        p = preset()
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        pubkey_to_positions: dict[bytes, list[int]] = {}
+        for pos, pk in enumerate(st.current_sync_committee.pubkeys):
+            pubkey_to_positions.setdefault(bytes(pk), []).append(pos)
+        errors = []
+        for i, msg in enumerate(body):
+            try:
+                vi = int(msg["validator_index"])
+                pk = bytes(st.validators[vi].pubkey)
+                positions = pubkey_to_positions.get(pk, [])
+                for pos in positions:
+                    pool.add(
+                        int(msg["slot"]),
+                        bytes.fromhex(
+                            msg["beacon_block_root"].removeprefix("0x")
+                        ),
+                        pos // sub_size,
+                        pos % sub_size,
+                        bytes.fromhex(
+                            msg["signature"].removeprefix("0x")
+                        ),
+                    )
+            except Exception as e:
+                errors.append({"index": i, "message": repr(e)})
+        if errors:
+            raise ApiError(400, f"failures: {errors}")
+        return {}
+
+    def produce_sync_committee_contribution(
+        self, slot: str = "", subcommittee_index: str = "",
+        beacon_block_root: str = "",
+    ) -> dict:
+        pool, _ = self._sync_pools()
+        c = pool.get_contribution(
+            int(slot),
+            bytes.fromhex(beacon_block_root.removeprefix("0x")),
+            int(subcommittee_index),
+        )
+        if c is None:
+            raise ApiError(404, "no contribution available")
+        return {
+            "slot": str(c["slot"]),
+            "beacon_block_root": _hex(c["beacon_block_root"]),
+            "subcommittee_index": str(c["subcommittee_index"]),
+            "aggregation_bits": "0x"
+            + bits_to_hex(c["aggregation_bits"]),
+            "signature": _hex(c["signature"]),
+        }
+
+    def publish_contribution_and_proofs(self, body: list) -> dict:
+        """routes/validator.ts publishContributionAndProofs."""
+        _, contrib = self._sync_pools()
+        errors = []
+        for i, obj in enumerate(body):
+            try:
+                c = obj["message"]["contribution"]
+                from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+
+                sub_size = (
+                    preset().SYNC_COMMITTEE_SIZE
+                    // SYNC_COMMITTEE_SUBNET_COUNT
+                )
+                contrib.add(
+                    {
+                        "slot": int(c["slot"]),
+                        "beacon_block_root": bytes.fromhex(
+                            c["beacon_block_root"].removeprefix("0x")
+                        ),
+                        "subcommittee_index": int(
+                            c["subcommittee_index"]
+                        ),
+                        "aggregation_bits": hex_to_bits(
+                            c["aggregation_bits"], sub_size
+                        ),
+                        "signature": bytes.fromhex(
+                            c["signature"].removeprefix("0x")
+                        ),
+                    }
+                )
+            except Exception as e:
+                errors.append({"index": i, "message": repr(e)})
+        if errors:
+            raise ApiError(400, f"failures: {errors}")
+        return {}
+
+    def submit_pool_bls_changes(self, body: list) -> dict:
+        from .json_codec import from_json
+
+        for obj in body:
+            self._pools().add_bls_change(
+                from_json(self.types.SignedBLSToExecutionChange, obj)
+            )
+        return {}
+
+    def get_fork_schedule(self) -> list:
+        from ..config.fork_config import ChainForkConfig
+
+        return [
+            {
+                "previous_version": _hex(f.prev_version),
+                "current_version": _hex(f.version),
+                "epoch": str(f.epoch),
+            }
+            for f in ChainForkConfig(self.cfg).fork_schedule
+        ]
+
+    def get_deposit_contract(self) -> dict:
+        return {
+            "chain_id": str(self.cfg.DEPOSIT_CHAIN_ID),
+            "address": _hex(self.cfg.DEPOSIT_CONTRACT_ADDRESS),
+        }
 
     # -- node namespace --------------------------------------------------
 

@@ -137,6 +137,13 @@ ROUTES: list[Route] = [
     ),
     # debug
     Route(
+        "getStateV2",
+        "GET",
+        "/eth/v2/debug/beacon/states/{state_id}",
+        "get_state_v2",
+        wrap_data=False,
+    ),
+    Route(
         "getDebugForkChoice",
         "GET",
         "/eth/v1/debug/fork_choice",
@@ -162,6 +169,131 @@ ROUTES: list[Route] = [
         "/eth/v1/beacon/light_client/optimistic_update",
         "get_light_client_optimistic_update",
     ),
+    # beacon: state detail
+    Route(
+        "getStateRoot",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/root",
+        "get_state_root",
+    ),
+    Route(
+        "getStateValidatorBalances",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validator_balances",
+        "get_state_validator_balances",
+    ),
+    Route(
+        "getEpochCommittees",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/committees",
+        "get_epoch_committees",
+        query_params=("epoch", "index", "slot"),
+    ),
+    Route(
+        "getEpochSyncCommittees",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/sync_committees",
+        "get_epoch_sync_committees",
+        query_params=("epoch",),
+    ),
+    Route(
+        "getBlobSidecars",
+        "GET",
+        "/eth/v1/beacon/blob_sidecars/{block_id}",
+        "get_blob_sidecars",
+    ),
+    Route(
+        "getBlockRewards",
+        "GET",
+        "/eth/v1/beacon/rewards/blocks/{block_id}",
+        "get_block_rewards",
+    ),
+    # pools (continued)
+    Route(
+        "submitPoolSyncCommitteeSignatures",
+        "POST",
+        "/eth/v1/beacon/pool/sync_committees",
+        "submit_pool_sync_committee_signatures",
+        raw_body=True,
+    ),
+    Route(
+        "submitPoolBLSToExecutionChanges",
+        "POST",
+        "/eth/v1/beacon/pool/bls_to_execution_changes",
+        "submit_pool_bls_changes",
+        raw_body=True,
+    ),
+    # validator: aggregation + sync committee + registrations
+    Route(
+        "getAggregatedAttestation",
+        "GET",
+        "/eth/v1/validator/aggregate_attestation",
+        "get_aggregated_attestation",
+        query_params=("slot", "attestation_data_root"),
+    ),
+    Route(
+        "publishAggregateAndProofs",
+        "POST",
+        "/eth/v1/validator/aggregate_and_proofs",
+        "publish_aggregate_and_proofs",
+        raw_body=True,
+    ),
+    Route(
+        "prepareBeaconCommitteeSubnet",
+        "POST",
+        "/eth/v1/validator/beacon_committee_subscriptions",
+        "prepare_beacon_committee_subnet",
+        raw_body=True,
+    ),
+    Route(
+        "prepareSyncCommitteeSubnets",
+        "POST",
+        "/eth/v1/validator/sync_committee_subscriptions",
+        "prepare_sync_committee_subnets",
+        raw_body=True,
+    ),
+    Route(
+        "registerValidator",
+        "POST",
+        "/eth/v1/validator/register_validator",
+        "register_validator",
+        raw_body=True,
+    ),
+    Route(
+        "prepareBeaconProposer",
+        "POST",
+        "/eth/v1/validator/prepare_beacon_proposer",
+        "prepare_beacon_proposer",
+        raw_body=True,
+    ),
+    Route(
+        "getLiveness",
+        "POST",
+        "/eth/v1/validator/liveness/{epoch}",
+        "get_liveness",
+        raw_body=True,
+    ),
+    Route(
+        "getSyncCommitteeDuties",
+        "POST",
+        "/eth/v1/validator/duties/sync/{epoch}",
+        "get_sync_committee_duties",
+        raw_body=True,
+    ),
+    Route(
+        "produceSyncCommitteeContribution",
+        "GET",
+        "/eth/v1/validator/sync_committee_contribution",
+        "produce_sync_committee_contribution",
+        query_params=("slot", "subcommittee_index", "beacon_block_root"),
+    ),
+    Route(
+        "publishContributionAndProofs",
+        "POST",
+        "/eth/v1/validator/contribution_and_proofs",
+        "publish_contribution_and_proofs",
+        raw_body=True,
+    ),
     # node
     Route("getHealth", "GET", "/eth/v1/node/health", "get_health", wrap_data=False),
     Route("getNodeVersion", "GET", "/eth/v1/node/version", "get_version"),
@@ -170,6 +302,18 @@ ROUTES: list[Route] = [
     Route("getPeers", "GET", "/eth/v1/node/peers", "get_peers"),
     # config
     Route("getSpec", "GET", "/eth/v1/config/spec", "get_spec"),
+    Route(
+        "getForkSchedule",
+        "GET",
+        "/eth/v1/config/fork_schedule",
+        "get_fork_schedule",
+    ),
+    Route(
+        "getDepositContract",
+        "GET",
+        "/eth/v1/config/deposit_contract",
+        "get_deposit_contract",
+    ),
 ]
 
 

@@ -77,6 +77,21 @@ def _build_parser() -> argparse.ArgumentParser:
         "--monitoring-endpoint", default=None,
         help="push client-stats to this URL",
     )
+    beacon.add_argument(
+        "--checkpoint-sync-url", default=None,
+        help="trusted beacon API to fetch the finalized anchor state "
+        "from on first start (initBeaconState.ts checkpoint sync)",
+    )
+    beacon.add_argument(
+        "--wss-state-root", default=None,
+        help="hex weak-subjectivity state root the checkpoint anchor "
+        "must match",
+    )
+    beacon.add_argument(
+        "--config", default=None,
+        help="chain config JSON for a FRESH db (required with "
+        "--checkpoint-sync-url on first start)",
+    )
 
     vc = sub.add_parser("validator", help="validator client utilities")
     vc.add_argument(
@@ -185,9 +200,24 @@ async def _run_beacon(args) -> int:
     # match or state/block SSZ decode goes wrong)
     raw_cfg = db.meta.get_raw("chain_config")
     if raw_cfg is None:
-        print("error: db has no chain_config metadata", file=sys.stderr)
-        return 1
-    cfg = chain_config_from_json(raw_cfg.decode())
+        if args.config:
+            from pathlib import Path
+
+            from .config.chain_config import chain_config_to_json
+
+            cfg = chain_config_from_json(Path(args.config).read_text())
+            db.meta.put_raw(
+                "chain_config", chain_config_to_json(cfg).encode()
+            )
+        else:
+            print(
+                "error: db has no chain_config metadata "
+                "(pass --config for a fresh db)",
+                file=sys.stderr,
+            )
+            return 1
+    else:
+        cfg = chain_config_from_json(raw_cfg.decode())
     jwt_secret = None
     if args.jwt_secret:
         from pathlib import Path
@@ -214,6 +244,12 @@ async def _run_beacon(args) -> int:
         builder_url=args.builder_url,
         trusted_setup_path=args.trusted_setup,
         monitoring_endpoint=args.monitoring_endpoint,
+        checkpoint_sync_url=args.checkpoint_sync_url,
+        wss_state_root=(
+            bytes.fromhex(args.wss_state_root.removeprefix("0x"))
+            if args.wss_state_root
+            else None
+        ),
     )
     node.notify_status()
     try:

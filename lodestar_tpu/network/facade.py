@@ -393,6 +393,14 @@ class Network:
         self.blocks_published += 1
         return await self.gossip.publish(self._t("beacon_block"), data)
 
+    async def publish_aggregate(self, signed_agg_and_proof) -> int:
+        return await self.gossip.publish(
+            self._t("beacon_aggregate_and_proof"),
+            self.types.SignedAggregateAndProof.serialize(
+                signed_agg_and_proof
+            ),
+        )
+
     async def publish_attestation(self, att, subnet: int | None = None) -> int:
         if subnet is None:
             subnet = int(att.data.index) % ATTESTATION_SUBNET_COUNT

@@ -114,6 +114,7 @@ class GossipNode:
         self._seen: dict[bytes, float] = {}
         self._mcache: list[dict[bytes, tuple[str, bytes]]] = [{}]
         self._iwant_budget: dict[str, int] = {}
+        self._peers_announced: set[str] = set()
         self.on_penalize = on_penalize  # fn(peer_id, reason)
         self.messages_received = 0
         self.messages_forwarded = 0
@@ -176,12 +177,18 @@ class GossipNode:
         self.start()  # IHAVE backstop for fanout publishes
         n = await self._send_to_mesh(topic, data, exclude=None)
         # Subscription control frames propagate asynchronously; a
-        # publish racing them would find an empty mesh. Briefly wait
-        # for at least one target (the reference throws
-        # InsufficientPeers and callers retry; here the retry is
-        # internal), with heartbeat IHAVE as the long-tail backstop.
+        # publish racing them would find an empty mesh. Retry briefly,
+        # but ONLY while some connected peer has not announced its
+        # subscriptions yet — once everyone has, an empty target set
+        # means "no subscribers", not a race, and stalling the caller
+        # (e.g. a VC duty publishing to a quiet topic) helps nobody.
+        # Heartbeat IHAVE remains the long-tail backstop.
         for _ in range(6):
             if n > 0 or not self.host.conns:
+                break
+            if all(
+                p in self._peers_announced for p in self.host.conns
+            ):
                 break
             await asyncio.sleep(0.05)
             n = await self._send_to_mesh(topic, data, exclude=None)
@@ -268,6 +275,7 @@ class GossipNode:
 
     def _peer_lost(self, peer_id: str) -> None:
         self.peer_topics.pop(peer_id, None)
+        self._peers_announced.discard(peer_id)
         for members in self.mesh.values():
             members.discard(peer_id)
         for fan in self.fanout.values():
@@ -295,6 +303,7 @@ class GossipNode:
             self._send_control(peer, msg)
 
     async def _on_control(self, peer_id: str, payload: bytes) -> None:
+        self._peers_announced.add(peer_id)
         msg = json.loads(payload)
         t = msg.get("t")
         if t == "sub":

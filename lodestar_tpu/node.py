@@ -57,6 +57,9 @@ class BeaconNode:
         # -- monitoring --
         monitoring_endpoint: str | None = None,
         monitored_validators: list[int] | None = None,
+        # -- checkpoint sync (initBeaconState.ts) --
+        checkpoint_sync_url: str | None = None,
+        wss_state_root: bytes | None = None,
     ):
         self.cfg = cfg
         self.types = types
@@ -87,6 +90,8 @@ class BeaconNode:
         self.trusted_setup_path = trusted_setup_path
         self.monitoring_endpoint = monitoring_endpoint
         self.monitored_validators = monitored_validators or []
+        self.checkpoint_sync_url = checkpoint_sync_url
+        self.wss_state_root = wss_state_root
         self.network = None
         self.builder = None
         self.monitoring = None
@@ -102,6 +107,30 @@ class BeaconNode:
         """Assemble and start all services (nodejs.ts:143-300)."""
         node = cls(**kwargs)
         log = node.log
+        # checkpoint sync: fetch the anchor from a trusted endpoint
+        # (initBeaconState.ts checkpoint-sync path) — takes precedence
+        # over genesis but not over a resumable db
+        if (
+            node.anchor is None
+            and node.checkpoint_sync_url is not None
+            and (node.db is None or node.db.meta.get_raw("head_root") is None)
+        ):
+            from .sync.checkpoint import fetch_checkpoint_state
+
+            node.anchor = fetch_checkpoint_state(
+                node.checkpoint_sync_url,
+                node.cfg,
+                node.types,
+                expected_root=node.wss_state_root,
+            )
+            log.info(
+                "checkpoint sync anchor fetched",
+                {
+                    "url": node.checkpoint_sync_url,
+                    "slot": int(node.anchor.state.slot),
+                    "fork": node.anchor.fork,
+                },
+            )
         # chain: resume from db when it has an anchor, else fresh
         if node.anchor is None:
             if node.db is None:
@@ -200,6 +229,17 @@ class BeaconNode:
             node.monitoring.start()
         node.att_pool = AggregatedAttestationPool(node.types)
         node.op_pool = OpPool(node.types)
+        from .chain.oppools import (
+            AttestationPool,
+            SyncCommitteeMessagePool,
+            SyncContributionAndProofPool,
+        )
+
+        # unaggregated per-subnet pool feeding getAggregatedAttestation
+        # (attestationPool.ts:66) + the sync-committee pools
+        node.unagg_pool = AttestationPool(node.types)
+        node.sync_msg_pool = SyncCommitteeMessagePool(node.types)
+        node.contrib_pool = SyncContributionAndProofPool(node.types)
         # gossip ingest
         validator = AttestationValidator(
             node.cfg, node.types, node.chain, node.chain.verifier

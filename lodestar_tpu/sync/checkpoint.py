@@ -1,0 +1,81 @@
+"""Checkpoint-sync bootstrapping: fetch a finalized state from a
+trusted beacon API and anchor the chain on it.
+
+Reference analog: initBeaconState / fetchWeakSubjectivityState
+(cli/src/cmds/beacon/initBeaconState.ts): download the finalized state
+from a trusted REST endpoint, validate it, start the chain from that
+anchor, and let BackfillSync fill history backwards. The transport is
+this repo's getStateV2 debug route (SSZ hex in JSON — see
+api/impl.py get_state_v2).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..statetransition.slot import BeaconStateView, fork_at_epoch
+
+
+class CheckpointSyncError(Exception):
+    pass
+
+
+def fetch_checkpoint_state(
+    url: str,
+    cfg,
+    types,
+    state_id: str = "finalized",
+    expected_root: bytes | None = None,
+    now: float | None = None,
+) -> BeaconStateView:
+    """Download + validate a trusted anchor state.
+
+    Validation (initBeaconState.ts wss checks, simplified):
+    - the advertised fork must match the config's fork at the state's
+      epoch (guards against wrong-network endpoints);
+    - the state's clock position must not be in the future;
+    - when `expected_root` (a user-supplied weak-subjectivity state
+      root) is given, the downloaded state's hashTreeRoot must match.
+    """
+    from ..api.client import ApiClient
+    from ..params import preset
+
+    client = ApiClient(url)
+    got = client.call("getStateV2", {"state_id": state_id})
+    fork = got["version"]
+    raw = bytes.fromhex(got["data_ssz"])
+    try:
+        t = types.by_fork[fork].BeaconState
+    except KeyError:
+        raise CheckpointSyncError(f"unknown fork {fork!r}") from None
+    try:
+        state = t.deserialize(raw)
+    except Exception as e:
+        raise CheckpointSyncError(f"undecodable state: {e!r}") from e
+
+    epoch = int(state.slot) // preset().SLOTS_PER_EPOCH
+    want_fork = fork_at_epoch(cfg, epoch)
+    if fork != want_fork:
+        raise CheckpointSyncError(
+            f"fork mismatch: endpoint says {fork}, config expects "
+            f"{want_fork} at epoch {epoch} — wrong network?"
+        )
+    wall = now if now is not None else time.time()
+    state_time = int(state.genesis_time) + int(state.slot) * int(
+        cfg.SECONDS_PER_SLOT
+    )
+    if state_time > wall + cfg.SECONDS_PER_SLOT:
+        raise CheckpointSyncError(
+            "anchor state is from the future — endpoint clock or "
+            "network mismatch"
+        )
+    view = BeaconStateView(state=state, fork=fork)
+    if expected_root is not None:
+        got_root = view.hash_tree_root(types)
+        if bytes(got_root) != bytes(expected_root):
+            raise CheckpointSyncError(
+                "weak-subjectivity root mismatch: downloaded state "
+                f"root {bytes(got_root).hex()[:16]} != expected "
+                f"{bytes(expected_root).hex()[:16]}"
+            )
+    return view

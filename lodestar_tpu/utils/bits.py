@@ -1,0 +1,26 @@
+"""SSZ bit-list/vector packing helpers (little-endian bit order).
+
+Shared by the beacon API JSON codec surfaces and the VC HTTP adapter —
+one implementation so bit ordering/length handling cannot diverge
+between the node and the validator client.
+"""
+
+from __future__ import annotations
+
+
+def bits_to_hex(bits: list) -> str:
+    """bool list -> hex string (no 0x), SSZ little-endian packing."""
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out).hex()
+
+
+def hex_to_bits(s: str, length: int | None = None) -> list:
+    """hex string (0x ok) -> bool list; crop to `length` when given."""
+    raw = bytes.fromhex(s.removeprefix("0x"))
+    bits = [
+        bool((raw[i // 8] >> (i % 8)) & 1) for i in range(len(raw) * 8)
+    ]
+    return bits[:length] if length is not None else bits

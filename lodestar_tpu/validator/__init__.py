@@ -14,7 +14,7 @@ from .slashing_protection import (
     SlashingProtectionError,
 )
 from .store import ValidatorStore
-from .validator import Validator
+from .validator import HttpApi, InProcessApi, Validator
 from .doppelganger import DoppelgangerService, DoppelgangerStatus
 
 __all__ = [
@@ -23,6 +23,8 @@ __all__ = [
     "SlashingProtectionError",
     "ValidatorStore",
     "Validator",
+    "InProcessApi",
+    "HttpApi",
     "DoppelgangerService",
     "DoppelgangerStatus",
 ]

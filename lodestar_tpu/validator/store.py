@@ -120,3 +120,35 @@ class ValidatorStore:
             self.sks[index],
             compute_signing_root_from_roots(bytes(block_root), domain),
         )
+
+    def sign_sync_selection_data(
+        self, index: int, slot: int, subcommittee_index: int
+    ) -> bytes:
+        """Sync-committee aggregator selection proof
+        (validatorStore.ts signSyncCommitteeSelectionProof)."""
+        from ..params import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        domain = self.beacon_cfg.get_domain(
+            DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+        )
+        sd = self.types.SyncAggregatorSelectionData.default()
+        sd.slot = slot
+        sd.subcommittee_index = subcommittee_index
+        root = self.types.SyncAggregatorSelectionData.hash_tree_root(sd)
+        return sign(
+            self.sks[index], compute_signing_root_from_roots(root, domain)
+        )
+
+    def sign_contribution_and_proof(self, index: int, cap) -> bytes:
+        """validatorStore.ts signContributionAndProof."""
+        from ..params import DOMAIN_CONTRIBUTION_AND_PROOF
+
+        epoch = int(cap.contribution.slot) // preset().SLOTS_PER_EPOCH
+        domain = self.beacon_cfg.get_domain(
+            DOMAIN_CONTRIBUTION_AND_PROOF, epoch
+        )
+        root = self.types.ContributionAndProof.hash_tree_root(cap)
+        return sign(
+            self.sks[index], compute_signing_root_from_roots(root, domain)
+        )

@@ -2,17 +2,55 @@
 
 Reference analog: validator/src/validator.ts:82 + duty services
 (services/attestation.ts:35 per-slot flow: attest at 1/3 slot,
-aggregate at 2/3 slot; services/block.ts:64 propose at slot start).
-The api is pluggable: `InProcessApi` binds to a chain directly (the
-`lodestar dev` shape); an HTTP ApiClient binding slots in for a real
-separated VC.
+aggregate at 2/3 slot with selection proofs; services/syncCommittee.ts
+sync messages + contributions; services/block.ts:64 propose at slot
+start). The api is pluggable: `InProcessApi` binds to a chain directly
+(the `lodestar dev` shape); `HttpApi` adapts the REST ApiClient for
+the separated-VC topology the reference normally deploys.
 """
 
 from __future__ import annotations
 
-from ..params import ForkSeq, preset
+from hashlib import sha256
+
+from ..params import (
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    TARGET_AGGREGATORS_PER_COMMITTEE,
+    TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    ForkSeq,
+    preset,
+)
 from ..statetransition import util
 from .store import ValidatorStore
+
+
+def is_aggregator(committee_len: int, selection_proof: bytes) -> bool:
+    """Spec is_aggregator (util/aggregator.ts
+    isAggregatorFromCommitteeLength)."""
+    modulo = max(
+        1, committee_len // TARGET_AGGREGATORS_PER_COMMITTEE
+    )
+    return (
+        int.from_bytes(sha256(selection_proof).digest()[:8], "little")
+        % modulo
+        == 0
+    )
+
+
+def is_sync_committee_aggregator(selection_proof: bytes) -> bool:
+    """Spec is_sync_committee_aggregator."""
+    p = preset()
+    modulo = max(
+        1,
+        p.SYNC_COMMITTEE_SIZE
+        // SYNC_COMMITTEE_SUBNET_COUNT
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    return (
+        int.from_bytes(sha256(selection_proof).digest()[:8], "little")
+        % modulo
+        == 0
+    )
 
 
 class InProcessApi:
@@ -28,12 +66,24 @@ class InProcessApi:
         return self.chain.head_state
 
     def produce_block(self, slot: int, randao_reveal: bytes, attestations):
+        sync_aggregate = None
+        if self.contrib_pool is not None:
+            view = self.chain.head_state
+            if view.fork_seq >= ForkSeq.altair:
+                # the block includes the previous slot's contributions
+                # signing the then-head (produceBlockBody syncAggregate)
+                sync_aggregate = self.contrib_pool.get_sync_aggregate(
+                    slot - 1, self.chain.head_root
+                )
         block, post = self.chain.produce_block(
-            slot, randao_reveal, attestations=attestations
+            slot,
+            randao_reveal,
+            attestations=attestations,
+            sync_aggregate=sync_aggregate,
         )
         return block, post.fork
 
-    async def publish_block(self, signed_block):
+    async def publish_block(self, signed_block, fork: str | None = None):
         await self.chain.process_block(signed_block, is_timely=True)
 
     def attestation_data(self, slot: int, committee_index: int):
@@ -59,6 +109,326 @@ class InProcessApi:
 
     async def publish_attestation(self, attestation, committee):
         await self.chain.on_attestation(attestation, committee)
+        if self.unagg_pool is not None:
+            self.unagg_pool.add(attestation, len(committee))
+
+    # aggregation + sync-committee seams (duck-typed with HttpApi)
+
+    unagg_pool = None  # set by tests/devnode for aggregation flow
+    sync_msg_pool = None
+    contrib_pool = None
+
+    def get_aggregated_attestation(self, slot: int, data_root: bytes):
+        if self.unagg_pool is None:
+            return None
+        return self.unagg_pool.get_aggregate(slot, data_root)
+
+    async def publish_aggregate_and_proof(self, signed_agg):
+        pass  # in-process: the pool already holds the aggregate
+
+    def get_sync_committee_duties(self, epoch: int, indices):
+        st = self.chain.head_state.state
+        view = self.chain.head_state
+        if view.fork_seq < ForkSeq.altair:
+            return []
+        # honor the epoch's sync-committee period (current/next)
+        per = preset().EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        state_period = util.get_current_epoch(st) // per
+        period = epoch // per
+        if period == state_period:
+            committee = st.current_sync_committee
+        elif period == state_period + 1:
+            committee = st.next_sync_committee
+        else:
+            return []
+        wanted = set(indices)
+        pk2i = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        duties: dict[int, list[int]] = {}
+        for pos, pk in enumerate(committee.pubkeys):
+            vi = pk2i.get(bytes(pk))
+            if vi is not None and vi in wanted:
+                duties.setdefault(vi, []).append(pos)
+        return [
+            {"validator_index": vi, "positions": positions}
+            for vi, positions in duties.items()
+        ]
+
+    async def submit_sync_committee_message(
+        self, slot: int, block_root: bytes, validator_index: int,
+        position: int, signature: bytes,
+    ):
+        if self.sync_msg_pool is None:
+            return
+        p = preset()
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        self.sync_msg_pool.add(
+            slot,
+            block_root,
+            position // sub_size,
+            position % sub_size,
+            signature,
+        )
+
+    def produce_sync_contribution(
+        self, slot: int, subcommittee_index: int, block_root: bytes
+    ):
+        if self.sync_msg_pool is None:
+            return None
+        return self.sync_msg_pool.get_contribution(
+            slot, block_root, subcommittee_index
+        )
+
+    async def publish_contribution_and_proof(self, signed_cap):
+        if self.contrib_pool is None:
+            return
+        c = signed_cap.message.contribution
+        self.contrib_pool.add(
+            {
+                "slot": int(c.slot),
+                "beacon_block_root": bytes(c.beacon_block_root),
+                "subcommittee_index": int(c.subcommittee_index),
+                "aggregation_bits": [
+                    bool(b) for b in c.aggregation_bits
+                ],
+                "signature": bytes(c.signature),
+            }
+        )
+
+    def head_root(self) -> bytes:
+        return self.chain.head_root
+
+    def proposer_for_slot(self, slot: int) -> int:
+        from ..chain.chain import _clone
+        from ..statetransition.slot import process_slots
+
+        scratch = _clone(self.chain.head_state, self.types)
+        process_slots(self.cfg, scratch, slot, self.types)
+        return util.get_beacon_proposer_index(
+            scratch.state,
+            electra=scratch.fork_seq >= ForkSeq.electra,
+        )
+
+    def committees_at_slot(self, slot: int) -> list:
+        st = self.chain.head_state.state
+        epoch = util.compute_epoch_at_slot(slot)
+        sh = util.get_shuffling(st, epoch)
+        return [
+            [int(v) for v in committee]
+            for committee in sh.committees_at_slot(slot)
+        ]
+
+
+class HttpApi:
+    """The same duck-typed seam over the REST ApiClient — the
+    separated-VC topology (reference: the VC always talks REST,
+    validator.ts + api client). All duty inputs come from public
+    endpoints; no direct chain access."""
+
+    def __init__(self, client, cfg, types):
+        self.client = client
+        self.cfg = cfg
+        self.types = types
+
+    def proposer_for_slot(self, slot: int) -> int:
+        epoch = slot // preset().SLOTS_PER_EPOCH
+        duties = self.client.call(
+            "getProposerDuties", {"epoch": epoch}
+        )
+        for d in duties:
+            if int(d["slot"]) == slot:
+                return int(d["validator_index"])
+        raise RuntimeError(f"no proposer duty for slot {slot}")
+
+    def committees_at_slot(self, slot: int) -> list:
+        out = self.client.call(
+            "getEpochCommittees",
+            {"state_id": "head", "slot": slot},
+        )
+        return [
+            [int(v) for v in c["validators"]]
+            for c in sorted(out, key=lambda c: int(c["index"]))
+        ]
+
+    def head_root(self) -> bytes:
+        got = self.client.call("getBlockRoot", {"block_id": "head"})
+        return bytes.fromhex(got["root"].removeprefix("0x"))
+
+    def produce_block(self, slot: int, randao_reveal: bytes, attestations):
+        from ..api.json_codec import from_json
+
+        got = self.client.call(
+            "produceBlockV2",
+            {
+                "slot": slot,
+                "randao_reveal": "0x" + randao_reveal.hex(),
+            },
+        )
+        fork = got["version"]
+        block = from_json(
+            self.types.by_fork[fork].BeaconBlock, got["data"]
+        )
+        return block, fork
+
+    async def publish_block(self, signed_block, fork: str | None = None):
+        from ..api.json_codec import to_json
+
+        assert fork is not None, "HttpApi.publish_block needs the fork"
+        self.client.call(
+            "publishBlock",
+            body=to_json(
+                self.types.by_fork[fork].SignedBeaconBlock,
+                signed_block,
+            ),
+        )
+
+    def attestation_data(self, slot: int, committee_index: int):
+        from ..api.json_codec import from_json
+
+        got = self.client.call(
+            "produceAttestationData",
+            {"slot": slot, "committee_index": committee_index},
+        )
+        return from_json(self.types.AttestationData, got)
+
+    async def publish_attestation(self, attestation, committee):
+        from ..api.json_codec import to_json
+
+        self.client.call(
+            "submitPoolAttestations",
+            body=[to_json(self.types.Attestation, attestation)],
+        )
+
+    def get_aggregated_attestation(self, slot: int, data_root: bytes):
+        from ..api.json_codec import from_json
+
+        from ..api import ApiError
+
+        try:
+            got = self.client.call(
+                "getAggregatedAttestation",
+                {
+                    "slot": slot,
+                    "attestation_data_root": "0x" + data_root.hex(),
+                },
+            )
+        except ApiError:
+            return None
+        return from_json(self.types.Attestation, got)
+
+    async def publish_aggregate_and_proof(self, signed_agg):
+        from ..api.json_codec import to_json
+
+        self.client.call(
+            "publishAggregateAndProofs",
+            body=[
+                to_json(
+                    self.types.SignedAggregateAndProof, signed_agg
+                )
+            ],
+        )
+
+    def get_sync_committee_duties(self, epoch: int, indices):
+        duties = self.client.call(
+            "getSyncCommitteeDuties",
+            {"epoch": epoch},
+            body=[str(i) for i in indices],
+        )
+        return [
+            {
+                "validator_index": int(d["validator_index"]),
+                "positions": [
+                    int(p)
+                    for p in d["validator_sync_committee_indices"]
+                ],
+            }
+            for d in duties
+        ]
+
+    async def submit_sync_committee_message(
+        self, slot, block_root, validator_index, position, signature
+    ):
+        self.client.call(
+            "submitPoolSyncCommitteeSignatures",
+            body=[
+                {
+                    "slot": str(slot),
+                    "beacon_block_root": "0x" + bytes(block_root).hex(),
+                    "validator_index": str(validator_index),
+                    "signature": "0x" + bytes(signature).hex(),
+                }
+            ],
+        )
+
+    def produce_sync_contribution(
+        self, slot: int, subcommittee_index: int, block_root: bytes
+    ):
+        from ..api import ApiError
+
+        try:
+            got = self.client.call(
+                "produceSyncCommitteeContribution",
+                {
+                    "slot": slot,
+                    "subcommittee_index": subcommittee_index,
+                    "beacon_block_root": "0x" + bytes(block_root).hex(),
+                },
+            )
+        except ApiError:
+            return None
+        from ..utils.bits import hex_to_bits
+
+        sub_size = (
+            preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        bits = hex_to_bits(got["aggregation_bits"], sub_size)
+        return {
+            "slot": int(got["slot"]),
+            "beacon_block_root": bytes.fromhex(
+                got["beacon_block_root"].removeprefix("0x")
+            ),
+            "subcommittee_index": int(got["subcommittee_index"]),
+            "aggregation_bits": bits,
+            "signature": bytes.fromhex(
+                got["signature"].removeprefix("0x")
+            ),
+        }
+
+    async def publish_contribution_and_proof(self, signed_cap):
+        from ..utils.bits import bits_to_hex
+
+        c = signed_cap.message.contribution
+        packed_hex = bits_to_hex([bool(b) for b in c.aggregation_bits])
+        self.client.call(
+            "publishContributionAndProofs",
+            body=[
+                {
+                    "message": {
+                        "aggregator_index": str(
+                            int(signed_cap.message.aggregator_index)
+                        ),
+                        "contribution": {
+                            "slot": str(int(c.slot)),
+                            "beacon_block_root": "0x"
+                            + bytes(c.beacon_block_root).hex(),
+                            "subcommittee_index": str(
+                                int(c.subcommittee_index)
+                            ),
+                            "aggregation_bits": "0x" + packed_hex,
+                            "signature": "0x"
+                            + bytes(c.signature).hex(),
+                        },
+                        "selection_proof": "0x"
+                        + bytes(
+                            signed_cap.message.selection_proof
+                        ).hex(),
+                    },
+                    "signature": "0x"
+                    + bytes(signed_cap.signature).hex(),
+                }
+            ],
+        )
 
 
 class Validator:
@@ -71,22 +441,39 @@ class Validator:
         self.att_pool = att_pool
         self.blocks_proposed = 0
         self.attestations_published = 0
+        self.aggregates_published = 0
+        self.sync_messages_published = 0
+        self.sync_contributions_published = 0
+        # per-slot/epoch duty memos: the attest + aggregate phases (and
+        # message + contribution phases) share identical duty data; one
+        # fetch per slot avoids doubled REST round-trips over HttpApi
+        self._committees_memo: tuple = (None, None)
+        self._sync_duties_memo: tuple = (None, None)
+
+    def _committees(self, slot: int) -> list:
+        if self._committees_memo[0] != slot:
+            self._committees_memo = (
+                slot,
+                self.api.committees_at_slot(slot),
+            )
+        return self._committees_memo[1]
+
+    def _sync_duties(self, epoch: int) -> list:
+        if self._sync_duties_memo[0] != epoch:
+            self._sync_duties_memo = (
+                epoch,
+                self.api.get_sync_committee_duties(
+                    epoch, self.store.indices()
+                ),
+            )
+        return self._sync_duties_memo[1]
 
     # -- block duty ------------------------------------------------------
 
     async def run_block_duties(self, slot: int) -> bytes | None:
         """Propose if one of our validators owns the slot
         (BlockProposingService.runBlockTasks)."""
-        view = self.api.head_state()
-        st = view.state
-        from ..chain.chain import _clone
-        from ..statetransition.slot import process_slots
-
-        scratch = _clone(view, self.types)
-        process_slots(self.api.cfg, scratch, slot, self.types)
-        proposer = util.get_beacon_proposer_index(
-            scratch.state, electra=scratch.fork_seq >= ForkSeq.electra
-        )
+        proposer = self.api.proposer_for_slot(slot)
         if not self.store.has_validator(proposer):
             return None
         epoch = slot // preset().SLOTS_PER_EPOCH
@@ -98,7 +485,7 @@ class Validator:
         )
         block, fork = self.api.produce_block(slot, randao, atts)
         signed = self.store.sign_block(proposer, block, fork)
-        await self.api.publish_block(signed)
+        await self.api.publish_block(signed, fork)
         self.blocks_proposed += 1
         ns = self.types.by_fork[fork]
         return ns.BeaconBlock.hash_tree_root(block)
@@ -109,12 +496,8 @@ class Validator:
         """All owned validators in this slot's committees attest
         (AttestationService: one attestation data per committee, signed
         per validator)."""
-        view = self.api.head_state()
-        st = view.state
-        epoch = util.compute_epoch_at_slot(slot)
-        sh = util.get_shuffling(st, epoch)
         published = 0
-        for ci, committee in enumerate(sh.committees_at_slot(slot)):
+        for ci, committee in enumerate(self._committees(slot)):
             owned = [
                 (pos, int(v))
                 for pos, v in enumerate(committee)
@@ -138,6 +521,127 @@ class Validator:
         self.attestations_published += published
         return published
 
+    # -- aggregation duty (2/3 slot; attestation.ts:35) -------------------
+
+    async def run_aggregation_duties(self, slot: int) -> int:
+        """Owned validators that win aggregator selection publish
+        SignedAggregateAndProof for their committee's best aggregate
+        (AttestationService aggregation phase + jobItem selection)."""
+        epoch = util.compute_epoch_at_slot(slot)
+        published = 0
+        for ci, committee in enumerate(self._committees(slot)):
+            owned = [
+                int(v)
+                for v in committee
+                if self.store.has_validator(int(v))
+            ]
+            if not owned:
+                continue
+            data = self.api.attestation_data(slot, ci)
+            data_root = self.types.AttestationData.hash_tree_root(data)
+            for vindex in owned:
+                proof = self.store.sign_selection_proof(vindex, slot)
+                if not is_aggregator(len(committee), proof):
+                    continue
+                agg = self.api.get_aggregated_attestation(
+                    slot, bytes(data_root)
+                )
+                if agg is None:
+                    continue
+                aap = self.types.AggregateAndProof.default()
+                aap.aggregator_index = vindex
+                aap.aggregate = agg
+                aap.selection_proof = proof
+                sig = self.store.sign_aggregate_and_proof(
+                    vindex, aap, epoch
+                )
+                signed = self.types.SignedAggregateAndProof.default()
+                signed.message = aap
+                signed.signature = sig
+                await self.api.publish_aggregate_and_proof(signed)
+                published += 1
+        self.aggregates_published += published
+        return published
+
+    # -- sync committee duties (syncCommittee.ts:24) ----------------------
+
+    async def run_sync_committee_duties(self, slot: int) -> int:
+        """Sync-committee messages for the head at this slot."""
+        epoch = util.compute_epoch_at_slot(slot)
+        duties = self._sync_duties(epoch)
+        if not duties:
+            return 0
+        head = self.api.head_root()
+        published = 0
+        for duty in duties:
+            vi = int(duty["validator_index"])
+            sig = self.store.sign_sync_committee_message(
+                vi, slot, head
+            )
+            for pos in duty["positions"]:
+                await self.api.submit_sync_committee_message(
+                    slot, head, vi, int(pos), sig
+                )
+            published += 1
+        self.sync_messages_published += published
+        return published
+
+    async def run_sync_contribution_duties(self, slot: int) -> int:
+        """2/3-slot contribution phase: selection-proof winners wrap
+        the best subcommittee contribution into a
+        SignedContributionAndProof (syncCommittee.ts contribution
+        flow)."""
+        epoch = util.compute_epoch_at_slot(slot)
+        duties = self._sync_duties(epoch)
+        if not duties:
+            return 0
+        p = preset()
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        head = self.api.head_root()
+        published = 0
+        for duty in duties:
+            vi = int(duty["validator_index"])
+            subnets = {
+                int(pos) // sub_size for pos in duty["positions"]
+            }
+            for subnet in subnets:
+                proof = self.store.sign_sync_selection_data(
+                    vi, slot, subnet
+                )
+                if not is_sync_committee_aggregator(proof):
+                    continue
+                contrib = self.api.produce_sync_contribution(
+                    slot, subnet, head
+                )
+                if contrib is None:
+                    continue
+                c = self.types.SyncCommitteeContribution.default()
+                c.slot = contrib["slot"]
+                c.beacon_block_root = contrib["beacon_block_root"]
+                c.subcommittee_index = contrib["subcommittee_index"]
+                c.aggregation_bits = contrib["aggregation_bits"]
+                c.signature = contrib["signature"]
+                cap = self.types.ContributionAndProof.default()
+                cap.aggregator_index = vi
+                cap.contribution = c
+                cap.selection_proof = proof
+                sig = self.store.sign_contribution_and_proof(vi, cap)
+                signed = (
+                    self.types.SignedContributionAndProof.default()
+                )
+                signed.message = cap
+                signed.signature = sig
+                await self.api.publish_contribution_and_proof(signed)
+                published += 1
+        self.sync_contributions_published += published
+        return published
+
     async def on_slot(self, slot: int) -> None:
+        """Full per-slot duty flow: propose at slot start, attest +
+        sync messages at 1/3, aggregate + contribute at 2/3
+        (attestation.ts:35, syncCommittee.ts:24)."""
         await self.run_block_duties(slot)
         await self.run_attestation_duties(slot)
+        await self.run_sync_committee_duties(slot)
+        await self.run_aggregation_duties(slot)
+        await self.run_sync_contribution_duties(slot)

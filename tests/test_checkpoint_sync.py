@@ -1,0 +1,189 @@
+"""Checkpoint-sync bootstrapping (VERDICT r2 #9a).
+
+Reference analog: initBeaconState.ts — fetch the finalized state from
+a trusted REST endpoint, validate, anchor the chain on it. 'Done'
+criterion: a node boots from another node's API snapshot in a test.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api.impl import BeaconApiImpl
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.params import preset
+from lodestar_tpu.sync.checkpoint import (
+    CheckpointSyncError,
+    fetch_checkpoint_state,
+)
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+class StubVerifier:
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class TestCheckpointSync:
+    def test_node_boots_from_peer_api_snapshot(self, types):
+        """Producer finalizes a few epochs; a fresh node fetches the
+        finalized state over the API, anchors on it, and keeps
+        importing producer blocks forward from the anchor."""
+        cfg = _cfg()
+        p = preset()
+        target = p.SLOTS_PER_EPOCH * 4
+
+        async def go():
+            producer = DevNode(
+                cfg, types, N, verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            await producer.run_until(target)
+            assert producer.chain.finalized_checkpoint.epoch >= 2
+
+            impl = BeaconApiImpl(cfg, types, producer.chain)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            try:
+                url = f"http://127.0.0.1:{port}"
+                # the VALIDATED fetch, including the wss root pin
+                fin_root = producer.chain.finalized_checkpoint.root
+                fin_view = producer.chain.get_state(fin_root)
+                expected = fin_view.hash_tree_root(types)
+                anchor = await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    lambda: fetch_checkpoint_state(
+                        url, cfg, types, expected_root=expected,
+                        now=10**12,
+                    ),
+                )
+                assert int(anchor.state.slot) > 0
+                # a fresh chain anchored on the snapshot
+                consumer = BeaconChain(
+                    cfg, types, anchor, verifier=StubVerifier()
+                )
+                assert consumer.genesis_root != b"\x00" * 32
+                # it imports producer blocks forward from the anchor
+                anchor_slot = int(anchor.state.slot)
+                imported = 0
+                for n in reversed(
+                    list(
+                        producer.chain.fork_choice.proto.iter_chain(
+                            producer.chain.head_root
+                        )
+                    )
+                ):
+                    if n.slot <= anchor_slot:
+                        continue
+                    blk = producer.chain.get_block(n.block_root)
+                    if blk is None:
+                        continue
+                    await consumer.process_block(blk, is_timely=False)
+                    imported += 1
+                assert imported > 0
+                assert consumer.head_root == producer.chain.head_root
+            finally:
+                srv.stop()
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_wss_root_mismatch_rejected(self, types):
+        cfg = _cfg()
+
+        async def go():
+            producer = DevNode(
+                cfg, types, N, verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            await producer.run_until(4)
+            impl = BeaconApiImpl(cfg, types, producer.chain)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            try:
+                url = f"http://127.0.0.1:{port}"
+                with pytest.raises(
+                    CheckpointSyncError, match="weak-subjectivity"
+                ):
+                    await asyncio.get_event_loop().run_in_executor(
+                        None,
+                        lambda: fetch_checkpoint_state(
+                            url,
+                            cfg,
+                            types,
+                            state_id="head",
+                            expected_root=b"\xde\xad" * 16,
+                            now=10**12,
+                        ),
+                    )
+            finally:
+                srv.stop()
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_future_state_rejected(self, types):
+        cfg = _cfg()
+
+        async def go():
+            producer = DevNode(
+                cfg, types, N, verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            await producer.run_until(4)
+            impl = BeaconApiImpl(cfg, types, producer.chain)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            try:
+                url = f"http://127.0.0.1:{port}"
+                with pytest.raises(
+                    CheckpointSyncError, match="future"
+                ):
+                    await asyncio.get_event_loop().run_in_executor(
+                        None,
+                        lambda: fetch_checkpoint_state(
+                            url, cfg, types, state_id="head", now=0.0
+                        ),
+                    )
+            finally:
+                srv.stop()
+            await producer.close()
+
+        asyncio.run(go())

@@ -1,0 +1,199 @@
+"""Full validator-client duty loop: aggregation + sync committee.
+
+VERDICT r2 #7 'Done' criteria: aggregates and sync contributions land
+in produced blocks, and the full duty loop runs over the HTTP
+ApiClient against a live node (separated-VC topology). Reference:
+validator/src/services/attestation.ts:35 (aggregate at 2/3 slot with
+selection proofs), syncCommittee.ts:24, syncCommitteeDuties.ts:80.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api.impl import BeaconApiImpl
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.oppools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.params import preset
+from lodestar_tpu.statetransition import (
+    create_interop_genesis_state,
+    interop_secret_key,
+)
+from lodestar_tpu.types import ssz_types
+from lodestar_tpu.validator import InProcessApi, Validator, ValidatorStore
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+class StubVerifier:
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+def _altair_cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+def _mk_vc(cfg, types, chain):
+    gvr = bytes(chain.head_state.state.genesis_validators_root)
+    bc = BeaconConfig(cfg, gvr)
+    store = ValidatorStore(
+        bc, types, {i: interop_secret_key(i) for i in range(N)}
+    )
+    api = InProcessApi(cfg, types, chain)
+    api.unagg_pool = AttestationPool(types)
+    api.sync_msg_pool = SyncCommitteeMessagePool(types)
+    api.contrib_pool = SyncContributionAndProofPool(types)
+    vc = Validator(api, store, att_pool=AggregatedAttestationPool(types))
+    return vc, api
+
+
+class TestFullDutyLoop:
+    def test_aggregates_and_contributions_land_in_blocks(self, types):
+        """1.5 epochs of the full duty flow on an altair chain: the
+        produced blocks carry sync aggregates built from the VC's own
+        contributions, and aggregation duties publish."""
+        cfg = _altair_cfg()
+        p = preset()
+        genesis = create_interop_genesis_state(cfg, types, N)
+        chain = BeaconChain(cfg, types, genesis, verifier=StubVerifier())
+        vc, api = _mk_vc(cfg, types, chain)
+
+        async def go():
+            for slot in range(1, p.SLOTS_PER_EPOCH + 5):
+                await vc.on_slot(slot)
+
+        asyncio.run(go())
+        assert vc.blocks_proposed == p.SLOTS_PER_EPOCH + 4
+        assert vc.attestations_published > 0
+        assert vc.aggregates_published > 0, "no aggregation duty ran"
+        assert vc.sync_messages_published > 0
+        assert vc.sync_contributions_published > 0
+        # a later block must carry non-empty sync committee bits
+        head = chain.get_block(chain.head_root)
+        bits = list(head.message.body.sync_aggregate.sync_committee_bits)
+        assert any(bits), "sync contributions never reached a block"
+
+    def test_selection_proofs_gate_aggregation(self, types):
+        """Not every validator aggregates: the selection-proof modulo
+        must gate (TARGET_AGGREGATORS_PER_COMMITTEE)."""
+        from lodestar_tpu.validator.validator import is_aggregator
+
+        # with committee_len <= 16*? modulo 1 -> everyone aggregates;
+        # large committees gate down
+        proofs = [bytes([i]) * 96 for i in range(64)]
+        big = sum(1 for pr in proofs if is_aggregator(1024, pr))
+        assert big < len(proofs)  # gated
+        assert all(is_aggregator(8, pr) for pr in proofs)  # modulo 1
+
+
+class TestSeparatedVcOverHttp:
+    def test_duties_over_rest_api(self, types):
+        """The SAME Validator drives a node purely over HTTP: REST
+        server on the node side, ApiClient + HttpApi adapter on the VC
+        side (the reference's normal deployment topology)."""
+        cfg = _altair_cfg()
+        p = preset()
+
+        async def go():
+            from types import SimpleNamespace
+
+            from lodestar_tpu.api.client import ApiClient
+            from lodestar_tpu.validator.validator import HttpApi
+
+            genesis = create_interop_genesis_state(cfg, types, N)
+            chain = BeaconChain(
+                cfg, types, genesis, verifier=StubVerifier()
+            )
+            node = SimpleNamespace(
+                att_pool=AggregatedAttestationPool(types),
+                unagg_pool=AttestationPool(types),
+                sync_msg_pool=SyncCommitteeMessagePool(types),
+                contrib_pool=SyncContributionAndProofPool(types),
+                op_pool=None,
+                network=None,
+                attestation_validator=None,
+                builder=None,
+            )
+            impl = BeaconApiImpl(cfg, types, chain, node=node)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            try:
+                client = ApiClient(f"http://127.0.0.1:{port}")
+                gvr = bytes(genesis.state.genesis_validators_root)
+                bc = BeaconConfig(cfg, gvr)
+                store = ValidatorStore(
+                    bc,
+                    types,
+                    {i: interop_secret_key(i) for i in range(N)},
+                )
+                api = HttpApi(client, cfg, types)
+                vc = Validator(api, store)
+
+                # the VC runs in its own thread with its own loop —
+                # a real separated VC is its own process; the node's
+                # loop must stay free to serve the async API routes
+                def drive():
+                    async def run():
+                        for slot in range(1, 6):
+                            await vc.on_slot(slot)
+
+                    asyncio.run(run())
+
+                await asyncio.get_event_loop().run_in_executor(
+                    None, drive
+                )
+                head = chain.fork_choice.proto.get_node(
+                    chain.head_root
+                )
+                assert head.slot == 5
+                assert vc.blocks_proposed == 5
+                assert vc.attestations_published > 0
+                assert vc.sync_messages_published > 0
+                # aggregation produced SignedAggregateAndProofs whose
+                # aggregates reached the node's pool over REST
+                assert vc.aggregates_published > 0
+                # contributions flowed over REST into the node pool and
+                # back into block production
+                assert vc.sync_contributions_published > 0
+                blk = chain.get_block(chain.head_root)
+                bits = list(
+                    blk.message.body.sync_aggregate.sync_committee_bits
+                )
+                assert any(bits)
+            finally:
+                srv.stop()
+
+        asyncio.run(go())
