@@ -100,3 +100,61 @@ def message_to_g2(message: bytes, dst: bytes = BLS_DST_SIG):
     return h2c.hash_to_g2(message, dst)
 
 
+@lru_cache(maxsize=16384)
+def message_draws(message: bytes, dst: bytes = BLS_DST_SIG):
+    """Host half of DEVICE hash-to-G2: expand_message_xmd + reduction
+    to two Fq2 draws (microseconds); the SSWU/isogeny/cofactor field
+    work runs batched on the TPU (ops/ingest.py)."""
+    u0, u1 = h2c.hash_to_field_fq2(message, dst, 2)
+    return u0, u1
+
+
+@lru_cache(maxsize=16384)
+def decompress_signature_parsed(sig_x: tuple, sign: bool):
+    """Host decompression from a parsed (xc0, xc1, sign) triple — the
+    small-bucket path where device ingest isn't warranted. Returns
+    affine ints or None (not on curve / subgroup)."""
+    from ..crypto.bls import fields as F
+    from ..crypto.bls.curve import g2_in_subgroup
+
+    xc0, xc1 = sig_x
+    x = (xc0, xc1)
+    rhs = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), (4, 4))
+    y = F.fq2_sqrt(rhs)
+    if y is None:
+        return None
+    # spec sign rule: a_flag reflects y_im (or y_re when y_im == 0)
+    half = (F.P - 1) // 2
+    computed = (y[1] > half) if y[1] != 0 else (y[0] > half)
+    if computed != sign:
+        y = F.fq2_neg(y)
+    p = (x, y)
+    if not g2_in_subgroup(p):
+        return None
+    return p
+
+
+@lru_cache(maxsize=16384)
+def draws_to_g2(draws: tuple):
+    """Host SSWU+iso+cofactor from cached field draws (small-bucket
+    path; the heavy expand_message_xmd half is already done)."""
+    from ..crypto.bls import hash_to_curve as h2c
+    from ..crypto.bls.curve import g2_add, g2_clear_cofactor
+
+    u0, u1 = draws
+    q0 = h2c.iso_map_g2(h2c.map_to_curve_sswu(u0))
+    q1 = h2c.iso_map_g2(h2c.map_to_curve_sswu(u1))
+    return g2_clear_cofactor(g2_add(q0, q1))
+
+
+@lru_cache(maxsize=16384)
+def parse_signature(sig: bytes):
+    """96B compressed G2 -> (xc0, xc1, sign, host_ok) without the
+    expensive sqrt/subgroup work (that runs on device). host_ok False
+    covers malformed flags, non-canonical coordinates, and the
+    infinity encoding."""
+    from ..ops import ingest
+
+    return ingest.parse_g2_compressed(sig)
+
+

@@ -112,6 +112,109 @@ def _stage_prepare_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
     return px, py, qx, qy, full_mask
 
 
+# Device ingest is gated to the big production bucket: each ingest
+# stage is a multi-minute XLA compile per bucket size, so compiling it
+# for 4..128 too would multiply warmup cost for no throughput (small
+# buckets are host-prep-affordable: 128 sets x ~2.5 ms). Tests can
+# lower this to exercise the device path on small CPU batches.
+INGEST_MIN_BUCKET = 2048
+
+
+@jax.jit
+def _stage_g2_sqrt(sig_x, sig_sign):
+    """Ingest sub-stage 1: y from the curve equation + QR flag + spec
+    sign selection (shared impl: ops/ingest.g2_sqrt_with_sign). Split
+    from the subgroup check so each compiled graph stays small
+    (compile time is superlinear in op count — the fused ingest stage
+    compiled >58 min on the chip)."""
+    from ..ops import ingest
+
+    return ingest.g2_sqrt_with_sign(sig_x, sig_sign)
+
+
+@jax.jit
+def _stage_g2_subgroup(x, y, is_qr, mask):
+    """Ingest sub-stage 2: psi subgroup check; returns the point and
+    the combined validity conjunction (padding auto-valid)."""
+    from ..ops import ingest
+
+    q = C.jac_from_affine(C.FQ2_OPS, x, y)
+    valid = jnp.logical_and(
+        is_qr, ingest.g2_in_subgroup(q, mask.shape)
+    )
+    return q, jnp.all(jnp.logical_or(valid, ~mask))
+
+
+def _stage_g2_decompress(sig_x, sig_sign, mask):
+    x, y, is_qr = _stage_g2_sqrt(sig_x, sig_sign)
+    return _stage_g2_subgroup(x, y, is_qr, mask)
+
+
+@jax.jit
+def _stage_sswu_iso(u0, u1):
+    """Ingest sub-stage 3: both SSWU maps + isogeny + point add
+    (shared impl: ops/ingest.sswu_iso_sum)."""
+    from ..ops import ingest
+
+    return ingest.sswu_iso_sum(u0, u1)
+
+
+@jax.jit
+def _stage_cofactor(s, mask):
+    """Ingest sub-stage 4: psi cofactor clearing + affine conversion."""
+    from ..ops import ingest
+
+    h = ingest.g2_clear_cofactor(s, mask.shape)
+    return _to_affine(C.FQ2_OPS, h)
+
+
+def _stage_hash_to_g2(u0, u1, mask):
+    return _stage_cofactor(_stage_sswu_iso(u0, u1), mask)
+
+
+@jax.jit
+def _stage_final_with_valid(prod, all_valid):
+    """Final exponentiation AND the ingest validity conjunction."""
+    return jnp.logical_and(
+        pairing.fq12_is_one(pairing.final_exponentiation(prod)),
+        all_valid,
+    )
+
+
+def run_verify_batch_ingest_async(
+    pk: C.JacPoint, sig_x, sig_sign, u0, u1, rand_bits, mask
+):
+    """Batch verify with device-side ingestion; returns the device ()
+    bool WITHOUT readback (see run_verify_batch_async). Composes the
+    ingest stages with the UNCHANGED prepare/miller/product stages so
+    their compiled artifacts are shared with the legacy path."""
+    jaxcache.enable()
+    sig, all_valid = _stage_g2_decompress(sig_x, sig_sign, mask)
+    hx, hy = _stage_hash_to_g2(u0, u1, mask)
+    px, py, qx, qy, pair_mask = _stage_prepare_batch(
+        pk, hx, hy, sig, rand_bits, mask
+    )
+    f = _stage_miller(px, py, qx, qy)
+    prod = _stage_product(f, pair_mask)
+    return _stage_final_with_valid(prod, all_valid)
+
+
+def run_verify_same_message_ingest_async(
+    pk: C.JacPoint, h, sig_x, sig_sign, rand_bits, mask
+):
+    """Same-message verify with device-side signature decompression
+    (the message is hashed once on host — amortized across the whole
+    group by the attData-keyed queue)."""
+    jaxcache.enable()
+    sig, all_valid = _stage_g2_decompress(sig_x, sig_sign, mask)
+    px, py, qx, qy, pair_mask = _stage_prepare_same_message(
+        pk, h[0], h[1], sig, rand_bits, mask
+    )
+    f = _stage_miller(px, py, qx, qy)
+    prod = _stage_product(f, pair_mask)
+    return _stage_final_with_valid(prod, all_valid)
+
+
 @jax.jit
 def _stage_prepare_same_message(
     pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask

@@ -57,11 +57,45 @@ def _rand_scalars(n: int):
     return [secrets.randbits(kernels.RAND_BITS) | 1 for _ in range(n)]
 
 
+_PAD_CACHE: list = []
+
+
+def _pad_prepared() -> "_PreparedSet":
+    """A valid prepared set used for bucket padding (mask False slots
+    still flow through the device sqrt/ladder chains)."""
+    if not _PAD_CACHE:
+        from ..crypto.bls.signature import sign, sk_to_pk
+
+        msg = b"\x5a" * 32
+        sig = sign(7, msg)
+        xc0, xc1, s, ok = api.parse_signature(sig)
+        assert ok
+        _PAD_CACHE.append(
+            _PreparedSet(
+                api.decompress_pubkey(sk_to_pk(7)),
+                (xc0, xc1),
+                s,
+                api.message_draws(msg),
+            )
+        )
+    return _PAD_CACHE[0]
+
+
 @dataclass
 class _PreparedSet:
-    pk: tuple  # affine G1 ints
-    h: tuple  # affine G2 ints (hashed message)
-    sig: tuple | None  # affine G2 ints, None = invalid/identity
+    """Host-light prepared set for DEVICE ingestion: the pubkey is
+    decompressed on host (cached — validators recur), but signatures
+    stay as compressed x-coordinates and messages as hash_to_field
+    draws; sqrt/subgroup/SSWU run batched on the TPU
+    (kernels.run_verify_batch_ingest_async, composing the
+    _stage_g2_* and _stage_sswu_*/_stage_cofactor sub-stages)."""
+
+    pk: tuple  # affine G1 ints (cache-decompressed)
+    sig_x: tuple  # (xc0, xc1) compressed signature x
+    sig_sign: bool
+    draws: tuple  # (u0, u1) Fq2 hash_to_field draws
+    sig_raw: bytes = b""  # compressed bytes (small-bucket host path)
+    msg_raw: bytes = b""  # signing root (small-bucket host path)
 
 
 @dataclass
@@ -187,22 +221,28 @@ class TpuBlsVerifier:
         loop = asyncio.get_event_loop()
 
         def prep():
+            # ONE host hash for the whole group (amortized by the
+            # attData-keyed queue); signatures stay compressed — the
+            # device decompresses them
             h = api.message_to_g2(message)
+            draws = api.message_draws(message)
             out = []
             for s in sets:
                 try:
                     pk = api.decompress_pubkey(s.pubkey)
-                    sig = api.decompress_signature(s.signature)
                 except api.InvalidPointError:
-                    pk, sig = None, None
-                out.append((pk, sig))
-            return h, out
+                    out.append(None)
+                    continue
+                xc0, xc1, sign, ok = api.parse_signature(s.signature)
+                out.append(
+                    ((pk, (xc0, xc1), sign)) if ok else None
+                )
+            return h, draws, out
 
-        h, prepared = await loop.run_in_executor(self._prep_pool, prep)
-        valid = [
-            p is not None and s is not None for p, s in prepared
-        ]
-        live = [i for i, v in enumerate(valid) if v]
+        h, draws, prepared = await loop.run_in_executor(
+            self._prep_pool, prep
+        )
+        live = [i for i, p in enumerate(prepared) if p is not None]
         if not live:
             return [False] * len(sets)
         results = [False] * len(sets)
@@ -217,7 +257,14 @@ class TpuBlsVerifier:
         self.metrics.same_message_retries += 1
         singles = await self._verdict_wave(
             [
-                [_PreparedSet(prepared[i][0], h, prepared[i][1])]
+                [
+                    _PreparedSet(
+                        prepared[i][0],
+                        prepared[i][1],
+                        prepared[i][2],
+                        draws,
+                    )
+                ]
                 for i in live
             ]
         )
@@ -251,11 +298,19 @@ class TpuBlsVerifier:
 
     # -- internals ------------------------------------------------------
 
-    def _prepare(self, s: api.SignatureSet) -> _PreparedSet:
+    def _prepare(self, s: api.SignatureSet) -> _PreparedSet | None:
+        """Host prep: pubkey cache + byte parsing + message expansion.
+        None = malformed on host (bad flags / non-canonical / infinity
+        signature) -> the job resolves False without device work
+        (maybeBatch.ts:17-44 semantics)."""
         pk = api.decompress_pubkey(s.pubkey)
-        h = api.message_to_g2(s.message)
-        sig = api.decompress_signature(s.signature)
-        return _PreparedSet(pk, h, sig)
+        xc0, xc1, sign, ok = api.parse_signature(s.signature)
+        if not ok:
+            return None
+        draws = api.message_draws(s.message)
+        return _PreparedSet(
+            pk, (xc0, xc1), sign, draws, s.signature, s.message
+        )
 
     def _ensure_runner(self):
         if self._closed:
@@ -337,7 +392,7 @@ class TpuBlsVerifier:
                 prepared = [self._prepare(s) for s in j.sets]
             except api.InvalidPointError:
                 return None
-            if any(p.sig is None for p in prepared):
+            if any(p is None for p in prepared):
                 return None
             return prepared
 
@@ -466,22 +521,76 @@ class TpuBlsVerifier:
     def _submit_bucket(self, sets: list[_PreparedSet]):
         """Pad to a bucket size, build device arrays (sharded over the
         mesh when even), dispatch WITHOUT readback. Returns the device
-        () bool."""
+        () bool. Signatures/messages ship compressed — decompression
+        and hash-to-G2 run inside the device program."""
+        from ..ops import tower
+
         n = len(sets)
         b = kernels.bucket_size(n)
         pad = b - n
-        pks = [s.pk for s in sets] + [oc.G1_GEN] * pad
-        hs = [s.h for s in sets] + [oc.G2_GEN] * pad
-        sigs = [s.sig for s in sets] + [oc.G2_GEN] * pad
+        pad_set = _pad_prepared()
+        full = sets + [pad_set] * pad
         rand = _rand_scalars(b)
-        pk_dev = C.g1_batch_from_ints(pks)
-        h_dev = C.g2_batch_from_ints(hs)
-        sig_dev = C.g2_batch_from_ints(sigs)
+        pk_dev = C.g1_batch_from_ints([s.pk for s in full])
         bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
         mask = jnp.asarray([True] * n + [False] * pad)
-        h = (h_dev.x, h_dev.y)
         mesh = self._mesh
-        if mesh is not None and b % mesh.devices.size == 0:
+        shard = (
+            mesh is not None and b % mesh.devices.size == 0
+        )
+        if b >= kernels.INGEST_MIN_BUCKET:
+            # device ingest: compressed signatures + field draws in
+            sig_x = tower.fq2_from_ints([s.sig_x for s in full])
+            sig_sign = jnp.asarray([s.sig_sign for s in full])
+            u0 = tower.fq2_from_ints([s.draws[0] for s in full])
+            u1 = tower.fq2_from_ints([s.draws[1] for s in full])
+            if shard:
+                from .. import parallel
+
+                pk_dev = parallel.shard_batch(mesh, pk_dev)
+                sig_x = parallel.shard_batch(mesh, sig_x)
+                sig_sign = parallel.shard_batch(mesh, sig_sign)
+                u0 = parallel.shard_batch(mesh, u0)
+                u1 = parallel.shard_batch(mesh, u1)
+                bits = parallel.shard_batch(mesh, bits)
+                mask = parallel.shard_batch(mesh, mask)
+            return kernels.run_verify_batch_ingest_async(
+                pk_dev, sig_x, sig_sign, u0, u1, bits, mask
+            )
+        # small buckets: host decompression/hashing (cached C calls —
+        # affordable at this scale, and it avoids compiling the ingest
+        # stages for every small bucket size)
+        hs, sigs = [], []
+        ok = True
+        for s in full:
+            try:
+                sig = (
+                    api.decompress_signature(s.sig_raw)
+                    if s.sig_raw
+                    else api.decompress_signature_parsed(
+                        s.sig_x, s.sig_sign
+                    )
+                )
+            except api.InvalidPointError:
+                sig = None
+            if sig is None:
+                ok = False
+                sig = oc.G2_GEN
+            sigs.append(sig)
+            hs.append(
+                api.message_to_g2(s.msg_raw)
+                if s.msg_raw
+                else api.draws_to_g2(s.draws)
+            )
+        if not ok:
+            # an invalid signature fails the bucket without device work
+            import jax.numpy as _jnp
+
+            return _jnp.asarray(False)
+        h_dev = C.g2_batch_from_ints(hs)
+        sig_dev = C.g2_batch_from_ints(sigs)
+        h = (h_dev.x, h_dev.y)
+        if shard:
             from .. import parallel
 
             pk_dev = parallel.shard_batch(mesh, pk_dev)
@@ -547,7 +656,9 @@ class TpuBlsVerifier:
 
     async def _run_same_message(self, pairs, h) -> bool:
         """One fused aggregate+pairing check; splits above the device
-        cap and ANDs (random weights keep each part sound)."""
+        cap and ANDs (random weights keep each part sound). pairs:
+        (pk_ints, (xc0, xc1), sign) triples — signature decompression
+        happens on device."""
         cap = DEVICE_BUCKET_MAX
         if len(pairs) > cap:
             parts = [
@@ -560,22 +671,55 @@ class TpuBlsVerifier:
         loop = asyncio.get_event_loop()
 
         def dispatch():
+            from ..ops import tower
+
             n = len(pairs)
             b = kernels.bucket_size(n)
             pad = b - n
-            pks = [p for p, _ in pairs] + [oc.G1_GEN] * pad
-            sigs = [s for _, s in pairs] + [oc.G2_GEN] * pad
+            pad_set = _pad_prepared()
             rand = _rand_scalars(b)
+            pks = [p for p, _, _ in pairs] + [pad_set.pk] * pad
             pk_dev = C.g1_batch_from_ints(pks)
-            sig_dev = C.g2_batch_from_ints(sigs)
             h_dev = C.g2_batch_from_ints([h])  # batch (1,)
             bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
             mask = jnp.asarray([True] * n + [False] * pad)
+            if b >= kernels.INGEST_MIN_BUCKET:
+                sxs = [x for _, x, _ in pairs] + [
+                    pad_set.sig_x
+                ] * pad
+                sgs = [s for _, _, s in pairs] + [
+                    pad_set.sig_sign
+                ] * pad
+                sig_x = tower.fq2_from_ints(sxs)
+                sig_sign = jnp.asarray(sgs)
+                return kernels.run_verify_same_message_ingest_async(
+                    pk_dev,
+                    (h_dev.x, h_dev.y),
+                    sig_x,
+                    sig_sign,
+                    bits,
+                    mask,
+                )
+            # small groups: host decompression (cached C), avoiding a
+            # per-bucket-size ingest-stage compile on the gossip path
+            sigs = []
+            for _, sx, sg in pairs:
+                sig = api.decompress_signature_parsed(sx, sg)
+                if sig is None:
+                    return jnp.asarray(False)
+                sigs.append(sig)
+            sigs += [
+                api.decompress_signature_parsed(
+                    pad_set.sig_x, pad_set.sig_sign
+                )
+            ] * pad
+            sig_dev = C.g2_batch_from_ints(sigs)
             return kernels.run_verify_same_message(
                 pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
             )
 
-        return bool(await loop.run_in_executor(None, dispatch))
+        ok = await loop.run_in_executor(None, dispatch)
+        return bool((await self._readback([ok]))[0])
 
 
 class OracleBlsVerifier:

@@ -165,4 +165,191 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "Total db write requests",
         label_names=("bucket",),
     )
+
+    # -- network / peers (peerManager.ts, metrics/lodestar.ts peers) -----
+    n = SimpleNamespace()
+    m.network = n
+    n.peers = reg.gauge(
+        "libp2p_peers", "Number of connected peers"
+    )
+    n.peers_by_direction = reg.gauge(
+        "lodestar_peers_by_direction_count",
+        "Connected peers by connection direction",
+        label_names=("direction",),
+    )
+    n.peer_disconnects_total = reg.counter(
+        "lodestar_peer_disconnects_total",
+        "Total peer disconnections",
+        label_names=("reason",),
+    )
+    n.peers_banned_total = reg.counter(
+        "lodestar_peers_banned_total", "Total peers banned by score"
+    )
+    n.gossip_mesh_peers = reg.gauge(
+        "lodestar_gossip_mesh_peers_by_type_count",
+        "Gossipsub mesh size per topic",
+        label_names=("type",),
+    )
+    n.gossip_messages_published_total = reg.counter(
+        "lodestar_gossip_published_messages_total",
+        "Gossip messages published",
+        label_names=("topic",),
+    )
+    n.gossip_messages_received_total = reg.counter(
+        "lodestar_gossip_received_messages_total",
+        "Gossip messages received",
+        label_names=("topic",),
+    )
+    n.reqresp_outgoing_requests_total = reg.counter(
+        "beacon_reqresp_outgoing_requests_total",
+        "ReqResp requests sent",
+        label_names=("method",),
+    )
+    n.reqresp_incoming_requests_total = reg.counter(
+        "beacon_reqresp_incoming_requests_total",
+        "ReqResp requests served",
+        label_names=("method",),
+    )
+    n.reqresp_outgoing_errors_total = reg.counter(
+        "beacon_reqresp_outgoing_errors_total",
+        "ReqResp requests failed",
+        label_names=("method",),
+    )
+
+    # -- sync (sync.ts, range.ts, backfill.ts) ---------------------------
+    s = SimpleNamespace()
+    m.sync = s
+    s.status = reg.gauge(
+        "lodestar_sync_status",
+        "Sync mode: 0 stalled, 1 syncing-finalized, 2 syncing-head, 3 synced",
+    )
+    s.range_blocks_imported_total = reg.counter(
+        "lodestar_sync_range_blocks_imported_total",
+        "Blocks imported by range sync",
+    )
+    s.range_batches_total = reg.counter(
+        "lodestar_sync_range_batches_total",
+        "Range-sync batches processed",
+        label_names=("result",),
+    )
+    s.unknown_block_requests_total = reg.counter(
+        "lodestar_sync_unknown_block_requests_total",
+        "UnknownBlockSync fetch attempts",
+    )
+    s.backfill_blocks_total = reg.counter(
+        "lodestar_sync_backfill_blocks_total",
+        "Blocks verified and stored by backfill sync",
+    )
+
+    # -- regen + state caches (regen/queued.ts, stateCache/) -------------
+    r = SimpleNamespace()
+    m.regen = r
+    r.requests_total = reg.counter(
+        "lodestar_regen_queue_requests_total",
+        "State regen requests",
+        label_names=("caller",),
+    )
+    r.replays_total = reg.counter(
+        "lodestar_regen_replays_total", "State replays executed"
+    )
+    r.blocks_replayed_total = reg.counter(
+        "lodestar_regen_blocks_replayed_total",
+        "Blocks re-executed during state regen",
+    )
+    r.state_cache_hits_total = reg.counter(
+        "lodestar_state_cache_hits_total", "Block-state cache hits"
+    )
+    r.state_cache_size = reg.gauge(
+        "lodestar_state_cache_size", "Cached block states"
+    )
+    r.checkpoint_cache_size = reg.gauge(
+        "lodestar_cp_state_cache_size", "Cached checkpoint states"
+    )
+
+    # -- op pools (opPools/) ---------------------------------------------
+    o = SimpleNamespace()
+    m.op_pool = o
+    o.attestation_pool_size = reg.gauge(
+        "lodestar_oppool_attestation_pool_size",
+        "Aggregated attestations pooled for block inclusion",
+    )
+    o.unagg_attestation_pool_size = reg.gauge(
+        "lodestar_oppool_unaggregated_attestation_pool_size",
+        "Unaggregated attestations pooled per subnet",
+    )
+    o.sync_committee_message_pool_size = reg.gauge(
+        "lodestar_oppool_sync_committee_message_pool_size",
+        "Pooled sync-committee message groups",
+    )
+    o.sync_contribution_pool_size = reg.gauge(
+        "lodestar_oppool_sync_contribution_and_proof_pool_size",
+        "Pooled sync contributions",
+    )
+    o.voluntary_exit_pool_size = reg.gauge(
+        "lodestar_oppool_voluntary_exit_pool_size",
+        "Pooled voluntary exits",
+    )
+    o.attester_slashing_pool_size = reg.gauge(
+        "lodestar_oppool_attester_slashing_pool_size",
+        "Pooled attester slashings",
+    )
+    o.proposer_slashing_pool_size = reg.gauge(
+        "lodestar_oppool_proposer_slashing_pool_size",
+        "Pooled proposer slashings",
+    )
+    o.bls_to_execution_change_pool_size = reg.gauge(
+        "lodestar_oppool_bls_to_execution_change_pool_size",
+        "Pooled BLS-to-execution changes",
+    )
+
+    # -- REST api (rest/activeSockets.ts, server metrics) ----------------
+    a = SimpleNamespace()
+    m.api = a
+    a.requests_total = reg.counter(
+        "lodestar_api_rest_requests_total",
+        "REST api requests",
+        label_names=("operation",),
+    )
+    a.errors_total = reg.counter(
+        "lodestar_api_rest_errors_total",
+        "REST api error responses",
+        label_names=("operation",),
+    )
+    a.response_time = reg.histogram(
+        "lodestar_api_rest_response_time_seconds",
+        "REST api handler time",
+        buckets=(0.001, 0.01, 0.05, 0.25, 1, 5),
+    )
+
+    # -- eth1 / execution (eth1/, execution/) ----------------------------
+    e = SimpleNamespace()
+    m.execution = e
+    e.engine_requests_total = reg.counter(
+        "lodestar_execution_engine_http_requests_total",
+        "Engine API calls",
+        label_names=("method",),
+    )
+    e.engine_errors_total = reg.counter(
+        "lodestar_execution_engine_http_errors_total",
+        "Engine API failures",
+        label_names=("method",),
+    )
+    e.eth1_deposits_followed = reg.gauge(
+        "lodestar_eth1_deposit_count", "Deposit logs followed"
+    )
+    e.eth1_blocks_followed = reg.gauge(
+        "lodestar_eth1_followed_blocks_count",
+        "Eth1 headers in the vote-candidate window",
+    )
+
+    # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
+    k = SimpleNamespace()
+    m.clock = k
+    k.slot = reg.gauge("beacon_clock_slot", "Wall-clock slot")
+    k.epoch = reg.gauge("beacon_clock_epoch", "Wall-clock epoch")
+    k.event_loop_lag = reg.histogram(
+        "lodestar_event_loop_lag_seconds",
+        "Observed asyncio loop scheduling lag",
+        buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1),
+    )
     return m

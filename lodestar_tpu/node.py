@@ -331,7 +331,48 @@ class BeaconNode:
         )
         port = node.api_server.start()
         log.info("rest api listening", {"port": port})
-        # metrics
+        # metrics: sampled gauges collect live values at scrape time
+        # (reference addCollect pattern, registryMetricCreator.ts)
+        mm = node.metrics
+        if node.network is not None:
+            mm.network.peers.add_collect(
+                lambda g: g.set(len(node.network.host.conns))
+            )
+            mm.network.gossip_mesh_peers.add_collect(
+                lambda g: [
+                    g.set(len(peers), type=topic.rsplit("/", 2)[-2])
+                    for topic, peers in node.network.gossip.mesh.items()
+                ]
+            )
+        mm.regen.state_cache_size.add_collect(
+            lambda g: g.set(len(node.chain._states))
+        )
+        mm.op_pool.attestation_pool_size.add_collect(
+            lambda g: g.set(
+                sum(len(v) for v in node.att_pool._groups.values())
+            )
+        )
+        def _wall_slot(g):
+            import time as _t
+
+            gt = node.chain.genesis_time
+            sps = node.cfg.SECONDS_PER_SLOT
+            slot = max(0, int((_t.time() - gt) // sps))
+            g.set(slot)
+
+        mm.clock.slot.add_collect(_wall_slot)
+        mm.clock.epoch.add_collect(
+            lambda g: g.set(
+                max(
+                    0,
+                    int(
+                        (__import__("time").time() - node.chain.genesis_time)
+                        // node.cfg.SECONDS_PER_SLOT
+                    ),
+                )
+                // preset().SLOTS_PER_EPOCH
+            )
+        )
         if node.metrics_port is not None:
             node.metrics_server = MetricsServer(
                 node.metrics_registry, port=node.metrics_port

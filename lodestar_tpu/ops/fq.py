@@ -55,11 +55,22 @@ def select(mask: jax.Array, a: Lv, b: Lv) -> Lv:
 
 
 def pow_const(a: Lv, e: int) -> Lv:
-    """a^e for a fixed public exponent, as a scan over its bits (LSB
-    first). Graph size is O(1) in the exponent length."""
+    """a^e for a fixed public exponent. On TPU with a 1-D batch the
+    whole square-and-multiply chain runs as ONE fused Pallas kernel
+    with the limb state VMEM-resident (ops/pallas_chain.py — measured
+    0.6 ms vs 452 ms for the XLA scan at batch 2048, 379-bit
+    exponent). Elsewhere: a scan over the exponent bits (LSB first),
+    graph size O(1) in the exponent length."""
     assert e >= 0
     if e == 0:
         return const(1, a.v.shape[:-1])
+    if e > 1 and a.v.ndim == 2:
+        import jax as _jax
+
+        if _jax.default_backend() == "tpu":
+            from . import pallas_chain
+
+            return pallas_chain.pow_const(a, e)
     bits = jnp.asarray(
         np.array([(e >> i) & 1 for i in range(e.bit_length())], np.bool_)
     )

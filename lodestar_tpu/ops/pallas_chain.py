@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: fused fixed-exponent Fp power chains.
+
+Why: the ingest pipeline's sqrt/inverse chains are ~381-step
+square-and-multiply loops. As XLA scans, every step round-trips the
+(batch, 40)-limb state through HBM (~0.15 ms/step at batch 2048 —
+bandwidth-bound), so one chain costs ~60+ ms and the ingest stages
+stack up ~16 of them. This kernel runs the WHOLE chain with the limb
+state resident in VMEM: per step only register/VMEM traffic, turning
+the chain compute-bound (~100 vector ops per modular multiply).
+
+Layout: limbs on SUBLANES (40 rows, statically indexed — no lane
+shuffles, the failure mode of earlier Pallas attempts), batch on
+LANES (128 per grid block). The exponent is a static python int baked
+into the kernel via an SMEM bit array + fori_loop.
+
+Used by ops/ingest.py when running on a real TPU; the XLA scan
+(fq.pow_const) remains the fallback and the differential oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls.fields import P
+from . import limbs as L
+
+NLIMB = 39  # value limbs (see ops/limbs.py)
+ROWS = 40  # canonical row count (39 + carry)
+PAD_ROWS = 80  # product accumulator rows (79 used, padded to 8k)
+LANES = 128  # batch elements per grid block
+
+
+FOLD_ROWS = 48  # 41 used (limbs 40..80), padded to a sublane multiple
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_rows() -> np.ndarray:
+    """(48, 40) int32: row k = canonical limbs of 2^(10*(40+k)) mod P.
+    Rows 0..39 fold product limbs 40..79; row 40 folds the explicit
+    carry captured out of accumulator row 79 (weight 2^800)."""
+    out = np.zeros((FOLD_ROWS, ROWS), np.int32)
+    for k in range(41):
+        out[k, :NLIMB] = L.int_to_limbs(pow(2, L.BITS * (40 + k), P))
+    return out
+
+
+def _carry(acc, passes: int):
+    """Parallel carry passes: limb = limb&1023 + incoming carry.
+    Non-negative inputs only. Keeps shape; carries out of the top row
+    are folded by the caller's fold step (values stay < 2^31)."""
+    for _ in range(passes):
+        hi = acc >> L.BITS
+        lo = acc - (hi << L.BITS)
+        shifted = jnp.concatenate(
+            [jnp.zeros((1, acc.shape[1]), jnp.int32), hi[:-1, :]],
+            axis=0,
+        )
+        acc = lo + shifted
+    return acc
+
+
+def _modmul(a, b, fold_const):
+    """(40, 128) x (40, 128) canonical non-negative limbs -> (40, 128).
+
+    Schoolbook product into an 80-row accumulator via 40 broadcast
+    MACs (static sublane slices), parallel carries, constant-row fold
+    of limbs 40..78, final carry + one-row refold."""
+    # Schoolbook accumulation as a sum of zero-padded shifted terms:
+    # Mosaic lowers neither scatter-add nor value dynamic_slice, but
+    # static concatenation + adds vectorize cleanly.
+    acc = jnp.zeros((PAD_ROWS, LANES), jnp.int32)
+    for i in range(ROWS):
+        term = a[i : i + 1, :] * b  # (40, 128)
+        parts = []
+        if i:
+            parts.append(jnp.zeros((i, LANES), jnp.int32))
+        parts.append(term)
+        parts.append(
+            jnp.zeros((PAD_ROWS - ROWS - i, LANES), jnp.int32)
+        )
+        acc = acc + jnp.concatenate(parts, axis=0)
+    # limbs <= 40 * 1025^2 < 2^26. Pass 1 brings them <= 1023 + 2^16
+    # without losing anything (row 79 only RECEIVES carry in pass 1).
+    acc = _carry(acc, 1)
+    # Pass 2 with the row-79 outgoing carry captured explicitly: its
+    # weight is limb 80 and it folds through fold row 40.
+    hi2 = acc >> L.BITS
+    lo2 = acc - (hi2 << L.BITS)
+    extra = hi2[PAD_ROWS - 1 : PAD_ROWS, :]  # <= 64, weight 2^800
+    acc = lo2 + jnp.concatenate(
+        [jnp.zeros((1, LANES), jnp.int32), hi2[:-1, :]], axis=0
+    )
+    lo = acc[:ROWS, :]
+    hi = acc[ROWS:, :]  # rows 40..79, limbs <= ~1088
+    for k in range(ROWS):
+        lo = lo + fold_const[k].reshape(ROWS, 1) * hi[k : k + 1, :]
+    lo = lo + fold_const[ROWS].reshape(ROWS, 1) * extra
+    # fold sum < 41 * 1088 * 1023 < 2^26. Reduce with capture-and-fold
+    # rounds: every carry pass captures the row-39 outgoing carry
+    # (weight = limb 40) and folds it straight back through fold row 0
+    # — a plain carry would silently DROP it. Four rounds bring the
+    # worst case down to a canonical-profile value.
+    fold0 = fold_const[0].reshape(ROWS, 1)
+    for _ in range(4):
+        hi_ = lo >> L.BITS
+        lo = lo - (hi_ << L.BITS)
+        top = hi_[ROWS - 1 : ROWS, :]
+        lo = (
+            lo
+            + jnp.concatenate(
+                [jnp.zeros((1, LANES), jnp.int32), hi_[:-1, :]],
+                axis=0,
+            )
+            + fold0 * top
+        )
+    return lo
+
+
+def _chain_kernel(bits_ref, fold_ref, base_ref, out_ref, *, nbits: int):
+    fold_const = fold_ref[:]
+    base = base_ref[:]
+
+    def body(i, acc):
+        acc = _modmul(acc, acc, fold_const)
+        prod = _modmul(acc, base, fold_const)
+        bit = bits_ref[i + 1]  # MSB consumed by the init
+        return jnp.where(bit == 1, prod, acc)
+
+    acc = jax.lax.fori_loop(0, nbits - 1, body, base)
+    out_ref[:] = acc
+
+
+@functools.lru_cache(maxsize=None)
+def _chain_call(e: int, n_blocks: int):
+    nbits = e.bit_length()
+    bits = np.array(
+        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], np.int32
+    )
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_chain_kernel, nbits=nbits)
+
+    @jax.jit
+    def run(base):  # base: (40, n_blocks*128), limbs on sublanes
+        return pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (ROWS, n_blocks * LANES), jnp.int32
+            ),
+        )(jnp.asarray(bits), jnp.asarray(_fold_rows()), base)
+
+    return run
+
+
+def pow_const(a: L.Lv, e: int) -> L.Lv:
+    """Drop-in for fq.pow_const on TPU: a^(e) for batched canonical
+    values, whole chain fused in one Pallas kernel. Batch must be 1-D;
+    padded to a multiple of 128 lanes."""
+    assert e > 0
+    x = L.normalize(a)
+    v = x.v  # (batch, NCANON)
+    batch = v.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+    vt = jnp.transpose(
+        jnp.pad(v, ((0, padded - batch), (0, 0)))
+    )  # (40, padded) limbs-on-sublanes
+    out = _chain_call(e, n_blocks)(vt)
+    res = jnp.transpose(out)[:batch, :]
+    # HONEST bounds: the kernel's final capture-and-fold rounds leave
+    # limbs <= ~1025 everywhere INCLUDING row 39 (fold rows have zero
+    # top limbs, but row 39 still receives ordinary carries), so the
+    # value can exceed the canonical-profile claim. Downstream
+    # normalize()/canon_digits stay sound because the interval
+    # machinery sees these wider bounds and reduces accordingly.
+    hi = tuple([L.B + 2] * L.NCANON)
+    return L.Lv(res, tuple([0] * L.NCANON), hi)

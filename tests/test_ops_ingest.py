@@ -1,0 +1,125 @@
+"""Device-side ingestion kernels vs the pure-Python oracle.
+
+Covers ops/ingest.py (fq2 sqrt, G2 decompression with psi subgroup
+check, SSWU/isogeny/cofactor hash-to-G2) and ops/pallas_chain.py (the
+fused power-chain kernel, in interpreter mode on CPU).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as oc
+from lodestar_tpu.crypto.bls import fields as OF
+from lodestar_tpu.crypto.bls.fields import P
+from lodestar_tpu.ops import curve as C
+from lodestar_tpu.ops import ingest, limbs as L, tower
+
+
+class TestFq2SqrtFlagged:
+    def test_squares_and_non_squares(self):
+        cases = [
+            OF.fq2_sqr((12345, 67890)),
+            (OF.fq2_sqr((5, 0))[0], 0),  # a1=0, a0 QR
+            OF.fq2_sqr((0, 987654321)),  # a1=0, a0 non-QR (=-c^2)
+            (7, 9),
+            (3, 5),
+            (11, 2),
+        ]
+        vals = tower.fq2_from_ints(cases)
+        y, flag = jax.jit(ingest.fq2_sqrt_flagged)(vals)
+        flag = np.asarray(flag)
+        y0 = L.to_ints(y[0])
+        y1 = L.to_ints(y[1])
+        for i, a in enumerate(cases):
+            want = OF.fq2_sqrt(a)
+            assert bool(flag[i]) == (want is not None), i
+            if want is not None:
+                got = (int(y0[i]), int(y1[i]))
+                assert OF.fq2_sqr(got) == a, i
+
+
+class TestG2DecompressDevice:
+    def test_matches_oracle_and_rejects_tampered(self):
+        sigs = [
+            oc.g2_to_bytes(oc.g2_mul(oc.G2_GEN, k)) for k in (5, 77)
+        ]
+        bad = bytearray(sigs[0])
+        bad[60] ^= 0xFF
+        sigs.append(bytes(bad))
+        parsed = [ingest.parse_g2_compressed(s) for s in sigs]
+        xs = tower.fq2_from_ints([(p[0], p[1]) for p in parsed])
+        signs = jnp.asarray([p[2] for p in parsed])
+        q, valid = jax.jit(
+            lambda x, s: ingest.g2_decompress(x, s, (3,))
+        )(xs, signs)
+        valid = np.asarray(valid)
+        assert list(valid[:2]) == [True, True]
+        affs = C.jac_to_affine_ints(C.FQ2_OPS, q)
+        for i, k in enumerate((5, 77)):
+            assert affs[i] == oc.g2_mul(oc.G2_GEN, k)
+        assert not bool(valid[2])
+
+    def test_parse_rejects_bad_encodings(self):
+        gen = oc.g2_to_bytes(oc.G2_GEN)
+        assert ingest.parse_g2_compressed(gen)[3]
+        # no compression bit
+        bad = bytes([gen[0] & 0x7F]) + gen[1:]
+        assert not ingest.parse_g2_compressed(bad)[3]
+        # infinity encoding is invalid for verification
+        inf = bytes([0xC0]) + b"\x00" * 95
+        assert not ingest.parse_g2_compressed(inf)[3]
+        # non-canonical coordinate (x >= P)
+        over = bytearray(gen)
+        over[48:96] = (P + 1).to_bytes(48, "big")
+        assert not ingest.parse_g2_compressed(bytes(over))[3]
+
+
+class TestHashToG2Device:
+    def test_matches_oracle(self):
+        from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2_py
+        from lodestar_tpu.params import BLS_DST_SIG
+
+        msgs = [bytes([i]) * 32 for i in range(2)]
+        draws = [
+            ingest.message_to_field_draws(m, bytes(BLS_DST_SIG))
+            for m in msgs
+        ]
+        u0 = tower.fq2_from_ints([d[0] for d in draws])
+        u1 = tower.fq2_from_ints([d[1] for d in draws])
+        h = jax.jit(
+            lambda a, b: ingest.hash_to_g2_device(a, b, (2,))
+        )(u0, u1)
+        affs = C.jac_to_affine_ints(C.FQ2_OPS, h)
+        for i, m in enumerate(msgs):
+            assert affs[i] == hash_to_g2_py(m, bytes(BLS_DST_SIG)), i
+
+
+class TestPallasChain:
+    def test_interpret_mode_matches_pow(self):
+        from jax.experimental import pallas as pl
+
+        from lodestar_tpu.ops import pallas_chain as PC
+
+        orig = pl.pallas_call
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        PC._chain_call.cache_clear()
+        try:
+            import random
+
+            random.seed(11)
+            xs = [12345, P - 1, P - 2, 3] + [
+                random.randrange(P) for _ in range(4)
+            ]
+            a = L.from_ints(xs)
+            for e in (2, 65537, (P + 1) // 4):
+                got = [int(v) for v in L.to_ints(PC.pow_const(a, e))]
+                assert got == [pow(x, e, P) for x in xs], e
+                arr = np.asarray(PC.pow_const(a, e).v)
+                assert arr.min() >= 0 and arr.max() <= L.B + 1
+        finally:
+            pl.pallas_call = orig
+            PC._chain_call.cache_clear()

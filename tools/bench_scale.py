@@ -1,0 +1,128 @@
+"""Scale-realism benchmarks at mainnet preset (VERDICT r2 #10).
+
+Reference analog: packages/state-transition/test/perf/ (epoch
+processing per step, hashTreeRoot, block packing). Measures, at
+100k-1M validator registries:
+  - full epoch transition (process_epoch) on a participation-filled
+    altair state,
+  - aggregated-attestation pool packing (getAttestationsForBlock),
+  - swap-or-not shuffling of the full registry.
+HTR numbers live in tools/bench_htr.py.
+
+Run: LODESTAR_PRESET=mainnet python tools/bench_scale.py [n_validators]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("LODESTAR_PRESET", "mainnet")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from lodestar_tpu.chain.oppools import AggregatedAttestationPool  # noqa: E402
+from lodestar_tpu.config.chain_config import ChainConfig  # noqa: E402
+from lodestar_tpu.params import preset  # noqa: E402
+from lodestar_tpu.statetransition import util  # noqa: E402
+from lodestar_tpu.statetransition.epoch import process_epoch  # noqa: E402
+from lodestar_tpu.types import factory  # noqa: E402
+
+FAR = 2**64 - 1
+
+
+def build_state(types, n: int):
+    """Active altair registry of n validators with full participation
+    (the worst-case epoch-processing shape)."""
+    ns = types.by_fork["altair"]
+    state = ns.BeaconState.default()
+    p = preset()
+    state.slot = 10 * p.SLOTS_PER_EPOCH - 1
+    for i in range(n):
+        state.validators.append(
+            types.Validator(
+                pubkey=i.to_bytes(48, "little"),
+                withdrawal_credentials=(i * 7).to_bytes(32, "little"),
+                effective_balance=32_000_000_000,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=FAR,
+                withdrawable_epoch=FAR,
+            )
+        )
+        state.balances.append(32_000_000_000)
+    state.previous_epoch_participation = [0b111] * n
+    state.current_epoch_participation = [0b111] * n
+    state.inactivity_scores = [0] * n
+    # checkpoints so justification math runs
+    state.current_justified_checkpoint.epoch = 8
+    state.previous_justified_checkpoint.epoch = 8
+    state.finalized_checkpoint.epoch = 7
+    for i in range(p.EPOCHS_PER_HISTORICAL_VECTOR):
+        state.randao_mixes[i] = os.urandom(32)
+    for i in range(p.SLOTS_PER_HISTORICAL_ROOT):
+        state.block_roots[i] = b"\x11" * 32
+        state.state_roots[i] = b"\x22" * 32
+    return state
+
+
+def bench_epoch(cfg, types, n: int) -> float:
+    from lodestar_tpu.params import ForkSeq
+
+    state = build_state(types, n)
+    t0 = time.perf_counter()
+    process_epoch(cfg, state, types, int(ForkSeq.altair))
+    return time.perf_counter() - t0
+
+
+def bench_shuffle(types, n: int) -> float:
+    state = build_state(types, n)
+    t0 = time.perf_counter()
+    util.get_shuffling(state, 9)
+    return time.perf_counter() - t0
+
+
+def bench_pool_packing(types, n_atts: int = 1024) -> float:
+    """Pack a slot's block attestations from a pool holding n_atts
+    aggregates across recent slots (aggregatedAttestationPool.ts:94)."""
+    p = preset()
+    pool = AggregatedAttestationPool(types)
+    comm = p.TARGET_COMMITTEE_SIZE
+    for i in range(n_atts):
+        att = types.Attestation.default()
+        att.data.slot = 30 + (i % p.SLOTS_PER_EPOCH)
+        att.data.index = i % p.MAX_COMMITTEES_PER_SLOT
+        att.data.beacon_block_root = bytes([i % 251]) * 32
+        att.aggregation_bits = [
+            (i + j) % 3 != 0 for j in range(comm)
+        ]
+        att.signature = b"\xc0" + b"\x00" * 95
+        pool.add(att)
+    t0 = time.perf_counter()
+    got = pool.get_attestations_for_block(30 + p.SLOTS_PER_EPOCH)
+    dt = time.perf_counter() - t0
+    assert len(got) <= p.MAX_ATTESTATIONS
+    return dt
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg = ChainConfig(ALTAIR_FORK_EPOCH=0)
+    types = factory.ssz_types()
+    p = preset()
+    print(f"preset={os.environ['LODESTAR_PRESET']} validators={n}")
+    dt = bench_epoch(cfg, types, n)
+    print(f"epoch transition ({n} validators): {dt * 1000:.0f} ms")
+    dt = bench_shuffle(types, n)
+    print(f"shuffling ({n} validators): {dt * 1000:.0f} ms")
+    dt = bench_pool_packing(types)
+    print(f"attestation pool packing (1024 aggregates): {dt * 1000:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
