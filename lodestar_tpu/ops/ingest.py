@@ -222,7 +222,17 @@ def jac_eq(a: C.JacPoint, b: C.JacPoint) -> jax.Array:
 
 
 def g2_in_subgroup(p: C.JacPoint, batch) -> jax.Array:
-    """psi(Q) == [x]Q (Bowe's fast check; csrc analog)."""
+    """psi(Q) == [x]Q (Bowe's fast check; csrc analog). Callers pass
+    an AFFINE-constructed point (jac_from_affine), so on TPU the |x|
+    ladder runs as the fused Pallas kernel."""
+    if jax.default_backend() == "tpu" and len(tuple(batch)) == 1:
+        from . import pallas_ladder as PL
+
+        bits = jnp.broadcast_to(
+            jnp.asarray(_x_bits()), tuple(batch) + (64,)
+        )
+        xq = jac_neg(PL.g2_scalar_mul(p.x, p.y, bits, p.inf))
+        return jac_eq(jac_psi(p), xq)
     return jac_eq(jac_psi(p), _mul_x(p, batch))
 
 

@@ -1,0 +1,317 @@
+"""Pallas TPU kernel: fused G2 scalar-multiplication ladder.
+
+Companion to pallas_chain.py (same layout: limbs on sublanes, batch on
+lanes, whole loop VMEM-resident). A 64-step double-and-add over a G2
+point in jacobian coordinates costs ~45 modular multiplies per step;
+as XLA scan every step round-trips ~1 KB/element through HBM, which
+makes the two random-weight ladders and the ingest subgroup/cofactor
+ladders a large slice of the verify pipeline. Here the whole ladder is
+one kernel invocation.
+
+Field layout per fq2 element: two (40, 128) int32 planes (c0, c1).
+Point state: affine base (qx, qy) + jacobian accumulator (X, Y, Z) +
+an (1, 128) infinity mask. Formulas mirror ops/curve.py jac_double
+(dbl-2009-l) and jac_mixed_add exactly — that module is the
+differential oracle.
+
+Signed values never appear: subtraction adds a limb-wise offset O with
+per-limb O_i >= 1025 and value(O) == 0 mod P (ops/limbs._offset_limbs
+construction), then a capture-and-fold carry round renormalizes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls.fields import P
+from . import limbs as L
+from .pallas_chain import LANES, ROWS, _fold_rows, _modmul
+
+NBITS = 64  # random-weight ladder width (kernels.RAND_BITS)
+
+
+@functools.lru_cache(maxsize=None)
+def _sub_offset() -> np.ndarray:
+    """(40,) int32: per-limb >= 1025, value == 0 mod P."""
+    off = L._offset_limbs(tuple([-1025] * ROWS))
+    arr = np.zeros(ROWS, np.int32)
+    arr[: len(off)] = off[:ROWS]
+    # _offset_limbs may produce >40 limbs; fold any excess back
+    extra = sum(
+        int(v) << (L.BITS * (ROWS + i)) for i, v in enumerate(off[ROWS:])
+    )
+    if extra:
+        red = L.int_to_limbs(extra % P)
+        arr[: len(red)] += red
+    assert all(arr >= 1025), arr.min()
+    return arr
+
+
+def _norm2(x, fold0):
+    """Two capture-and-fold carry rounds. The fold rows' top limbs are
+    zero (residues < P < 2^381 have empty limb 39), so captured top
+    carries do not feed back — two rounds bring post-add/sub limb
+    magnitudes (~<2^13) back to ~1030 with row 39 small."""
+    for _ in range(2):
+        hi = x >> L.BITS
+        lo = x - (hi << L.BITS)
+        top = hi[ROWS - 1 : ROWS, :]
+        x = (
+            lo
+            + jnp.concatenate(
+                [jnp.zeros((1, x.shape[1]), jnp.int32), hi[:-1, :]],
+                axis=0,
+            )
+            + fold0 * top
+        )
+    return x
+
+
+def _mk_field(fold_const, off_const):
+    """Field helpers bound to the in-kernel constants."""
+    fold0 = fold_const[0].reshape(ROWS, 1)
+    off = off_const.reshape(ROWS, 1)
+
+    def mm(a, b):
+        return _modmul(a, b, fold_const)
+
+    def sub(a, b):
+        # a <= ~1100 per limb, off >= 1025 >= b's post-norm limbs...
+        # b may reach ~1100 after adds: use 2*off to stay non-negative
+        return _norm2(a + 2 * off - b, fold0)
+
+    def add(a, b):
+        return _norm2(a + b, fold0)
+
+    def small(a, k):
+        return _norm2(a * k, fold0)
+
+    def f2_mul(a, b):
+        m0 = mm(a[0], b[0])
+        m1 = mm(a[1], b[1])
+        s = mm(_norm2(a[0] + a[1], fold0), _norm2(b[0] + b[1], fold0))
+        return (sub(m0, m1), sub(sub(s, m0), m1))
+
+    def f2_sqr(a):
+        return f2_mul(a, a)
+
+    def f2_sub(a, b):
+        return (sub(a[0], b[0]), sub(a[1], b[1]))
+
+    def f2_add(a, b):
+        return (add(a[0], b[0]), add(a[1], b[1]))
+
+    def f2_small(a, k):
+        return (small(a[0], k), small(a[1], k))
+
+    def f2_sel(m, a, b):
+        # m: (1, LANES) int32 0/1
+        return (
+            jnp.where(m != 0, a[0], b[0]),
+            jnp.where(m != 0, a[1], b[1]),
+        )
+
+    return mm, f2_mul, f2_sqr, f2_sub, f2_add, f2_small, f2_sel
+
+
+def _ladder_kernel(
+    nbits,
+    bits_ref,
+    fold_ref,
+    off_ref,
+    qx0_ref, qx1_ref, qy0_ref, qy1_ref, qinf_ref,
+    ox0_ref, ox1_ref, oy0_ref, oy1_ref, oz0_ref, oz1_ref, oinf_ref,
+):
+    fold_const = fold_ref[:]
+    off_const = off_ref[0:1, :].reshape(ROWS)
+    (mm, f2_mul, f2_sqr, f2_sub, f2_add, f2_small, f2_sel) = _mk_field(
+        fold_const, off_const
+    )
+    qx = (qx0_ref[:], qx1_ref[:])
+    qy = (qy0_ref[:], qy1_ref[:])
+    q_inf = qinf_ref[:]  # (1, LANES) int32
+
+    def jac_double(X, Y, Z):
+        A = f2_sqr(X)
+        Bv = f2_sqr(Y)
+        Cv = f2_sqr(Bv)
+        t = f2_sqr(f2_add(X, Bv))
+        D = f2_small(f2_sub(f2_sub(t, A), Cv), 2)
+        E = f2_small(A, 3)
+        F = f2_sqr(E)
+        x3 = f2_sub(F, f2_small(D, 2))
+        y3 = f2_sub(f2_mul(E, f2_sub(D, x3)), f2_small(Cv, 8))
+        z3 = f2_small(f2_mul(Y, Z), 2)
+        return x3, y3, z3
+
+    def jac_mixed_add(X, Y, Z, inf):
+        z2 = f2_sqr(Z)
+        z3 = f2_mul(z2, Z)
+        mu = f2_sub(f2_mul(qx, z2), X)
+        th = f2_sub(f2_mul(qy, z3), Y)
+        mu2 = f2_sqr(mu)
+        mu3 = f2_mul(mu2, mu)
+        xmu2 = f2_mul(X, mu2)
+        x3 = f2_sub(f2_sub(f2_sqr(th), mu3), f2_small(xmu2, 2))
+        y3 = f2_sub(
+            f2_mul(th, f2_sub(xmu2, x3)), f2_mul(Y, mu3)
+        )
+        z3v = f2_mul(Z, mu)
+        # acc at infinity -> q (affine, Z = 1)
+        one = jnp.concatenate(
+            [jnp.ones((1, LANES), jnp.int32),
+             jnp.zeros((ROWS - 1, LANES), jnp.int32)],
+            axis=0,
+        )
+        x3 = f2_sel(inf, qx, x3)
+        y3 = f2_sel(inf, qy, y3)
+        z3v = f2_sel(inf, (one, jnp.zeros((ROWS, LANES), jnp.int32)), z3v)
+        new_inf = inf * q_inf  # stay infinite only if q is too
+        return x3, y3, z3v, new_inf
+
+    zero = jnp.zeros((ROWS, LANES), jnp.int32)
+    state = (
+        zero, zero,  # X
+        zero, zero,  # Y
+        zero, zero,  # Z
+        jnp.ones((1, LANES), jnp.int32),  # inf
+    )
+
+    def body(i, st):
+        X = (st[0], st[1]); Y = (st[2], st[3]); Z = (st[4], st[5])
+        inf = st[6]
+        dX, dY, dZ = jac_double(X, Y, Z)
+        # doubling infinity stays infinity: select old state
+        dX = f2_sel(inf, X, dX)
+        dY = f2_sel(inf, Y, dY)
+        dZ = f2_sel(inf, Z, dZ)
+        aX, aY, aZ, a_inf = jac_mixed_add(dX, dY, dZ, inf)
+        bit = bits_ref[i, 0:1, :]  # (1, LANES)
+        nX = f2_sel(bit, aX, dX)
+        nY = f2_sel(bit, aY, dY)
+        nZ = f2_sel(bit, aZ, dZ)
+        n_inf = jnp.where(bit != 0, a_inf, inf)
+        return (nX[0], nX[1], nY[0], nY[1], nZ[0], nZ[1], n_inf)
+
+    st = jax.lax.fori_loop(0, nbits, body, state)
+    ox0_ref[:] = st[0]
+    ox1_ref[:] = st[1]
+    oy0_ref[:] = st[2]
+    oy1_ref[:] = st[3]
+    oz0_ref[:] = st[4]
+    oz1_ref[:] = st[5]
+    oinf_ref[:] = st[6]
+
+
+@functools.lru_cache(maxsize=None)
+def _ladder_call(n_blocks: int, nbits: int = NBITS):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_ladder_kernel, nbits)
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    flag = lambda: pl.BlockSpec(  # noqa: E731
+        (1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(bits, qx0, qx1, qy0, qy1, qinf):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (nbits, 1, LANES),
+                    lambda i: (0, 0, i),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+                vec(), vec(), vec(), vec(), flag(),
+            ],
+            out_specs=[vec(), vec(), vec(), vec(), vec(), vec(), flag()],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(6)
+            ]
+            + [jax.ShapeDtypeStruct((1, n), jnp.int32)],
+        )(
+            bits,
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            qx0, qx1, qy0, qy1, qinf,
+        )
+
+    return run
+
+
+def g2_scalar_mul(qx, qy, bits, q_inf=None):
+    """[k]Q for per-element 64-bit scalars — drop-in for
+    curve.scalar_mul(FQ2_OPS, ...) on TPU.
+
+    qx, qy: fq2 tuples of canonical Lv (batch, 40); bits: (batch, 64)
+    bool MSB-first; q_inf: optional (batch,) bool. Returns a
+    curve.JacPoint with canonical-profile coordinates."""
+    from . import curve as C
+
+    x0 = L.normalize(qx[0]).v
+    x1 = L.normalize(qx[1]).v
+    y0 = L.normalize(qy[0]).v
+    y1 = L.normalize(qy[1]).v
+    batch = x0.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+
+    def prep(v):
+        return jnp.transpose(jnp.pad(v, ((0, padded - batch), (0, 0))))
+
+    nbits = bits.shape[-1]
+    bits_arr = jnp.transpose(
+        jnp.pad(
+            bits.astype(jnp.int32), ((0, padded - batch), (0, 0))
+        )
+    ).reshape(nbits, 1, padded)
+    if q_inf is None:
+        qinf_arr = jnp.zeros((1, padded), jnp.int32)
+    else:
+        qinf_arr = jnp.pad(
+            q_inf.astype(jnp.int32), (0, padded - batch),
+            constant_values=1,
+        ).reshape(1, padded)
+    outs = _ladder_call(n_blocks, nbits)(
+        bits_arr, prep(x0), prep(x1), prep(y0), prep(y1), qinf_arr
+    )
+    def unprep(v):
+        return jnp.transpose(v)[:batch, :]
+
+    def lv(v):
+        # HONEST bounds (see pallas_chain.pow_const): kernel limbs can
+        # reach ~1025 in every row including the top one, wider than
+        # the canonical-profile claim — downstream interval-driven
+        # reduction must see that or exact equality goes wrong.
+        return L.Lv(
+            unprep(v),
+            tuple([0] * L.NCANON),
+            tuple([L.B + 2] * L.NCANON),
+        )
+
+    return C.JacPoint(
+        (lv(outs[0]), lv(outs[1])),
+        (lv(outs[2]), lv(outs[3])),
+        (lv(outs[4]), lv(outs[5])),
+        jnp.transpose(outs[6])[:batch, 0] != 0,
+    )
