@@ -715,20 +715,35 @@ class BeaconApiImpl:
 
     async def publish_aggregate_and_proofs(self, body: list) -> dict:
         """SignedAggregateAndProof submissions
-        (routes/validator.ts publishAggregateAndProofs): validated
-        through the gossip aggregate path, then pooled for block
-        inclusion."""
+        (routes/validator.ts publishAggregateAndProofs): each aggregate
+        runs the FULL gossip aggregate validation (three signature sets
+        through the TPU verifier, processor.process_aggregate) before
+        pooling/re-publish; invalid ones 400 (gossipHandlers
+        submitPoolAggregateAndProofs semantics). Without a wired
+        processor (embedded test api), falls back to direct pooling."""
+        from ..chain.validation import GossipAction
         from .json_codec import from_json
 
+        proc = getattr(self.node, "processor", None) if self.node else None
+        has_validator = (
+            proc is not None and proc.aggregate_validator is not None
+        )
         errors = []
         for i, obj in enumerate(body):
             try:
                 sap = from_json(
                     self.types.SignedAggregateAndProof, obj
                 )
-                agg = sap.message.aggregate
-                if self.node is not None and self.node.att_pool is not None:
-                    self.node.att_pool.add(agg)
+                if has_validator:
+                    action = await proc.process_aggregate(sap)
+                    if action == GossipAction.REJECT:
+                        errors.append(
+                            {"index": i, "message": "rejected: invalid"}
+                        )
+                        continue
+                    # ACCEPT pooled by the processor; IGNORE = seen
+                elif self.node is not None and self.node.att_pool is not None:
+                    self.node.att_pool.add(sap.message.aggregate)
                 if self.node is not None and self.node.network is not None:
                     await self.node.network.publish_aggregate(sap)
             except Exception as e:
@@ -844,16 +859,33 @@ class BeaconApiImpl:
         from ..params import SYNC_COMMITTEE_SUBNET_COUNT
 
         pool, _ = self._sync_pools()
-        st = self.chain.head_state.state
+        view = self.chain.head_state
+        st = view.state
         p = preset()
         sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
-        pubkey_to_positions: dict[bytes, list[int]] = {}
-        for pos, pk in enumerate(st.current_sync_committee.pubkeys):
-            pubkey_to_positions.setdefault(bytes(pk), []).append(pos)
+        # committee by the MESSAGE slot's period (epoch(slot+1) rule,
+        # mirroring get_sync_committee_duties) — near a period boundary
+        # next-period messages would get wrong/missing positions from
+        # current_sync_committee alone (ADVICE r3)
+        pos_memo: dict[int, dict[bytes, list[int]]] = {}
+
+        def positions_for(slot: int) -> dict[bytes, list[int]]:
+            epoch = util.compute_epoch_at_slot(slot + 1)
+            per = preset().EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            period = epoch // per
+            if period not in pos_memo:
+                committee = self._sync_committee_for_epoch(view, epoch)
+                m: dict[bytes, list[int]] = {}
+                for pos, pk in enumerate(committee.pubkeys):
+                    m.setdefault(bytes(pk), []).append(pos)
+                pos_memo[period] = m
+            return pos_memo[period]
+
         errors = []
         for i, msg in enumerate(body):
             try:
                 vi = int(msg["validator_index"])
+                pubkey_to_positions = positions_for(int(msg["slot"]))
                 pk = bytes(st.validators[vi].pubkey)
                 positions = pubkey_to_positions.get(pk, [])
                 for pos in positions:

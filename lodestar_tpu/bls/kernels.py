@@ -182,10 +182,7 @@ def _stage_hash_to_g2(u0, u1, mask):
 @jax.jit
 def _stage_final_with_valid(prod, all_valid):
     """Final exponentiation AND the ingest validity conjunction."""
-    return jnp.logical_and(
-        pairing.fq12_is_one(pairing.final_exponentiation(prod)),
-        all_valid,
-    )
+    return jnp.logical_and(_stage_final(prod), all_valid)
 
 
 def run_verify_batch_ingest_async(
@@ -247,15 +244,50 @@ def _stage_prepare_same_message(
     return px, py, qx, qy, jnp.asarray([True, True])
 
 
-_stage_miller = jax.jit(pairing.miller_loop)
+_stage_miller_xla = jax.jit(pairing.miller_loop)
 _stage_product = jax.jit(pairing._fq12_masked_product)
 
 
+def _pallas_pairing_on() -> bool:
+    """The fused Miller/final-exp kernels run only on real TPUs (the
+    XLA scan path stays as CPU fallback + differential oracle)."""
+    return jax.default_backend() == "tpu"
+
+
 @jax.jit
+def _stage_miller_pallas(px, py, qx, qy):
+    from ..ops import pallas_pairing as PP
+
+    return PP.miller_loop(px, py, qx, qy)
+
+
+def _stage_miller(px, py, qx, qy):
+    """Miller loop: VMEM-resident Pallas ladder on TPU (the round-3
+    device-time wall — 63 scan steps round-tripping the Fq12 state
+    through HBM), XLA scan elsewhere."""
+    if _pallas_pairing_on():
+        return _stage_miller_pallas(px, py, qx, qy)
+    return _stage_miller_xla(px, py, qx, qy)
+
+
+@jax.jit
+def _stage_final_xla(prod):
+    return pairing.fq12_is_one(pairing.final_exponentiation(prod))
+
+
+@jax.jit
+def _stage_final_pallas(prod):
+    from ..ops import pallas_pairing as PP
+
+    return pairing.fq12_is_one(PP.final_exponentiation(prod))
+
+
 def _stage_final(prod):
     """Shared final exponentiation + ==1 test. Batch shape () — one
     compile serves every bucket size."""
-    return pairing.fq12_is_one(pairing.final_exponentiation(prod))
+    if _pallas_pairing_on():
+        return _stage_final_pallas(prod)
+    return _stage_final_xla(prod)
 
 
 def _run_pipeline(prepare, pk, h, sig, rand_bits, mask):

@@ -407,6 +407,11 @@ class TpuBlsVerifier:
             if p is None:
                 if not j.future.done():
                     j.future.set_result(False)
+            elif len(p) == 0:
+                # empty set list: vacuously true, and it would carry no
+                # bucket parts — _finalize_wave would never resolve it
+                if not j.future.done():
+                    j.future.set_result(True)
             else:
                 j.prepared = p
                 live.append(j)
@@ -609,6 +614,8 @@ class TpuBlsVerifier:
         def read():
             import numpy as np
 
+            if not oks:
+                return []
             if len(oks) == 1:
                 return [bool(oks[0])]
             return [bool(v) for v in np.asarray(jnp.stack(oks))]

@@ -11,9 +11,15 @@ from .attestation import (
     GossipAction,
     GossipValidationError,
 )
+from .aggregate import AggregateAndProofValidator
+from .block import GossipBlockValidator
+from .sync_committee import SyncCommitteeValidator
 
 __all__ = [
+    "AggregateAndProofValidator",
     "AttestationValidator",
     "GossipAction",
+    "GossipBlockValidator",
     "GossipValidationError",
+    "SyncCommitteeValidator",
 ]

@@ -1,0 +1,182 @@
+"""Gossip aggregate-and-proof validation.
+
+Reference analog: chain/validation/aggregateAndProof.ts
+(validateGossipAggregateAndProof, :49) — the spec p2p conditions plus
+THREE signature sets verified as one batch (:253):
+selection proof (DOMAIN_SELECTION_PROOF over the slot), the
+aggregator's AggregateAndProof signature
+(DOMAIN_AGGREGATE_AND_PROOF), and the aggregate attestation itself
+(DOMAIN_BEACON_ATTESTER, fast-aggregate-verify over the participant
+pubkeys). All three ride the TPU verifier's batch path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...bls import api as bls_api
+from ...params import DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_SELECTION_PROOF
+from ...config.beacon_config import compute_signing_root_from_roots
+from ...crypto.bls.signature import aggregate_pubkeys
+from ...ssz import uint64 as ssz_uint64
+from ...statetransition.block import compute_signing_root, get_domain
+from ...validator.validator import is_aggregator
+from ..seen_caches import SeenAggregators
+from .attestation import (
+    ATTESTATION_PROPAGATION_SLOT_RANGE,
+    GossipAction,
+    GossipValidationError,
+)
+
+
+class AggregateAndProofValidator:
+    """Validates SignedAggregateAndProof from gossip or the API.
+
+    Shares the attestation validator's resolved attData cache (target /
+    committee / signing-root work is identical) and owns the
+    SeenAggregators dedup cache."""
+
+    def __init__(self, cfg, types, chain, verifier, att_validator):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+        self.verifier = verifier
+        self.att_validator = att_validator  # reuses _resolve_att_data
+        self.seen_aggregators = SeenAggregators()
+
+    def on_slot(self, slot: int) -> None:
+        pass  # seen cache prunes by finalized epoch via prune()
+
+    def prune(self, finalized_epoch: int) -> None:
+        self.seen_aggregators.prune(finalized_epoch)
+
+    async def validate(self, signed_agg) -> GossipAction:
+        """Raises GossipValidationError on IGNORE/REJECT; returns
+        ACCEPT. Reference: validateAggregateAndProof (:101-260)."""
+        agg_and_proof = signed_agg.message
+        aggregate = agg_and_proof.aggregate
+        data = aggregate.data
+        slot = int(data.slot)
+        agg_index = int(agg_and_proof.aggregator_index)
+        target_epoch = int(data.target.epoch)
+        index = int(data.index)
+
+        # [IGNORE] propagation window (aggregateAndProof.ts:118)
+        clock = self.att_validator.clock_slot
+        if not (
+            slot <= clock + 1
+            and clock <= slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+        ):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "outside propagation slot range"
+            )
+        # [IGNORE] one aggregate per (epoch, committee, aggregator)
+        # (:151 seenAggregators)
+        if self.seen_aggregators.is_known_agg(
+            target_epoch, index, agg_index
+        ):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "aggregator already seen"
+            )
+        # [REJECT] must have participants (:143)
+        bits = np.asarray(aggregate.aggregation_bits, bool)
+        if bits.sum() == 0:
+            raise GossipValidationError(
+                GossipAction.REJECT, "empty aggregation bits"
+            )
+        # attData-level checks: target/head/committee resolution, shared
+        # cache with the unaggregated path (raises IGNORE/REJECT)
+        key = self.att_validator.att_data_key(data)
+        entry = self.att_validator._resolve_att_data(data, key)
+        committee = entry.committee
+        # [REJECT] bits length must match the committee (:190)
+        if len(bits) != len(committee):
+            raise GossipValidationError(
+                GossipAction.REJECT, "bits/committee length mismatch"
+            )
+        # [REJECT] aggregator must be in the committee (:196)
+        if agg_index not in set(int(v) for v in committee):
+            raise GossipValidationError(
+                GossipAction.REJECT, "aggregator not in committee"
+            )
+        # [REJECT] selection proof must select the aggregator (:183)
+        proof = bytes(agg_and_proof.selection_proof)
+        if not is_aggregator(len(committee), proof):
+            raise GossipValidationError(
+                GossipAction.REJECT, "selection proof not aggregator"
+            )
+
+        view = self.chain.get_state(
+            bytes(data.beacon_block_root)
+        ) or self.chain.head_state
+        state = view.state
+        validators = state.validators
+        if agg_index >= len(validators):
+            raise GossipValidationError(
+                GossipAction.REJECT, "unknown aggregator index"
+            )
+        agg_pubkey = bytes(validators[agg_index].pubkey)
+
+        # the three signature sets (:253 getAggregateAndProofSigSets)
+        sets = []
+        # 1. selection proof over the slot
+        sel_domain = get_domain(
+            self.cfg, state, DOMAIN_SELECTION_PROOF, target_epoch
+        )
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pubkey,
+                compute_signing_root_from_roots(
+                    ssz_uint64.hash_tree_root(slot), sel_domain
+                ),
+                proof,
+            )
+        )
+        # 2. aggregator signature over AggregateAndProof
+        ap_domain = get_domain(
+            self.cfg, state, DOMAIN_AGGREGATE_AND_PROOF, target_epoch
+        )
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pubkey,
+                compute_signing_root(
+                    self.types.AggregateAndProof, agg_and_proof, ap_domain
+                ),
+                bytes(signed_agg.signature),
+            )
+        )
+        # 3. the aggregate itself: fast-aggregate-verify over the
+        # participant pubkeys on the cached attData signing root
+        participants = [
+            int(committee[i]) for i in np.flatnonzero(bits)
+        ]
+        pubkeys = [bytes(validators[v].pubkey) for v in participants]
+        try:
+            agg_pk = aggregate_pubkeys(pubkeys)
+        except Exception as e:
+            raise GossipValidationError(
+                GossipAction.REJECT, f"bad participant pubkey: {e}"
+            ) from e
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pk, entry.signing_root, bytes(aggregate.signature)
+            )
+        )
+        ok = await self.verifier.verify_signature_sets(sets)
+        if not ok:
+            raise GossipValidationError(
+                GossipAction.REJECT, "invalid signature"
+            )
+        # re-check after the async verify (:151 double-observation)
+        if self.seen_aggregators.is_known_agg(
+            target_epoch, index, agg_index
+        ):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "aggregator seen during verification"
+            )
+        self.seen_aggregators.add_agg(target_epoch, index, agg_index)
+        # feed fork choice with the aggregate's votes
+        self.chain.fork_choice.on_attestation(
+            participants, bytes(data.beacon_block_root), target_epoch
+        )
+        return GossipAction.ACCEPT

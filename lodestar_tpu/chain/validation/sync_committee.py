@@ -1,0 +1,291 @@
+"""Gossip sync-committee message + contribution validation.
+
+Reference analog: chain/validation/syncCommittee.ts
+(validateSyncCommitteeSigOnly, :17) and
+syncCommitteeContributionAndProof.ts (validateContributionAndProof,
+:23) — slot currency, subnet position checks, first-seen dedup
+(seenCommittee.ts / seenContributionAndProof.ts), and the signature
+sets: one DOMAIN_SYNC_COMMITTEE set for a message; selection proof +
+aggregator + aggregate for a contribution — all through the TPU
+verifier batch path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...bls import api as bls_api
+from ...config.beacon_config import compute_signing_root_from_roots
+from ...crypto.bls.signature import aggregate_pubkeys
+from ...params import (
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    preset,
+)
+from ...statetransition import util
+from ...statetransition.block import get_domain
+from ...validator.validator import is_sync_committee_aggregator
+from ..seen_caches import SeenSyncCommitteeMessages
+from .attestation import GossipAction, GossipValidationError
+
+MAXIMUM_GOSSIP_CLOCK_DISPARITY_SLOTS = 1
+
+
+class SeenSyncContributions:
+    """(slot, subcommittee, aggregator) dedup
+    (seenContributionAndProof.ts:17)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[tuple[int, int]]] = {}
+
+    def is_known(self, slot: int, subnet: int, aggregator: int) -> bool:
+        return (subnet, aggregator) in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, subnet: int, aggregator: int) -> None:
+        self._by_slot.setdefault(slot, set()).add((subnet, aggregator))
+
+    def prune(self, min_slot: int) -> None:
+        for s in [s for s in self._by_slot if s < min_slot]:
+            del self._by_slot[s]
+
+
+class SyncCommitteeValidator:
+    """Validates sync-committee messages and contributions against the
+    head state's committee for the message slot's period."""
+
+    def __init__(self, cfg, types, chain, verifier):
+        self.cfg = cfg
+        self.types = types
+        self.chain = chain
+        self.verifier = verifier
+        self.seen_messages = SeenSyncCommitteeMessages()
+        self.seen_contributions = SeenSyncContributions()
+        self.clock_slot = 0
+
+    def on_slot(self, slot: int) -> None:
+        self.clock_slot = slot
+        if slot > 3:
+            self.seen_messages.prune(slot - 3)
+            self.seen_contributions.prune(slot - 3)
+
+    # -- shared helpers ---------------------------------------------------
+
+    def _check_slot_current(self, slot: int) -> None:
+        # [IGNORE] the message slot must be the current slot, with
+        # clock disparity (syncCommittee.ts:35)
+        if not (
+            slot - MAXIMUM_GOSSIP_CLOCK_DISPARITY_SLOTS
+            <= self.clock_slot
+            <= slot + MAXIMUM_GOSSIP_CLOCK_DISPARITY_SLOTS
+        ):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "not the current slot"
+            )
+
+    def _committee_for_slot(self, slot: int):
+        """(committee pubkeys, state) by the epoch(slot+1) period rule
+        (getSyncCommitteeAtSlot analog)."""
+        view = self.chain.head_state
+        st = view.state
+        per = preset().EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        epoch = util.compute_epoch_at_slot(slot + 1)
+        state_period = util.get_current_epoch(st) // per
+        period = epoch // per
+        if period == state_period:
+            committee = st.current_sync_committee
+        elif period == state_period + 1:
+            committee = st.next_sync_committee
+        else:
+            raise GossipValidationError(
+                GossipAction.IGNORE, "slot outside sync-committee window"
+            )
+        return committee, st
+
+    def _positions_of(self, committee, pubkey: bytes) -> list[int]:
+        return [
+            i
+            for i, pk in enumerate(committee.pubkeys)
+            if bytes(pk) == pubkey
+        ]
+
+    # -- message path (sync_committee_{subnet} topics) --------------------
+
+    async def validate_message(self, msg, subnet: int) -> list[int]:
+        """SyncCommitteeMessage gossip conditions + signature
+        (syncCommittee.ts:17-80). Returns the validator's committee
+        positions that fall on `subnet` (non-empty == ACCEPT) so the
+        caller pools without re-deriving the committee."""
+        slot = int(msg.slot)
+        vindex = int(msg.validator_index)
+        self._check_slot_current(slot)
+        committee, st = self._committee_for_slot(slot)
+        if vindex >= len(st.validators):
+            raise GossipValidationError(
+                GossipAction.REJECT, "unknown validator index"
+            )
+        pubkey = bytes(st.validators[vindex].pubkey)
+        positions = self._positions_of(committee, pubkey)
+        if not positions:
+            raise GossipValidationError(
+                GossipAction.REJECT, "validator not in sync committee"
+            )
+        # [REJECT] subnet must match one of the validator's positions
+        # (syncCommittee.ts:55)
+        sub_size = (
+            preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        subnet_positions = [
+            p for p in positions if p // sub_size == subnet
+        ]
+        if not subnet_positions:
+            raise GossipValidationError(
+                GossipAction.REJECT, "wrong subnet for validator"
+            )
+        # [IGNORE] first message per (slot, subnet, validator) (:47)
+        if self.seen_messages.is_known(slot, subnet, vindex):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "already seen this slot"
+            )
+        # signature over the block root at the message slot's domain
+        epoch = util.compute_epoch_at_slot(slot)
+        domain = get_domain(self.cfg, st, DOMAIN_SYNC_COMMITTEE, epoch)
+        root = compute_signing_root_from_roots(
+            bytes(msg.beacon_block_root), domain
+        )
+        ok = await self.verifier.verify_signature_sets(
+            [bls_api.SignatureSet(pubkey, root, bytes(msg.signature))],
+            batchable=True,
+        )
+        if not ok:
+            raise GossipValidationError(
+                GossipAction.REJECT, "invalid signature"
+            )
+        if self.seen_messages.is_known(slot, subnet, vindex):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "seen during verification"
+            )
+        self.seen_messages.add(slot, subnet, vindex)
+        return subnet_positions
+
+    # -- contribution path (sync_committee_contribution_and_proof) --------
+
+    async def validate_contribution(self, signed_cap) -> GossipAction:
+        """SignedContributionAndProof gossip conditions + the three
+        signature sets (syncCommitteeContributionAndProof.ts:23-130)."""
+        cap = signed_cap.message
+        contribution = cap.contribution
+        slot = int(contribution.slot)
+        subnet = int(contribution.subcommittee_index)
+        agg_index = int(cap.aggregator_index)
+        self._check_slot_current(slot)
+        # [REJECT] subcommittee range (:40)
+        if subnet >= SYNC_COMMITTEE_SUBNET_COUNT:
+            raise GossipValidationError(
+                GossipAction.REJECT, "subcommittee index out of range"
+            )
+        # [REJECT] non-empty participation (:47)
+        bits = np.asarray(contribution.aggregation_bits, bool)
+        if bits.sum() == 0:
+            raise GossipValidationError(
+                GossipAction.REJECT, "empty contribution"
+            )
+        # [IGNORE] first contribution per (slot, subnet, aggregator)
+        if self.seen_contributions.is_known(slot, subnet, agg_index):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "aggregator already seen"
+            )
+        committee, st = self._committee_for_slot(slot)
+        if agg_index >= len(st.validators):
+            raise GossipValidationError(
+                GossipAction.REJECT, "unknown aggregator index"
+            )
+        agg_pubkey = bytes(st.validators[agg_index].pubkey)
+        # [REJECT] aggregator in the sync committee (:62)
+        if not self._positions_of(committee, agg_pubkey):
+            raise GossipValidationError(
+                GossipAction.REJECT, "aggregator not in sync committee"
+            )
+        # [REJECT] selection proof wins aggregation (:55)
+        proof = bytes(cap.selection_proof)
+        if not is_sync_committee_aggregator(proof):
+            raise GossipValidationError(
+                GossipAction.REJECT, "selection proof not aggregator"
+            )
+        sub_size = (
+            preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        if len(bits) != sub_size:
+            raise GossipValidationError(
+                GossipAction.REJECT, "bits/subcommittee size mismatch"
+            )
+        epoch = util.compute_epoch_at_slot(slot)
+        sets = []
+        # 1. selection proof over SyncAggregatorSelectionData (:90)
+        sd = self.types.SyncAggregatorSelectionData.default()
+        sd.slot = slot
+        sd.subcommittee_index = subnet
+        sel_domain = get_domain(
+            self.cfg, st, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+        )
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pubkey,
+                compute_signing_root_from_roots(
+                    self.types.SyncAggregatorSelectionData.hash_tree_root(
+                        sd
+                    ),
+                    sel_domain,
+                ),
+                proof,
+            )
+        )
+        # 2. aggregator signature over ContributionAndProof (:100)
+        cap_domain = get_domain(
+            self.cfg, st, DOMAIN_CONTRIBUTION_AND_PROOF, epoch
+        )
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pubkey,
+                compute_signing_root_from_roots(
+                    self.types.ContributionAndProof.hash_tree_root(cap),
+                    cap_domain,
+                ),
+                bytes(signed_cap.signature),
+            )
+        )
+        # 3. the contribution aggregate over the participants (:110)
+        participants = [
+            bytes(committee.pubkeys[subnet * sub_size + i])
+            for i in np.flatnonzero(bits)
+        ]
+        try:
+            agg_pk = aggregate_pubkeys(participants)
+        except Exception as e:
+            raise GossipValidationError(
+                GossipAction.REJECT, f"bad participant pubkey: {e}"
+            ) from e
+        msg_domain = get_domain(
+            self.cfg, st, DOMAIN_SYNC_COMMITTEE, epoch
+        )
+        sets.append(
+            bls_api.SignatureSet(
+                agg_pk,
+                compute_signing_root_from_roots(
+                    bytes(contribution.beacon_block_root), msg_domain
+                ),
+                bytes(contribution.signature),
+            )
+        )
+        ok = await self.verifier.verify_signature_sets(sets)
+        if not ok:
+            raise GossipValidationError(
+                GossipAction.REJECT, "invalid signature"
+            )
+        if self.seen_contributions.is_known(slot, subnet, agg_index):
+            raise GossipValidationError(
+                GossipAction.IGNORE, "seen during verification"
+            )
+        self.seen_contributions.add(slot, subnet, agg_index)
+        return GossipAction.ACCEPT

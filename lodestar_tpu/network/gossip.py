@@ -121,6 +121,14 @@ class GossipNode:
         self.messages_published = 0
         self.frames_sent = 0  # gossip data frames (fan-out accounting)
         self._hb_task: asyncio.Task | None = None
+        # validation tasks: validation can await the chain's batch
+        # verifier (50ms+ windows), so it runs DETACHED from the
+        # transport's per-connection handler slots — holding a slot
+        # across the wait would let 64 pending validations stop the
+        # read loop from delivering RESP frames (head-of-line block).
+        # The attestation queue / verifier queue bound the real work;
+        # this set just keeps strong refs (asyncio GC caveat).
+        self._validation_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -139,6 +147,9 @@ class GossipNode:
         if self._hb_task is not None:
             self._hb_task.cancel()
             self._hb_task = None
+        for t in list(self._validation_tasks):
+            t.cancel()
+        self._validation_tasks.clear()
 
     # -- subscription management ----------------------------------------
 
@@ -254,7 +265,33 @@ class GossipNode:
         except snappy.SnappyError:
             self._invalid(peer_id, "bad snappy frame")
             return
-        result = await handler(peer_id, ssz_bytes)
+        # run validate+forward detached so the transport handler slot
+        # frees immediately (see _validation_tasks note above); the
+        # mesh still forwards ONLY after the handler's verdict
+        task = asyncio.ensure_future(
+            self._validate_and_forward(
+                handler, peer_id, topic, mid, data, ssz_bytes
+            )
+        )
+        self._validation_tasks.add(task)
+        task.add_done_callback(self._validation_tasks.discard)
+
+    async def _validate_and_forward(
+        self, handler, peer_id, topic, mid, data, ssz_bytes
+    ) -> None:
+        try:
+            result = await handler(peer_id, ssz_bytes)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a crashing handler must not kill the engine — but a
+            # broken topic must not look like a quiet one either
+            import logging
+
+            logging.getLogger("lodestar_tpu.gossip").exception(
+                "gossip handler crashed on %s", topic
+            )
+            return
         if result is ValidationResult.ACCEPT:
             sc = self.scores.setdefault(peer_id, GossipPeerScore())
             sc.first_deliveries += 1.0

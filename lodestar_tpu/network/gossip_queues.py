@@ -89,6 +89,10 @@ class IndexedGossipQueueMinSize:
         self.min_chunk_size = min_chunk_size
         self.max_chunk_size = max_chunk_size
         self.min_wait_ms = min_wait_ms
+        # overflow callback: receives the evicted item so producers
+        # awaiting a per-item verdict can be released (dropped work
+        # must resolve IGNORE, not hang its gossip handler)
+        self.on_drop = None
         # key -> (first_seen_ms, deque of items); insertion-ordered
         self._by_key: OrderedDict[bytes, tuple[float, deque]] = OrderedDict()
         self._min_size_keys: OrderedDict[bytes, None] = OrderedDict()
@@ -119,11 +123,13 @@ class IndexedGossipQueueMinSize:
             return 0
         # overflow: drop the oldest item of the oldest key
         first_key, (seen, items) = next(iter(self._by_key.items()))
-        items.popleft()
+        victim = items.popleft()
         self._length -= 1
         self.dropped_total += 1
         if not items:
             self._drop_key(first_key)
+        if self.on_drop is not None:
+            self.on_drop(victim)
         return 1
 
     def _drop_key(self, key) -> None:

@@ -26,7 +26,7 @@ from .metrics import (
 )
 from .network.processor import NetworkProcessor
 from .network.reqresp import InProcessTransport, ReqResp
-from .params import preset
+from .params import ForkSeq, preset
 from .sync import RangeSync, SyncServer
 
 
@@ -101,6 +101,26 @@ class BeaconNode:
         self.reprocess = None
         self.prepare_next_slot = None
         self.checkpoint_states = None
+        self.clock = None
+        self._altair_topics_on = False
+
+    def _maybe_subscribe_altair_topics(self, epoch: int) -> None:
+        """Sync-committee + LC update topics exist from altair
+        (gossip/interface.ts:24-69). Called at assembly AND on every
+        slot tick so a node started pre-altair subscribes when the
+        fork activates, not only at restart."""
+        if self._altair_topics_on or self.network is None:
+            return
+        from .statetransition.slot import fork_at_epoch
+
+        head_seq = self.chain.head_state.fork_seq
+        clock_fork = fork_at_epoch(self.cfg, epoch)
+        if head_seq >= ForkSeq.altair or ForkSeq[clock_fork] >= ForkSeq.altair:
+            self.network.subscribe_sync_committee_topics()
+            self.network.subscribe_light_client_topics(
+                self.chain.light_client_server
+            )
+            self._altair_topics_on = True
 
     @classmethod
     async def init(cls, **kwargs) -> "BeaconNode":
@@ -115,13 +135,29 @@ class BeaconNode:
             and node.checkpoint_sync_url is not None
             and (node.db is None or node.db.meta.get_raw("head_root") is None)
         ):
+            import functools
+
             from .sync.checkpoint import fetch_checkpoint_state
 
-            node.anchor = fetch_checkpoint_state(
-                node.checkpoint_sync_url,
-                node.cfg,
-                node.types,
-                expected_root=node.wss_state_root,
+            if node.wss_state_root is None:
+                # the endpoint's state is trusted wholesale (fork/clock
+                # checks only) — surface the trade-off at runtime, not
+                # just in docs (ADVICE r3)
+                log.warn(
+                    "checkpoint sync WITHOUT --wss-state-root: trusting "
+                    "the endpoint's state unverified",
+                    {"url": node.checkpoint_sync_url},
+                )
+            # blocking urllib fetch off the event loop
+            node.anchor = await asyncio.get_running_loop().run_in_executor(
+                None,
+                functools.partial(
+                    fetch_checkpoint_state,
+                    node.checkpoint_sync_url,
+                    node.cfg,
+                    node.types,
+                    expected_root=node.wss_state_root,
+                ),
             )
             log.info(
                 "checkpoint sync anchor fetched",
@@ -245,14 +281,63 @@ class BeaconNode:
             node.cfg, node.types, node.chain, node.chain.verifier
         )
         node.attestation_validator = validator
+        from .chain.validation import (
+            AggregateAndProofValidator,
+            GossipBlockValidator,
+            SyncCommitteeValidator,
+        )
+
+        node.aggregate_validator = AggregateAndProofValidator(
+            node.cfg, node.types, node.chain, node.chain.verifier,
+            validator,
+        )
+        node.block_validator = GossipBlockValidator(
+            node.cfg, node.types, node.chain, node.chain.verifier
+        )
+        node.sync_validator = SyncCommitteeValidator(
+            node.cfg, node.types, node.chain, node.chain.verifier
+        )
         node.processor = NetworkProcessor(
             node.chain,
             validator,
             node.chain.verifier,
             att_pool=node.att_pool,
             metrics=node.metrics,
+            aggregate_validator=node.aggregate_validator,
+            block_validator=node.block_validator,
+            sync_validator=node.sync_validator,
+            unagg_pool=node.unagg_pool,
+            sync_msg_pool=node.sync_msg_pool,
+            contrib_pool=node.contrib_pool,
         )
         node.processor.start()
+        # wall-clock slot driver: the gossip validators' slot-window
+        # checks and seen-cache pruning track real time (the reference
+        # clock feeds every validator via chain.clock). Without this
+        # clock_slot would stay 0 and every gossip block past slot 1
+        # would be IGNOREd as a future slot.
+        from .chain.clock import Clock
+
+        node.clock = Clock(
+            node.cfg, int(node.chain.head_state.state.genesis_time)
+        )
+
+        def _on_clock_slot(slot: int) -> None:
+            validator.on_slot(slot)
+            node.block_validator.on_slot(slot)
+            node.sync_validator.on_slot(slot)
+            fin = node.chain.fork_choice.finalized_checkpoint
+            node.aggregate_validator.prune(int(fin.epoch))
+            node.block_validator.prune(
+                int(fin.epoch) * preset().SLOTS_PER_EPOCH
+            )
+            node._maybe_subscribe_altair_topics(
+                slot // preset().SLOTS_PER_EPOCH
+            )
+
+        node.clock.on_slot(_on_clock_slot)
+        _on_clock_slot(node.clock.current_slot)
+        node.clock.start()
         # wire stack: real TCP/UDP network when a port is requested,
         # else the in-process transport (tests, embedded use)
         if node.tcp_port is not None:
@@ -269,6 +354,9 @@ class BeaconNode:
             node.network.op_pool = node.op_pool
             await node.network.start(
                 tcp_port=node.tcp_port, udp_port=node.udp_port
+            )
+            node._maybe_subscribe_altair_topics(
+                node.clock.current_epoch
             )
             for host, port in node.bootnodes:
                 node.network.discovery.add_bootnode(host, port)
@@ -324,6 +412,9 @@ class BeaconNode:
                 asyncio.ensure_future(node._head_check(peer_id))
 
             node.network.peer_manager.on_new_peer = _on_new_peer
+            node.network.on_unknown_parent = (
+                node.unknown_block_sync.on_unknown_block
+            )
         # REST API
         impl = BeaconApiImpl(node.cfg, node.types, node.chain, node)
         node.api_server = BeaconRestApiServer(
@@ -430,6 +521,8 @@ class BeaconNode:
 
     async def close(self) -> None:
         """Reverse-order shutdown (graceful SIGINT path)."""
+        if self.clock is not None:
+            self.clock.stop()
         if self.monitoring is not None:
             await self.monitoring.stop()
         if self.api_server is not None:

@@ -174,32 +174,38 @@ def _pow_u(f):
     return r
 
 
-def _pow_x(f):
+def _pow_x(f, pow_u=None):
     """f^x = conj(f^|x|) — valid for unitary f (conj == inverse)."""
-    return tower.fq12_conj(_pow_u(f))
+    return tower.fq12_conj((pow_u or _pow_u)(f))
 
 
-def _pow_x_minus_1(f):
+def _pow_x_minus_1(f, pow_u=None):
     """f^(x-1) = conj(f^(|x|+1)) for unitary f."""
-    return tower.fq12_conj(_norm12(tower.fq12_mul(_pow_u(f), f)))
+    return tower.fq12_conj(
+        _norm12(tower.fq12_mul((pow_u or _pow_u)(f), f))
+    )
 
 
-def final_exponentiation(f):
+def final_exponentiation(f, pow_u=None):
     """f^(3 * (q^12-1)/r) — the cube of the spec map; exponent-equivalent
     for membership/product checks (3 coprime to r). Easy part by
     Frobenius/conjugation, hard part by the (x-1)^2 (x+q) (x^2+q^2-1)+3
-    chain (5 exponentiations by |x|)."""
+    chain (5 exponentiations by |x|).
+
+    `pow_u` overrides the f^|x| ladder (the dominant cost) — on TPU,
+    ops/pallas_pairing.pow_u fuses the whole ladder in one kernel."""
+    pu = pow_u or _pow_u
     f = _norm12(f)
     # easy: f^((q^6-1)(q^2+1)) — lands in the cyclotomic subgroup
     t = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
     t = _norm12(t)
     t = _norm12(tower.fq12_mul(tower.fq12_frobenius_n(t, 2), t))
     # hard
-    a = _pow_x_minus_1(_pow_x_minus_1(t))  # t^((x-1)^2)
-    b = _norm12(tower.fq12_mul(_pow_x(a), tower.fq12_frobenius(a)))
+    a = _pow_x_minus_1(_pow_x_minus_1(t, pu), pu)  # t^((x-1)^2)
+    b = _norm12(tower.fq12_mul(_pow_x(a, pu), tower.fq12_frobenius(a)))
     c = _norm12(
         tower.fq12_mul(
-            tower.fq12_mul(_pow_u(_pow_u(b)), tower.fq12_frobenius_n(b, 2)),
+            tower.fq12_mul(pu(pu(b)), tower.fq12_frobenius_n(b, 2)),
             tower.fq12_conj(b),
         )
     )  # b^(x^2 + q^2 - 1)  (x^2 = |x|^2)

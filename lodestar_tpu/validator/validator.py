@@ -53,6 +53,16 @@ def is_sync_committee_aggregator(selection_proof: bytes) -> bool:
     )
 
 
+async def _res(x):
+    """Await duck-typed api results: HttpApi methods are async
+    (executor-offloaded REST), InProcessApi's are plain sync."""
+    import inspect
+
+    if inspect.isawaitable(x):
+        return await x
+    return x
+
+
 class InProcessApi:
     """Duck-typed beacon api over an in-process chain (test/dev mode;
     the reference's equivalent seam is the REST api the VC talks to)."""
@@ -231,9 +241,25 @@ class HttpApi:
         self.cfg = cfg
         self.types = types
 
-    def proposer_for_slot(self, slot: int) -> int:
+    async def _call(self, operation_id, params=None, body=None):
+        """The urllib ApiClient blocks up to its timeout; run every
+        REST round-trip in the default executor so slow beacon
+        responses cannot starve the duty loop past its 1/3- and
+        2/3-slot windows (ADVICE r3)."""
+        import asyncio
+        import functools
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            functools.partial(
+                self.client.call, operation_id, params, body
+            ),
+        )
+
+    async def proposer_for_slot(self, slot: int) -> int:
         epoch = slot // preset().SLOTS_PER_EPOCH
-        duties = self.client.call(
+        duties = await self._call(
             "getProposerDuties", {"epoch": epoch}
         )
         for d in duties:
@@ -241,8 +267,8 @@ class HttpApi:
                 return int(d["validator_index"])
         raise RuntimeError(f"no proposer duty for slot {slot}")
 
-    def committees_at_slot(self, slot: int) -> list:
-        out = self.client.call(
+    async def committees_at_slot(self, slot: int) -> list:
+        out = await self._call(
             "getEpochCommittees",
             {"state_id": "head", "slot": slot},
         )
@@ -251,14 +277,14 @@ class HttpApi:
             for c in sorted(out, key=lambda c: int(c["index"]))
         ]
 
-    def head_root(self) -> bytes:
-        got = self.client.call("getBlockRoot", {"block_id": "head"})
+    async def head_root(self) -> bytes:
+        got = await self._call("getBlockRoot", {"block_id": "head"})
         return bytes.fromhex(got["root"].removeprefix("0x"))
 
-    def produce_block(self, slot: int, randao_reveal: bytes, attestations):
+    async def produce_block(self, slot: int, randao_reveal: bytes, attestations):
         from ..api.json_codec import from_json
 
-        got = self.client.call(
+        got = await self._call(
             "produceBlockV2",
             {
                 "slot": slot,
@@ -275,7 +301,7 @@ class HttpApi:
         from ..api.json_codec import to_json
 
         assert fork is not None, "HttpApi.publish_block needs the fork"
-        self.client.call(
+        await self._call(
             "publishBlock",
             body=to_json(
                 self.types.by_fork[fork].SignedBeaconBlock,
@@ -283,10 +309,10 @@ class HttpApi:
             ),
         )
 
-    def attestation_data(self, slot: int, committee_index: int):
+    async def attestation_data(self, slot: int, committee_index: int):
         from ..api.json_codec import from_json
 
-        got = self.client.call(
+        got = await self._call(
             "produceAttestationData",
             {"slot": slot, "committee_index": committee_index},
         )
@@ -295,18 +321,18 @@ class HttpApi:
     async def publish_attestation(self, attestation, committee):
         from ..api.json_codec import to_json
 
-        self.client.call(
+        await self._call(
             "submitPoolAttestations",
             body=[to_json(self.types.Attestation, attestation)],
         )
 
-    def get_aggregated_attestation(self, slot: int, data_root: bytes):
+    async def get_aggregated_attestation(self, slot: int, data_root: bytes):
         from ..api.json_codec import from_json
 
         from ..api import ApiError
 
         try:
-            got = self.client.call(
+            got = await self._call(
                 "getAggregatedAttestation",
                 {
                     "slot": slot,
@@ -320,7 +346,7 @@ class HttpApi:
     async def publish_aggregate_and_proof(self, signed_agg):
         from ..api.json_codec import to_json
 
-        self.client.call(
+        await self._call(
             "publishAggregateAndProofs",
             body=[
                 to_json(
@@ -329,8 +355,8 @@ class HttpApi:
             ],
         )
 
-    def get_sync_committee_duties(self, epoch: int, indices):
-        duties = self.client.call(
+    async def get_sync_committee_duties(self, epoch: int, indices):
+        duties = await self._call(
             "getSyncCommitteeDuties",
             {"epoch": epoch},
             body=[str(i) for i in indices],
@@ -349,7 +375,7 @@ class HttpApi:
     async def submit_sync_committee_message(
         self, slot, block_root, validator_index, position, signature
     ):
-        self.client.call(
+        await self._call(
             "submitPoolSyncCommitteeSignatures",
             body=[
                 {
@@ -361,13 +387,13 @@ class HttpApi:
             ],
         )
 
-    def produce_sync_contribution(
+    async def produce_sync_contribution(
         self, slot: int, subcommittee_index: int, block_root: bytes
     ):
         from ..api import ApiError
 
         try:
-            got = self.client.call(
+            got = await self._call(
                 "produceSyncCommitteeContribution",
                 {
                     "slot": slot,
@@ -400,7 +426,7 @@ class HttpApi:
 
         c = signed_cap.message.contribution
         packed_hex = bits_to_hex([bool(b) for b in c.aggregation_bits])
-        self.client.call(
+        await self._call(
             "publishContributionAndProofs",
             body=[
                 {
@@ -450,20 +476,22 @@ class Validator:
         self._committees_memo: tuple = (None, None)
         self._sync_duties_memo: tuple = (None, None)
 
-    def _committees(self, slot: int) -> list:
+    async def _committees(self, slot: int) -> list:
         if self._committees_memo[0] != slot:
             self._committees_memo = (
                 slot,
-                self.api.committees_at_slot(slot),
+                await _res(self.api.committees_at_slot(slot)),
             )
         return self._committees_memo[1]
 
-    def _sync_duties(self, epoch: int) -> list:
+    async def _sync_duties(self, epoch: int) -> list:
         if self._sync_duties_memo[0] != epoch:
             self._sync_duties_memo = (
                 epoch,
-                self.api.get_sync_committee_duties(
-                    epoch, self.store.indices()
+                await _res(
+                    self.api.get_sync_committee_duties(
+                        epoch, self.store.indices()
+                    )
                 ),
             )
         return self._sync_duties_memo[1]
@@ -473,7 +501,7 @@ class Validator:
     async def run_block_duties(self, slot: int) -> bytes | None:
         """Propose if one of our validators owns the slot
         (BlockProposingService.runBlockTasks)."""
-        proposer = self.api.proposer_for_slot(slot)
+        proposer = await _res(self.api.proposer_for_slot(slot))
         if not self.store.has_validator(proposer):
             return None
         epoch = slot // preset().SLOTS_PER_EPOCH
@@ -483,7 +511,9 @@ class Validator:
             if self.att_pool is not None
             else []
         )
-        block, fork = self.api.produce_block(slot, randao, atts)
+        block, fork = await _res(
+            self.api.produce_block(slot, randao, atts)
+        )
         signed = self.store.sign_block(proposer, block, fork)
         await self.api.publish_block(signed, fork)
         self.blocks_proposed += 1
@@ -497,7 +527,7 @@ class Validator:
         (AttestationService: one attestation data per committee, signed
         per validator)."""
         published = 0
-        for ci, committee in enumerate(self._committees(slot)):
+        for ci, committee in enumerate(await self._committees(slot)):
             owned = [
                 (pos, int(v))
                 for pos, v in enumerate(committee)
@@ -505,7 +535,7 @@ class Validator:
             ]
             if not owned:
                 continue
-            data = self.api.attestation_data(slot, ci)
+            data = await _res(self.api.attestation_data(slot, ci))
             for pos, vindex in owned:
                 sig = self.store.sign_attestation(vindex, data)
                 att = self.types.Attestation.default()
@@ -529,7 +559,7 @@ class Validator:
         (AttestationService aggregation phase + jobItem selection)."""
         epoch = util.compute_epoch_at_slot(slot)
         published = 0
-        for ci, committee in enumerate(self._committees(slot)):
+        for ci, committee in enumerate(await self._committees(slot)):
             owned = [
                 int(v)
                 for v in committee
@@ -537,14 +567,16 @@ class Validator:
             ]
             if not owned:
                 continue
-            data = self.api.attestation_data(slot, ci)
+            data = await _res(self.api.attestation_data(slot, ci))
             data_root = self.types.AttestationData.hash_tree_root(data)
             for vindex in owned:
                 proof = self.store.sign_selection_proof(vindex, slot)
                 if not is_aggregator(len(committee), proof):
                     continue
-                agg = self.api.get_aggregated_attestation(
-                    slot, bytes(data_root)
+                agg = await _res(
+                    self.api.get_aggregated_attestation(
+                        slot, bytes(data_root)
+                    )
                 )
                 if agg is None:
                     continue
@@ -566,12 +598,17 @@ class Validator:
     # -- sync committee duties (syncCommittee.ts:24) ----------------------
 
     async def run_sync_committee_duties(self, slot: int) -> int:
-        """Sync-committee messages for the head at this slot."""
-        epoch = util.compute_epoch_at_slot(slot)
-        duties = self._sync_duties(epoch)
+        """Sync-committee messages for the head at this slot.
+
+        Duty committee selection follows the spec's epoch(slot+1) rule
+        (getSyncCommitteeSignatureSet / compute_sync_committee_period
+        on slot+1): at the final slot of a period the message must be
+        produced against the INCOMING committee (ADVICE r3)."""
+        epoch = util.compute_epoch_at_slot(slot + 1)
+        duties = await self._sync_duties(epoch)
         if not duties:
             return 0
-        head = self.api.head_root()
+        head = await _res(self.api.head_root())
         published = 0
         for duty in duties:
             vi = int(duty["validator_index"])
@@ -590,14 +627,14 @@ class Validator:
         """2/3-slot contribution phase: selection-proof winners wrap
         the best subcommittee contribution into a
         SignedContributionAndProof (syncCommittee.ts contribution
-        flow)."""
-        epoch = util.compute_epoch_at_slot(slot)
-        duties = self._sync_duties(epoch)
+        flow). Committee by the epoch(slot+1) rule, as for messages."""
+        epoch = util.compute_epoch_at_slot(slot + 1)
+        duties = await self._sync_duties(epoch)
         if not duties:
             return 0
         p = preset()
         sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
-        head = self.api.head_root()
+        head = await _res(self.api.head_root())
         published = 0
         for duty in duties:
             vi = int(duty["validator_index"])
@@ -610,9 +647,9 @@ class Validator:
                 )
                 if not is_sync_committee_aggregator(proof):
                     continue
-                contrib = self.api.produce_sync_contribution(
+                contrib = await _res(self.api.produce_sync_contribution(
                     slot, subnet, head
-                )
+                ))
                 if contrib is None:
                     continue
                 c = self.types.SyncCommitteeContribution.default()

@@ -1,0 +1,720 @@
+"""Gossip validators: aggregate-and-proof, block pre-validation,
+sync-committee messages/contributions — and their wire behavior
+(invalid objects are REJECTed, scored against the peer, NOT forwarded).
+
+Reference analogs: chain/validation/aggregateAndProof.ts:49,
+block.ts:27, syncCommittee.ts:17, syncCommitteeContributionAndProof.ts
+:23; seenCache/seenBlockProposers.ts. VERDICT r3 next #2/#3/#4 'done'
+criteria live here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.oppools import (
+    AggregatedAttestationPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
+from lodestar_tpu.chain.validation import (
+    AggregateAndProofValidator,
+    AttestationValidator,
+    GossipAction,
+    GossipBlockValidator,
+    GossipValidationError,
+    SyncCommitteeValidator,
+)
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.signature import (
+    aggregate_pubkeys,
+    aggregate_signatures,
+    fast_aggregate_verify,
+    sign,
+    verify,
+)
+from lodestar_tpu.network.processor import NetworkProcessor
+from lodestar_tpu.params import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    preset,
+)
+from lodestar_tpu.statetransition import (
+    create_interop_genesis_state,
+    util,
+)
+from lodestar_tpu.statetransition.block import (
+    compute_signing_root,
+    get_domain,
+)
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 32
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg(**forks):
+    base = dict(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    base.update(forks)
+    return ChainConfig(**base)
+
+
+class OracleVerifier:
+    """IBlsVerifier that verifies for real via the host oracle — the
+    validator-logic tests check signature REJECTion paths, so a
+    stub-True verifier would mask them."""
+
+    def can_accept_work(self):
+        return True
+
+    async def verify_signature_sets(self, sets, **kw):
+        return all(
+            verify(s.pubkey, s.message, s.signature) for s in sets
+        )
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [
+            verify(s.pubkey, message, s.signature) for s in sets
+        ]
+
+    async def close(self):
+        pass
+
+
+def _devnode(types, **forks):
+    cfg = _cfg(**forks)
+    node = DevNode(
+        cfg, types, N, verifier=OracleVerifier(),
+        verify_attestations=False,
+    )
+    return cfg, node
+
+
+def _make_aggregate(node, types, slot, bad_selection=False,
+                    bad_aggregate_sig=False, bad_agg_sig=False):
+    """A SignedAggregateAndProof over committee 0 of `slot`, signed by
+    the interop keys (aggregator = first committee member; minimal
+    preset committees are small so everyone is an aggregator)."""
+    from lodestar_tpu.config.beacon_config import (
+        compute_signing_root_from_roots,
+    )
+    from lodestar_tpu.ssz import uint64 as ssz_uint64
+
+    st = node.chain.get_state(node.chain.head_root).state
+    epoch = util.compute_epoch_at_slot(slot)
+    sh = util.EpochShuffling(st, epoch)
+    committee = sh.committees_at_slot(slot)[0]
+    try:
+        target_root = util.get_block_root(st, epoch)
+    except ValueError:
+        target_root = node.chain.head_root
+    data = types.AttestationData.default()
+    data.slot = slot
+    data.index = 0
+    data.beacon_block_root = node.chain.head_root
+    data.source = st.current_justified_checkpoint
+    tgt = types.Checkpoint.default()
+    tgt.epoch = epoch
+    tgt.root = target_root
+    data.target = tgt
+    att_domain = get_domain(node.cfg, st, DOMAIN_BEACON_ATTESTER, epoch)
+    att_root = compute_signing_root(types.AttestationData, data, att_domain)
+    sigs = [sign(node.sks[int(v)], att_root) for v in committee]
+    agg = types.Attestation.default()
+    agg.data = data
+    agg.aggregation_bits = [True] * len(committee)
+    agg.signature = aggregate_signatures(sigs)
+    if bad_aggregate_sig:
+        agg.signature = sigs[0]  # one signer, all bits set -> invalid
+
+    aggregator = int(committee[0])
+    sel_domain = get_domain(node.cfg, st, DOMAIN_SELECTION_PROOF, epoch)
+    proof = sign(
+        node.sks[aggregator],
+        compute_signing_root_from_roots(
+            ssz_uint64.hash_tree_root(slot), sel_domain
+        ),
+    )
+    if bad_selection:
+        proof = sign(
+            node.sks[aggregator],
+            compute_signing_root_from_roots(
+                ssz_uint64.hash_tree_root(slot + 1), sel_domain
+            ),
+        )
+    aap = types.AggregateAndProof.default()
+    aap.aggregator_index = aggregator
+    aap.aggregate = agg
+    aap.selection_proof = proof
+    ap_domain = get_domain(
+        node.cfg, st, DOMAIN_AGGREGATE_AND_PROOF, epoch
+    )
+    sig = sign(
+        node.sks[aggregator],
+        compute_signing_root(types.AggregateAndProof, aap, ap_domain),
+    )
+    signed = types.SignedAggregateAndProof.default()
+    signed.message = aap
+    signed.signature = bytes(96) if bad_agg_sig else sig
+    return signed, committee
+
+
+def _validators(cfg, types, node):
+    att_v = AttestationValidator(
+        cfg, types, node.chain, node.chain.verifier
+    )
+    agg_v = AggregateAndProofValidator(
+        cfg, types, node.chain, node.chain.verifier, att_v
+    )
+    return att_v, agg_v
+
+
+class TestAggregateValidation:
+    def test_valid_aggregate_accepts_and_pools(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            await node.run_until(2)
+            att_v, agg_v = _validators(cfg, types, node)
+            att_v.on_slot(node.slot)
+            pool = AggregatedAttestationPool(types)
+            proc = NetworkProcessor(
+                node.chain, att_v, node.chain.verifier,
+                att_pool=pool, aggregate_validator=agg_v,
+            )
+            sagg, committee = _make_aggregate(node, types, node.slot)
+            action = await proc.process_aggregate(sagg)
+            assert action == GossipAction.ACCEPT
+            # pooled for block packing
+            atts = pool.get_attestations_for_block(node.slot + 1)
+            assert len(atts) >= 1
+            # duplicate -> IGNORE (seen aggregator)
+            action = await proc.process_aggregate(sagg)
+            assert action == GossipAction.IGNORE
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_bad_selection_proof_rejected(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            await node.run_until(2)
+            att_v, agg_v = _validators(cfg, types, node)
+            att_v.on_slot(node.slot)
+            sagg, _ = _make_aggregate(
+                node, types, node.slot, bad_selection=True
+            )
+            with pytest.raises(GossipValidationError) as ei:
+                await agg_v.validate(sagg)
+            assert ei.value.action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_bad_aggregate_signature_rejected(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            await node.run_until(2)
+            att_v, agg_v = _validators(cfg, types, node)
+            att_v.on_slot(node.slot)
+            sagg, _ = _make_aggregate(
+                node, types, node.slot, bad_aggregate_sig=True
+            )
+            with pytest.raises(GossipValidationError) as ei:
+                await agg_v.validate(sagg)
+            assert ei.value.action == GossipAction.REJECT
+            # empty bits REJECT
+            sagg2, committee = _make_aggregate(node, types, node.slot)
+            sagg2.message.aggregate.aggregation_bits = [False] * len(
+                committee
+            )
+            with pytest.raises(GossipValidationError) as ei:
+                await agg_v.validate(sagg2)
+            assert ei.value.action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_api_submission_validates(self, types):
+        """publishAggregateAndProofs rejects a bad selection proof
+        (VERDICT r3 next #3 'done')."""
+        from lodestar_tpu.api.impl import BeaconApiImpl
+        from lodestar_tpu.api import ApiError
+        from lodestar_tpu.api.json_codec import to_json
+
+        cfg, node = _devnode(types)
+
+        async def go():
+            await node.run_until(2)
+            att_v, agg_v = _validators(cfg, types, node)
+            att_v.on_slot(node.slot)
+            pool = AggregatedAttestationPool(types)
+            proc = NetworkProcessor(
+                node.chain, att_v, node.chain.verifier,
+                att_pool=pool, aggregate_validator=agg_v,
+            )
+
+            class NodeShim:
+                processor = proc
+                att_pool = pool
+                network = None
+
+            impl = BeaconApiImpl(cfg, types, node.chain, NodeShim())
+            bad, _ = _make_aggregate(
+                node, types, node.slot, bad_selection=True
+            )
+            with pytest.raises(ApiError):
+                await impl.publish_aggregate_and_proofs(
+                    [to_json(types.SignedAggregateAndProof, bad)]
+                )
+            good, _ = _make_aggregate(node, types, node.slot)
+            await impl.publish_aggregate_and_proofs(
+                [to_json(types.SignedAggregateAndProof, good)]
+            )
+            assert len(pool.get_attestations_for_block(node.slot + 1)) >= 1
+            await node.close()
+
+        asyncio.run(go())
+
+
+class TestGossipBlockValidation:
+    def test_valid_block_accepts_equivocation_ignored(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            root = await node.advance_slot()
+            blk = node.chain.get_block(root)
+            view = node.chain.get_state(root)
+            bv = GossipBlockValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            bv.on_slot(node.slot)
+            # validate against a FRESH validator as a gossip peer would
+            # (chain already imported it; pre-checks don't care)
+            action = await bv.validate(blk, view.fork)
+            assert action == GossipAction.ACCEPT
+            # same (slot, proposer) again -> equivocation IGNORE
+            with pytest.raises(GossipValidationError) as ei:
+                await bv.validate(blk, view.fork)
+            assert ei.value.action == GossipAction.IGNORE
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_bad_proposer_signature_rejected(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            root = await node.advance_slot()
+            blk = node.chain.get_block(root)
+            view = node.chain.get_state(root)
+            bv = GossipBlockValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            bv.on_slot(node.slot)
+            tampered = types.by_fork[
+                view.fork
+            ].SignedBeaconBlock.deserialize(
+                types.by_fork[view.fork].SignedBeaconBlock.serialize(blk)
+            )
+            tampered.signature = bytes(96)
+            with pytest.raises(GossipValidationError) as ei:
+                await bv.validate(tampered, view.fork)
+            assert ei.value.action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_future_slot_and_unknown_parent_ignored(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            root = await node.advance_slot()
+            blk = node.chain.get_block(root)
+            view = node.chain.get_state(root)
+            bv = GossipBlockValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            bv.on_slot(node.slot)
+            t = types.by_fork[view.fork].SignedBeaconBlock
+            future = t.deserialize(t.serialize(blk))
+            future.message.slot = node.slot + 5  # beyond disparity
+            with pytest.raises(GossipValidationError) as ei:
+                await bv.validate(future, view.fork)
+            assert ei.value.action == GossipAction.IGNORE
+            bv.on_slot(node.slot + 5)
+            orphan = t.deserialize(t.serialize(blk))
+            orphan.message.slot = node.slot + 1
+            orphan.message.parent_root = b"\x99" * 32
+            with pytest.raises(GossipValidationError) as ei:
+                await bv.validate(orphan, view.fork)
+            assert ei.value.action == GossipAction.IGNORE
+            await node.close()
+
+        asyncio.run(go())
+
+
+def _sync_msg(node, types, slot, vindex, bad_sig=False):
+    from lodestar_tpu.config.beacon_config import (
+        compute_signing_root_from_roots,
+    )
+
+    st = node.chain.get_state(node.chain.head_root).state
+    epoch = util.compute_epoch_at_slot(slot)
+    domain = get_domain(node.cfg, st, DOMAIN_SYNC_COMMITTEE, epoch)
+    root = node.chain.head_root
+    msg = types.SyncCommitteeMessage.default()
+    msg.slot = slot
+    msg.beacon_block_root = root
+    msg.validator_index = vindex
+    msg.signature = (
+        bytes(96)
+        if bad_sig
+        else sign(
+            node.sks[vindex],
+            compute_signing_root_from_roots(bytes(root), domain),
+        )
+    )
+    return msg
+
+
+class TestSyncCommitteeValidation:
+    def test_message_validate_and_pool(self, types):
+        cfg, node = _devnode(types, ALTAIR_FORK_EPOCH=0)
+
+        async def go():
+            await node.run_until(2)
+            sv = SyncCommitteeValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            sv.on_slot(node.slot)
+            st = node.chain.head_state.state
+            committee, _ = sv._committee_for_slot(node.slot)
+            pk0 = bytes(committee.pubkeys[0])
+            vindex = next(
+                i
+                for i, v in enumerate(st.validators)
+                if bytes(v.pubkey) == pk0
+            )
+            sub_size = (
+                preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+            )
+            positions = sv._positions_of(committee, pk0)
+            subnet = positions[0] // sub_size
+            msg = _sync_msg(node, types, node.slot, vindex)
+            pool = SyncCommitteeMessagePool(types)
+            proc = NetworkProcessor(
+                node.chain, None, node.chain.verifier,
+                sync_validator=sv, sync_msg_pool=pool,
+            )
+            action = await proc.process_sync_committee_message(
+                msg, subnet
+            )
+            assert action == GossipAction.ACCEPT
+            assert (
+                pool.get_contribution(
+                    node.slot, bytes(node.chain.head_root), subnet
+                )
+                is not None
+            )
+            # duplicate IGNORE
+            action = await proc.process_sync_committee_message(
+                msg, subnet
+            )
+            assert action == GossipAction.IGNORE
+            # wrong subnet REJECT
+            with pytest.raises(GossipValidationError) as ei:
+                await sv.validate_message(
+                    _sync_msg(node, types, node.slot, vindex),
+                    (subnet + 1) % SYNC_COMMITTEE_SUBNET_COUNT,
+                )
+            # wrong subnet unless validator also sits there
+            assert ei.value.action in (
+                GossipAction.REJECT, GossipAction.IGNORE,
+            )
+            # bad signature REJECT (fresh dedup window)
+            sv.seen_messages._by_slot.clear()
+            with pytest.raises(GossipValidationError) as ei:
+                await sv.validate_message(
+                    _sync_msg(
+                        node, types, node.slot, vindex, bad_sig=True
+                    ),
+                    subnet,
+                )
+            assert ei.value.action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_contribution_validate_and_pool(self, types):
+        from lodestar_tpu.config.beacon_config import (
+            compute_signing_root_from_roots,
+        )
+
+        cfg, node = _devnode(types, ALTAIR_FORK_EPOCH=0)
+
+        async def go():
+            await node.run_until(2)
+            sv = SyncCommitteeValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            sv.on_slot(node.slot)
+            st = node.chain.head_state.state
+            committee, _ = sv._committee_for_slot(node.slot)
+            slot = node.slot
+            epoch = util.compute_epoch_at_slot(slot)
+            sub_size = (
+                preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+            )
+            subnet = 0
+            head = bytes(node.chain.head_root)
+            pk_to_idx = {
+                bytes(v.pubkey): i for i, v in enumerate(st.validators)
+            }
+            members = [
+                pk_to_idx[bytes(pk)]
+                for pk in committee.pubkeys[
+                    subnet * sub_size : (subnet + 1) * sub_size
+                ]
+            ]
+            msg_domain = get_domain(
+                cfg, st, DOMAIN_SYNC_COMMITTEE, epoch
+            )
+            msg_root = compute_signing_root_from_roots(head, msg_domain)
+            sigs = [sign(node.sks[v], msg_root) for v in members]
+            # aggregator: first subcommittee member with a winning proof
+            sel_domain = get_domain(
+                cfg, st, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+            )
+            from lodestar_tpu.validator.validator import (
+                is_sync_committee_aggregator,
+            )
+
+            agg_idx, proof = None, None
+            for v in members:
+                sd = types.SyncAggregatorSelectionData.default()
+                sd.slot = slot
+                sd.subcommittee_index = subnet
+                pr = sign(
+                    node.sks[v],
+                    compute_signing_root_from_roots(
+                        types.SyncAggregatorSelectionData.hash_tree_root(
+                            sd
+                        ),
+                        sel_domain,
+                    ),
+                )
+                if is_sync_committee_aggregator(pr):
+                    agg_idx, proof = v, pr
+                    break
+            assert agg_idx is not None, (
+                "no winning aggregator in subcommittee (minimal preset "
+                "modulo should be 1)"
+            )
+            contrib = types.SyncCommitteeContribution.default()
+            contrib.slot = slot
+            contrib.beacon_block_root = head
+            contrib.subcommittee_index = subnet
+            contrib.aggregation_bits = [True] * sub_size
+            contrib.signature = aggregate_signatures(sigs)
+            cap = types.ContributionAndProof.default()
+            cap.aggregator_index = agg_idx
+            cap.contribution = contrib
+            cap.selection_proof = proof
+            cap_domain = get_domain(
+                cfg, st, DOMAIN_CONTRIBUTION_AND_PROOF, epoch
+            )
+            scap = types.SignedContributionAndProof.default()
+            scap.message = cap
+            scap.signature = sign(
+                node.sks[agg_idx],
+                compute_signing_root_from_roots(
+                    types.ContributionAndProof.hash_tree_root(cap),
+                    cap_domain,
+                ),
+            )
+            pool = SyncContributionAndProofPool(types)
+            proc = NetworkProcessor(
+                node.chain, None, node.chain.verifier,
+                sync_validator=sv, contrib_pool=pool,
+            )
+            action = await proc.process_sync_contribution(scap)
+            assert action == GossipAction.ACCEPT
+            sa = pool.get_sync_aggregate(slot, head)
+            assert any(sa.sync_committee_bits)
+            # bad contribution signature REJECT
+            sv.seen_contributions._by_slot.clear()
+            scap2 = types.SignedContributionAndProof.deserialize(
+                types.SignedContributionAndProof.serialize(scap)
+            )
+            scap2.message.contribution.signature = sigs[0]
+            with pytest.raises(GossipValidationError) as ei:
+                await sv.validate_contribution(scap2)
+            assert ei.value.action == GossipAction.REJECT
+            await node.close()
+
+        asyncio.run(go())
+
+
+class TestTwoNodeWire:
+    """Wire-level 'done' criteria: invalid objects are REJECTed at the
+    first hop (peer scored, NOT forwarded); valid sync messages reach a
+    second node's contribution pool over TCP gossip."""
+
+    def _wire_node(self, cfg, types, chain, peer_id, altair=False):
+        from lodestar_tpu.network.facade import Network
+
+        att_v = AttestationValidator(cfg, types, chain, chain.verifier)
+        agg_v = AggregateAndProofValidator(
+            cfg, types, chain, chain.verifier, att_v
+        )
+        bv = GossipBlockValidator(cfg, types, chain, chain.verifier)
+        sv = SyncCommitteeValidator(cfg, types, chain, chain.verifier)
+        pool = AggregatedAttestationPool(types)
+        sync_pool = SyncCommitteeMessagePool(types)
+        contrib_pool = SyncContributionAndProofPool(types)
+        proc = NetworkProcessor(
+            chain, att_v, chain.verifier, att_pool=pool,
+            aggregate_validator=agg_v, block_validator=bv,
+            sync_validator=sv, sync_msg_pool=sync_pool,
+            contrib_pool=contrib_pool,
+        )
+        gvr = bytes(chain.head_state.state.genesis_validators_root)
+        bc = BeaconConfig(cfg, gvr)
+        net = Network(chain, bc, types, processor=proc, peer_id=peer_id)
+        return net, proc, (att_v, agg_v, bv, sv), pool, sync_pool
+
+    def test_invalid_aggregate_rejected_scored_not_forwarded(self, types):
+        cfg, node = _devnode(types)
+
+        async def go():
+            await node.run_until(2)
+            # B validates, C must never see the invalid aggregate
+            chain_b = node.chain
+            net_b, proc_b, vs_b, *_ = self._wire_node(
+                cfg, types, chain_b, "nodeB"
+            )
+            vs_b[0].on_slot(node.slot)
+            genesis = create_interop_genesis_state(cfg, types, N)
+            chain_c = BeaconChain(
+                cfg, types, genesis, verifier=OracleVerifier()
+            )
+            net_c, proc_c, vs_c, *_ = self._wire_node(
+                cfg, types, chain_c, "nodeC"
+            )
+            vs_c[0].on_slot(node.slot)
+            # A is a bare publisher (no processor: IGNOREs inbound)
+            genesis_a = create_interop_genesis_state(cfg, types, N)
+            chain_a = BeaconChain(
+                cfg, types, genesis_a, verifier=OracleVerifier()
+            )
+            from lodestar_tpu.network.facade import Network
+
+            gvr = bytes(chain_a.head_state.state.genesis_validators_root)
+            bc = BeaconConfig(cfg, gvr)
+            net_a = Network(chain_a, bc, types, peer_id="nodeA")
+            for net in (net_a, net_b, net_c):
+                await net.start(run_maintenance=False)
+            # line topology A - B - C: a forward is observable at C
+            await net_a.connect("127.0.0.1", net_b.host.port)
+            await net_c.connect("127.0.0.1", net_b.host.port)
+            await asyncio.sleep(0.1)
+
+            bad, _ = _make_aggregate(
+                node, types, node.slot, bad_selection=True
+            )
+            await net_a.publish_aggregate(bad)
+            await asyncio.sleep(0.3)
+            # B rejected: nothing pooled, A penalized, C saw nothing
+            assert proc_b.rejected >= 1
+            assert proc_c.rejected == 0 and proc_c.accepted == 0
+            assert net_b.peer_manager.scores["nodeA"].score < 0
+            assert net_c.gossip.messages_received == 0
+
+            good, _ = _make_aggregate(node, types, node.slot)
+            await net_a.publish_aggregate(good)
+            await asyncio.sleep(0.3)
+            assert proc_b.accepted >= 1
+            for net in (net_a, net_b, net_c):
+                await net.stop()
+            await node.close()
+
+        asyncio.run(go())
+
+    def test_sync_messages_reach_second_node_over_tcp(self, types):
+        """A VC-signed sync message published on sync_committee_{n}
+        reaches a second node's message pool over TCP gossip
+        (VERDICT r3 next #4 'done')."""
+        cfg, node = _devnode(types, ALTAIR_FORK_EPOCH=0)
+
+        async def go():
+            await node.run_until(2)
+            chain_b = node.chain
+            net_b, proc_b, vs_b, _, sync_pool_b = self._wire_node(
+                cfg, types, chain_b, "nodeB"
+            )
+            vs_b[3].on_slot(node.slot)
+            from lodestar_tpu.network.facade import Network
+
+            gvr = bytes(
+                node.chain.head_state.state.genesis_validators_root
+            )
+            bc = BeaconConfig(cfg, gvr)
+            net_a = Network(node.chain, bc, types, peer_id="nodeA")
+            await net_a.start(run_maintenance=False)
+            await net_b.start(run_maintenance=False)
+            net_b.subscribe_sync_committee_topics()
+            await net_a.connect("127.0.0.1", net_b.host.port)
+            await asyncio.sleep(0.1)
+
+            sv = vs_b[3]
+            committee, _ = sv._committee_for_slot(node.slot)
+            st = node.chain.head_state.state
+            pk0 = bytes(committee.pubkeys[0])
+            vindex = next(
+                i for i, v in enumerate(st.validators)
+                if bytes(v.pubkey) == pk0
+            )
+            sub_size = (
+                preset().SYNC_COMMITTEE_SIZE
+                // SYNC_COMMITTEE_SUBNET_COUNT
+            )
+            subnet = sv._positions_of(committee, pk0)[0] // sub_size
+            msg = _sync_msg(node, types, node.slot, vindex)
+            await net_a.publish_sync_committee_message(msg, subnet)
+            await asyncio.sleep(0.3)
+            assert (
+                sync_pool_b.get_contribution(
+                    node.slot, bytes(node.chain.head_root), subnet
+                )
+                is not None
+            ), "sync message never reached the second node's pool"
+            await net_a.stop()
+            await net_b.stop()
+            await node.close()
+
+        asyncio.run(go())

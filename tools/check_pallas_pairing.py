@@ -1,0 +1,106 @@
+"""Differential check: Pallas Miller/pow_u kernels vs the XLA scan path
+on the current backend (run on the real TPU; CPU uses interpret mode and
+is very slow — prefer tests/test_pallas_pairing.py there).
+
+Both implementations are polynomial maps, so arbitrary canonical field
+elements exercise every formula — no curve setup needed. Also times the
+kernels at the production bucket shape (2049 pairs).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lodestar_tpu.crypto.bls.fields import P  # noqa: E402
+from lodestar_tpu.ops import limbs as L  # noqa: E402
+from lodestar_tpu.ops import pairing, pallas_pairing, tower  # noqa: E402
+from lodestar_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable()
+rng = np.random.default_rng(7)
+
+
+def rand_fq(n):
+    return L.from_ints([int(rng.integers(0, 2**63)) ** 7 % P for _ in range(n)])
+
+
+def rand_fq2(n):
+    return (rand_fq(n), rand_fq(n))
+
+
+def fq12_ints(f):
+    return [L.to_ints(lv) for c6 in f for c2 in c6 for lv in c2]
+
+
+def check(label, a, b):
+    xs, ys = fq12_ints(a), fq12_ints(b)
+    ok = all(np.array_equal(x, y) for x, y in zip(xs, ys))
+    print(f"{label}: {'OK' if ok else 'MISMATCH'}", flush=True)
+    if not ok:
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            if not np.array_equal(x, y):
+                print(f"  comp {i}: {x[:2]} vs {y[:2]}")
+        sys.exit(1)
+
+
+def timeit(label, fn, reps=3):
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn())
+    print(f"{label}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms", flush=True)
+
+
+def main():
+    print(f"platform={jax.default_backend()}", flush=True)
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+    px, py = rand_fq(n), rand_fq(n)
+    qx, qy = rand_fq2(n), rand_fq2(n)
+    t0 = time.perf_counter()
+    f_pal = pallas_pairing.miller_loop(px, py, qx, qy)
+    jax.block_until_ready(f_pal[0][0][0].v)
+    print(f"miller pallas compile+run: {time.perf_counter() - t0:.1f} s", flush=True)
+    t0 = time.perf_counter()
+    f_xla = pairing.miller_loop(px, py, qx, qy)
+    jax.block_until_ready(f_xla[0][0][0].v)
+    print(f"miller xla compile+run: {time.perf_counter() - t0:.1f} s", flush=True)
+    check("miller", f_pal, f_xla)
+
+    g = tuple(
+        tuple((rand_fq(n), rand_fq(n)) for _ in range(3)) for _ in range(2)
+    )
+    t0 = time.perf_counter()
+    p_pal = pallas_pairing.pow_u(g)
+    jax.block_until_ready(p_pal[0][0][0].v)
+    print(f"pow_u pallas compile+run: {time.perf_counter() - t0:.1f} s", flush=True)
+    p_xla = pairing._pow_u(g)
+    check("pow_u", p_pal, p_xla)
+
+    # scalar-shape pow_u (the production final-exp shape)
+    g1 = jax.tree.map(lambda t: t[0], g)
+    check("pow_u scalar", pallas_pairing.pow_u(g1), pairing._pow_u(g1))
+
+    if jax.default_backend() == "tpu":
+        N = 2049
+        px, py = rand_fq(N), rand_fq(N)
+        qx, qy = rand_fq2(N), rand_fq2(N)
+        timeit(
+            f"miller pallas n={N}",
+            lambda: pallas_pairing.miller_loop(px, py, qx, qy)[0][0][0].v,
+        )
+        timeit(
+            "final_exp pallas (scalar)",
+            lambda: pallas_pairing.final_exponentiation(g1)[0][0][0].v,
+        )
+    print("all checks passed")
+
+
+if __name__ == "__main__":
+    main()
