@@ -166,6 +166,42 @@ void hash64_batch(const uint8_t *in, uint8_t *out, size_t n) {
     hash64(in + i * 64, out + i * 32);
 }
 
+/* n independent fixed-length messages (len <= 55: one padded block
+ * each) -> n 32-byte digests. Drives the swap-or-not shuffle's
+ * per-round decision hashes (seed||round||block, 37 bytes) without a
+ * Python-loop hashlib call per 256-index block. */
+void hash_small_batch(const uint8_t *in, size_t len, uint8_t *out,
+                      size_t n) {
+  if (len > 55)
+    return; /* caller contract: single-block messages only */
+  compress_fn f = get_compress();
+  uint64_t bits = (uint64_t)len * 8;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t block[64];
+    memset(block, 0, 64);
+    memcpy(block, in + i * len, len);
+    block[len] = 0x80;
+    block[56] = (uint8_t)(bits >> 56);
+    block[57] = (uint8_t)(bits >> 48);
+    block[58] = (uint8_t)(bits >> 40);
+    block[59] = (uint8_t)(bits >> 32);
+    block[60] = (uint8_t)(bits >> 24);
+    block[61] = (uint8_t)(bits >> 16);
+    block[62] = (uint8_t)(bits >> 8);
+    block[63] = (uint8_t)bits;
+    uint32_t st[8];
+    memcpy(st, H0, sizeof(st));
+    f(st, block);
+    uint8_t *o = out + i * 32;
+    for (int j = 0; j < 8; j++) {
+      o[j * 4] = (uint8_t)(st[j] >> 24);
+      o[j * 4 + 1] = (uint8_t)(st[j] >> 16);
+      o[j * 4 + 2] = (uint8_t)(st[j] >> 8);
+      o[j * 4 + 3] = (uint8_t)st[j];
+    }
+  }
+}
+
 /* Full sub-tree merkleization: `count` 32-byte chunks, `depth` levels,
  * virtual zero-subtree padding via zero_hashes (33*32 bytes,
  * zero_hashes[i] = root of depth-i zero subtree). scratch needs

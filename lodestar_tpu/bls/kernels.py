@@ -97,12 +97,13 @@ def _stage_prepare_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
     affine conversion + pairing-input assembly (n+1 pairs). On TPU the
     G2 ladder (the expensive one) runs as the fused Pallas kernel
     (ops/pallas_ladder.py: 160 ms vs scan at batch 2048)."""
-    rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
     if jax.default_backend() == "tpu" and bits.ndim == 2:
         from ..ops import pallas_ladder as PL
 
+        rpk = PL.g1_scalar_mul(pk.x, pk.y, bits, pk.inf)
         rsig = PL.g2_scalar_mul(sig.x, sig.y, bits, sig.inf)
     else:
+        rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
         rsig = C.scalar_mul(C.FQ2_OPS, sig.x, sig.y, bits, sig.inf)
     rsig = C.jac_select(
         C.FQ2_OPS, mask, rsig, C.jac_infinity(C.FQ2_OPS, mask.shape)
@@ -226,8 +227,14 @@ def _stage_prepare_same_message(
     """Both random-weighted MSMs (aggregateWithRandomness on device —
     the reference's measured main-thread bottleneck, jobItem.ts:60-75)
     + pairing-input assembly (2 pairs)."""
-    rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
-    rsig = C.scalar_mul(C.FQ2_OPS, sig.x, sig.y, bits, sig.inf)
+    if jax.default_backend() == "tpu" and bits.ndim == 2:
+        from ..ops import pallas_ladder as PL
+
+        rpk = PL.g1_scalar_mul(pk.x, pk.y, bits, pk.inf)
+        rsig = PL.g2_scalar_mul(sig.x, sig.y, bits, sig.inf)
+    else:
+        rpk = C.scalar_mul(C.FQ_OPS, pk.x, pk.y, bits, pk.inf)
+        rsig = C.scalar_mul(C.FQ2_OPS, sig.x, sig.y, bits, sig.inf)
     rpk = C.jac_select(
         C.FQ_OPS, mask, rpk, C.jac_infinity(C.FQ_OPS, mask.shape)
     )

@@ -123,6 +123,10 @@ class BlsVerifierMetrics:
         self.total_device_time_s = 0.0
         self.waves = 0
         self.buckets_dispatched = 0
+        # last-wave stats for the TPU verifier dashboard
+        self.last_wave_sets = 0
+        self.last_wave_duration_s = 0.0
+        self.wave_sets_total = 0
 
 
 class TpuBlsVerifier:
@@ -521,7 +525,14 @@ class TpuBlsVerifier:
         except Exception as e:
             self._fail_jobs([j for b in buckets for j, _ in b], e)
         finally:
-            self.metrics.total_device_time_s += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            self.metrics.total_device_time_s += dt
+            n_sets = sum(
+                len(part) for b in buckets for _, part in b
+            )
+            self.metrics.last_wave_sets = n_sets
+            self.metrics.wave_sets_total += n_sets
+            self.metrics.last_wave_duration_s = dt
 
     def _submit_bucket(self, sets: list[_PreparedSet]):
         """Pad to a bucket size, build device arrays (sharded over the

@@ -32,6 +32,18 @@ class PrepareNextSlotScheduler:
         process_slots(self.chain.cfg, work, next_slot, self.chain.types)
         self.prepared = {key: work}  # keep only the newest
         self.prepares += 1
+        # warm the epoch shuffling memo off the critical path
+        # (prepareNextSlot.ts:40 precomputeNextEpochTransition): at an
+        # epoch boundary the first import would otherwise pay the full
+        # registry shuffle inline
+        try:
+            from ..statetransition import util as _util
+
+            _util.get_shuffling(
+                work.state, _util.get_current_epoch(work.state)
+            )
+        except Exception:
+            pass
         if self.chain.execution_engine is not None:
             # fcU WITH payload attributes so the EL starts building the
             # next payload now (produceBlockBody then only getPayloads)

@@ -54,6 +54,11 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     beacon.add_argument("--discovery-port", type=int, default=0)
     beacon.add_argument(
+        "--network-core-thread", action="store_true",
+        help="run the wire stack on a dedicated thread "
+        "(networkCoreWorker analog)",
+    )
+    beacon.add_argument(
         "--bootnodes", default=None,
         help="comma-separated host:udp_port discovery bootstrap list",
     )
@@ -238,6 +243,7 @@ async def _run_beacon(args) -> int:
         metrics_port=args.metrics_port,
         tcp_port=args.port,
         udp_port=args.discovery_port,
+        network_isolated=getattr(args, "network_core_thread", False),
         bootnodes=bootnodes,
         execution_url=args.execution_url,
         jwt_secret=jwt_secret,

@@ -83,6 +83,12 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_size_t,
         ]
+        lib.hash_small_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
         lib.merkle_root.argtypes = [
             ctypes.c_char_p,
             ctypes.c_size_t,
@@ -106,6 +112,21 @@ def hash64_batch(data: bytes) -> bytes:
     n = len(data) // 64
     out = ctypes.create_string_buffer(32 * n)
     lib.hash64_batch(data, out, n)
+    return out.raw
+
+
+def hash_small_batch(data: bytes, msg_len: int) -> bytes:
+    """Hash n concatenated fixed-length (<= 55 byte) messages -> n
+    concatenated 32-byte digests. One padded SHA-256 block per message
+    (the swap-or-not decision-hash shape: 37 bytes)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native sha256 hasher unavailable (no compiler?)")
+    if msg_len > 55:
+        raise ValueError("msg_len > 55 needs multi-block hashing")
+    n = len(data) // msg_len
+    out = ctypes.create_string_buffer(32 * n)
+    lib.hash_small_batch(data, msg_len, out, n)
     return out.raw
 
 

@@ -84,6 +84,48 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "Count of total batchable signature sets",
     )
 
+    # -- TPU verifier wave pipeline (no reference analog: the device
+    # replaces the worker pool; these drive
+    # dashboards/lodestar_tpu_bls_verifier.json) ------------------------
+    tv = SimpleNamespace()
+    m.tpu_verifier = tv
+    tv.queue_length = reg.gauge(
+        "lodestar_tpu_verifier_queue_length",
+        "Jobs waiting for the next device wave",
+    )
+    tv.waves_total = reg.gauge(
+        "lodestar_tpu_verifier_waves_total",
+        "Total device waves dispatched",
+    )
+    tv.buckets_dispatched_total = reg.gauge(
+        "lodestar_tpu_verifier_buckets_dispatched_total",
+        "Total device buckets dispatched",
+    )
+    tv.wave_sets_total = reg.gauge(
+        "lodestar_tpu_verifier_wave_sets_total",
+        "Total signature sets carried by device waves",
+    )
+    tv.last_wave_sets = reg.gauge(
+        "lodestar_tpu_verifier_last_wave_sets",
+        "Signature sets in the most recent wave",
+    )
+    tv.last_wave_duration_seconds = reg.gauge(
+        "lodestar_tpu_verifier_last_wave_duration_seconds",
+        "Dispatch-to-verdict latency of the most recent wave",
+    )
+    tv.device_time_seconds_total = reg.gauge(
+        "lodestar_tpu_verifier_device_time_seconds_total",
+        "Cumulative wall time waves spent in flight on the device",
+    )
+    tv.batch_sigs_success_total = reg.gauge(
+        "lodestar_tpu_verifier_batch_sigs_success_total",
+        "Signature sets verified successfully in device batches",
+    )
+    tv.batch_retries_total = reg.gauge(
+        "lodestar_tpu_verifier_batch_retries_total",
+        "Failed waves re-verified per job/per set",
+    )
+
     # -- gossip ingest --------------------------------------------------
     g = SimpleNamespace()
     m.gossip = g

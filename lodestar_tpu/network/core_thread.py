@@ -1,0 +1,79 @@
+"""Network core isolation: the wire stack on its own thread + loop.
+
+Reference analog: the reference runs its entire libp2p/gossipsub/
+reqresp stack in a worker thread (network/core/networkCoreWorker.ts,
+spawned at networkCoreWorkerHandler.ts:123) so gossip decode, mesh
+heartbeats, and reqresp serving cannot head-of-line-block the chain's
+event loop. Here the same shape: a dedicated thread runs an asyncio
+loop that owns TcpHost + GossipNode + discovery + peer manager; the
+chain keeps its own loop. The two sides talk ONLY through
+`LoopBridge.call` (run_coroutine_threadsafe both ways), mirroring the
+reference's worker message channel.
+
+Python's GIL means CPU-bound work still shares one interpreter, but
+the isolation is real for the event-loop head-of-line problem: a slow
+chain-side await (block import, TPU readback) no longer freezes frame
+reads, heartbeats, or reqresp serving — and vice versa. Snappy decode
+and AEAD crypto release the GIL in their C extensions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class LoopBridge:
+    """Marshal coroutines onto a foreign event loop and await the
+    result from the calling loop."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+
+    async def call(self, coro):
+        """Run `coro` on the bridged loop; await its result here."""
+        cfut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return await asyncio.wrap_future(cfut)
+
+    def call_nowait(self, coro) -> "asyncio.Future":
+        """Schedule without awaiting (returns concurrent future)."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+class NetworkCoreThread:
+    """A daemon thread running the network's private event loop."""
+
+    def __init__(self, name: str = "network-core"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._started = threading.Event()
+        self.bridge = LoopBridge(self.loop)
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+        # drain pending callbacks after stop() so closes complete
+        pending = asyncio.all_tasks(self.loop)
+        for task in pending:
+            task.cancel()
+        if pending:
+            self.loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        self.loop.close()
+
+    def start(self) -> None:
+        self._thread.start()
+        self._started.wait(5.0)
+
+    async def run(self, coro):
+        """Chain-side helper: run `coro` on the core loop."""
+        return await self.bridge.call(coro)
+
+    def stop(self) -> None:
+        if self._thread.is_alive():
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(5.0)

@@ -30,15 +30,25 @@ class TcpReqRespTransport:
     def __init__(self, host: TcpHost):
         self.host = host
         self._local: rr.ReqResp | None = None
+        self.core = None  # NetworkCoreThread under isolation
+        self.main_loop = None
         host.on_request = self._serve
 
     def register(self, peer_id: str, node: rr.ReqResp) -> None:
         self._local = node
 
     async def _serve(self, peer_id: str, protocol: str, data: bytes):
+        """Inbound request handler — runs on the core loop under
+        isolation; chain/db reads marshal to the chain loop."""
         if self._local is None:
             return b""
-        return await self._local._serve_raw(peer_id, protocol, data)
+        coro = self._local._serve_raw(peer_id, protocol, data)
+        if self.main_loop is not None and (
+            asyncio.get_running_loop() is not self.main_loop
+        ):
+            cfut = asyncio.run_coroutine_threadsafe(coro, self.main_loop)
+            return await asyncio.wrap_future(cfut)
+        return await coro
 
     async def request_raw(
         self, from_peer: str, to_peer: str, protocol: str, data: bytes
@@ -48,6 +58,10 @@ class TcpReqRespTransport:
             raise rr.ReqRespError(
                 rr.RESP_SERVER_ERROR, f"not connected to {to_peer}"
             )
+        if self.core is not None and (
+            asyncio.get_running_loop() is not self.core.loop
+        ):
+            return await self.core.run(conn.request(protocol, data))
         return await conn.request(protocol, data)
 
 
@@ -63,6 +77,7 @@ class Network:
         host_addr: str = "127.0.0.1",
         peer_id: str | None = None,
         target_peers: int = 25,
+        isolated: bool = False,
     ):
         self.chain = chain
         self.beacon_cfg = beacon_cfg
@@ -95,6 +110,12 @@ class Network:
         self.blocks_received = 0
         self.blocks_published = 0
         self.lc_server = None  # wired by the node assembly
+        # network-core isolation (networkCoreWorker.ts analog): when
+        # set, the wire stack runs on its own thread's event loop and
+        # every chain-touching handler marshals to the chain loop
+        self.isolated = isolated
+        self._core = None
+        self._main_loop = None
         # strong refs to fire-and-forget import tasks (asyncio GC caveat)
         self._import_tasks: set = set()
         # unknown-parent escalation hook: fn(parent_root) — the node
@@ -109,7 +130,15 @@ class Network:
         udp_port: int = 0,
         run_maintenance: bool = True,
     ) -> None:
-        port = await self.host.listen(tcp_port)
+        self._main_loop = asyncio.get_running_loop()
+        self.reqresp_transport.main_loop = self._main_loop
+        if self.isolated:
+            from .core_thread import NetworkCoreThread
+
+            self._core = NetworkCoreThread(f"netcore-{self.peer_id}")
+            self._core.start()
+            self.reqresp_transport.core = self._core
+        port = await self._on_core(self.host.listen(tcp_port))
         self.discovery = Discovery(
             NodeRecord(
                 peer_id=self.peer_id,
@@ -119,21 +148,62 @@ class Network:
                 fork_digest=self.fork_digest.hex(),
             )
         )
-        await self.discovery.listen()
+        await self._on_core(self.discovery.listen())
         self.peer_manager.discovery = self.discovery
         self._subscribe_core_topics()
         if run_maintenance:
             # heartbeat pings/dials + discovery random walk (the tests
             # that dial explicitly pass run_maintenance=False)
-            self.peer_manager.start()
-            self.discovery.start_random_walk()
+            if self._core is not None:
+                self._core.loop.call_soon_threadsafe(
+                    self.peer_manager.start
+                )
+                self._core.loop.call_soon_threadsafe(
+                    self.discovery.start_random_walk
+                )
+            else:
+                self.peer_manager.start()
+                self.discovery.start_random_walk()
+
+    def _needs_core_marshal(self) -> bool:
+        """True when called from any loop other than the core loop
+        while isolation is on — sync gossip-engine mutations
+        (subscribe/unsubscribe create tasks and control sends) must hop
+        to the core loop or two threads race the connection writers."""
+        if self._core is None:
+            return False
+        try:
+            return asyncio.get_running_loop() is not self._core.loop
+        except RuntimeError:
+            return True
+
+    async def _on_core(self, coro):
+        """Run a wire-stack coroutine on the core loop (no-op without
+        isolation)."""
+        if self._core is None:
+            return await coro
+        return await self._core.run(coro)
+
+    async def _on_main(self, coro):
+        """Run a chain-touching coroutine on the chain's loop; called
+        from handlers that execute on the core loop under isolation."""
+        if (
+            self._main_loop is None
+            or asyncio.get_running_loop() is self._main_loop
+        ):
+            return await coro
+        cfut = asyncio.run_coroutine_threadsafe(coro, self._main_loop)
+        return await asyncio.wrap_future(cfut)
 
     async def stop(self) -> None:
-        await self.gossip.stop()
-        await self.peer_manager.stop()
+        await self._on_core(self.gossip.stop())
+        await self._on_core(self.peer_manager.stop())
         if self.discovery is not None:
-            await self.discovery.close()
-        await self.host.close()
+            await self._on_core(self.discovery.close())
+        await self._on_core(self.host.close())
+        if self._core is not None:
+            self._core.stop()
+            self._core = None
 
     def _penalize(self, peer_id: str, reason: str) -> None:
         self.peer_manager.penalize(peer_id, reason)
@@ -144,6 +214,11 @@ class Network:
         return topic_name(self.fork_digest, name)
 
     def _subscribe_core_topics(self) -> None:
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self._subscribe_core_topics
+            )
+            return
         self.gossip.subscribe(self._t("beacon_block"), self._on_block)
         self.gossip.subscribe(
             self._t("beacon_aggregate_and_proof"), self._on_aggregate
@@ -202,29 +277,38 @@ class Network:
             except Exception:
                 return ValidationResult.REJECT
             # full spec validation (incl. signatures) before the pool
-            # or any forwarding (chain/validation/*.ts contract)
-            try:
-                validate(self.chain, value)
-            except OpValidationError:
-                return ValidationResult.REJECT
-            except Exception:
-                return ValidationResult.IGNORE
-            pool = getattr(self.op_pool, pool_method, None) if (
-                self.op_pool is not None
-            ) else None
-            if pool is None:
-                return ValidationResult.IGNORE
-            try:
-                pool(value)
-            except Exception:
-                return ValidationResult.IGNORE
-            return ValidationResult.ACCEPT
+            # or any forwarding (chain/validation/*.ts contract) —
+            # chain-state reads marshal to the chain loop
+            async def _validate_and_pool():
+                try:
+                    validate(self.chain, value)
+                except OpValidationError:
+                    return ValidationResult.REJECT
+                except Exception:
+                    return ValidationResult.IGNORE
+                pool = getattr(self.op_pool, pool_method, None) if (
+                    self.op_pool is not None
+                ) else None
+                if pool is None:
+                    return ValidationResult.IGNORE
+                try:
+                    pool(value)
+                except Exception:
+                    return ValidationResult.IGNORE
+                return ValidationResult.ACCEPT
+
+            return await self._on_main(_validate_and_pool())
 
         return handler
 
     def subscribe_blob_sidecars(self, fork: str, n_subnets: int = 6) -> None:
         """Deneb blob sidecar topics: validate inclusion proof + KZG
         before forwarding (validation/blobSidecar.ts gossip path)."""
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.subscribe_blob_sidecars, fork, n_subnets
+            )
+            return
         from ..chain.blobs import verify_blob_sidecar_inclusion_proof
         from ..crypto import kzg
 
@@ -263,6 +347,11 @@ class Network:
 
     def subscribe_att_subnet(self, subnet: int) -> None:
         """AttnetsService subscribe window (attnetsService.ts:43)."""
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.subscribe_att_subnet, subnet
+            )
+            return
         self.duty_subnets.add(subnet)
         if subnet not in self.subscribed_subnets:
             self.metadata_seq += 1
@@ -273,6 +362,11 @@ class Network:
         )
 
     def unsubscribe_att_subnet(self, subnet: int) -> None:
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.unsubscribe_att_subnet, subnet
+            )
+            return
         self.duty_subnets.discard(subnet)
         if subnet not in self.long_lived_subnets:
             if subnet in self.subscribed_subnets:
@@ -307,9 +401,15 @@ class Network:
 
     def rotate_long_lived_subnets(self, epoch: int) -> None:
         """Apply the deterministic assignment for this epoch.
+        (Marshals to the core loop under isolation.)
         `subscribed_subnets` is the live subscription set (duty windows
         ∪ long-lived); rotation must never tear down a subnet a duty
         window still needs."""
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.rotate_long_lived_subnets, epoch
+            )
+            return
         want = set(self.compute_long_lived_subnets(epoch))
         for subnet in list(self.long_lived_subnets):
             if subnet not in want:
@@ -361,6 +461,11 @@ class Network:
             ].SignedBeaconBlock.deserialize(ssz_bytes)
         except Exception:
             return ValidationResult.REJECT
+        return await self._on_main(self._on_block_main(block, fork))
+
+    async def _on_block_main(self, block, fork: str):
+        from ..chain.validation import GossipValidationError
+
         if (
             self.processor is not None
             and self.processor.block_validator is not None
@@ -441,11 +546,14 @@ class Network:
             if self.processor is not None:
                 # await the batch verdict: the mesh forwards only
                 # verified attestations (VERDICT r3 weak #4)
-                action = await self.processor.on_gossip_attestation(att)
+                action = await self._on_main(self._att_verdict(att))
                 return self._to_result(action)
             return ValidationResult.IGNORE
 
         return handler
+
+    async def _att_verdict(self, att):
+        return await self.processor.on_gossip_attestation(att)
 
     async def _on_aggregate(self, peer_id: str, ssz_bytes: bytes):
         try:
@@ -453,7 +561,9 @@ class Network:
         except Exception:
             return ValidationResult.REJECT
         if self.processor is not None:
-            action = await self.processor.process_aggregate(agg)
+            action = await self._on_main(
+                self.processor.process_aggregate(agg)
+            )
             return self._to_result(action)
         return ValidationResult.IGNORE
 
@@ -461,6 +571,11 @@ class Network:
 
     def subscribe_sync_committee_topics(self) -> None:
         """sync_committee_{subnet} + contribution_and_proof topics."""
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.subscribe_sync_committee_topics
+            )
+            return
         from ..params import SYNC_COMMITTEE_SUBNET_COUNT
 
         for subnet in range(SYNC_COMMITTEE_SUBNET_COUNT):
@@ -482,8 +597,8 @@ class Network:
             except Exception:
                 return ValidationResult.REJECT
             if self.processor is not None:
-                action = (
-                    await self.processor.process_sync_committee_message(
+                action = await self._on_main(
+                    self.processor.process_sync_committee_message(
                         msg, subnet
                     )
                 )
@@ -500,7 +615,9 @@ class Network:
         except Exception:
             return ValidationResult.REJECT
         if self.processor is not None:
-            action = await self.processor.process_sync_contribution(cap)
+            action = await self._on_main(
+                self.processor.process_sync_contribution(cap)
+            )
             return self._to_result(action)
         return ValidationResult.IGNORE
 
@@ -515,6 +632,11 @@ class Network:
         never forwards them."""
         if lc_server is not None:
             self.lc_server = lc_server
+        if self._needs_core_marshal():
+            self._core.loop.call_soon_threadsafe(
+                self.subscribe_light_client_topics
+            )
+            return
 
         def mk(type_name: str, attr: str):
             async def handler(peer_id: str, ssz_bytes: bytes):
@@ -554,48 +676,66 @@ class Network:
             signed_block
         )
         self.blocks_published += 1
-        return await self.gossip.publish(self._t("beacon_block"), data)
+        return await self._on_core(
+            self.gossip.publish(self._t("beacon_block"), data)
+        )
 
     async def publish_aggregate(self, signed_agg_and_proof) -> int:
-        return await self.gossip.publish(
-            self._t("beacon_aggregate_and_proof"),
-            self.types.SignedAggregateAndProof.serialize(
-                signed_agg_and_proof
-            ),
+        return await self._on_core(
+            self.gossip.publish(
+                self._t("beacon_aggregate_and_proof"),
+                self.types.SignedAggregateAndProof.serialize(
+                    signed_agg_and_proof
+                ),
+            )
         )
 
     async def publish_attestation(self, att, subnet: int | None = None) -> int:
         if subnet is None:
             subnet = int(att.data.index) % ATTESTATION_SUBNET_COUNT
-        return await self.gossip.publish(
-            self._t(f"beacon_attestation_{subnet}"),
-            self.types.Attestation.serialize(att),
+        return await self._on_core(
+            self.gossip.publish(
+                self._t(f"beacon_attestation_{subnet}"),
+                self.types.Attestation.serialize(att),
+            )
         )
 
     async def publish_sync_committee_message(self, msg, subnet: int) -> int:
-        return await self.gossip.publish(
-            self._t(f"sync_committee_{subnet}"),
-            self.types.SyncCommitteeMessage.serialize(msg),
+        return await self._on_core(
+            self.gossip.publish(
+                self._t(f"sync_committee_{subnet}"),
+                self.types.SyncCommitteeMessage.serialize(msg),
+            )
         )
 
     async def publish_sync_contribution(self, signed_cap) -> int:
-        return await self.gossip.publish(
-            self._t("sync_committee_contribution_and_proof"),
-            self.types.SignedContributionAndProof.serialize(signed_cap),
+        return await self._on_core(
+            self.gossip.publish(
+                self._t("sync_committee_contribution_and_proof"),
+                self.types.SignedContributionAndProof.serialize(
+                    signed_cap
+                ),
+            )
         )
 
     async def publish_light_client_finality_update(self, update) -> int:
         t = self.types.LightClientFinalityUpdate
-        return await self.gossip.publish(
-            self._t("light_client_finality_update"), t.serialize(update)
+        return await self._on_core(
+            self.gossip.publish(
+                self._t("light_client_finality_update"),
+                t.serialize(update),
+            )
         )
 
     async def publish_light_client_optimistic_update(self, update) -> int:
         t = self.types.LightClientOptimisticUpdate
-        return await self.gossip.publish(
-            self._t("light_client_optimistic_update"), t.serialize(update)
+        return await self._on_core(
+            self.gossip.publish(
+                self._t("light_client_optimistic_update"),
+                t.serialize(update),
+            )
         )
 
     async def connect(self, host: str, port: int) -> str:
-        conn = await self.host.dial(host, port)
+        conn = await self._on_core(self.host.dial(host, port))
         return conn.peer_id

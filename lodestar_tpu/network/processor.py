@@ -329,10 +329,17 @@ class NetworkProcessor:
                 if fut is not None and not fut.done():
                     fut.set_result(res.action)
         except Exception as e:
+            # the futures carry the failure to every waiter; this task
+            # itself has no awaiter, so re-raising would only produce
+            # "Task exception was never retrieved" noise
+            import logging
+
+            logging.getLogger("lodestar_tpu.network").warning(
+                "attestation chunk validation failed: %r", e
+            )
             for fut in futs:
                 if fut is not None and not fut.done():
                     fut.set_exception(e)
-            raise
         finally:
             self._in_flight -= 1
             self._wake.set()

@@ -23,8 +23,12 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
+import time
+
+from . import noise
 
 MAX_FRAME = 32 * 1024 * 1024
+HANDSHAKE_TIMEOUT = 5.0
 
 K_HELLO, K_GOSSIP, K_REQ, K_RESP, K_PING, K_PONG, K_CONTROL = range(7)
 
@@ -37,12 +41,18 @@ class PeerConnection:
     """One live TCP connection to a peer (post-handshake)."""
 
     def __init__(self, reader, writer, peer_id: str, hello: dict,
-                 outbound: bool = False):
+                 outbound: bool = False, send_cipher=None,
+                 recv_cipher=None, remote_static: bytes | None = None):
         self.reader = reader
         self.writer = writer
         self.peer_id = peer_id
         self.hello = hello
         self.outbound = outbound
+        # Noise transport ciphers (None only in the rare plaintext
+        # test construction; TcpHost always provides them)
+        self.send_cipher = send_cipher
+        self.recv_cipher = recv_cipher
+        self.remote_static = remote_static
         self._send_lock = asyncio.Lock()
         self._req_id = 0
         self._pending: dict[int, asyncio.Future] = {}
@@ -52,14 +62,32 @@ class PeerConnection:
         # blocking of RESP frames
         self.handler_slots = asyncio.Semaphore(64)
         self.closed = False
+        # liveness: wall time of the last PONG seen on this socket
+        self.last_pong_at: float | None = None
 
     async def send_frame(self, kind: int, payload: bytes) -> None:
         if self.closed:
             raise TransportError(f"connection to {self.peer_id} closed")
-        frame = struct.pack(">IB", len(payload) + 1, kind) + payload
         async with self._send_lock:
+            # encrypt under the lock: the AEAD nonce counter must match
+            # the on-wire frame order
+            if self.send_cipher is not None:
+                ct = self.send_cipher.encrypt(
+                    b"", bytes([kind]) + payload
+                )
+                frame = struct.pack(">I", len(ct)) + ct
+            else:
+                frame = (
+                    struct.pack(">IB", len(payload) + 1, kind) + payload
+                )
             self.writer.write(frame)
             await self.writer.drain()
+
+    async def read_frame(self) -> tuple[int, bytes]:
+        kind, payload = await read_frame(
+            self.reader, self.recv_cipher
+        )
+        return kind, payload
 
     async def request(
         self, protocol: str, data: bytes, timeout: float = 10.0
@@ -98,12 +126,19 @@ class PeerConnection:
                 fut.set_exception(TransportError("connection closed"))
 
 
-async def read_frame(reader) -> tuple[int, bytes]:
+async def read_frame(reader, cipher=None) -> tuple[int, bytes]:
     head = await reader.readexactly(4)
     (length,) = struct.unpack(">I", head)
     if not 1 <= length <= MAX_FRAME:
         raise TransportError(f"bad frame length {length}")
     body = await reader.readexactly(length)
+    if cipher is not None:
+        try:
+            body = cipher.decrypt(b"", body)
+        except noise.NoiseError as e:
+            raise TransportError(str(e)) from e
+        if not body:
+            raise TransportError("empty decrypted frame")
     return body[0], body[1:]
 
 
@@ -116,9 +151,15 @@ class TcpHost:
     """
 
     def __init__(self, peer_id: str, fork_digest: bytes, host="127.0.0.1"):
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+        )
+
         self.peer_id = peer_id
         self.fork_digest = fork_digest
         self.host = host
+        # transport identity: Noise XX static key (libp2p-noise analog)
+        self.static_key = X25519PrivateKey.generate()
         self.port: int | None = None
         self.conns: dict[str, PeerConnection] = {}
         self._server = None
@@ -170,38 +211,56 @@ class TcpHost:
 
     async def dial(self, host: str, port: int) -> PeerConnection:
         reader, writer = await asyncio.open_connection(host, port)
-        writer.write(
-            struct.pack(">IB", len(self._hello_payload()) + 1, K_HELLO)
-            + self._hello_payload()
-        )
+        try:
+            send_c, recv_c, rs = await asyncio.wait_for(
+                noise.initiator_handshake(
+                    reader, writer, self.static_key
+                ),
+                HANDSHAKE_TIMEOUT,
+            )
+        except (noise.NoiseError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError, OSError) as e:
+            writer.close()
+            raise TransportError(f"noise handshake failed: {e}") from e
+        hello_pt = bytes([K_HELLO]) + self._hello_payload()
+        ct = send_c.encrypt(b"", hello_pt)
+        writer.write(struct.pack(">I", len(ct)) + ct)
         await writer.drain()
-        kind, payload = await read_frame(reader)
+        kind, payload = await read_frame(reader, recv_c)
         if kind != K_HELLO:
             writer.close()
             raise TransportError("expected HELLO")
         hello = json.loads(payload)
         conn = PeerConnection(
-            reader, writer, hello["peer_id"], hello, outbound=True
+            reader, writer, hello["peer_id"], hello, outbound=True,
+            send_cipher=send_c, recv_cipher=recv_c, remote_static=rs,
         )
         self._install(conn)
         return conn
 
     async def _accept(self, reader, writer) -> None:
         try:
-            kind, payload = await read_frame(reader)
+            # Noise XX first: a plaintext peer cannot produce a valid
+            # message A/C and is dropped before any protocol state
+            send_c, recv_c, rs = await asyncio.wait_for(
+                noise.responder_handshake(
+                    reader, writer, self.static_key
+                ),
+                HANDSHAKE_TIMEOUT,
+            )
+            kind, payload = await read_frame(reader, recv_c)
             if kind != K_HELLO:
                 writer.close()
                 return
             hello = json.loads(payload)
             peer_id = hello["peer_id"]
-            writer.write(
-                struct.pack(
-                    ">IB", len(self._hello_payload()) + 1, K_HELLO
-                )
-                + self._hello_payload()
-            )
+            hello_pt = bytes([K_HELLO]) + self._hello_payload()
+            ct = send_c.encrypt(b"", hello_pt)
+            writer.write(struct.pack(">I", len(ct)) + ct)
             await writer.drain()
         except (
+            noise.NoiseError,
+            asyncio.TimeoutError,
             asyncio.IncompleteReadError,
             TransportError,
             OSError,
@@ -210,7 +269,10 @@ class TcpHost:
         ):
             writer.close()
             return
-        conn = PeerConnection(reader, writer, peer_id, hello)
+        conn = PeerConnection(
+            reader, writer, peer_id, hello,
+            send_cipher=send_c, recv_cipher=recv_c, remote_static=rs,
+        )
         self._install(conn)
 
     def _initiator(self, conn: PeerConnection) -> str:
@@ -285,7 +347,7 @@ class TcpHost:
     async def _read_loop(self, conn: PeerConnection) -> None:
         try:
             while not conn.closed:
-                kind, payload = await read_frame(conn.reader)
+                kind, payload = await conn.read_frame()
                 # handlers run as tasks: a slow block import must not
                 # head-of-line-block RESP frames on the same socket.
                 # The semaphore caps tasks per connection.
@@ -309,7 +371,7 @@ class TcpHost:
                 elif kind == K_PING:
                     await conn.send_frame(K_PONG, payload)
                 elif kind == K_PONG:
-                    pass  # PeerManager tracks liveness by any traffic
+                    conn.last_pong_at = time.time()
                 elif kind == K_CONTROL:
                     if self.on_control is not None:
                         self._spawn(

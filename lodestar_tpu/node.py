@@ -47,6 +47,7 @@ class BeaconNode:
         tcp_port: int | None = None,
         udp_port: int = 0,
         bootnodes: list[tuple[str, int]] | None = None,
+        network_isolated: bool = False,
         # -- execution layer --
         execution_url: str | None = None,
         jwt_secret: bytes | None = None,
@@ -82,6 +83,7 @@ class BeaconNode:
         self.op_pool = None
         self.tcp_port = tcp_port
         self.udp_port = udp_port
+        self.network_isolated = network_isolated
         self.bootnodes = bootnodes or []
         self.execution_url = execution_url
         self.jwt_secret = jwt_secret
@@ -350,6 +352,7 @@ class BeaconNode:
                 node.types,
                 processor=node.processor,
                 peer_id=node.peer_id,
+                isolated=node.network_isolated,
             )
             node.network.op_pool = node.op_pool
             await node.network.start(
@@ -405,11 +408,25 @@ class BeaconNode:
             # feed every connected peer into the sync components and
             # head-check it (BeaconSync's status-driven mode switch,
             # sync.ts:19): behind a peer -> range sync toward its head
+            main_loop = asyncio.get_running_loop()
+
             def _on_new_peer(peer_id: str) -> None:
-                node.range_sync.add_peer(peer_id)
-                node.unknown_block_sync.add_peer(peer_id)
-                node.backfill.add_peer(peer_id)
-                asyncio.ensure_future(node._head_check(peer_id))
+                # fires on the network-core thread under isolation —
+                # marshal the chain-side bookkeeping to the chain loop
+                def _add() -> None:
+                    node.range_sync.add_peer(peer_id)
+                    node.unknown_block_sync.add_peer(peer_id)
+                    node.backfill.add_peer(peer_id)
+                    asyncio.ensure_future(node._head_check(peer_id))
+
+                try:
+                    running = asyncio.get_running_loop()
+                except RuntimeError:
+                    running = None
+                if running is main_loop:
+                    _add()
+                else:
+                    main_loop.call_soon_threadsafe(_add)
 
             node.network.peer_manager.on_new_peer = _on_new_peer
             node.network.on_unknown_parent = (
@@ -451,6 +468,36 @@ class BeaconNode:
             slot = max(0, int((_t.time() - gt) // sps))
             g.set(slot)
 
+        # bridge the verifier service's wave stats into the registry
+        # (dashboards/lodestar_tpu_bls_verifier.json panels)
+        vm = getattr(node.chain.verifier, "metrics", None)
+        if vm is not None:
+            tv = mm.tpu_verifier
+            tv.queue_length.add_collect(
+                lambda g: g.set(vm.queue_length)
+            )
+            tv.waves_total.add_collect(lambda g: g.set(vm.waves))
+            tv.buckets_dispatched_total.add_collect(
+                lambda g: g.set(vm.buckets_dispatched)
+            )
+            tv.wave_sets_total.add_collect(
+                lambda g: g.set(vm.wave_sets_total)
+            )
+            tv.last_wave_sets.add_collect(
+                lambda g: g.set(vm.last_wave_sets)
+            )
+            tv.last_wave_duration_seconds.add_collect(
+                lambda g: g.set(vm.last_wave_duration_s)
+            )
+            tv.device_time_seconds_total.add_collect(
+                lambda g: g.set(vm.total_device_time_s)
+            )
+            tv.batch_sigs_success_total.add_collect(
+                lambda g: g.set(vm.batch_sigs_success)
+            )
+            tv.batch_retries_total.add_collect(
+                lambda g: g.set(vm.batch_retries)
+            )
         mm.clock.slot.add_collect(_wall_slot)
         mm.clock.epoch.add_collect(
             lambda g: g.set(

@@ -259,6 +259,201 @@ def _ladder_call(n_blocks: int, nbits: int = NBITS):
     return run
 
 
+def _g1_ladder_kernel(
+    nbits,
+    bits_ref,
+    fold_ref,
+    off_ref,
+    qx_ref, qy_ref, qinf_ref,
+    ox_ref, oy_ref, oz_ref, oinf_ref,
+):
+    """G1 double-and-add: the Fq (single-plane) rendition of the G2
+    kernel above — same dbl-2009-l / mixed-add formulas, same
+    capture-and-fold normalization discipline."""
+    fold_const = fold_ref[:]
+    off_const = off_ref[0:1, :].reshape(ROWS)
+    fold0 = fold_const[0].reshape(ROWS, 1)
+    off = off_const.reshape(ROWS, 1)
+
+    def mm(a, b):
+        return _modmul(a, b, fold_const)
+
+    def nrm(x):
+        return _norm2(x, fold0)
+
+    def sub(a, b):
+        return nrm(a + 2 * off - b)
+
+    def add(a, b):
+        return nrm(a + b)
+
+    def small(a, k):
+        return nrm(a * k)
+
+    def sel(m, a, b):
+        return jnp.where(m != 0, a, b)
+
+    qx = qx_ref[:]
+    qy = qy_ref[:]
+    q_inf = qinf_ref[:]
+
+    def jac_double(X, Y, Z):
+        A = mm(X, X)
+        Bv = mm(Y, Y)
+        Cv = mm(Bv, Bv)
+        t = add(X, Bv)
+        t = mm(t, t)
+        D = small(sub(sub(t, A), Cv), 2)
+        E = small(A, 3)
+        F = mm(E, E)
+        x3 = sub(F, small(D, 2))
+        y3 = sub(mm(E, sub(D, x3)), small(Cv, 8))
+        z3 = small(mm(Y, Z), 2)
+        return x3, y3, z3
+
+    def jac_mixed_add(X, Y, Z, inf):
+        z2 = mm(Z, Z)
+        z3 = mm(z2, Z)
+        mu = sub(mm(qx, z2), X)
+        th = sub(mm(qy, z3), Y)
+        mu2 = mm(mu, mu)
+        mu3 = mm(mu2, mu)
+        xmu2 = mm(X, mu2)
+        x3 = sub(sub(mm(th, th), mu3), small(xmu2, 2))
+        y3 = sub(mm(th, sub(xmu2, x3)), mm(Y, mu3))
+        z3v = mm(Z, mu)
+        one = jnp.concatenate(
+            [jnp.ones((1, LANES), jnp.int32),
+             jnp.zeros((ROWS - 1, LANES), jnp.int32)],
+            axis=0,
+        )
+        x3 = sel(inf, qx, x3)
+        y3 = sel(inf, qy, y3)
+        z3v = sel(inf, one, z3v)
+        return x3, y3, z3v, inf * q_inf
+
+    zero = jnp.zeros((ROWS, LANES), jnp.int32)
+    state = (zero, zero, zero, jnp.ones((1, LANES), jnp.int32))
+
+    def body(i, st):
+        X, Y, Z, inf = st
+        dX, dY, dZ = jac_double(X, Y, Z)
+        dX = sel(inf, X, dX)
+        dY = sel(inf, Y, dY)
+        dZ = sel(inf, Z, dZ)
+        aX, aY, aZ, a_inf = jac_mixed_add(dX, dY, dZ, inf)
+        bit = bits_ref[i, 0:1, :]
+        return (
+            sel(bit, aX, dX),
+            sel(bit, aY, dY),
+            sel(bit, aZ, dZ),
+            jnp.where(bit != 0, a_inf, inf),
+        )
+
+    st = jax.lax.fori_loop(0, nbits, body, state)
+    ox_ref[:] = st[0]
+    oy_ref[:] = st[1]
+    oz_ref[:] = st[2]
+    oinf_ref[:] = st[3]
+
+
+@functools.lru_cache(maxsize=None)
+def _g1_ladder_call(n_blocks: int, nbits: int = NBITS):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_g1_ladder_kernel, nbits)
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    flag = lambda: pl.BlockSpec(  # noqa: E731
+        (1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(bits, qx, qy, qinf):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (nbits, 1, LANES),
+                    lambda i: (0, 0, i),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+                vec(), vec(), flag(),
+            ],
+            out_specs=[vec(), vec(), vec(), flag()],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(3)
+            ]
+            + [jax.ShapeDtypeStruct((1, n), jnp.int32)],
+        )(
+            bits,
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            qx, qy, qinf,
+        )
+
+    return run
+
+
+def g1_scalar_mul(qx, qy, bits, q_inf=None):
+    """[k]Q on G1 for per-element scalars — drop-in for
+    curve.scalar_mul(FQ_OPS, ...) on TPU (the Fq analog of
+    g2_scalar_mul below)."""
+    from . import curve as C
+
+    x = L.normalize(qx).v
+    y = L.normalize(qy).v
+    batch = x.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+
+    def prep(v):
+        return jnp.transpose(jnp.pad(v, ((0, padded - batch), (0, 0))))
+
+    nbits = bits.shape[-1]
+    bits_arr = jnp.transpose(
+        jnp.pad(bits.astype(jnp.int32), ((0, padded - batch), (0, 0)))
+    ).reshape(nbits, 1, padded)
+    if q_inf is None:
+        qinf_arr = jnp.zeros((1, padded), jnp.int32)
+    else:
+        qinf_arr = jnp.pad(
+            q_inf.astype(jnp.int32), (0, padded - batch),
+            constant_values=1,
+        ).reshape(1, padded)
+    outs = _g1_ladder_call(n_blocks, nbits)(
+        bits_arr, prep(x), prep(y), qinf_arr
+    )
+
+    def lv(v):
+        return L.Lv(
+            jnp.transpose(v)[:batch, :],
+            tuple([0] * L.NCANON),
+            tuple([L.B + 2] * L.NCANON),
+        )
+
+    return C.JacPoint(
+        lv(outs[0]),
+        lv(outs[1]),
+        lv(outs[2]),
+        jnp.transpose(outs[3])[:batch, 0] != 0,
+    )
+
+
 def g2_scalar_mul(qx, qy, bits, q_inf=None):
     """[k]Q for per-element 64-bit scalars — drop-in for
     curve.scalar_mul(FQ2_OPS, ...) on TPU.

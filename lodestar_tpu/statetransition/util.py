@@ -10,6 +10,7 @@ exactly what makes the epoch transition map onto the TPU later.
 
 from __future__ import annotations
 
+import functools
 from hashlib import sha256
 
 import numpy as np
@@ -270,6 +271,67 @@ def compute_shuffled_index(index: int, count: int, seed: bytes) -> int:
     return index
 
 
+_SHUFFLE_PAD = 65536  # shape bucket: bounds XLA recompiles per size
+
+
+@functools.lru_cache(maxsize=4)
+def _shuffle_rounds_jit(padded: int, rounds: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(idx0, pivots, blocks_flat, count):
+        def body(r, idx):
+            pivot = pivots[r]
+            flip = pivot - idx
+            flip = jnp.where(flip < 0, flip + count, flip)
+            position = jnp.maximum(idx, flip)
+            byte = blocks_flat[
+                r, ((position >> 8) << 5) + ((position & 255) >> 3)
+            ]
+            bit = (byte >> (position & 7).astype(jnp.uint8)) & 1
+            return jnp.where(bit == 1, flip, idx)
+
+        return jax.lax.fori_loop(0, rounds, body, idx0)
+
+    return run
+
+
+def _shuffle_rounds_xla(count: int, seed: bytes, blocks_all):
+    """All SHUFFLE_ROUND_COUNT swap-or-not rounds as ONE jitted XLA
+    program (fused elementwise + gathers; runs on the TPU when it is
+    the default backend — the device-side epoch-boundary path). Shapes
+    are padded to _SHUFFLE_PAD buckets so churn-driven active-count
+    changes don't recompile. Returns None when JAX is unavailable."""
+    try:
+        import jax.numpy as jnp
+    except Exception:  # pragma: no cover
+        return None
+    p = preset()
+    rounds = p.SHUFFLE_ROUND_COUNT
+    padded = -(-count // _SHUFFLE_PAD) * _SHUFFLE_PAD
+    # pad lanes run with idx=0: their gathers stay in range (flip and
+    # position are < count) and their results are discarded
+    idx0 = jnp.asarray(
+        np.pad(np.arange(count, dtype=np.int32), (0, padded - count))
+    )
+    pivots = np.array(
+        [
+            int.from_bytes(hash32(seed + bytes([r]))[:8], "little")
+            % count
+            for r in range(rounds)
+        ],
+        np.int32,
+    )
+    out = _shuffle_rounds_jit(padded, rounds)(
+        idx0,
+        jnp.asarray(pivots),
+        jnp.asarray(blocks_all.reshape(rounds, -1)),
+        jnp.int32(count),
+    )
+    return np.asarray(out)[:count].astype(np.int64)
+
+
 def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
     """Vectorized swap-or-not over all indices at once: shuffled[i] is
     where index i lands (equals compute_shuffled_index(i) for all i).
@@ -285,25 +347,72 @@ def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
     p = preset()
     idx = np.arange(count, dtype=np.int64)
     n_blocks = (count + 255) // 256
-    for r in range(p.SHUFFLE_ROUND_COUNT):
+    rounds = p.SHUFFLE_ROUND_COUNT
+    # ALL decision hashes of ALL rounds in one native batched SHA-256
+    # call (seed||round||block_le4, 37 bytes each): at 1M validators
+    # that's 90 x 3907 hashes — a per-block hashlib loop here was 95%
+    # of the measured 37 s full-registry shuffle (round-4 scale work).
+    blocks_all = None
+    try:
+        from ..crypto import sha256_batch as _sb
+
+        if _sb.available():
+            # message matrix built vectorized (a bytes-join generator
+            # here measured 2 s at 1M validators)
+            msgs = np.zeros((rounds, n_blocks, 37), np.uint8)
+            msgs[:, :, :32] = np.frombuffer(seed, np.uint8)
+            msgs[:, :, 32] = np.arange(rounds, dtype=np.uint8)[:, None]
+            msgs[:, :, 33:37] = (
+                np.arange(n_blocks, dtype=np.uint32)
+                .view(np.uint8)
+                .reshape(n_blocks, 4)[None, :, :]
+            )
+            digests = _sb.hash_small_batch(msgs.tobytes(), 37)
+            blocks_all = np.frombuffer(digests, np.uint8).reshape(
+                rounds, n_blocks, 32
+            )
+    except Exception:
+        blocks_all = None
+    if blocks_all is not None:
+        fast = _shuffle_rounds_xla(count, seed, blocks_all)
+        if fast is not None:
+            return fast
+    # int32 lanes + branch-free bit ops per round: count < 2^31 always
+    # (VALIDATOR_REGISTRY_LIMIT fits), and the only non-power-of-two
+    # modulo ((pivot - idx) mod count) reduces to one conditional add
+    # since pivot - idx is in (-count, count)
+    idx32 = idx.astype(np.int32)
+    cnt = np.int32(count)
+    for r in range(rounds):
         rh = hash32(seed + bytes([r]))
-        pivot = int.from_bytes(rh[:8], "little") % count
-        flip = (pivot + count - idx) % count
-        position = np.maximum(idx, flip)
-        # decision bytes for every 256-position block of this round
-        blocks = np.stack(
-            [
-                np.frombuffer(
-                    hash32(seed + bytes([r]) + int(b).to_bytes(4, "little")),
-                    np.uint8,
-                )
-                for b in range(n_blocks)
-            ]
-        )  # (n_blocks, 32)
-        byte = blocks[position // 256, (position % 256) // 8]
-        bit = (byte >> (position % 8).astype(np.uint8)) & 1
-        idx = np.where(bit == 1, flip, idx)
-    return idx
+        pivot = np.int32(int.from_bytes(rh[:8], "little") % count)
+        flip = pivot - idx32
+        np.add(flip, cnt, out=flip, where=flip < 0)
+        position = np.maximum(idx32, flip)
+        if blocks_all is not None:
+            flat = blocks_all[r].reshape(-1)
+        else:
+            # hashlib fallback (no C compiler on this host)
+            flat = np.concatenate(
+                [
+                    np.frombuffer(
+                        hash32(
+                            seed
+                            + bytes([r])
+                            + int(b).to_bytes(4, "little")
+                        ),
+                        np.uint8,
+                    )
+                    for b in range(n_blocks)
+                ]
+            )
+        # byte index: (position >> 8)*32 + ((position & 255) >> 3)
+        byte = flat[
+            ((position >> 8) << 5) + ((position & 255) >> 3)
+        ]
+        bit = (byte >> (position & 7).astype(np.uint8)) & 1
+        idx32 = np.where(bit == 1, flip, idx32)
+    return idx32.astype(np.int64)
 
 
 # ---------------------------------------------------------------------------

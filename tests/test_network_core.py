@@ -1,0 +1,145 @@
+"""Network-core isolation (VERDICT r3 next #6): the wire stack on its
+own thread keeps serving pings/gossip-cache duties while the chain's
+event loop is blocked — and the isolated topology still propagates
+blocks end-to-end."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lodestar_tpu.chain import DevNode
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config.beacon_config import BeaconConfig
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.network.facade import Network
+from lodestar_tpu.network.transport import K_PING
+from lodestar_tpu.statetransition import create_interop_genesis_state
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+N = 16
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg():
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class StubVerifier:
+    def can_accept_work(self):
+        return True
+
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    async def close(self):
+        pass
+
+
+class TestIsolatedCore:
+    def test_blocks_propagate_through_isolated_network(self, types):
+        """Functional parity: an isolated-core producer gossips blocks
+        a plain follower imports."""
+        cfg = _cfg()
+
+        async def go():
+            producer = DevNode(
+                cfg, types, N, verifier=StubVerifier(),
+                verify_attestations=False,
+            )
+            genesis = create_interop_genesis_state(cfg, types, N)
+            follower = BeaconChain(
+                cfg, types, genesis, verifier=StubVerifier()
+            )
+            bc = BeaconConfig(
+                cfg, bytes(genesis.state.genesis_validators_root)
+            )
+            n1 = Network(
+                producer.chain, bc, types, peer_id="prod",
+                isolated=True,
+            )
+            n2 = Network(follower, bc, types, peer_id="foll")
+            await n1.start(run_maintenance=False)
+            await n2.start(run_maintenance=False)
+            await n2.connect("127.0.0.1", n1.host.port)
+            await asyncio.sleep(0.15)
+            for _ in range(3):
+                root = await producer.advance_slot()
+                blk = producer.chain.get_block(root)
+                st = producer.chain.get_state(root)
+                await n1.publish_block(st.fork, blk)
+                await asyncio.sleep(0.15)
+            assert follower.head_root == producer.chain.head_root
+            await n1.stop()
+            await n2.stop()
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_pings_served_while_chain_loop_blocked(self, types):
+        """The worker-thread payoff (networkCoreWorker.ts): with the
+        chain loop synchronously blocked, isolated cores still exchange
+        transport pings — the pong lands DURING the blocked window."""
+        cfg = _cfg()
+
+        async def go():
+            genesis = create_interop_genesis_state(cfg, types, N)
+            bc = BeaconConfig(
+                cfg, bytes(genesis.state.genesis_validators_root)
+            )
+            target = Network(
+                BeaconChain(
+                    cfg, types, genesis, verifier=StubVerifier()
+                ),
+                bc, types, peer_id="target", isolated=True,
+            )
+            probe = Network(
+                BeaconChain(
+                    cfg, types,
+                    create_interop_genesis_state(cfg, types, N),
+                    verifier=StubVerifier(),
+                ),
+                bc, types, peer_id="probe", isolated=True,
+            )
+            await target.start(run_maintenance=False)
+            await probe.start(run_maintenance=False)
+            await probe.connect("127.0.0.1", target.host.port)
+            await asyncio.sleep(0.15)
+            conn = probe.host.conns["target"]
+            assert conn.send_cipher is not None  # encrypted transport
+            # fire a ping from the probe's CORE loop, then block the
+            # chain loop solid; both read loops live on core threads
+            t0 = time.time()
+            probe._core.bridge.call_nowait(
+                conn.send_frame(K_PING, b"ABCDEFGH")
+            )
+            time.sleep(0.8)  # chain loop blocked
+            t1 = time.time()
+            assert conn.last_pong_at is not None, (
+                "no pong while the chain loop was blocked — the wire "
+                "stack is not isolated"
+            )
+            assert t0 <= conn.last_pong_at <= t1 - 0.2, (
+                "pong arrived only after the chain loop unblocked"
+            )
+            await probe.stop()
+            await target.stop()
+
+        asyncio.run(go())

@@ -1,0 +1,135 @@
+"""Micro-probe: true device cost of verify-pipeline pieces at batch
+2048 on the tunneled chip.
+
+Timing method: every probe jits a wrapper that reduces the result to
+ONE scalar, so a timed call costs dispatch + device + exactly one
+readback. The measured null-call baseline (~150 ms through the tunnel)
+is printed and should be subtracted mentally; per-leaf device_get
+timing (the old approach) charged ~150 ms PER ARRAY and made 40 ms
+stages look like seconds."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lodestar_tpu.crypto.bls.fields import P  # noqa: E402
+from lodestar_tpu.ops import curve as C  # noqa: E402
+from lodestar_tpu.ops import fq, ingest, tower  # noqa: E402
+from lodestar_tpu.ops import limbs as L  # noqa: E402
+from lodestar_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable()
+N = 2048
+rng = np.random.default_rng(5)
+
+
+def rand_fq(n=N):
+    return L.from_ints([int(rng.integers(0, 2**63)) ** 5 % P for _ in range(n)])
+
+
+def _scalarize(out):
+    leaves = jax.tree.leaves(out)
+    acc = jnp.int32(0)
+    for leaf in leaves:
+        if leaf.dtype == jnp.bool_:
+            acc = acc + jnp.sum(leaf.astype(jnp.int32))
+        else:
+            acc = acc + jnp.sum(leaf, dtype=jnp.int32)
+    return acc
+
+
+def t(label, fn, *args, reps=3):
+    wrapped = jax.jit(lambda *a: _scalarize(fn(*a)))
+    np.asarray(jax.device_get(wrapped(*args)))  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(jax.device_get(wrapped(*args)))
+    print(
+        f"{label}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms",
+        flush=True,
+    )
+
+
+def main():
+    print(f"platform={jax.default_backend()}", flush=True)
+    a = (rand_fq(), rand_fq())
+    b = (rand_fq(), rand_fq())
+
+    def mul32(x, y):
+        for _ in range(32):
+            x = fq.mul(x, y)
+        return x
+
+    def eq8(x, y):
+        return [fq.eq(fq.mul(x, y), x) for _ in range(8)]
+
+    t("null baseline", lambda x: x, a[0], reps=5)
+    t("fq.mul x1", lambda x, y: fq.mul(x, y), a[0], b[0])
+    t("fq.mul x32", mul32, a[0], b[0])
+    t("fq.eq x8", eq8, a[0], b[0])
+    t("fq.inv chain", lambda x: fq.inv(x), a[0])
+    t("fq2_sqrt_flagged", lambda x: ingest.fq2_sqrt_flagged(x), a)
+
+    x, y, _ = ingest.g2_sqrt_with_sign(a, jnp.zeros(N, bool))
+    q = C.jac_from_affine(C.FQ2_OPS, tower.fq2_norm(x), tower.fq2_norm(y))
+
+    t("jac_psi", lambda p: ingest.jac_psi(p), q)
+    t("jac_eq", lambda p: ingest.jac_eq(p, p), q)
+    t("jac_add", lambda p: C.jac_add(C.FQ2_OPS, p, p), q)
+
+    from lodestar_tpu.ops import pallas_ladder as PL
+
+    bits = jnp.broadcast_to(jnp.asarray(ingest._x_bits()), (N, 64))
+    t(
+        "pallas ladder [x]Q",
+        lambda qx0, qx1, qy0, qy1, b_: PL.g2_scalar_mul(
+            (qx0, qx1), (qy0, qy1), b_
+        ),
+        q.x[0], q.x[1], q.y[0], q.y[1], bits,
+    )
+    t("scan ladder [x]Q", lambda p: ingest._mul_x_abs(p, (N,)), q)
+    t("g2_in_subgroup", lambda p: ingest.g2_in_subgroup(p, (N,)), q)
+    t("g2_clear_cofactor", lambda p: ingest.g2_clear_cofactor(p, (N,)), q)
+    t("sswu single", lambda u: ingest._sswu(u), a)
+    t("iso_map", lambda u: ingest._iso_map(u, u), a)
+
+    # prepare-stage pieces
+    from lodestar_tpu.bls import kernels
+    from lodestar_tpu.bls.verifier import _rand_scalars
+
+    rbits = C.scalars_to_bits(_rand_scalars(N), kernels.RAND_BITS)
+    g1x, g1y = rand_fq(), rand_fq()
+    t(
+        "G1 scan ladder (rand)",
+        lambda px, py, b_: C.scalar_mul(C.FQ_OPS, px, py, b_),
+        g1x, g1y, rbits,
+    )
+    t(
+        "G2 jac_sum_scan",
+        lambda p: C.jac_sum_scan(C.FQ2_OPS, p),
+        q,
+    )
+    # product stage at the pairing batch shape
+    f12 = tuple(
+        tuple((rand_fq(N + 1), rand_fq(N + 1)) for _ in range(3))
+        for _ in range(2)
+    )
+    mask = jnp.ones(N + 1, bool)
+    from lodestar_tpu.ops import pairing
+
+    t(
+        "fq12 masked product (2049)",
+        lambda m: pairing._fq12_masked_product(f12, m),
+        mask,
+    )
+
+
+if __name__ == "__main__":
+    main()
