@@ -128,6 +128,17 @@ def main() -> None:
                 subprocess.call([sys.executable] + sys.argv, env=env)
             )
         os.environ["_BENCH_MESH"] = str(mesh_n)
+    if mesh_n and os.environ.get("_BENCH_MESH") == str(mesh_n):
+        # re-exec'd child: the ambient sitecustomize may import jax
+        # before the env var is read — force via the config API too
+        # (same dance as tests/conftest.py)
+        import jax as _jax
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            try:
+                _jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
     import jax
 
     print(

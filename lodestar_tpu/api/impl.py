@@ -306,6 +306,130 @@ class BeaconApiImpl:
             },
         }
 
+    def get_block_headers(self, slot: str = "", parent_root: str = "") -> list:
+        """routes/beacon/block.ts getBlockHeaders: list headers filtered
+        by slot and/or parent_root (the canonical chain view the proto
+        array answers)."""
+        proto = self.chain.fork_choice.proto
+        head = self.chain.head_root
+        want_slot = int(slot) if slot != "" else None
+        want_parent = (
+            bytes.fromhex(str(parent_root).removeprefix("0x"))
+            if parent_root
+            else None
+        )
+        out = []
+        for node in proto.nodes:
+            if node is None:
+                continue
+            if want_slot is not None and node.slot != want_slot:
+                continue
+            if (
+                want_parent is not None
+                and (node.parent_root or b"") != want_parent
+            ):
+                continue
+            canonical = (
+                proto.ancestor_at_slot(head, node.slot)
+                == node.block_root
+            )
+            out.append(
+                {
+                    "root": _hex(node.block_root),
+                    "canonical": canonical,
+                    "header": {
+                        "message": {
+                            "slot": str(node.slot),
+                            "parent_root": _hex(
+                                node.parent_root or b"\x00" * 32
+                            ),
+                            "state_root": _hex(node.state_root),
+                        },
+                    },
+                }
+            )
+        if want_slot is None and want_parent is None:
+            # unfiltered: the head header only (reference behavior)
+            out = [self.get_block_header("head")]
+        return out
+
+    def get_deposit_snapshot(self) -> dict:
+        """EIP-4881 deposit tree snapshot
+        (routes/beacon/index.ts getDepositSnapshot)."""
+        eth1 = getattr(self.chain, "eth1", None)
+        if eth1 is None or len(eth1.tree) == 0:
+            raise ApiError(404, "no deposit snapshot available")
+        tree = eth1.tree
+        count = len(tree)
+        return {
+            "finalized": [
+                _hex(h) for h in tree.branch(count - 1, count)
+            ],
+            "deposit_root": _hex(tree.root()),
+            "deposit_count": str(count),
+            "execution_block_hash": _hex(
+                getattr(eth1, "latest_block_hash", b"\x00" * 32) or b"\x00" * 32
+            ),
+            "execution_block_height": str(
+                getattr(eth1, "latest_block_number", 0) or 0
+            ),
+        }
+
+    # -- proof namespace (routes/proof.ts) -------------------------------
+
+    def get_state_proof(self, state_id: str, field: str = "") -> dict:
+        """SSZ Merkle proof of one top-level BeaconState field against
+        the state root (proof.ts getStateProof; field-level descriptor
+        subset — the ssz/proofs machinery provides the branches)."""
+        from ..ssz.proofs import container_field_branch
+
+        if not field:
+            raise ApiError(400, "field query parameter required")
+        view = self._resolve_state(state_id)
+        state_t = self.types.by_fork[view.fork].BeaconState
+        if field not in state_t.field_names:
+            raise ApiError(400, f"unknown state field {field!r}")
+        leaf, branch, idx = container_field_branch(
+            state_t, view.state, field
+        )
+        depth = len(branch)
+        return {
+            "type": "single",
+            "field": field,
+            "gindex": str((1 << depth) + idx),
+            "leaf": _hex(leaf),
+            "witnesses": [_hex(w) for w in branch],
+            "state_root": _hex(state_t.hash_tree_root(view.state)),
+        }
+
+    def get_block_proof(self, block_id: str, field: str = "") -> dict:
+        """SSZ Merkle proof of one top-level BeaconBlock field against
+        the block root (proof.ts getBlockProof subset)."""
+        from ..ssz.proofs import container_field_branch
+
+        if not field:
+            raise ApiError(400, "field query parameter required")
+        root = self._resolve_block_root(block_id)
+        signed = self.chain.get_block(root)
+        if signed is None:
+            raise ApiError(404, f"block {block_id} not found")
+        view = self.chain.get_state(root) or self.chain.head_state
+        block_t = self.types.by_fork[view.fork].BeaconBlock
+        if field not in block_t.field_names:
+            raise ApiError(400, f"unknown block field {field!r}")
+        leaf, branch, idx = container_field_branch(
+            block_t, signed.message, field
+        )
+        depth = len(branch)
+        return {
+            "type": "single",
+            "field": field,
+            "gindex": str((1 << depth) + idx),
+            "leaf": _hex(leaf),
+            "witnesses": [_hex(w) for w in branch],
+            "block_root": _hex(root),
+        }
+
     def _resolve_block_root(self, block_id) -> bytes:
         block_id = str(block_id)  # numeric path params arrive as ints
         chain = self.chain
@@ -626,6 +750,27 @@ class BeaconApiImpl:
                 }
             )
         return out
+
+    def get_peer(self, peer_id: str) -> dict:
+        """routes/node.ts getPeer: one peer's detail."""
+        net = getattr(self.node, "network", None) if self.node else None
+        if net is None:
+            raise ApiError(404, "no network")
+        conn = net.host.conns.get(str(peer_id))
+        if conn is None:
+            raise ApiError(404, f"peer {peer_id} not connected")
+        score = net.peer_manager.scores.get(str(peer_id))
+        return {
+            "peer_id": str(peer_id),
+            "enr": "",
+            "last_seen_p2p_address": (
+                f"/ip4/{net.host.host}/tcp/"
+                f"{conn.hello.get('tcp_port', 0)}"
+            ),
+            "state": "connected",
+            "direction": "outbound" if conn.outbound else "inbound",
+            "score": score.value() if score else 0.0,
+        }
 
     # -- validator namespace --------------------------------------------
 

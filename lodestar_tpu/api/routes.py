@@ -300,6 +300,9 @@ ROUTES: list[Route] = [
     Route("getSyncingStatus", "GET", "/eth/v1/node/syncing", "get_syncing"),
     Route("getNetworkIdentity", "GET", "/eth/v1/node/identity", "get_identity"),
     Route("getPeers", "GET", "/eth/v1/node/peers", "get_peers"),
+    Route(
+        "getPeer", "GET", "/eth/v1/node/peers/{peer_id}", "get_peer"
+    ),
     # config
     Route("getSpec", "GET", "/eth/v1/config/spec", "get_spec"),
     Route(
@@ -313,6 +316,34 @@ ROUTES: list[Route] = [
         "GET",
         "/eth/v1/config/deposit_contract",
         "get_deposit_contract",
+    ),
+    Route(
+        "getBlockHeaders",
+        "GET",
+        "/eth/v1/beacon/headers",
+        "get_block_headers",
+        query_params=("slot", "parent_root"),
+    ),
+    Route(
+        "getDepositSnapshot",
+        "GET",
+        "/eth/v1/beacon/deposit_snapshot",
+        "get_deposit_snapshot",
+    ),
+    # proof namespace (routes/proof.ts)
+    Route(
+        "getStateProof",
+        "GET",
+        "/eth/v0/beacon/proof/state/{state_id}",
+        "get_state_proof",
+        query_params=("field",),
+    ),
+    Route(
+        "getBlockProof",
+        "GET",
+        "/eth/v0/beacon/proof/block/{block_id}",
+        "get_block_proof",
+        query_params=("field",),
     ),
 ]
 

@@ -108,7 +108,12 @@ def _stage_prepare_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
     rsig = C.jac_select(
         C.FQ2_OPS, mask, rsig, C.jac_infinity(C.FQ2_OPS, mask.shape)
     )
-    s = C.jac_sum_scan(C.FQ2_OPS, rsig)
+    if jax.default_backend() == "tpu" and bits.ndim == 2:
+        from ..ops import pallas_pairing as PP
+
+        s = PP.g2_sum(rsig)
+    else:
+        s = C.jac_sum_scan(C.FQ2_OPS, rsig)
     s_aff = _to_affine(C.FQ2_OPS, s)
     rpk_aff = _to_affine(C.FQ_OPS, rpk)
     ngx, ngy = _g1_neg_gen((1,))
@@ -252,7 +257,22 @@ def _stage_prepare_same_message(
 
 
 _stage_miller_xla = jax.jit(pairing.miller_loop)
-_stage_product = jax.jit(pairing._fq12_masked_product)
+_stage_product_xla = jax.jit(pairing._fq12_masked_product)
+
+
+@jax.jit
+def _stage_product_pallas(f, mask):
+    from ..ops import pallas_pairing as PP
+
+    return PP.fq12_masked_product(f, mask)
+
+
+def _stage_product(f, mask):
+    """Masked pairing-product reduction: lane-halving VMEM kernel on
+    TPU for big buckets, XLA scan+tree elsewhere."""
+    if _pallas_pairing_on():
+        return _stage_product_pallas(f, mask)
+    return _stage_product_xla(f, mask)
 
 
 def _pallas_pairing_on() -> bool:

@@ -478,7 +478,36 @@ class BeaconChain:
                 block_root, block.body.sync_aggregate, int(block.slot)
             )
         if self.validator_monitor is not None:
-            self.validator_monitor.on_block_imported(block)
+            vm = self.validator_monitor
+            vm.on_block_imported(block)
+            if vm.count and work.fork_seq >= ForkSeq.altair:
+                # monitored sync-committee members included in this
+                # block's SyncAggregate (registerSyncAggregateInBlock);
+                # pubkey->index via the process-wide incremental view —
+                # rebuilding a dict here would walk the registry per
+                # imported block
+                try:
+                    from ..statetransition.util import PubkeyIndexView
+
+                    st = work.state
+                    pk2i = PubkeyIndexView(st)
+                    agg = block.body.sync_aggregate
+                    participants = []
+                    for pk, bit in zip(
+                        st.current_sync_committee.pubkeys,
+                        agg.sync_committee_bits,
+                    ):
+                        if not bit:
+                            continue
+                        i = pk2i.get(bytes(pk))
+                        if i is not None:
+                            participants.append(i)
+                    if participants:
+                        vm.on_sync_aggregate_included(
+                            participants, int(block.slot)
+                        )
+                except Exception:
+                    pass  # monitoring must never fail an import
         return block_root
 
     async def _notify_new_payload(self, work, block, block_root):

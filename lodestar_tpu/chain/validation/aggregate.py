@@ -179,4 +179,7 @@ class AggregateAndProofValidator:
         self.chain.fork_choice.on_attestation(
             participants, bytes(data.beacon_block_root), target_epoch
         )
+        vm = getattr(self.chain, "validator_monitor", None)
+        if vm is not None and vm.count:
+            vm.on_aggregate_participation(participants, target_epoch)
         return GossipAction.ACCEPT

@@ -98,6 +98,37 @@ def _build_parser() -> argparse.ArgumentParser:
         "--checkpoint-sync-url on first start)",
     )
 
+    lc = sub.add_parser(
+        "lightclient",
+        help="run a light client against a beacon REST endpoint",
+    )
+    lc.add_argument(
+        "--beacon-api-url", required=True,
+        help="beacon node REST endpoint to sync from",
+    )
+    lc.add_argument(
+        "--checkpoint-root", required=True,
+        help="trusted finalized block root (0x..) for bootstrap",
+    )
+    lc.add_argument(
+        "--poll-seconds", type=float, default=12.0,
+        help="finality/optimistic update poll interval",
+    )
+    lc.add_argument(
+        "--max-polls", type=int, default=0,
+        help="exit after N polls (0 = run forever)",
+    )
+
+    bn = sub.add_parser(
+        "bootnode",
+        help="run a standalone discovery bootnode (no chain)",
+    )
+    bn.add_argument("--discovery-port", type=int, default=9000)
+    bn.add_argument(
+        "--max-seconds", type=float, default=0,
+        help="exit after this long (0 = run forever)",
+    )
+
     vc = sub.add_parser("validator", help="validator client utilities")
     vc.add_argument(
         "--vc-db",
@@ -294,6 +325,138 @@ def _run_validator(args) -> int:
     return 1
 
 
+async def _run_lightclient(args) -> int:
+    """Bootstrap from a trusted root over REST, then follow finality /
+    optimistic updates (reference: packages/light-client running
+    against the beacon API transport)."""
+    from .api.client import ApiClient
+    from .api.json_codec import from_json
+    from .config.beacon_config import BeaconConfig
+    from .config.chain_config import ChainConfig
+    from .lightclient import LightClient
+    from .logger import get_logger
+
+    log = get_logger("lightclient")
+    client = ApiClient(args.beacon_api_url)
+    loop = asyncio.get_running_loop()
+
+    def call(op, params=None):
+        return client.call(op, params)
+
+    genesis = await loop.run_in_executor(None, call, "getGenesis")
+    gvr = bytes.fromhex(
+        genesis["genesis_validators_root"].removeprefix("0x")
+    )
+    # fork schedule from the endpoint: domains must match the serving
+    # chain, not this host's defaults
+    spec = await loop.run_in_executor(None, call, "getSpec")
+    cfg = ChainConfig(
+        **{
+            k: int(spec[k])
+            for k in (
+                "ALTAIR_FORK_EPOCH",
+                "BELLATRIX_FORK_EPOCH",
+                "CAPELLA_FORK_EPOCH",
+                "DENEB_FORK_EPOCH",
+                "ELECTRA_FORK_EPOCH",
+            )
+            if k in spec
+        }
+    )
+    bc = BeaconConfig(cfg, gvr)
+    from .types import ssz_types
+
+    types = ssz_types()
+    root = args.checkpoint_root.removeprefix("0x")
+    boot_json = await loop.run_in_executor(
+        None, call, "getLightClientBootstrap", {"block_root": "0x" + root}
+    )
+    bootstrap = from_json(types.LightClientBootstrap, boot_json)
+    lc = LightClient(bc, types, bootstrap, bytes.fromhex(root))
+    log.info(
+        "light client bootstrapped",
+        {"slot": int(bootstrap.header.beacon.slot)},
+    )
+    def _to_full(u, has_finality: bool):
+        # process_update consumes full LightClientUpdate shapes; wrap
+        # finality/optimistic updates with empty committee fields
+        full = types.LightClientUpdate.default()
+        full.attested_header = u.attested_header
+        full.sync_aggregate = u.sync_aggregate
+        full.signature_slot = u.signature_slot
+        if has_finality:
+            full.finalized_header = u.finalized_header
+            full.finality_branch = u.finality_branch
+        return full
+
+    polls = 0
+    applied = 0
+    while args.max_polls == 0 or polls < args.max_polls:
+        for op, t, fin in (
+            (
+                "getLightClientFinalityUpdate",
+                types.LightClientFinalityUpdate,
+                True,
+            ),
+            (
+                "getLightClientOptimisticUpdate",
+                types.LightClientOptimisticUpdate,
+                False,
+            ),
+        ):
+            try:
+                upd = await loop.run_in_executor(None, call, op)
+                lc.process_update(_to_full(from_json(t, upd), fin))
+                applied += 1
+                log.info(
+                    "update applied",
+                    {
+                        "op": op,
+                        "head_slot": int(
+                            lc.optimistic_header.beacon.slot
+                        ),
+                    },
+                )
+            except Exception as e:
+                log.warn("update poll failed", {"op": op, "err": repr(e)})
+        polls += 1
+        await asyncio.sleep(args.poll_seconds)
+    # bounded runs report failure when NO update ever applied — a
+    # wrong fork schedule or dead endpoint must not exit 0
+    return 0 if applied else 1
+
+
+async def _run_bootnode(args) -> int:
+    """Discovery-only node: answers FINDNODE walks so fresh nodes can
+    bootstrap peer discovery (reference: the standalone bootnode cmd,
+    cli/src/cmds/bootnode)."""
+    from .network.discovery import Discovery, NodeRecord
+    from .logger import get_logger
+
+    log = get_logger("bootnode")
+    disc = Discovery(
+        NodeRecord(
+            peer_id="bootnode",
+            host="0.0.0.0",
+            tcp_port=0,
+            udp_port=args.discovery_port,
+            fork_digest="00000000",
+        )
+    )
+    await disc.listen()
+    log.info("bootnode listening", {"udp": args.discovery_port})
+    import time as _t
+
+    t0 = _t.time()
+    try:
+        while not args.max_seconds or _t.time() - t0 < args.max_seconds:
+            await asyncio.sleep(1.0)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await disc.close()
+    return 0
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     _set_preset(args.preset)
@@ -303,6 +466,10 @@ def main(argv=None) -> int:
         return asyncio.run(_run_beacon(args))
     if args.cmd == "validator":
         return _run_validator(args)
+    if args.cmd == "lightclient":
+        return asyncio.run(_run_lightclient(args))
+    if args.cmd == "bootnode":
+        return asyncio.run(_run_bootnode(args))
     return 1
 
 

@@ -2,9 +2,13 @@
 
 Reference analog: createValidatorMonitor
 (metrics/validatorMonitor.ts:255) — the beacon node tracks registered
-local validators' attestation inclusion/correctness and proposals,
-exposing per-epoch summaries and prometheus series so operators see
-liveness/effectiveness without trusting external explorers.
+local validators across every duty surface: unaggregated/aggregated
+attestations seen on gossip, on-chain inclusion (delay + head/target
+correctness), block proposals (and misses against the expected
+proposer), sync-committee messages and their on-chain inclusion, and
+per-epoch balance deltas. Rolled up per epoch into prometheus series
+(labeled by validator index, as the reference's `index` label) and a
+structured summary operators can log/alert on.
 """
 
 from __future__ import annotations
@@ -13,19 +17,33 @@ from dataclasses import dataclass, field
 
 from ..params import preset
 
+HISTORY_EPOCHS = 4  # summaries kept per validator (reference keeps 3+)
+
 
 @dataclass
 class _EpochSummary:
-    attestation_seen: bool = False
+    # attestations
+    attestation_seen_gossip: int = 0  # unaggregated copies seen
+    attestation_seen_aggregate: int = 0  # included in seen aggregates
+    attestation_included: bool = False
     attestation_inclusion_delay: int | None = None
     attestation_correct_head: bool = False
     attestation_correct_target: bool = False
+    # proposals
     blocks_proposed: int = 0
+    blocks_missed: int = 0
+    # sync committee
+    sync_messages_seen: int = 0
+    sync_signatures_included: int = 0
+    # balances (gwei)
+    balance: int | None = None
+    balance_delta: int | None = None
 
 
 @dataclass
 class _MonitoredValidator:
     index: int
+    pubkey: bytes | None = None
     summaries: dict[int, _EpochSummary] = field(default_factory=dict)
 
     def summary(self, epoch: int) -> _EpochSummary:
@@ -35,15 +53,16 @@ class _MonitoredValidator:
             # bound memory: keep the newest few epochs, but never the
             # one just requested (old-epoch events arrive via reorg /
             # unknown-block imports)
-            for old in sorted(self.summaries)[:-4]:
+            for old in sorted(self.summaries)[:-HISTORY_EPOCHS]:
                 if old != epoch:
                     del self.summaries[old]
         return s
 
 
 class ValidatorMonitor:
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, logger=None):
         self.validators: dict[int, _MonitoredValidator] = {}
+        self.log = logger
         if registry is not None:
             reg = registry
             self._m_att_hit = reg.counter(
@@ -54,17 +73,70 @@ class ValidatorMonitor:
                 "validator_monitor_prev_epoch_on_chain_attester_miss_total",
                 "Missed attestations for monitored validators",
             )
+            self._m_head_hit = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_head_attester_hit_total",
+                "Included attestations voting the correct head",
+            )
+            self._m_target_hit = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_target_attester_hit_total",
+                "Included attestations voting the correct target",
+            )
+            self._m_inclusion_delay = reg.histogram(
+                "validator_monitor_prev_epoch_attestation_inclusion_delay",
+                "Best inclusion delay of monitored attestations",
+                buckets=(1, 2, 3, 5, 8, 16, 32),
+            )
+            self._m_gossip_unagg = reg.counter(
+                "validator_monitor_unaggregated_attestation_total",
+                "Monitored validators' attestations seen on gossip",
+                label_names=("src",),
+            )
             self._m_proposals = reg.counter(
                 "validator_monitor_beacon_block_total",
                 "Blocks proposed by monitored validators",
             )
+            self._m_proposals_missed = reg.counter(
+                "validator_monitor_validator_block_miss_total",
+                "Expected proposals a monitored validator missed",
+            )
+            self._m_sync_seen = reg.counter(
+                "validator_monitor_sync_committee_message_total",
+                "Sync-committee messages seen from monitored validators",
+            )
+            self._m_sync_included = reg.counter(
+                "validator_monitor_sync_signature_in_block_total",
+                "Monitored sync signatures included in imported blocks",
+            )
+            self._m_balance = reg.gauge(
+                "validator_monitor_balance_gwei",
+                "Latest observed balance of a monitored validator",
+                label_names=("index",),
+            )
         else:
-            self._m_att_hit = self._m_att_miss = self._m_proposals = None
+            self._m_att_hit = self._m_att_miss = None
+            self._m_head_hit = self._m_target_hit = None
+            self._m_inclusion_delay = None
+            self._m_gossip_unagg = None
+            self._m_proposals = self._m_proposals_missed = None
+            self._m_sync_seen = self._m_sync_included = None
+            self._m_balance = None
 
-    def register_local_validator(self, index: int) -> None:
-        self.validators.setdefault(index, _MonitoredValidator(index))
+    # -- registration -----------------------------------------------------
 
-    # -- event feeds (called from block import) ---------------------------
+    def register_local_validator(
+        self, index: int, pubkey: bytes | None = None
+    ) -> None:
+        mv = self.validators.setdefault(
+            index, _MonitoredValidator(index)
+        )
+        if pubkey is not None:
+            mv.pubkey = bytes(pubkey)
+
+    @property
+    def count(self) -> int:
+        return len(self.validators)
+
+    # -- event feeds ------------------------------------------------------
 
     def on_block_imported(self, block) -> None:
         idx = int(block.proposer_index)
@@ -75,6 +147,37 @@ class ValidatorMonitor:
         mv.summary(epoch).blocks_proposed += 1
         if self._m_proposals is not None:
             self._m_proposals.inc()
+
+    def on_missed_block(self, proposer_index: int, slot: int) -> None:
+        """Expected proposer produced nothing for `slot`
+        (validatorMonitor registerBeaconBlock miss path)."""
+        mv = self.validators.get(int(proposer_index))
+        if mv is None:
+            return
+        epoch = int(slot) // preset().SLOTS_PER_EPOCH
+        mv.summary(epoch).blocks_missed += 1
+        if self._m_proposals_missed is not None:
+            self._m_proposals_missed.inc()
+
+    def on_gossip_attestation(self, validator_index: int, epoch: int) -> None:
+        """Unaggregated attestation from a monitored validator seen on
+        gossip (registerUnaggregatedAttestation)."""
+        mv = self.validators.get(int(validator_index))
+        if mv is None:
+            return
+        mv.summary(int(epoch)).attestation_seen_gossip += 1
+        if self._m_gossip_unagg is not None:
+            self._m_gossip_unagg.inc(src="gossip")
+
+    def on_aggregate_participation(
+        self, attester_indices, epoch: int
+    ) -> None:
+        """Monitored validators covered by a seen aggregate
+        (registerAggregatedAttestation)."""
+        for idx in attester_indices:
+            mv = self.validators.get(int(idx))
+            if mv is not None:
+                mv.summary(int(epoch)).attestation_seen_aggregate += 1
 
     def on_attestation_included(
         self,
@@ -89,7 +192,7 @@ class ValidatorMonitor:
             if mv is None:
                 continue
             s = mv.summary(attestation_epoch)
-            s.attestation_seen = True
+            s.attestation_included = True
             if (
                 s.attestation_inclusion_delay is None
                 or inclusion_delay < s.attestation_inclusion_delay
@@ -98,16 +201,90 @@ class ValidatorMonitor:
             s.attestation_correct_head |= correct_head
             s.attestation_correct_target |= correct_target
 
+    def on_sync_committee_message(
+        self, validator_index: int, slot: int
+    ) -> None:
+        mv = self.validators.get(int(validator_index))
+        if mv is None:
+            return
+        epoch = int(slot) // preset().SLOTS_PER_EPOCH
+        mv.summary(epoch).sync_messages_seen += 1
+        if self._m_sync_seen is not None:
+            self._m_sync_seen.inc()
+
+    def on_sync_aggregate_included(
+        self, participant_indices, slot: int
+    ) -> None:
+        """Monitored validators present in an imported block's
+        SyncAggregate (registerSyncAggregateInBlock)."""
+        epoch = int(slot) // preset().SLOTS_PER_EPOCH
+        for idx in participant_indices:
+            mv = self.validators.get(int(idx))
+            if mv is None:
+                continue
+            mv.summary(epoch).sync_signatures_included += 1
+            if self._m_sync_included is not None:
+                self._m_sync_included.inc()
+
+    def on_balances(self, state, epoch: int) -> None:
+        """Record monitored validators' balances for the epoch
+        (registerValidatorStatuses balance tracking)."""
+        balances = state.balances
+        n = len(balances)
+        for idx, mv in self.validators.items():
+            if idx >= n:
+                continue
+            bal = int(balances[idx])
+            s = mv.summary(epoch)
+            prev = mv.summaries.get(epoch - 1)
+            s.balance = bal
+            if prev is not None and prev.balance is not None:
+                s.balance_delta = bal - prev.balance
+            if self._m_balance is not None:
+                self._m_balance.set(bal, index=str(idx))
+
+    # -- epoch rollup -----------------------------------------------------
+
     def on_epoch_summary(self, prev_epoch: int) -> dict:
-        """Roll up the previous epoch (validatorMonitor's per-epoch
-        processing); returns {index: summary} and bumps counters."""
+        """Roll up the previous epoch (validatorMonitor's
+        onceEveryEndOfEpoch); returns {index: summary}, bumps the
+        prometheus series, and logs one structured line per validator
+        when a logger is attached."""
         out = {}
         for idx, mv in self.validators.items():
             s = mv.summary(prev_epoch)
             out[idx] = s
             if self._m_att_hit is not None:
-                if s.attestation_seen:
+                if s.attestation_included:
                     self._m_att_hit.inc()
+                    if s.attestation_correct_head:
+                        self._m_head_hit.inc()
+                    if s.attestation_correct_target:
+                        self._m_target_hit.inc()
+                    if s.attestation_inclusion_delay is not None:
+                        self._m_inclusion_delay.observe(
+                            s.attestation_inclusion_delay
+                        )
                 else:
                     self._m_att_miss.inc()
+            if self.log is not None:
+                self.log.info(
+                    "validator epoch summary",
+                    {
+                        "index": idx,
+                        "epoch": prev_epoch,
+                        "att_included": s.attestation_included,
+                        "incl_delay": s.attestation_inclusion_delay,
+                        "head_ok": s.attestation_correct_head,
+                        "target_ok": s.attestation_correct_target,
+                        "gossip_seen": s.attestation_seen_gossip,
+                        "agg_seen": s.attestation_seen_aggregate,
+                        "proposed": s.blocks_proposed,
+                        "missed": s.blocks_missed,
+                        "sync_seen": s.sync_messages_seen,
+                        "sync_included": s.sync_signatures_included,
+                        "balance": s.balance,
+                        "delta": s.balance_delta,
+                    },
+                )
         return out

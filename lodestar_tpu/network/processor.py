@@ -185,6 +185,11 @@ class NetworkProcessor:
         except GossipValidationError as e:
             self._count(e.action, GossipTopic.sync_committee)
             return e.action
+        vm = getattr(self.chain, "validator_monitor", None)
+        if vm is not None and vm.count:
+            vm.on_sync_committee_message(
+                int(msg.validator_index), int(msg.slot)
+            )
         if self.sync_msg_pool is not None:
             sub_size = self._sub_size()
             for pos in positions:
@@ -313,8 +318,14 @@ class NetworkProcessor:
                     atts
                 )
             )
+            vm = getattr(self.chain, "validator_monitor", None)
             for att, fut, res in zip(atts, futs, results):
                 if res.action == GossipAction.ACCEPT:
+                    if vm is not None and res.validator_index is not None:
+                        vm.on_gossip_attestation(
+                            res.validator_index,
+                            int(att.data.target.epoch),
+                        )
                     if self.att_pool is not None:
                         self.att_pool.add(att)
                     if self.unagg_pool is not None:

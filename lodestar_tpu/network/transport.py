@@ -160,6 +160,12 @@ class TcpHost:
         self.host = host
         # transport identity: Noise XX static key (libp2p-noise analog)
         self.static_key = X25519PrivateKey.generate()
+        # peer_id -> Noise static pub, trust-on-first-use: a later
+        # connection claiming a known peer_id under a DIFFERENT static
+        # key is dropped (a banned/competing peer cannot hijack a
+        # well-scored identity; libp2p derives ids from keys — here
+        # ids are operator-chosen, so the binding is pinned instead)
+        self.peer_statics: dict[str, bytes] = {}
         self.port: int | None = None
         self.conns: dict[str, PeerConnection] = {}
         self._server = None
@@ -204,6 +210,12 @@ class TcpHost:
                 "peer_id": self.peer_id,
                 "fork_digest": self.fork_digest.hex(),
                 "tcp_port": self.port or 0,
+                # bound to the Noise handshake: the receiver verifies
+                # this equals the rs it AUTHENTICATED via DH, tying the
+                # self-asserted hello to the encrypted channel's key
+                "static_key": self.static_key.public_key()
+                .public_bytes_raw()
+                .hex(),
             }
         ).encode()
 
@@ -226,11 +238,22 @@ class TcpHost:
         ct = send_c.encrypt(b"", hello_pt)
         writer.write(struct.pack(">I", len(ct)) + ct)
         await writer.drain()
-        kind, payload = await read_frame(reader, recv_c)
+        try:
+            kind, payload = await read_frame(reader, recv_c)
+        except (asyncio.IncompleteReadError, OSError) as e:
+            # server dropped us during the identity exchange (e.g. a
+            # peer_id/static-key binding mismatch on its side)
+            writer.close()
+            raise TransportError(f"hello exchange failed: {e}") from e
         if kind != K_HELLO:
             writer.close()
             raise TransportError("expected HELLO")
         hello = json.loads(payload)
+        if not self._check_identity(hello, rs):
+            writer.close()
+            raise TransportError(
+                "peer identity/static-key binding mismatch"
+            )
         conn = PeerConnection(
             reader, writer, hello["peer_id"], hello, outbound=True,
             send_cipher=send_c, recv_cipher=recv_c, remote_static=rs,
@@ -254,6 +277,9 @@ class TcpHost:
                 return
             hello = json.loads(payload)
             peer_id = hello["peer_id"]
+            if not self._check_identity(hello, rs):
+                writer.close()
+                return
             hello_pt = bytes([K_HELLO]) + self._hello_payload()
             ct = send_c.encrypt(b"", hello_pt)
             writer.write(struct.pack(">I", len(ct)) + ct)
@@ -277,6 +303,19 @@ class TcpHost:
 
     def _initiator(self, conn: PeerConnection) -> str:
         return self.peer_id if conn.outbound else conn.peer_id
+
+    def _check_identity(self, hello: dict, rs: bytes) -> bool:
+        """hello.static_key must equal the handshake-authenticated
+        remote static; peer_id must not be pinned to a different key."""
+        claimed = hello.get("static_key", "")
+        if claimed and bytes.fromhex(claimed) != rs:
+            return False
+        pid = hello.get("peer_id", "")
+        pinned = self.peer_statics.get(pid)
+        if pinned is not None and pinned != rs:
+            return False
+        self.peer_statics[pid] = rs
+        return True
 
     def _install(self, conn: PeerConnection) -> None:
         old = self.conns.get(conn.peer_id)

@@ -27,6 +27,10 @@ from .metrics import (
 from .network.processor import NetworkProcessor
 from .network.reqresp import InProcessTransport, ReqResp
 from .params import ForkSeq, preset
+
+
+def util_compute_epoch(slot: int) -> int:
+    return slot // preset().SLOTS_PER_EPOCH
 from .sync import RangeSync, SyncServer
 
 
@@ -105,6 +109,48 @@ class BeaconNode:
         self.checkpoint_states = None
         self.clock = None
         self._altair_topics_on = False
+
+    def _monitor_slot_tick(self, slot: int) -> None:
+        """Validator-monitor wall-clock duties: missed-proposal
+        detection for the previous slot, and per-epoch balance capture
+        + rollup at epoch starts (validatorMonitor onceEverySlot /
+        onceEveryEndOfEpoch)."""
+        vm = self.chain.validator_monitor
+        if vm is None or not vm.count:
+            return
+        p = preset()
+        try:
+            prev = slot - 1
+            if prev > 0:
+                head = self.chain.fork_choice.proto.get_node(
+                    self.chain.head_root
+                )
+                if head is not None and head.slot < prev:
+                    # no canonical block at prev: was one of ours due?
+                    # The proposer is slot-seeded, so only a state
+                    # ADVANCED to prev answers exactly — the next-slot
+                    # scheduler usually has one cached; skip otherwise
+                    view = None
+                    pns = self.prepare_next_slot
+                    if pns is not None:
+                        for w in pns.prepared.values():
+                            if int(w.state.slot) == prev:
+                                view = w
+                                break
+                    if view is not None:
+                        from .statetransition import util as _u
+
+                        proposer = _u.get_beacon_proposer_index(
+                            view.state,
+                            electra=view.fork_seq >= ForkSeq.electra,
+                        )
+                        vm.on_missed_block(proposer, prev)
+            if slot % p.SLOTS_PER_EPOCH == 0 and slot > 0:
+                epoch = slot // p.SLOTS_PER_EPOCH
+                vm.on_balances(self.chain.head_state.state, epoch - 1)
+                vm.on_epoch_summary(epoch - 1)
+        except Exception:
+            pass  # monitoring must never break the clock tick
 
     def _maybe_subscribe_altair_topics(self, epoch: int) -> None:
         """Sync-committee + LC update topics exist from altair
@@ -336,6 +382,7 @@ class BeaconNode:
             node._maybe_subscribe_altair_topics(
                 slot // preset().SLOTS_PER_EPOCH
             )
+            node._monitor_slot_tick(slot)
 
         node.clock.on_slot(_on_clock_slot)
         _on_clock_slot(node.clock.current_slot)

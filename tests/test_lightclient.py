@@ -269,3 +269,44 @@ class TestLightClientReqResp:
             assert int(upd.attested_header.beacon.slot) >= 0
 
         asyncio.run(go())
+
+
+class TestLightClientCli:
+    def test_cli_lightclient_against_live_api(self, types, lc_chain):
+        """`lodestar-tpu lightclient` bootstraps over a REAL REST
+        endpoint and applies a finality-update poll (round-4 CLI
+        breadth; reference: the standalone lightclient cmd)."""
+        from lodestar_tpu.api.impl import BeaconApiImpl
+        from lodestar_tpu.api.server import BeaconRestApiServer
+        from lodestar_tpu.cli import _run_lightclient
+
+        cfg, node, server = lc_chain
+
+        class Args:
+            poll_seconds = 0.01
+            max_polls = 1
+
+        async def go():
+            impl = BeaconApiImpl(cfg, types, node.chain)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            Args.beacon_api_url = f"http://127.0.0.1:{port}"
+            Args.checkpoint_root = (
+                "0x" + node.chain.finalized_checkpoint.root.hex()
+            )
+            rc = await _run_lightclient(Args)
+            assert rc == 0
+            srv.stop()
+
+        asyncio.run(go())
+
+    def test_cli_bootnode_smoke(self):
+        from lodestar_tpu.cli import _run_bootnode
+
+        class Args:
+            discovery_port = 0
+            max_seconds = 0.2
+
+        asyncio.run(_run_bootnode(Args))

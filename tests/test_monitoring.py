@@ -29,9 +29,9 @@ class TestValidatorMonitor:
             correct_target=True,
         )
         summary = vm.on_epoch_summary(5)
-        assert summary[3].attestation_seen
+        assert summary[3].attestation_included
         assert summary[3].attestation_inclusion_delay == 1
-        assert not summary[7].attestation_seen
+        assert not summary[7].attestation_included
         text = reg.expose()
         assert (
             "validator_monitor_prev_epoch_on_chain_attester_hit_total 1"

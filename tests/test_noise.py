@@ -170,3 +170,30 @@ class TestEncryptedHost:
             mitm_server.close()
 
         asyncio.run(go())
+
+    def test_peer_id_hijack_rejected(self):
+        """TOFU binding: a second host claiming an already-pinned
+        peer_id under a different Noise static key is dropped."""
+
+        async def go():
+            target = TcpHost("t", b"\x03" * 4)
+            honest = TcpHost("victim", b"\x03" * 4)
+            imposter = TcpHost("victim", b"\x03" * 4)  # same id, new key
+            await target.listen()
+            await honest.listen()
+            await imposter.listen()
+            await honest.dial("127.0.0.1", target.port)
+            await asyncio.sleep(0.1)
+            assert "victim" in target.conns
+            pinned = target.peer_statics["victim"]
+            with pytest.raises(TransportError):
+                await imposter.dial("127.0.0.1", target.port)
+            # pin unchanged; original connection intact
+            assert target.peer_statics["victim"] == pinned
+            await asyncio.sleep(0.1)
+            assert target.conns["victim"].remote_static == pinned
+            await target.close()
+            await honest.close()
+            await imposter.close()
+
+        asyncio.run(go())

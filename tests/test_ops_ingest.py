@@ -19,6 +19,10 @@ from lodestar_tpu.ops import curve as C
 from lodestar_tpu.ops import ingest, limbs as L, tower
 
 
+
+# kernel-emulation module: minutes on CPU (conftest slow gating)
+pytestmark = pytest.mark.slow
+
 class TestFq2SqrtFlagged:
     def test_squares_and_non_squares(self):
         cases = [

@@ -16,6 +16,12 @@ from lodestar_tpu.ops import curve as tc
 from lodestar_tpu.ops import pairing as tp
 from lodestar_tpu.ops import tower
 
+import pytest
+
+
+# kernel-emulation module: minutes on CPU (conftest slow gating)
+pytestmark = pytest.mark.slow
+
 random.seed(0xBEEF)
 
 

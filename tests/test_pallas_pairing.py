@@ -1,0 +1,107 @@
+"""Differential checks: Pallas pairing/product kernels vs the XLA scan
+oracles, in interpret mode on CPU. Minutes per kernel — slow-gated
+(LODESTAR_SLOW_TESTS=1); the TPU-side differential runs in
+tools/check_pallas_pairing.py and the bench's warmup correctness gate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def interp():
+    from jax.experimental import pallas as pl
+
+    orig = pl.pallas_call
+    pl.pallas_call = functools.partial(orig, interpret=True)
+    yield
+    pl.pallas_call = orig
+
+
+def _rand_fq(n, rng):
+    from lodestar_tpu.crypto.bls.fields import P
+    from lodestar_tpu.ops import limbs as L
+
+    return L.from_ints(
+        [int(rng.integers(0, 2**63)) ** 5 % P for _ in range(n)]
+    )
+
+
+def _ints(f):
+    from lodestar_tpu.ops import limbs as L
+
+    return [L.to_ints(lv) for c6 in f for c2 in c6 for lv in c2]
+
+
+class TestPallasPairingInterp:
+    def test_miller_matches_scan(self, interp):
+        from lodestar_tpu.ops import pairing, pallas_pairing
+
+        rng = np.random.default_rng(3)
+        n = 1
+        px, py = _rand_fq(n, rng), _rand_fq(n, rng)
+        qx = (_rand_fq(n, rng), _rand_fq(n, rng))
+        qy = (_rand_fq(n, rng), _rand_fq(n, rng))
+        a = _ints(pallas_pairing.miller_loop(px, py, qx, qy))
+        b = _ints(pairing.miller_loop(px, py, qx, qy))
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_pow_u_matches_scan(self, interp):
+        from lodestar_tpu.ops import pairing, pallas_pairing
+
+        rng = np.random.default_rng(4)
+        g = tuple(
+            tuple((_rand_fq(1, rng), _rand_fq(1, rng)) for _ in range(3))
+            for _ in range(2)
+        )
+        a = _ints(pallas_pairing.pow_u(g))
+        b = _ints(pairing._pow_u(g))
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_product_matches_scan(self, interp):
+        import jax.numpy as jnp
+
+        from lodestar_tpu.ops import pairing, pallas_pairing
+
+        rng = np.random.default_rng(5)
+        n = 300  # > 2*LANES so the kernel path runs; 3 blocks
+        f = tuple(
+            tuple((_rand_fq(n, rng), _rand_fq(n, rng)) for _ in range(3))
+            for _ in range(2)
+        )
+        mask = jnp.asarray(rng.random(n) > 0.2)
+        a = _ints(pallas_pairing.fq12_masked_product(f, mask))
+        b = _ints(pairing._fq12_masked_product(f, mask))
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_g2_sum_matches_scan(self, interp):
+        import jax.numpy as jnp
+
+        from lodestar_tpu.crypto.bls import curve as oc
+        from lodestar_tpu.ops import curve as C
+        from lodestar_tpu.ops import limbs as L
+        from lodestar_tpu.ops import pallas_pairing as PP
+
+        rng = np.random.default_rng(6)
+        n = 260  # > 2*LANES -> kernel path, 3 blocks with padding
+        pts = [
+            oc.g2_mul(oc.G2_GEN, int(rng.integers(2, 2**60)))
+            for _ in range(n)
+        ]
+        p = C.g2_batch_from_ints(pts)
+        inf = np.zeros(n, bool)
+        inf[3] = inf[200] = True
+        p = C.JacPoint(p.x, p.y, p.z, jnp.asarray(inf))
+        out = PP.g2_sum(p)
+        ref = C.jac_sum_scan(C.FQ2_OPS, p)
+        # compare as affine ints via cross-multiplied equality
+        from lodestar_tpu.ops import ingest
+
+        eq = ingest.jac_eq(out, ref)
+        assert bool(np.asarray(eq))
