@@ -318,6 +318,9 @@ class BeaconApiImpl:
             if parent_root
             else None
         )
+        if want_slot is None and want_parent is None:
+            # unfiltered: the head header only (reference behavior)
+            return [self.get_block_header("head")]
         out = []
         for node in proto.nodes:
             if node is None:
@@ -348,9 +351,6 @@ class BeaconApiImpl:
                     },
                 }
             )
-        if want_slot is None and want_parent is None:
-            # unfiltered: the head header only (reference behavior)
-            out = [self.get_block_header("head")]
         return out
 
     def get_deposit_snapshot(self) -> dict:
@@ -410,11 +410,11 @@ class BeaconApiImpl:
         if not field:
             raise ApiError(400, "field query parameter required")
         root = self._resolve_block_root(block_id)
-        signed = self.chain.get_block(root)
-        if signed is None:
+        got = self._block_with_fork_by_root(root)
+        if got is None:
             raise ApiError(404, f"block {block_id} not found")
-        view = self.chain.get_state(root) or self.chain.head_state
-        block_t = self.types.by_fork[view.fork].BeaconBlock
+        fork, signed = got
+        block_t = self.types.by_fork[fork].BeaconBlock
         if field not in block_t.field_names:
             raise ApiError(400, f"unknown block field {field!r}")
         leaf, branch, idx = container_field_branch(
@@ -750,6 +750,365 @@ class BeaconApiImpl:
                 }
             )
         return out
+
+    def get_state_validator(self, state_id: str, validator_id: str) -> dict:
+        """routes/beacon/state.ts getStateValidator: one validator by
+        index or 0x-pubkey."""
+        st = self._resolve_state(state_id).state
+        vid = str(validator_id)
+        if vid.startswith("0x"):
+            pk = bytes.fromhex(vid[2:])
+            idx = util.PubkeyIndexView(st).get(pk)
+            if idx is None:
+                raise ApiError(404, f"validator {vid} not found")
+        else:
+            try:
+                idx = int(vid)
+            except ValueError:
+                raise ApiError(400, f"bad validator id {vid}") from None
+            if idx < 0:
+                raise ApiError(400, f"bad validator id {vid}")
+            if idx >= len(st.validators):
+                raise ApiError(404, f"validator {idx} not found")
+        v = st.validators[idx]
+        epoch = util.get_current_epoch(st)
+        return {
+            "index": str(idx),
+            "balance": str(int(st.balances[idx])),
+            "status": _validator_status(v, epoch),
+            "validator": {
+                "pubkey": _hex(bytes(v.pubkey)),
+                "effective_balance": str(int(v.effective_balance)),
+                "slashed": bool(v.slashed),
+                "activation_epoch": str(int(v.activation_epoch)),
+                "exit_epoch": str(int(v.exit_epoch)),
+            },
+        }
+
+    def get_state_randao(self, state_id: str, epoch: str = "") -> dict:
+        """routes/beacon/state.ts getStateRandao."""
+        st = self._resolve_state(state_id).state
+        ep = int(epoch) if epoch else util.get_current_epoch(st)
+        cur = util.get_current_epoch(st)
+        p = preset()
+        if not (
+            cur - p.EPOCHS_PER_HISTORICAL_VECTOR + 1 <= ep <= cur
+        ):
+            raise ApiError(400, f"epoch {ep} outside randao window")
+        return {"randao": _hex(bytes(util.get_randao_mix(st, ep)))}
+
+    def get_block_attestations(self, block_id: str) -> list:
+        """routes/beacon/block.ts getBlockAttestations."""
+        from .json_codec import to_json
+
+        root = self._resolve_block_root(block_id)
+        got = self._block_with_fork_by_root(root)
+        if got is None:
+            raise ApiError(404, f"block {block_id} not found")
+        _fork, signed = got
+        return [
+            to_json(self.types.Attestation, att)
+            for att in signed.message.body.attestations
+        ]
+
+    def _op_pool_list(self, attr: str, type_name: str) -> list:
+        from .json_codec import to_json
+
+        pool = getattr(self.node, "op_pool", None) if self.node else None
+        if pool is None:
+            return []
+        t = getattr(self.types, type_name)
+        ops = getattr(pool, attr, [])
+        if isinstance(ops, dict):  # index-keyed pools store op values
+            ops = ops.values()
+        return [to_json(t, v) for v in ops]
+
+    def get_pool_attester_slashings(self) -> list:
+        return self._op_pool_list(
+            "attester_slashings", "AttesterSlashing"
+        )
+
+    def get_pool_proposer_slashings(self) -> list:
+        return self._op_pool_list(
+            "proposer_slashings", "ProposerSlashing"
+        )
+
+    def get_pool_voluntary_exits(self) -> list:
+        return self._op_pool_list(
+            "voluntary_exits", "SignedVoluntaryExit"
+        )
+
+    def get_pool_bls_changes(self) -> list:
+        return self._op_pool_list(
+            "bls_changes", "SignedBLSToExecutionChange"
+        )
+
+    def get_peer_count(self) -> dict:
+        net = getattr(self.node, "network", None) if self.node else None
+        conns = net.host.conns.values() if net else ()
+        inbound = sum(1 for c in conns if not c.outbound)
+        outbound = sum(1 for c in conns if c.outbound)
+        return {
+            "disconnected": "0",
+            "connecting": "0",
+            "connected": str(inbound + outbound),
+            "disconnecting": "0",
+        }
+
+    def get_attestations_rewards(self, epoch: int, body=None) -> dict:
+        """routes/beacon/rewards.ts getAttestationsRewards: per-flag
+        attestation reward components for `epoch`'s PREVIOUS-epoch
+        participation, computed from a state in epoch+1 with the same
+        vectorized math the epoch transition uses (altair+ only)."""
+        import numpy as np
+
+        from ..statetransition.epoch import (
+            EpochTransitionCache,
+            _participation_arrays,
+            _unslashed_participating,
+        )
+        from ..params import (
+            PARTICIPATION_FLAG_WEIGHTS,
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_SOURCE_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+            WEIGHT_DENOMINATOR,
+        )
+
+        epoch = int(epoch)
+        view = None
+        for root, v in self.chain._states.items():
+            if util.get_current_epoch(v.state) == epoch + 1:
+                view = v
+                break
+        if view is None:
+            # the head state works when it sits in epoch+1
+            head = self.chain.head_state
+            if util.get_current_epoch(head.state) == epoch + 1:
+                view = head
+        if view is None:
+            raise ApiError(
+                404,
+                f"no cached state in epoch {epoch + 1} to derive "
+                f"epoch-{epoch} attestation rewards from",
+            )
+        if view.fork_seq < ForkSeq.altair:
+            raise ApiError(400, "attestation rewards require altair")
+        st = view.state
+        cache = EpochTransitionCache(self.cfg, st, view.fork_seq)
+        p = preset()
+        eb = cache.reg.effective_balance
+        increments = eb // p.EFFECTIVE_BALANCE_INCREMENT
+        base_reward_per_increment = (
+            p.EFFECTIVE_BALANCE_INCREMENT
+            * p.BASE_REWARD_FACTOR
+            // util.integer_squareroot(cache.total_active_balance)
+        )
+        base_reward = increments * base_reward_per_increment
+        active_increments = (
+            cache.total_active_balance // p.EFFECTIVE_BALANCE_INCREMENT
+        )
+        prev_part, _ = _participation_arrays(st)
+        n = cache.n
+        el = cache.eligible
+        comp = {}
+        names = {
+            TIMELY_SOURCE_FLAG_INDEX: "source",
+            TIMELY_TARGET_FLAG_INDEX: "target",
+            TIMELY_HEAD_FLAG_INDEX: "head",
+        }
+        for flag_index, weight in enumerate(
+            PARTICIPATION_FLAG_WEIGHTS
+        ):
+            mask = _unslashed_participating(
+                cache, prev_part, flag_index
+            )
+            participating_increments = int(increments[mask].sum())
+            vals = np.zeros(n, np.int64)
+            if not cache.is_in_inactivity_leak:
+                reward = (
+                    base_reward
+                    * weight
+                    * participating_increments
+                    // (active_increments * WEIGHT_DENOMINATOR)
+                )
+                vals = np.where(el & mask, reward, 0)
+            if flag_index != TIMELY_HEAD_FLAG_INDEX:
+                vals = vals - np.where(
+                    el & ~mask,
+                    base_reward * weight // WEIGHT_DENOMINATOR,
+                    0,
+                )
+            comp[names[flag_index]] = vals
+        want = None
+        if body:
+            want = {int(x) for x in body}
+        total = []
+        for i in range(n):
+            if not el[i]:
+                continue
+            if want is not None and i not in want:
+                continue
+            total.append(
+                {
+                    "validator_index": str(i),
+                    "head": str(int(comp["head"][i])),
+                    "target": str(int(comp["target"][i])),
+                    "source": str(int(comp["source"][i])),
+                    "inclusion_delay": "0",
+                    "inactivity": "0",
+                }
+            )
+        return {"ideal_rewards": [], "total_rewards": total}
+
+    def get_sync_committee_rewards(self, block_id: str, body=None) -> dict:
+        """routes/beacon/rewards.ts getSyncCommitteeRewards: per-
+        participant reward for a block's SyncAggregate."""
+        root = self._resolve_block_root(block_id)
+        got = self._block_with_fork_by_root(root)
+        if got is None:
+            raise ApiError(404, f"block {block_id} not found")
+        _fork, signed = got
+        block = signed.message
+        view = self.chain.get_state(bytes(block.parent_root))
+        if view is None:
+            raise ApiError(503, "parent state not cached")
+        if view.fork_seq < ForkSeq.altair:
+            raise ApiError(400, "sync rewards require altair")
+        st = view.state
+        p = preset()
+        total_active = sum(
+            v.effective_balance
+            for v in st.validators
+            if util.is_active_validator(
+                v, util.get_current_epoch(st)
+            )
+        )
+        total_base = (
+            p.EFFECTIVE_BALANCE_INCREMENT
+            * p.BASE_REWARD_FACTOR
+            * (total_active // p.EFFECTIVE_BALANCE_INCREMENT)
+            // util.integer_squareroot(total_active)
+        )
+        from ..params import SYNC_REWARD_WEIGHT, WEIGHT_DENOMINATOR
+
+        max_reward = (
+            total_base
+            * SYNC_REWARD_WEIGHT
+            // WEIGHT_DENOMINATOR
+            // p.SLOTS_PER_EPOCH
+        )
+        participant_reward = max_reward // p.SYNC_COMMITTEE_SIZE
+        pk2i = util.PubkeyIndexView(st)
+        want = {int(x) for x in body} if body else None
+        out = []
+        agg = block.body.sync_aggregate
+        for pk, bit in zip(
+            st.current_sync_committee.pubkeys,
+            agg.sync_committee_bits,
+        ):
+            idx = pk2i.get(bytes(pk))
+            if idx is None or (want is not None and idx not in want):
+                continue
+            out.append(
+                {
+                    "validator_index": str(idx),
+                    "reward": str(
+                        participant_reward if bit else -participant_reward
+                    ),
+                }
+            )
+        return out
+
+    # -- lodestar admin namespace (routes/lodestar.ts) -------------------
+
+    async def write_profile(self, duration: str = "1") -> dict:
+        """Admin-triggered CPU profile of the chain's event loop
+        (lodestar.ts writeProfile): cProfile enabled ON the loop
+        thread for `duration` seconds; returns the top entries."""
+        import asyncio
+        import cProfile
+        import io
+        import pstats
+
+        secs = min(30.0, max(0.1, float(duration)))
+        pr = cProfile.Profile()
+        pr.enable()
+        await asyncio.sleep(secs)
+        pr.disable()
+        buf = io.StringIO()
+        pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(
+            40
+        )
+        return {"duration": secs, "profile": buf.getvalue()}
+
+    def write_heapdump(self) -> dict:
+        """Heap snapshot via tracemalloc (lodestar.ts writeHeapdump
+        analog). First call starts tracing and returns a baseline;
+        later calls return the current top allocations."""
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return {"status": "tracing started; call again for a snapshot"}
+        snap = tracemalloc.take_snapshot()
+        top = snap.statistics("lineno")[:40]
+        return {
+            "total_kib": sum(s.size for s in top) // 1024,
+            "top": [str(s) for s in top],
+        }
+
+    def get_gossip_queue_items(self) -> list:
+        proc = getattr(self.node, "processor", None) if self.node else None
+        if proc is None:
+            return []
+        q = proc.att_queue
+        return [
+            {
+                "topic": "beacon_attestation",
+                "length": len(q),
+                "key_count": q.key_count,
+                "dropped_total": q.dropped_total,
+                "in_flight": proc._in_flight,
+            }
+        ]
+
+    def get_state_cache_items(self) -> list:
+        return [
+            {
+                "root": _hex(root),
+                "slot": str(int(view.state.slot)),
+                "fork": view.fork,
+            }
+            for root, view in self.chain._states.items()
+        ]
+
+    def get_gossip_peer_score_stats(self) -> list:
+        net = getattr(self.node, "network", None) if self.node else None
+        if net is None:
+            return []
+        return [
+            {
+                "peer_id": pid,
+                "score": sc.value,
+                "first_deliveries": sc.first_deliveries,
+                "invalid": sc.invalid,
+                "behaviour": sc.behaviour,
+            }
+            for pid, sc in net.gossip.scores.items()
+        ]
+
+    def get_sync_chains_debug_state(self) -> list:
+        rs = getattr(self.node, "range_sync", None) if self.node else None
+        if rs is None:
+            return []
+        return [
+            {
+                "status": str(getattr(rs, "state", "")),
+                "peers": len(getattr(rs, "peers", ())),
+                "batches": len(getattr(rs, "_batches", ())),
+            }
+        ]
 
     def get_peer(self, peer_id: str) -> dict:
         """routes/node.ts getPeer: one peer's detail."""

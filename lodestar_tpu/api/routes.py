@@ -330,6 +330,107 @@ ROUTES: list[Route] = [
         "/eth/v1/beacon/deposit_snapshot",
         "get_deposit_snapshot",
     ),
+    Route(
+        "getStateValidator",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+        "get_state_validator",
+    ),
+    Route(
+        "getStateRandao",
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/randao",
+        "get_state_randao",
+        query_params=("epoch",),
+    ),
+    Route(
+        "getBlockAttestations",
+        "GET",
+        "/eth/v1/beacon/blocks/{block_id}/attestations",
+        "get_block_attestations",
+    ),
+    Route(
+        "getPoolAttesterSlashings",
+        "GET",
+        "/eth/v1/beacon/pool/attester_slashings",
+        "get_pool_attester_slashings",
+    ),
+    Route(
+        "getPoolProposerSlashings",
+        "GET",
+        "/eth/v1/beacon/pool/proposer_slashings",
+        "get_pool_proposer_slashings",
+    ),
+    Route(
+        "getPoolVoluntaryExits",
+        "GET",
+        "/eth/v1/beacon/pool/voluntary_exits",
+        "get_pool_voluntary_exits",
+    ),
+    Route(
+        "getPoolBLSToExecutionChanges",
+        "GET",
+        "/eth/v1/beacon/pool/bls_to_execution_changes",
+        "get_pool_bls_changes",
+    ),
+    Route(
+        "getPeerCount",
+        "GET",
+        "/eth/v1/node/peer_count",
+        "get_peer_count",
+    ),
+    Route(
+        "getAttestationsRewards",
+        "POST",
+        "/eth/v1/beacon/rewards/attestations/{epoch}",
+        "get_attestations_rewards",
+        raw_body=True,
+    ),
+    Route(
+        "getSyncCommitteeRewards",
+        "POST",
+        "/eth/v1/beacon/rewards/sync_committee/{block_id}",
+        "get_sync_committee_rewards",
+        raw_body=True,
+    ),
+    # lodestar admin namespace (routes/lodestar.ts)
+    Route(
+        "writeProfile",
+        "POST",
+        "/eth/v1/lodestar/write_profile",
+        "write_profile",
+        query_params=("duration",),
+    ),
+    Route(
+        "writeHeapdump",
+        "POST",
+        "/eth/v1/lodestar/write_heapdump",
+        "write_heapdump",
+    ),
+    Route(
+        "getGossipQueueItems",
+        "GET",
+        "/eth/v1/lodestar/gossip_queue_items",
+        "get_gossip_queue_items",
+    ),
+    Route(
+        "getStateCacheItems",
+        "GET",
+        "/eth/v1/lodestar/state_cache_items",
+        "get_state_cache_items",
+    ),
+    Route(
+        "getGossipPeerScoreStats",
+        "GET",
+        "/eth/v1/lodestar/gossip_peer_score_stats",
+        "get_gossip_peer_score_stats",
+    ),
+    Route(
+        "getSyncChainsDebugState",
+        "GET",
+        "/eth/v1/lodestar/sync_chains_debug_state",
+        "get_sync_chains_debug_state",
+    ),
     # proof namespace (routes/proof.ts)
     Route(
         "getStateProof",

@@ -17,6 +17,11 @@ class PrepareNextSlotScheduler:
         self.chain = chain
         self.prepared: dict[bytes, object] = {}
         self.prepares = 0
+        # slot -> expected proposer index, recorded at prepare time
+        # (the advanced state is the only one that answers the
+        # slot-seeded proposer exactly); consumed by the validator
+        # monitor's missed-proposal detection
+        self.expected_proposers: dict[int, int] = {}
 
     async def prepare(self, next_slot: int):
         """Advance a head-state clone to `next_slot` and cache it keyed
@@ -37,11 +42,20 @@ class PrepareNextSlotScheduler:
         # epoch boundary the first import would otherwise pay the full
         # registry shuffle inline
         try:
+            from ..params import ForkSeq
             from ..statetransition import util as _util
 
             _util.get_shuffling(
                 work.state, _util.get_current_epoch(work.state)
             )
+            self.expected_proposers[int(next_slot)] = (
+                _util.get_beacon_proposer_index(
+                    work.state,
+                    electra=work.fork_seq >= ForkSeq.electra,
+                )
+            )
+            for old in sorted(self.expected_proposers)[:-4]:
+                del self.expected_proposers[old]
         except Exception:
             pass
         if self.chain.execution_engine is not None:

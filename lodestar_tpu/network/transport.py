@@ -160,12 +160,17 @@ class TcpHost:
         self.host = host
         # transport identity: Noise XX static key (libp2p-noise analog)
         self.static_key = X25519PrivateKey.generate()
-        # peer_id -> Noise static pub, trust-on-first-use: a later
-        # connection claiming a known peer_id under a DIFFERENT static
-        # key is dropped (a banned/competing peer cannot hijack a
-        # well-scored identity; libp2p derives ids from keys — here
-        # ids are operator-chosen, so the binding is pinned instead)
+        # peer_id -> Noise static pub, trust-on-first-use for the
+        # LIFETIME OF THE CONNECTION: while a peer_id is connected, a
+        # second connection claiming it under a different static key is
+        # dropped (no live-session hijack; libp2p derives ids from
+        # keys — here ids are operator-chosen, so the binding is
+        # pinned instead). The pin is evicted on disconnect: static
+        # keys are per-process, so a restarted peer legitimately
+        # returns with a new key. Bounded (inbound ids are
+        # attacker-chosen).
         self.peer_statics: dict[str, bytes] = {}
+        self._peer_statics_max = 4096
         self.port: int | None = None
         self.conns: dict[str, PeerConnection] = {}
         self._server = None
@@ -314,6 +319,8 @@ class TcpHost:
         pinned = self.peer_statics.get(pid)
         if pinned is not None and pinned != rs:
             return False
+        if len(self.peer_statics) >= self._peer_statics_max:
+            self.peer_statics.pop(next(iter(self.peer_statics)))
         self.peer_statics[pid] = rs
         return True
 
@@ -427,5 +434,8 @@ class TcpHost:
             await conn.close()
             if self.conns.get(conn.peer_id) is conn:
                 del self.conns[conn.peer_id]
+                # release the TOFU pin: static keys are per-process, so
+                # a restarted peer legitimately returns with a new key
+                self.peer_statics.pop(conn.peer_id, None)
                 for hook in self.peer_lost_hooks:
                     hook(conn.peer_id)

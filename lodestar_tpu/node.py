@@ -27,10 +27,6 @@ from .metrics import (
 from .network.processor import NetworkProcessor
 from .network.reqresp import InProcessTransport, ReqResp
 from .params import ForkSeq, preset
-
-
-def util_compute_epoch(slot: int) -> int:
-    return slot // preset().SLOTS_PER_EPOCH
 from .sync import RangeSync, SyncServer
 
 
@@ -109,6 +105,7 @@ class BeaconNode:
         self.checkpoint_states = None
         self.clock = None
         self._altair_topics_on = False
+        self._prepare_tasks: set = set()
 
     def _monitor_slot_tick(self, slot: int) -> None:
         """Validator-monitor wall-clock duties: missed-proposal
@@ -127,23 +124,16 @@ class BeaconNode:
                 )
                 if head is not None and head.slot < prev:
                     # no canonical block at prev: was one of ours due?
-                    # The proposer is slot-seeded, so only a state
-                    # ADVANCED to prev answers exactly — the next-slot
-                    # scheduler usually has one cached; skip otherwise
-                    view = None
+                    # The proposer was recorded when the next-slot
+                    # scheduler prepared prev's state (slot-seeded, so
+                    # only the advanced state answers exactly)
                     pns = self.prepare_next_slot
-                    if pns is not None:
-                        for w in pns.prepared.values():
-                            if int(w.state.slot) == prev:
-                                view = w
-                                break
-                    if view is not None:
-                        from .statetransition import util as _u
-
-                        proposer = _u.get_beacon_proposer_index(
-                            view.state,
-                            electra=view.fork_seq >= ForkSeq.electra,
-                        )
+                    proposer = (
+                        pns.expected_proposers.get(prev)
+                        if pns is not None
+                        else None
+                    )
+                    if proposer is not None:
                         vm.on_missed_block(proposer, prev)
             if slot % p.SLOTS_PER_EPOCH == 0 and slot > 0:
                 epoch = slot // p.SLOTS_PER_EPOCH
@@ -383,6 +373,25 @@ class BeaconNode:
                 slot // preset().SLOTS_PER_EPOCH
             )
             node._monitor_slot_tick(slot)
+            # precompute next slot's state + payload attributes + epoch
+            # shuffling off the critical path (prepareNextSlot.ts).
+            # Only when the wall clock tracks the head: a node behind
+            # (syncing, or a dev chain whose genesis_time is synthetic)
+            # must not advance a clone across thousands of empty slots
+            if node.prepare_next_slot is not None:
+                head = node.chain.fork_choice.proto.get_node(
+                    node.chain.head_root
+                )
+                if (
+                    head is not None
+                    and 0 <= slot + 1 - head.slot
+                    <= preset().SLOTS_PER_EPOCH
+                ):
+                    task = asyncio.ensure_future(
+                        node.prepare_next_slot.prepare(slot + 1)
+                    )
+                    node._prepare_tasks.add(task)
+                    task.add_done_callback(node._prepare_tasks.discard)
 
         node.clock.on_slot(_on_clock_slot)
         _on_clock_slot(node.clock.current_slot)

@@ -251,3 +251,96 @@ class TestProofAndBreadthRoutes:
             await node.close()
 
         asyncio.run(go())
+
+
+class TestRewardsAndBreadthRoutes:
+    def test_rewards_pools_randao_validator_peercount(self, types):
+        cfg = _cfg(ALTAIR_FORK_EPOCH=0)
+        p = preset()
+
+        async def go():
+            node = DevNode(cfg, types, N, verify_attestations=False)
+            await node.run_until(p.SLOTS_PER_EPOCH * 2 + 1)
+            impl = BeaconApiImpl(cfg, types, node.chain)
+
+            # single validator by index and by pubkey
+            v0 = impl.get_state_validator("head", "0")
+            assert v0["index"] == "0"
+            by_pk = impl.get_state_validator(
+                "head", v0["validator"]["pubkey"]
+            )
+            assert by_pk["index"] == "0"
+            # randao
+            r = impl.get_state_randao("head")
+            assert r["randao"].startswith("0x")
+            # block attestations come back as JSON attestations
+            atts = impl.get_block_attestations("head")
+            assert isinstance(atts, list)
+            # attestation rewards for the previous epoch (head sits in
+            # epoch 2 -> rewards for epoch 1)
+            rw = impl.get_attestations_rewards(1)
+            assert rw["total_rewards"], "no attestation rewards computed"
+            row = rw["total_rewards"][0]
+            assert int(row["target"]) != 0 or int(row["source"]) != 0
+            # sync committee rewards for the head block
+            sync = impl.get_sync_committee_rewards("head")
+            assert sync and all(
+                int(x["reward"]) != 0 for x in sync
+            )
+            # pool GETs are empty lists without a node
+            assert impl.get_pool_attester_slashings() == []
+            # peer count shape
+            pc = impl.get_peer_count()
+            assert pc["connected"] == "0"
+            await node.close()
+
+        asyncio.run(go())
+
+
+class TestLodestarAdminNamespace:
+    def test_profile_heap_and_debug_views(self, types):
+        import urllib.request as rq
+
+        cfg = _cfg()
+
+        async def go():
+            node = DevNode(cfg, types, N, verify_attestations=False)
+            await node.advance_slot()
+            impl = BeaconApiImpl(cfg, types, node.chain)
+            srv = BeaconRestApiServer(
+                impl, port=0, loop=asyncio.get_event_loop()
+            )
+            port = srv.start()
+            base = f"http://127.0.0.1:{port}"
+
+            def post(path):
+                req = rq.Request(base + path, method="POST", data=b"")
+                with rq.urlopen(req, timeout=20) as r:
+                    return json.loads(r.read())
+
+            def get(path):
+                with rq.urlopen(base + path, timeout=5) as r:
+                    return json.loads(r.read())
+
+            loop = asyncio.get_event_loop()
+            prof = await loop.run_in_executor(
+                None,
+                post,
+                "/eth/v1/lodestar/write_profile?duration=0.2",
+            )
+            assert "profile" in prof["data"]
+            heap1 = await loop.run_in_executor(
+                None, post, "/eth/v1/lodestar/write_heapdump"
+            )
+            heap2 = await loop.run_in_executor(
+                None, post, "/eth/v1/lodestar/write_heapdump"
+            )
+            assert "top" in heap2["data"]
+            caches = await loop.run_in_executor(
+                None, get, "/eth/v1/lodestar/state_cache_items"
+            )
+            assert caches["data"], "state cache listing empty"
+            await node.close()
+            srv.stop()
+
+        asyncio.run(go())
