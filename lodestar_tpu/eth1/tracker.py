@@ -178,6 +178,7 @@ class Eth1DepositDataTracker:
         self.types = types
         self.provider = provider
         self.tree = DepositTree()
+        self.metrics = None  # lodestar_eth1_* family (node wiring)
         self.deposits: list[DepositLog] = []
         self.blocks: dict[int, Eth1Block] = {}  # followed eth1 blocks
         # Log-follow starts at the deposit contract's deployment block —
@@ -195,8 +196,15 @@ class Eth1DepositDataTracker:
         (providers reject unbounded ranges) and headers are fetched only
         inside the eth1-vote candidate window, not for every followed
         block."""
-        head = await self.provider.get_block_number()
+        try:
+            head = await self.provider.get_block_number()
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.update_errors_total.inc()
+            raise
         followed = max(0, head - self.cfg.ETH1_FOLLOW_DISTANCE)
+        if self.metrics is not None:
+            self.metrics.followed_block_number.set(followed)
         if followed <= self._synced_to:
             return
         # Logs first, headers after each chunk's logs: _synced_to

@@ -78,6 +78,7 @@ class ForkChoice:
         self.cfg = cfg
         self.proto: ProtoArray = proto_array
         self.finalized_checkpoint = finalized_checkpoint
+        self.metrics = None  # lodestar_forkchoice_* family (node wiring)
         self.justified_checkpoint = justified_checkpoint
         self.unrealized_justified = justified_checkpoint
         self.unrealized_finalized = finalized_checkpoint
@@ -235,9 +236,29 @@ class ForkChoice:
             finalized_root=self.finalized_checkpoint.root,
             current_slot=self.current_slot,
         )
+        old_head = self.head
         self.head = self.proto.find_head(
             self.justified_checkpoint.root, current_slot=self.current_slot
         )
+        if self.metrics is not None:
+            self.metrics.find_head_total.inc()
+            if (
+                old_head is not None
+                and self.head != old_head
+                and not self.proto.is_descendant(old_head, self.head)
+            ):
+                # common ancestor depth for the reorg label
+                depth = 0
+                anc = old_head
+                while anc is not None and not self.proto.is_descendant(
+                    anc, self.head
+                ):
+                    n = self.proto.get_node(anc)
+                    if n is None or n.parent_root is None:
+                        break
+                    anc = n.parent_root
+                    depth += 1
+                self.metrics.reorg_total.inc(depth=str(depth))
         return self.head
 
     # -- queries ---------------------------------------------------------

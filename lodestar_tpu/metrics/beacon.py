@@ -384,6 +384,86 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "Eth1 headers in the vote-candidate window",
     )
 
+    # -- fork choice ----------------------------------------------------
+    fc = SimpleNamespace()
+    m.forkchoice = fc
+    fc.nodes = reg.gauge(
+        "lodestar_forkchoice_nodes_count",
+        "Proto-array node count",
+    )
+    fc.indices = reg.gauge(
+        "lodestar_forkchoice_indices_count",
+        "Proto-array index map size",
+    )
+    fc.find_head_total = reg.counter(
+        "lodestar_forkchoice_find_head_total",
+        "Times find-head recomputed the best descendant",
+    )
+    fc.reorg_total = reg.counter(
+        "lodestar_forkchoice_reorg_total",
+        "Head changes to a non-descendant of the previous head",
+        label_names=("depth",),
+    )
+    fc.votes = reg.gauge(
+        "lodestar_forkchoice_validated_attestation_datas",
+        "Tracked vote records",
+    )
+
+    # -- eth1 / deposits ------------------------------------------------
+    e1 = SimpleNamespace()
+    m.eth1 = e1
+    e1.deposit_tree_size = reg.gauge(
+        "lodestar_eth1_deposit_tree_size",
+        "Leaves in the deposit tree",
+    )
+    e1.followed_block_number = reg.gauge(
+        "lodestar_eth1_latest_followed_block_number",
+        "Latest eth1 block the tracker has processed logs through",
+    )
+    e1.update_errors_total = reg.counter(
+        "lodestar_eth1_update_errors_total",
+        "Failed eth1 follow iterations",
+    )
+
+    # -- light-client server --------------------------------------------
+    lcs = SimpleNamespace()
+    m.lightclient_server = lcs
+    lcs.best_updates = reg.gauge(
+        "lodestar_lightclient_server_best_updates_count",
+        "Sync-committee periods with a best LightClientUpdate",
+    )
+    lcs.latest_finality_slot = reg.gauge(
+        "lodestar_lightclient_server_finality_update_slot",
+        "Attested slot of the latest finality update",
+    )
+    lcs.latest_optimistic_slot = reg.gauge(
+        "lodestar_lightclient_server_optimistic_update_slot",
+        "Attested slot of the latest optimistic update",
+    )
+
+    # -- reqresp --------------------------------------------------------
+    rr = SimpleNamespace()
+    m.reqresp = rr
+    rr.outgoing_requests_total = reg.counter(
+        "lodestar_reqresp_outgoing_requests_total",
+        "Outgoing reqresp requests",
+        label_names=("protocol",),
+    )
+    rr.incoming_requests_total = reg.counter(
+        "lodestar_reqresp_incoming_requests_total",
+        "Incoming reqresp requests served",
+        label_names=("protocol",),
+    )
+    rr.request_errors_total = reg.counter(
+        "lodestar_reqresp_outgoing_errors_total",
+        "Outgoing requests that errored",
+        label_names=("protocol",),
+    )
+    rr.rate_limited_total = reg.counter(
+        "lodestar_reqresp_rate_limited_total",
+        "Inbound requests dropped by the GRCA rate limiter",
+    )
+
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
     k = SimpleNamespace()
     m.clock = k

@@ -123,6 +123,7 @@ class ReqResp:
         self.transport = transport
         self._handlers: dict[str, object] = {}
         self._limiter = GRCARateLimiter(*rate_limit_quota)
+        self.metrics = None  # lodestar_reqresp_* family (node wiring)
         transport.register(peer_id, self)
 
     def register_handler(self, protocol: str, handler) -> None:
@@ -140,11 +141,27 @@ class ReqResp:
         timeout: float = DEFAULT_TIMEOUT,
     ) -> list[ResponseChunk]:
         data = snappy.frame_compress(payload)
-        raw = await asyncio.wait_for(
-            self.transport.request_raw(self.peer_id, peer, protocol, data),
-            timeout=timeout,
-        )
-        return _decode_response(raw, _context_len(protocol))
+        if self.metrics is not None:
+            self.metrics.outgoing_requests_total.inc(
+                protocol=_short_proto(protocol)
+            )
+        try:
+            raw = await asyncio.wait_for(
+                self.transport.request_raw(
+                    self.peer_id, peer, protocol, data
+                ),
+                timeout=timeout,
+            )
+            # decode INSIDE the instrumented block: server-returned
+            # error chunks (rate limited, invalid request) raise here
+            # and are the most common outgoing-error class
+            return _decode_response(raw, _context_len(protocol))
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.request_errors_total.inc(
+                    protocol=_short_proto(protocol)
+                )
+            raise
 
     # -- server side ----------------------------------------------------
 
@@ -153,7 +170,13 @@ class ReqResp:
     ) -> bytes:
         loop = asyncio.get_event_loop()
         if not self._limiter.allows(from_peer, 1, loop.time()):
+            if self.metrics is not None:
+                self.metrics.rate_limited_total.inc()
             return _error_chunk(RESP_RESOURCE_UNAVAILABLE, "rate limited")
+        if self.metrics is not None:
+            self.metrics.incoming_requests_total.inc(
+                protocol=_short_proto(protocol)
+            )
         handler = self._handlers.get(protocol)
         if handler is None:
             return _error_chunk(
@@ -275,6 +298,12 @@ def _block_uncompressed_len(body: bytes) -> int:
             return v
         shift += 7
     raise ReqRespError(RESP_INVALID_REQUEST, "bad block preamble")
+
+
+def _short_proto(protocol: str) -> str:
+    """/eth2/beacon_chain/req/<name>/<v>/ssz_snappy -> name."""
+    parts = [p for p in protocol.split("/") if p]
+    return parts[3] if len(parts) > 3 else protocol
 
 
 def _decode_response(raw: bytes, ctx_len: int) -> list[ResponseChunk]:

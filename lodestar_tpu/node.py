@@ -554,6 +554,47 @@ class BeaconNode:
             tv.batch_retries_total.add_collect(
                 lambda g: g.set(vm.batch_retries)
             )
+        # fork choice / eth1 / light-client server sampled gauges
+        mm.forkchoice.nodes.add_collect(
+            lambda g: g.set(len(node.chain.fork_choice.proto.nodes))
+        )
+        mm.forkchoice.indices.add_collect(
+            lambda g: g.set(len(node.chain.fork_choice.proto.indices))
+        )
+        mm.forkchoice.votes.add_collect(
+            lambda g: g.set(len(node.chain.fork_choice.votes))
+        )
+        node.chain.fork_choice.metrics = mm.forkchoice
+        if getattr(node.chain, "eth1", None) is not None:
+            node.chain.eth1.metrics = mm.eth1
+            mm.eth1.deposit_tree_size.add_collect(
+                lambda g: g.set(len(node.chain.eth1.tree))
+            )
+        lcs = node.chain.light_client_server
+        if lcs is not None:
+            mm.lightclient_server.best_updates.add_collect(
+                lambda g: g.set(len(lcs.best_update_by_period))
+            )
+            mm.lightclient_server.latest_finality_slot.add_collect(
+                lambda g: g.set(
+                    int(
+                        lcs.latest_finality_update.attested_header.beacon.slot
+                    )
+                    if lcs.latest_finality_update is not None
+                    else 0
+                )
+            )
+            mm.lightclient_server.latest_optimistic_slot.add_collect(
+                lambda g: g.set(
+                    int(
+                        lcs.latest_optimistic_update.attested_header.beacon.slot
+                    )
+                    if lcs.latest_optimistic_update is not None
+                    else 0
+                )
+            )
+        if node.reqresp is not None:
+            node.reqresp.metrics = mm.reqresp
         mm.clock.slot.add_collect(_wall_slot)
         mm.clock.epoch.add_collect(
             lambda g: g.set(

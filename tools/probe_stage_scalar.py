@@ -1,0 +1,90 @@
+"""True per-stage device cost at bucket 2048: each probe jits the
+stage + a scalar reduction, so one call = dispatch + device + ONE
+readback (~100 ms baseline, printed first)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lodestar_tpu.bls import api as bls_api  # noqa: E402
+from lodestar_tpu.bls import kernels  # noqa: E402
+from lodestar_tpu.bls.verifier import _rand_scalars  # noqa: E402
+from lodestar_tpu.crypto.bls import curve as oc  # noqa: E402
+from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2  # noqa: E402
+from lodestar_tpu.ops import curve as C  # noqa: E402
+from lodestar_tpu.ops import limbs as L  # noqa: E402
+from lodestar_tpu.params import BLS_DST_SIG  # noqa: E402
+from lodestar_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable()
+N = 2048
+KEYS = 256
+
+
+def _scalarize(out):
+    acc = jnp.int32(0)
+    for leaf in jax.tree.leaves(out):
+        acc = acc + jnp.sum(leaf.astype(jnp.int32) if leaf.dtype == jnp.bool_ else leaf, dtype=jnp.int32)
+    return acc
+
+
+def t(label, fn, *args, reps=3):
+    wrapped = jax.jit(lambda *a: _scalarize(fn(*a)))
+    np.asarray(jax.device_get(wrapped(*args)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(jax.device_get(wrapped(*args)))
+    print(f"{label}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms", flush=True)
+
+
+def main():
+    print(f"platform={jax.default_backend()} N={N}", flush=True)
+    pks, sig_x0, sig_x1, sig_sign, u0l, u1l = [], [], [], [], [], []
+    for i in range(N):
+        sk = 10_000 + (i % KEYS)
+        msg = i.to_bytes(32, "little")
+        h = hash_to_g2(msg, BLS_DST_SIG)
+        pks.append(oc.g1_mul(oc.G1_GEN, sk))
+        sb = oc.g2_to_bytes(oc.g2_mul(h, sk))
+        xc0, xc1, sgn, ok = bls_api.parse_signature(sb)
+        sig_x0.append(xc0)
+        sig_x1.append(xc1)
+        sig_sign.append(sgn)
+        d = bls_api.message_draws(msg)
+        u0l.append(d[0])
+        u1l.append(d[1])
+    pk = C.g1_batch_from_ints(pks)
+    sig_x = (L.from_ints(sig_x0), L.from_ints(sig_x1))
+    sign_arr = jnp.asarray(np.asarray(sig_sign, np.int32))
+    u0 = (L.from_ints([u[0] for u in u0l]), L.from_ints([u[1] for u in u0l]))
+    u1 = (L.from_ints([u[0] for u in u1l]), L.from_ints([u[1] for u in u1l]))
+    mask = jnp.ones(N, bool)
+    bits = C.scalars_to_bits(_rand_scalars(N), kernels.RAND_BITS)
+
+    t("null", lambda x: x, mask, reps=5)
+    t("g2_sqrt", kernels._stage_g2_sqrt.__wrapped__, sig_x, sign_arr)
+    x, y, is_qr = kernels._stage_g2_sqrt(sig_x, sign_arr)
+    t("g2_subgroup", kernels._stage_g2_subgroup.__wrapped__, x, y, is_qr, mask)
+    sig, all_valid = kernels._stage_g2_subgroup(x, y, is_qr, mask)
+    t("sswu_iso", kernels._stage_sswu_iso.__wrapped__, u0, u1)
+    iso = kernels._stage_sswu_iso(u0, u1)
+    t("cofactor", kernels._stage_cofactor.__wrapped__, iso, mask)
+    hx, hy = kernels._stage_cofactor(iso, mask)
+    t("prepare", kernels._stage_prepare_batch.__wrapped__, pk, hx, hy, sig, bits, mask)
+    px, py, qx, qy, pm = kernels._stage_prepare_batch(pk, hx, hy, sig, bits, mask)
+    t("miller", lambda a, b, c, d: kernels._stage_miller(a, b, c, d), px, py, qx, qy)
+    f = kernels._stage_miller(px, py, qx, qy)
+    t("product", lambda ff, m: kernels._stage_product(ff, m), f, pm)
+    prod = kernels._stage_product(f, pm)
+    t("final", lambda p2, v: kernels._stage_final_with_valid(p2, v), prod, all_valid)
+
+
+if __name__ == "__main__":
+    main()
