@@ -76,6 +76,35 @@ def _x_bits():
 # ---------------------------------------------------------------------------
 
 
+def _sqrt_bases(a0, a1_zero, delta, delta2):
+    """Fold the a1==0 special case into the candidate bases:
+      base_a = a0    (y = (sqrt(a0), 0) when a0 is a QR)
+      base_b = -a0   (y = (0, sqrt(-a0)) otherwise; -1 is a non-QR)
+    SHARED by the XLA scan path and the Pallas finisher — this select
+    tree must never drift between them."""
+    base_a = fq.select(a1_zero, fq.normalize(a0), delta)
+    base_b = fq.select(
+        a1_zero, fq.normalize(fq.neg(a0)), delta2
+    )
+    return base_a, base_b
+
+
+def _sqrt_assemble(a, a1_zero, ok_a, ta, tb, y1_gen):
+    """Candidate assembly + exact verification (the validity flag
+    doubles as the QR test). SHARED by both sqrt paths."""
+    a0, a1 = a
+    zero = fq.const(0, ())
+    t = fq.select(ok_a, ta, tb)
+    cand_y0 = fq.select(a1_zero, fq.select(ok_a, ta, zero), t)
+    cand_y1 = fq.select(a1_zero, fq.select(ok_a, zero, tb), y1_gen)
+    y = (fq.normalize(cand_y0), fq.normalize(cand_y1))
+    sq = tower.fq2_sqr(y)
+    is_square = jnp.logical_and(
+        fq.eq(sq[0], a0), fq.eq(sq[1], a1)
+    )
+    return y, is_square
+
+
 def fq2_sqrt_flagged(a):
     """(y, is_square): y with y^2 == a when is_square; branch-free.
 
@@ -88,13 +117,7 @@ def fq2_sqrt_flagged(a):
     inv2 = fq.const((P + 1) // 2, ())  # 1/2 mod P
     delta = fq.mul(fq.add(a0, s), inv2)
     delta2 = fq.mul(fq.sub(a0, s), inv2)
-    # fold the a1==0 special case into the bases:
-    #   base_a = a0      (y = (sqrt(a0), 0) when a0 is a QR)
-    #   base_b = -a0     (y = (0, sqrt(-a0)) otherwise; -1 is a non-QR)
-    base_a = fq.select(a1_zero, fq.normalize(a0), delta)
-    base_b = fq.select(
-        a1_zero, fq.normalize(fq.neg(a0)), delta2
-    )
+    base_a, base_b = _sqrt_bases(a0, a1_zero, delta, delta2)
     ta = fq.pow_const(base_a, (P + 1) // 4)
     tb = fq.pow_const(base_b, (P + 1) // 4)
     # one inversion serves y1 = a1 / (2t) for both t candidates;
@@ -104,16 +127,7 @@ def fq2_sqrt_flagged(a):
     one = fq.const(1, ())
     t_guard = fq.select(fq.is_zero(t), one, t)
     y1_gen = fq.mul(a1, fq.inv(fq.mul_small(t_guard, 2)))
-    # candidates
-    zero = fq.const(0, ())
-    cand_y0 = fq.select(a1_zero, fq.select(ok_a, ta, zero), t)
-    cand_y1 = fq.select(a1_zero, fq.select(ok_a, zero, tb), y1_gen)
-    y = (fq.normalize(cand_y0), fq.normalize(cand_y1))
-    sq = tower.fq2_sqr(y)
-    is_square = jnp.logical_and(
-        fq.eq(sq[0], a0), fq.eq(sq[1], a1)
-    )
-    return y, is_square
+    return _sqrt_assemble(a, a1_zero, ok_a, ta, tb, y1_gen)
 
 
 # ---------------------------------------------------------------------------
@@ -403,9 +417,115 @@ def _iso_map(x, y):
     return tower.fq2_norm(xo), tower.fq2_norm(yo)
 
 
+def _cat_lv(a: L.Lv, b: L.Lv) -> L.Lv:
+    a, b = L.normalize(a), L.normalize(b)
+    return L.Lv(jnp.concatenate([a.v, b.v], 0), a.lo, a.hi)
+
+
+def _split_lv(lv: L.Lv, n: int):
+    return (
+        L.Lv(lv.v[:n], lv.lo, lv.hi),
+        L.Lv(lv.v[n:], lv.lo, lv.hi),
+    )
+
+
+def _finish_sswu_from_candidates(u, x1, x2, gx1, gx2, parts1, parts2):
+    """Exact-arithmetic tail of the SSWU map over kernel-computed
+    candidates: the select tree of fq2_sqrt_flagged (a1==0 folding, QR
+    candidate check, sgn0 correction) — only is_zero/eq/sgn0 and a few
+    elementwise selects run here."""
+
+    def sqrt_sel(g, parts):
+        # same base-fold + assembly trees as fq2_sqrt_flagged (shared
+        # helpers); only the candidate POWERS came from the kernel
+        g0, g1v = tower.fq2_norm(g)
+        s, ta_gen, tb_gen, ta_z, tb_z, y1a, y1b = parts
+        a1_zero = fq.is_zero(g1v)
+        inv2 = fq.const((P + 1) // 2, ())
+        delta = fq.mul(fq.add(g0, s), inv2)
+        delta2 = fq.mul(fq.sub(g0, s), inv2)
+        base_a, _base_b = _sqrt_bases(g0, a1_zero, delta, delta2)
+        ta = fq.select(a1_zero, ta_z, ta_gen)
+        tb = fq.select(a1_zero, tb_z, tb_gen)
+        ok_a = fq.eq(fq.sqr(ta), base_a)
+        y1_gen = fq.select(ok_a, y1a, y1b)
+        return _sqrt_assemble(
+            (g0, g1v), a1_zero, ok_a, ta, tb, y1_gen
+        )
+
+    y1_, ok1 = sqrt_sel(gx1, parts1)
+    y2_, _ok2 = sqrt_sel(gx2, parts2)
+    x = tower.fq2_select(ok1, x1, x2)
+    y = tower.fq2_select(ok1, y1_, y2_)
+    flip = _sgn0(u) != _sgn0(y)
+    y = tower.fq2_select(
+        flip,
+        (fq.normalize(fq.neg(y[0])), fq.normalize(fq.neg(y[1]))),
+        y,
+    )
+    return x, y
+
+
+def _sswu_iso_sum_tpu(u0, u1) -> C.JacPoint:
+    """Pallas path: both draws batched through kernel S (chains +
+    candidate field work VMEM-resident), the exact select tree in XLA,
+    both isogenies through kernel I, one complete jacobian add."""
+    from . import pallas_sswu as PS
+
+    u0 = tower.fq2_norm(u0)
+    u1 = tower.fq2_norm(u1)
+    n = u0[0].v.shape[0]
+    ucat = (_cat_lv(u0[0], u1[0]), _cat_lv(u0[1], u1[1]))
+    d = PS.sswu_candidates(ucat)
+
+    def half(i: int, name: str) -> L.Lv:
+        return _split_lv(d[name], n)[i]
+
+    def fin(i: int, u):
+        x1 = (half(i, "x1_0"), half(i, "x1_1"))
+        x2 = (half(i, "x2_0"), half(i, "x2_1"))
+        gx1 = (half(i, "g1_0"), half(i, "g1_1"))
+        gx2 = (half(i, "g2_0"), half(i, "g2_1"))
+        parts1 = [
+            half(i, k)
+            for k in (
+                "s_1", "ta_gen_1", "tb_gen_1", "ta_z_1", "tb_z_1",
+                "y1a_1", "y1b_1",
+            )
+        ]
+        parts2 = [
+            half(i, k)
+            for k in (
+                "s_2", "ta_gen_2", "tb_gen_2", "ta_z_2", "tb_z_2",
+                "y1a_2", "y1b_2",
+            )
+        ]
+        return _finish_sswu_from_candidates(
+            u, x1, x2, gx1, gx2, parts1, parts2
+        )
+
+    xa, ya = fin(0, u0)
+    xb, yb = fin(1, u1)
+    (xo_a, yo_a), (xo_b, yo_b) = PS.iso_map_pair(xa, ya, xb, yb)
+    q0 = C.jac_from_affine(
+        C.FQ2_OPS, tower.fq2_norm(xo_a), tower.fq2_norm(yo_a)
+    )
+    q1 = C.jac_from_affine(
+        C.FQ2_OPS, tower.fq2_norm(xo_b), tower.fq2_norm(yo_b)
+    )
+    return C.jac_add(C.FQ2_OPS, q0, q1)
+
+
 def sswu_iso_sum(u0, u1) -> C.JacPoint:
     """Both SSWU maps + isogeny + point add (pre-cofactor half of
-    hash-to-G2; shared with bls/kernels.py _stage_sswu_iso)."""
+    hash-to-G2; shared with bls/kernels.py _stage_sswu_iso). On TPU
+    with 1-D batches the field core runs as the fused Pallas kernels
+    (ops/pallas_sswu.py)."""
+    if (
+        jax.default_backend() == "tpu"
+        and u0[0].v.ndim == 2
+    ):
+        return _sswu_iso_sum_tpu(u0, u1)
     x0, y0 = _sswu(tower.fq2_norm(u0))
     x1, y1 = _sswu(tower.fq2_norm(u1))
     q0 = C.jac_from_affine(C.FQ2_OPS, *_iso_map(x0, y0))

@@ -1,0 +1,442 @@
+"""Pallas TPU kernels: SSWU map + 3-isogeny (hash-to-G2 field core).
+
+After the pairing/ladder/product kernels, the SSWU+isogeny stage was
+the largest remaining device cost (~164 ms of the ~440 ms 2048-set
+bucket): ~120 Fq2 multiplies of XLA glue around the already-fused
+power chains, each materializing the (batch, 40, 79) banded matrix
+through HBM.
+
+The exact-arithmetic split (is_zero / eq / sgn0 need canonical
+digits, which stay in XLA where they are cheap):
+
+  host XLA pre :  u^2, Z*u^2, tv = (Z u^2)^2 + Z u^2, tv_zero mask
+  KERNEL S     :  tv inverse chain, x1/x2, g(x1), g(x2), and BOTH
+                  sqrt-candidate chains per g (general delta bases
+                  AND the a1==0 fallback bases — computing all four
+                  avoids in-kernel exact zero tests), plus the
+                  1/(2t) chains for the y1 assembly
+  host XLA mid :  exact selects (a1_zero / QR check / sgn0), picking
+                  (x, y) per map — a handful of elementwise ops
+  KERNEL I     :  3-isogeny Horner ladders + the shared denominator
+                  inverse chain for both maps
+  host XLA post:  one complete jacobian add of the two mapped points
+
+Correctness oracle: ops/ingest._sswu + _iso_map (XLA scan path), which
+itself is differentially tested against crypto/bls/hash_to_curve.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls import fields as OF
+from ..crypto.bls.fields import P
+from . import limbs as L
+from .pallas_chain import LANES, ROWS, _fold_rows, _modmul
+from .pallas_ladder import _norm2, _sub_offset
+from .pallas_pairing import _mk_tower
+
+E_SQRT = (P + 1) // 4
+E_INV = P - 2
+
+
+@functools.lru_cache(maxsize=None)
+def _bits(e: int) -> np.ndarray:
+    n = e.bit_length()
+    return np.array(
+        [(e >> (n - 1 - i)) & 1 for i in range(n)], np.int32
+    )
+
+
+def _const_plane(x: int) -> np.ndarray:
+    limbs = np.zeros((ROWS, 1), np.int32)
+    limbs[: L.NLIMB, 0] = L.int_to_limbs(x % P)
+    return np.broadcast_to(limbs, (ROWS, LANES)).copy()
+
+
+@functools.lru_cache(maxsize=None)
+def _sswu_consts():
+    """Constant planes: -B'/A', B'/(Z A'), A', B', 1/2 (as in
+    ops/ingest: SSWU on E2' with the hash_to_curve constants)."""
+    from .ingest import A_PRIME, B_PRIME, Z_SSWU
+
+    nba = OF.fq2_mul(OF.fq2_neg(B_PRIME), OF.fq2_inv(A_PRIME))
+    x1e = OF.fq2_mul(B_PRIME, OF.fq2_inv(OF.fq2_mul(Z_SSWU, A_PRIME)))
+    inv2 = (P + 1) // 2
+    return {
+        "nba0": _const_plane(nba[0]),
+        "nba1": _const_plane(nba[1]),
+        "x1e0": _const_plane(x1e[0]),
+        "x1e1": _const_plane(x1e[1]),
+        "a0": _const_plane(A_PRIME[0]),
+        "a1": _const_plane(A_PRIME[1]),
+        "b0": _const_plane(B_PRIME[0]),
+        "b1": _const_plane(B_PRIME[1]),
+        "inv2": _const_plane(inv2),
+        "one": _const_plane(1),
+    }
+
+
+_CONST_KEYS = (
+    "nba0", "nba1", "x1e0", "x1e1", "a0", "a1", "b0", "b1", "inv2",
+    "one",
+)
+
+# kernel S output order (per lane): see _sswu_kernel tail
+S_OUTS = (
+    "x1_0", "x1_1", "x2_0", "x2_1",
+    "g1_0", "g1_1", "g2_0", "g2_1",
+    "s_1", "ta_gen_1", "tb_gen_1", "ta_z_1", "tb_z_1",
+    "y1a_1", "y1b_1",
+    "s_2", "ta_gen_2", "tb_gen_2", "ta_z_2", "tb_z_2",
+    "y1a_2", "y1b_2",
+)
+
+
+def _sswu_kernel(sqrt_bits, inv_bits, fold_ref, off_ref, *refs):
+    F = _mk_tower(fold_ref[:], off_ref[0:1, :].reshape(ROWS))
+    n_in = len(_CONST_KEYS) + 3  # consts + zu2_0,zu2_1,tvz
+    ins = [r[:] for r in refs[:n_in]]
+    outs = refs[n_in:]
+    consts = dict(zip(_CONST_KEYS, ins))
+    z_u2 = (ins[-3], ins[-2])
+    tvz = ins[-1]  # (ROWS, LANES) broadcast 0/1 mask
+
+    def powc(base, bits_ref, nbits):
+        """base^e (Fq plane), square-and-multiply MSB-first."""
+
+        def body(i, acc):
+            sq = F.mm(acc, acc)
+            pr = F.mm(sq, base)
+            return jnp.where(bits_ref[i] == 1, pr, sq)
+
+        return jax.lax.fori_loop(1, nbits, body, base)
+
+    n_sqrt = len(_bits(E_SQRT))
+    n_inv = len(_bits(E_INV))
+
+    # tv = (Z u^2)^2 + Z u^2 over Fq2, recomputed in-kernel (cheaper
+    # than 2 more input planes); exceptional-case select via the
+    # host-computed exact-zero mask
+    zu2sq = F.f2_sqr(z_u2)
+    tv = F.f2_add(zu2sq, z_u2)
+    tv = (
+        jnp.where(tvz != 0, consts["one"], tv[0]),
+        jnp.where(tvz != 0, jnp.zeros_like(tv[1]), tv[1]),
+    )
+    n_tv = F.nrm(
+        F.add(F.mm(tv[0], tv[0]), F.mm(tv[1], tv[1]))
+    )
+    n_tv_inv = powc(n_tv, inv_bits, n_inv)
+    tv1 = (
+        F.mm(tv[0], n_tv_inv),
+        F.mm(F.neg(tv[1]), n_tv_inv),
+    )
+    # x1 = (-B'/A') * (1 + tv1), exceptional -> B'/(Z A')
+    nba = (consts["nba0"], consts["nba1"])
+    one_p_tv1 = (F.add(tv1[0], consts["one"]), tv1[1])
+    x1_gen = F.f2_mul(nba, one_p_tv1)
+    x1 = F.f2_sel(tvz, (consts["x1e0"], consts["x1e1"]), x1_gen)
+    x2 = F.f2_mul(z_u2, x1)
+
+    a_p = (consts["a0"], consts["a1"])
+    b_p = (consts["b0"], consts["b1"])
+
+    def g_prime(x):
+        x2_ = F.f2_sqr(x)
+        x3_ = F.f2_mul(x2_, x)
+        return F.f2_add(F.f2_add(x3_, F.f2_mul(a_p, x)), b_p)
+
+    gx1 = g_prime(x1)
+    gx2 = g_prime(x2)
+
+    def sqrt_parts(g):
+        """All candidate chains of the complex sqrt for one Fq2 g."""
+        g0, g1 = g
+        n = F.nrm(F.add(F.mm(g0, g0), F.mm(g1, g1)))
+        s = powc(n, sqrt_bits, n_sqrt)
+        delta = F.mm(F.add(g0, s), consts["inv2"])
+        delta2 = F.mm(F.sub(g0, s), consts["inv2"])
+        ta_gen = powc(delta, sqrt_bits, n_sqrt)
+        tb_gen = powc(delta2, sqrt_bits, n_sqrt)
+        ta_z = powc(g0, sqrt_bits, n_sqrt)
+        tb_z = powc(F.neg(g0), sqrt_bits, n_sqrt)
+        # y1 = g1 / (2 t) for both general candidates. t == 0 needs
+        # no guard: 0^(P-2) = 0 gives y1 = 0, which simply fails the
+        # host's exact y^2 == g verification (fail-closed, same
+        # semantics as the scan path's flag)
+        def y1_of(t):
+            inv = powc(F.small(t, 2), inv_bits, n_inv)
+            return F.mm(g1, inv)
+
+        y1a = y1_of(ta_gen)
+        y1b = y1_of(tb_gen)
+        return [s, ta_gen, tb_gen, ta_z, tb_z, y1a, y1b]
+
+    p1 = sqrt_parts(gx1)
+    p2 = sqrt_parts(gx2)
+    planes = [
+        x1[0], x1[1], x2[0], x2[1],
+        gx1[0], gx1[1], gx2[0], gx2[1],
+        *p1, *p2,
+    ]
+    for ref, plane in zip(outs, planes):
+        ref[:] = plane
+
+
+@functools.lru_cache(maxsize=None)
+def _sswu_call(n_blocks: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    cvec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(zu2_0, zu2_1, tvz):
+        n = n_blocks * LANES
+        consts = _sswu_consts()
+        return pl.pallas_call(
+            _sswu_kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+            ]
+            + [cvec() for _ in _CONST_KEYS]
+            + [vec() for _ in range(3)],
+            out_specs=[vec() for _ in S_OUTS],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in S_OUTS
+            ],
+        )(
+            jnp.asarray(_bits(E_SQRT)),
+            jnp.asarray(_bits(E_INV)),
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            *[jnp.asarray(consts[k]) for k in _CONST_KEYS],
+            zu2_0, zu2_1, tvz,
+        )
+
+    return run
+
+
+def _prep(v, padded, batch):
+    return jnp.transpose(jnp.pad(v, ((0, padded - batch), (0, 0))))
+
+
+def _out_lv(plane, batch):
+    return L.Lv(
+        jnp.transpose(plane)[:batch, :],
+        tuple([0] * L.NCANON),
+        tuple([L.B + 2] * L.NCANON),
+    )
+
+
+def sswu_candidates(u):
+    """Run kernel S for a batch of Fq2 draws; returns a dict of Lv
+    per S_OUTS name. The caller (ingest._sswu_tpu) finishes the exact
+    selects in XLA."""
+    from . import fq, tower
+    from .ingest import Z_SSWU
+
+    u = tower.fq2_norm(u)
+    z = tower.fq2_const(Z_SSWU)
+    u2 = tower.fq2_sqr(u)
+    z_u2 = tower.fq2_norm(tower.fq2_mul(z, u2))
+    tv = tower.fq2_norm(
+        tower.fq2_add(tower.fq2_sqr(z_u2), z_u2)
+    )
+    tv_zero = tower.fq2_is_zero(tv)
+    batch = u[0].v.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+    tvz_plane = jnp.broadcast_to(
+        jnp.pad(tv_zero.astype(jnp.int32), (0, padded - batch))[
+            None, :
+        ],
+        (ROWS, padded),
+    )
+    outs = _sswu_call(n_blocks)(
+        _prep(z_u2[0].v, padded, batch),
+        _prep(z_u2[1].v, padded, batch),
+        tvz_plane,
+    )
+    d = {
+        name: _out_lv(p, batch) for name, p in zip(S_OUTS, outs)
+    }
+    d["tv_zero"] = tv_zero
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Kernel I: 3-isogeny for both maps + shared denominator inversion
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _iso_const_rows() -> np.ndarray:
+    """(32, 40) int32: rows = Fq components of K1(4)+K2(3)+K3(4)+K4(4)
+    Fq2 isogeny coefficients, c0 then c1 per coefficient."""
+    from ..crypto.bls.hash_to_curve import _K1, _K2, _K3, _K4
+
+    rows = []
+    for k in (_K1, _K2, _K3, _K4):
+        for c in k:
+            rows.append(L.int_to_limbs(c[0] % P))
+            rows.append(L.int_to_limbs(c[1] % P))
+    out = np.zeros((32, ROWS), np.int32)
+    for i, r in enumerate(rows):
+        out[i, : L.NLIMB] = r
+    return out
+
+
+def _iso_kernel(inv_bits, fold_ref, off_ref, const_ref, *refs):
+    F = _mk_tower(fold_ref[:], off_ref[0:1, :].reshape(ROWS))
+    ins = [r[:] for r in refs[:8]]
+    outs = refs[8:]
+    consts = const_ref[:]  # (32, 40)
+
+    def kc(i):
+        # row i -> (40, LANES) broadcast constant plane
+        return jnp.broadcast_to(
+            consts[i].reshape(ROWS, 1), (ROWS, LANES)
+        )
+
+    def kc2(i):
+        return (kc(2 * i), kc(2 * i + 1))
+
+    # coefficient index bases: K1 at 0..3, K2 at 4..6, K3 at 7..10,
+    # K4 at 11..14 (fq2 units)
+    K1 = [kc2(i) for i in range(0, 4)]
+    K2 = [kc2(i) for i in range(4, 7)]
+    K3 = [kc2(i) for i in range(7, 11)]
+    K4 = [kc2(i) for i in range(11, 15)]
+
+    n_inv = len(_bits(E_INV))
+
+    def powc(base, bits_ref, nbits):
+        def body(i, acc):
+            sq = F.mm(acc, acc)
+            pr = F.mm(sq, base)
+            return jnp.where(bits_ref[i] == 1, pr, sq)
+
+        return jax.lax.fori_loop(1, nbits, body, base)
+
+    def horner(coeffs, x):
+        acc = coeffs[-1]
+        for c in reversed(coeffs[:-1]):
+            acc = F.f2_add(F.f2_mul(acc, x), c)
+        return acc
+
+    def f2_inv(a):
+        n = F.nrm(F.add(F.mm(a[0], a[0]), F.mm(a[1], a[1])))
+        ninv = powc(n, inv_bits, n_inv)
+        return (F.mm(a[0], ninv), F.mm(F.neg(a[1]), ninv))
+
+    def iso(x, y):
+        x_num = horner(K1, x)
+        x_den = horner(K2, x)
+        y_num = horner(K3, x)
+        y_den = horner(K4, x)
+        prod = F.f2_mul(x_den, y_den)
+        ip = f2_inv(prod)
+        xo = F.f2_mul(x_num, F.f2_mul(ip, y_den))
+        yo = F.f2_mul(y, F.f2_mul(y_num, F.f2_mul(ip, x_den)))
+        return xo, yo
+
+    xa = (ins[0], ins[1])
+    ya = (ins[2], ins[3])
+    xb = (ins[4], ins[5])
+    yb = (ins[6], ins[7])
+    xo_a, yo_a = iso(xa, ya)
+    xo_b, yo_b = iso(xb, yb)
+    planes = [
+        xo_a[0], xo_a[1], yo_a[0], yo_a[1],
+        xo_b[0], xo_b[1], yo_b[0], yo_b[1],
+    ]
+    for ref, plane in zip(outs, planes):
+        ref[:] = plane
+
+
+@functools.lru_cache(maxsize=None)
+def _iso_call(n_blocks: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(*planes):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            _iso_kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (32, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+            ]
+            + [vec() for _ in range(8)],
+            out_specs=[vec() for _ in range(8)],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(8)
+            ],
+        )(
+            jnp.asarray(_bits(E_INV)),
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            jnp.asarray(_iso_const_rows()),
+            *planes,
+        )
+
+    return run
+
+
+def iso_map_pair(xa, ya, xb, yb):
+    """3-isogeny for two (x, y) Fq2 pairs in one kernel pass; returns
+    ((xo_a, yo_a), (xo_b, yo_b)) as canonical-widened Lv tuples."""
+    batch = xa[0].v.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+    planes = []
+    for t in (xa, ya, xb, yb):
+        for lv in t:
+            planes.append(_prep(L.normalize(lv).v, padded, batch))
+    outs = _iso_call(n_blocks)(*planes)
+    lvs = [_out_lv(p, batch) for p in outs]
+    return (
+        ((lvs[0], lvs[1]), (lvs[2], lvs[3])),
+        ((lvs[4], lvs[5]), (lvs[6], lvs[7])),
+    )

@@ -105,3 +105,21 @@ class TestPallasPairingInterp:
 
         eq = ingest.jac_eq(out, ref)
         assert bool(np.asarray(eq))
+
+    def test_sswu_iso_matches_scan(self, interp):
+        from lodestar_tpu.ops import curve as C
+        from lodestar_tpu.ops import ingest, tower
+
+        rng = np.random.default_rng(7)
+        n = 2
+        u0 = (_rand_fq(n, rng), _rand_fq(n, rng))
+        u1 = (_rand_fq(n, rng), _rand_fq(n, rng))
+        a = ingest._sswu_iso_sum_tpu(u0, u1)
+        x0, y0 = ingest._sswu(tower.fq2_norm(u0))
+        x1, y1 = ingest._sswu(tower.fq2_norm(u1))
+        b = C.jac_add(
+            C.FQ2_OPS,
+            C.jac_from_affine(C.FQ2_OPS, *ingest._iso_map(x0, y0)),
+            C.jac_from_affine(C.FQ2_OPS, *ingest._iso_map(x1, y1)),
+        )
+        assert bool(np.asarray(ingest.jac_eq(a, b)).all())
