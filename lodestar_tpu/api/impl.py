@@ -714,6 +714,48 @@ class BeaconApiImpl:
         t = self.types.by_fork[post.fork].BeaconBlock
         return {"version": post.fork, **{"data": to_json(t, block)}}
 
+    def produce_block_v3(
+        self,
+        slot: str,
+        randao_reveal: str,
+        graffiti: str = "",
+        skip_randao_verification: str = "",
+        builder_boost_factor: str = "",
+    ) -> dict:
+        """routes/validator.ts produceBlockV3. This node builds full
+        (non-blinded) local blocks, so Eth-Execution-Payload-Blinded is
+        always false and builder_boost_factor (a relative builder-bid
+        weighting) never changes the choice. Pre-deneb `data` is the
+        BeaconBlock; deneb+ it is BlockContents {block, kzg_proofs,
+        blobs} (this chain's local production carries no mempool
+        blobs, so both lists are empty unless the EL supplied some).
+        The spec's envelope response headers ride the __headers__
+        convention (api/server.py emits + strips them)."""
+        if skip_randao_verification in ("1", "true", "True"):
+            # spec: stub reveal, production must not verify it — this
+            # node's production path never verifies the reveal against
+            # the proposer key (the SIGNED block gets full validation
+            # on import), so the flag is accepted as a no-op
+            pass
+        out = self.produce_block_v2(slot, randao_reveal, graffiti)
+        fork = out["version"]
+        if ForkSeq[fork] >= ForkSeq.deneb:
+            out["data"] = {
+                "block": out["data"],
+                "kzg_proofs": [],
+                "blobs": [],
+            }
+        out["execution_payload_blinded"] = False
+        out["execution_payload_value"] = "0"
+        out["consensus_block_value"] = "0"
+        out["__headers__"] = {
+            "Eth-Consensus-Version": fork,
+            "Eth-Execution-Payload-Blinded": "false",
+            "Eth-Execution-Payload-Value": "0",
+            "Eth-Consensus-Block-Value": "0",
+        }
+        return out
+
     # -- node: identity / peers -------------------------------------------
 
     def get_identity(self) -> dict:

@@ -135,6 +135,19 @@ ROUTES: list[Route] = [
         wrap_data=False,  # impl returns the {version, data} envelope
         query_params=("randao_reveal", "graffiti"),
     ),
+    Route(
+        "produceBlockV3",
+        "GET",
+        "/eth/v3/validator/blocks/{slot}",
+        "produce_block_v3",
+        wrap_data=False,
+        query_params=(
+            "randao_reveal",
+            "graffiti",
+            "skip_randao_verification",
+            "builder_boost_factor",
+        ),
+    ),
     # debug
     Route(
         "getStateV2",

@@ -171,10 +171,20 @@ class BeaconRestApiServer:
                 finally:
                     emitter.unsubscribe(q)
 
-            def _json(self, status: int, obj) -> None:
+            def _json(self, status: int, obj, headers=None) -> None:
+                # impl methods attach spec response headers (e.g.
+                # produceBlockV3's Eth-Execution-Payload-Blinded) via
+                # a "__headers__" key, stripped before serializing
+                if isinstance(obj, dict) and "__headers__" in obj:
+                    headers = {
+                        **(headers or {}),
+                        **obj.pop("__headers__"),
+                    }
                 data = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

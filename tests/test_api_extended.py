@@ -344,3 +344,51 @@ class TestLodestarAdminNamespace:
             srv.stop()
 
         asyncio.run(go())
+
+
+class TestProduceBlockV3:
+    def test_v3_envelope(self, types):
+        from lodestar_tpu.crypto.bls.signature import sign
+        from lodestar_tpu.config.beacon_config import (
+            compute_signing_root_from_roots, BeaconConfig,
+        )
+        from lodestar_tpu.params import DOMAIN_RANDAO
+        from lodestar_tpu.ssz import uint64 as ssz_uint64
+
+        cfg = _cfg()
+
+        async def go():
+            node = DevNode(cfg, types, N, verify_attestations=False)
+            await node.advance_slot()
+            impl = BeaconApiImpl(cfg, types, node.chain)
+            slot = node.slot + 1
+            epoch = slot // preset().SLOTS_PER_EPOCH
+            proposer = impl.get_proposer_duties(epoch)
+            # find proposer for the slot and sign its randao
+            vi = next(
+                int(d["validator_index"])
+                for d in proposer
+                if int(d["slot"]) == slot
+            )
+            gvr = bytes(
+                node.chain.head_state.state.genesis_validators_root
+            )
+            bc = BeaconConfig(cfg, gvr)
+            domain = bc.get_domain(DOMAIN_RANDAO, epoch)
+            randao = sign(
+                node.sks[vi],
+                compute_signing_root_from_roots(
+                    ssz_uint64.hash_tree_root(epoch), domain
+                ),
+            )
+            out = impl.produce_block_v3(str(slot), "0x" + randao.hex())
+            assert out["execution_payload_blinded"] is False
+            assert (
+                out["__headers__"]["Eth-Execution-Payload-Blinded"]
+                == "false"
+            )
+            # pre-deneb config: data is the bare block
+            assert int(out["data"]["slot"]) == slot
+            await node.close()
+
+        asyncio.run(go())
