@@ -363,9 +363,9 @@ class BeaconApiImpl:
         count = len(tree)
         return {
             "finalized": [
-                _hex(h) for h in tree.branch(count - 1, count)
+                _hex(h) for h in tree.finalized_roots(count)
             ],
-            "deposit_root": _hex(tree.root()),
+            "deposit_root": _hex(tree.root),
             "deposit_count": str(count),
             "execution_block_hash": _hex(
                 getattr(eth1, "latest_block_hash", b"\x00" * 32) or b"\x00" * 32
@@ -1287,7 +1287,12 @@ class BeaconApiImpl:
                             {"index": i, "message": "rejected: invalid"}
                         )
                         continue
-                    # ACCEPT pooled by the processor; IGNORE = seen
+                    if action != GossipAction.ACCEPT:
+                        # IGNORE covers both duplicates and verifier
+                        # overload — neither may reach the mesh
+                        # unvalidated; duplicates were already forwarded
+                        # when first accepted
+                        continue
                 elif self.node is not None and self.node.att_pool is not None:
                     self.node.att_pool.add(sap.message.aggregate)
                 if self.node is not None and self.node.network is not None:

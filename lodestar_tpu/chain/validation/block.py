@@ -31,6 +31,13 @@ class GossipBlockValidator:
         self.verifier = verifier
         self.seen_proposers = SeenBlockProposers()
         self.clock_slot = 0
+        # small memo of fork-advanced parent views keyed by
+        # (parent_root, epoch): fork boundaries are rare, but spam AT
+        # the boundary must not force a fresh epoch transition per
+        # gossip message — and a one-entry memo would thrash when two
+        # viable head candidates alternate (a one-block reorg)
+        self._fork_view_cache: dict = {}
+        self._fork_view_cache_max = 4
 
     def on_slot(self, slot: int) -> None:
         self.clock_slot = slot
@@ -90,11 +97,29 @@ class GossipBlockValidator:
             raise GossipValidationError(
                 GossipAction.REJECT, "unknown proposer index"
             )
-        # [REJECT] expected proposer (:160) — computed from the parent
-        # state's shuffling when the epochs line up; a mismatched
-        # proposer is an equivocation attempt
+        # When the parent state is still on the PREVIOUS fork (first
+        # blocks after a fork boundary), advance a clone through the
+        # fork upgrade first: get_domain reads state.fork, so the
+        # un-upgraded version would REJECT valid blocks — and skipping
+        # the checks would open a signature-free forwarding window.
+        # An advance failure is a LOCAL error, not an attributable
+        # message fault -> IGNORE, never REJECT (don't downscore the
+        # relaying peers for our own state-regen trouble).
+        sig_view = view
+        if view.fork != fork:
+            try:
+                sig_view = self._fork_advanced_view(view, parent, slot)
+            except Exception as e:
+                raise GossipValidationError(
+                    GossipAction.IGNORE,
+                    f"fork-boundary state advance failed: {e}",
+                ) from e
+        # [REJECT] expected proposer (:160) — computed from the
+        # (possibly fork-advanced) parent state's shuffling when the
+        # epochs line up; a mismatched proposer is an equivocation
+        # attempt
         try:
-            expected = self._expected_proposer(view, slot)
+            expected = self._expected_proposer(sig_view, slot)
         except Exception:
             expected = None
         if expected is not None and expected != proposer:
@@ -102,26 +127,20 @@ class GossipBlockValidator:
                 GossipAction.REJECT, "wrong proposer for slot"
             )
         # [REJECT] proposer signature (:150) through the TPU verifier.
-        # Skipped when the parent state is still on the PREVIOUS fork:
-        # get_domain reads state.fork, so the version for the block's
-        # epoch would be wrong and a valid first-block-of-a-fork would
-        # be REJECTed — the full import (which advances the state
-        # through the fork upgrade) still verifies it completely.
-        if view.fork == fork:
-            try:
-                sig_set = self._proposer_set(view, signed_block, fork)
-            except Exception as e:
-                raise GossipValidationError(
-                    GossipAction.REJECT,
-                    f"signature set build failed: {e}",
-                ) from e
-            ok = await self.verifier.verify_signature_sets(
-                [sig_set], priority=True
+        try:
+            sig_set = self._proposer_set(sig_view, signed_block, fork)
+        except Exception as e:
+            raise GossipValidationError(
+                GossipAction.REJECT,
+                f"signature set build failed: {e}",
+            ) from e
+        ok = await self.verifier.verify_signature_sets(
+            [sig_set], priority=True
+        )
+        if not ok:
+            raise GossipValidationError(
+                GossipAction.REJECT, "invalid proposer signature"
             )
-            if not ok:
-                raise GossipValidationError(
-                    GossipAction.REJECT, "invalid proposer signature"
-                )
         # double-observation after async verify (block.ts:64 re-check)
         if self.seen_proposers.is_known(slot, proposer):
             raise GossipValidationError(
@@ -129,6 +148,33 @@ class GossipBlockValidator:
             )
         self.seen_proposers.add(slot, proposer)
         return GossipAction.ACCEPT
+
+    def _fork_advanced_view(self, view, parent_root: bytes, slot: int):
+        """Clone of the parent state advanced (process_slots) to the
+        first slot of the block's epoch, applying every fork upgrade on
+        the way, so the proposer-signature domain is built from the
+        block's fork. Memoized per (parent, epoch) — boundary spam must
+        not buy an epoch transition per message."""
+        epoch = util.compute_epoch_at_slot(slot)
+        key = (parent_root, epoch)
+        hit = self._fork_view_cache.get(key)
+        if hit is not None:
+            return hit
+        from ...statetransition.slot import process_slots
+        from ..chain import _clone
+
+        scratch = _clone(view, self.types)
+        target = max(
+            epoch * util.preset().SLOTS_PER_EPOCH,
+            int(view.state.slot),
+        )
+        process_slots(self.cfg, scratch, target, self.types)
+        if len(self._fork_view_cache) >= self._fork_view_cache_max:
+            self._fork_view_cache.pop(
+                next(iter(self._fork_view_cache))
+            )
+        self._fork_view_cache[key] = scratch
+        return scratch
 
     def _expected_proposer(self, view, slot: int) -> int | None:
         """Proposer for `slot` from the parent state, only when the

@@ -7,12 +7,18 @@ fork-schedule helpers; BeaconConfig caches per-fork signing domains once the
 genesis validators root is known.
 """
 
-from .chain_config import ChainConfig, MAINNET_CONFIG, MINIMAL_CONFIG
+from .chain_config import (
+    ChainConfig,
+    GNOSIS_CONFIG,
+    MAINNET_CONFIG,
+    MINIMAL_CONFIG,
+)
 from .fork_config import ChainForkConfig, ForkInfo
 from .beacon_config import BeaconConfig, create_beacon_config
 
 __all__ = [
     "ChainConfig",
+    "GNOSIS_CONFIG",
     "MAINNET_CONFIG",
     "MINIMAL_CONFIG",
     "ChainForkConfig",

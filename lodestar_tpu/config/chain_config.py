@@ -118,6 +118,44 @@ class ChainConfig:
 
 MAINNET_CONFIG = ChainConfig()
 
+# Gnosis chain (reference config/src/chainConfig/networks/gnosis.ts —
+# diff-only over mainnet, per gnosischain/configs mainnet/config.yaml)
+GNOSIS_CONFIG = ChainConfig(
+    PRESET_BASE="gnosis",
+    CONFIG_NAME="gnosis",
+    TERMINAL_TOTAL_DIFFICULTY=int(
+        "8626000000000000000000058750000000000000000000"
+    ),
+    SECONDS_PER_SLOT=5,
+    SECONDS_PER_ETH1_BLOCK=6,
+    ETH1_FOLLOW_DISTANCE=1024,
+    CHURN_LIMIT_QUOTIENT=4096,
+    MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT=2,
+    DEPOSIT_CHAIN_ID=100,
+    DEPOSIT_NETWORK_ID=100,
+    DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex(
+        "0b98057ea310f4d31f2a452b414647007d1645d9"
+    ),
+    DEPOSIT_CONTRACT_DEPLOY_BLOCK=19469077,
+    MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS=16384,
+    MIN_GENESIS_TIME=1638968400,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4096,
+    GENESIS_FORK_VERSION=bytes.fromhex("00000064"),
+    GENESIS_DELAY=6000,
+    ALTAIR_FORK_VERSION=bytes.fromhex("01000064"),
+    ALTAIR_FORK_EPOCH=512,
+    BELLATRIX_FORK_VERSION=bytes.fromhex("02000064"),
+    BELLATRIX_FORK_EPOCH=385536,
+    CAPELLA_FORK_VERSION=bytes.fromhex("03000064"),
+    CAPELLA_FORK_EPOCH=648704,
+    DENEB_FORK_VERSION=bytes.fromhex("04000064"),
+    DENEB_FORK_EPOCH=889856,
+    # Electra follows the reference pin (unscheduled for gnosis at
+    # v1.5.0-alpha.8) but carries the gnosis version namespace so an
+    # epoch-only override computes correct post-electra domains
+    ELECTRA_FORK_VERSION=bytes.fromhex("05000064"),
+)
+
 MINIMAL_CONFIG = ChainConfig(
     PRESET_BASE="minimal",
     CONFIG_NAME="minimal",

@@ -85,3 +85,19 @@ class DepositTree:
             idx >>= 1
         out.append(size.to_bytes(32, "little"))
         return out
+
+    def finalized_roots(self, size: int | None = None) -> list[bytes]:
+        """EIP-4881 snapshot `finalized` vector: roots of the maximal
+        full subtrees covering leaves [0, size), left to right (one per
+        set bit of size, descending subtree size). A consumer can
+        reconstruct a DepositTreeSnapshot from this list + count."""
+        if size is None:
+            size = len(self.leaves)
+        assert 0 <= size <= len(self.leaves)
+        out: list[bytes] = []
+        offset = 0
+        for level in range(DEPOSIT_CONTRACT_TREE_DEPTH, -1, -1):
+            if (size >> level) & 1:
+                out.append(self._node(level, offset >> level, size))
+                offset += 1 << level
+        return out

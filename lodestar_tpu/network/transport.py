@@ -319,8 +319,19 @@ class TcpHost:
         pinned = self.peer_statics.get(pid)
         if pinned is not None and pinned != rs:
             return False
-        if len(self.peer_statics) >= self._peer_statics_max:
-            self.peer_statics.pop(next(iter(self.peer_statics)))
+        if (
+            pid not in self.peer_statics
+            and len(self.peer_statics) >= self._peer_statics_max
+        ):
+            # evict the oldest pin that is NOT a live connection — an
+            # attacker holding many handshakes must never be able to
+            # flush a connected victim's pin and reclaim its peer_id.
+            # A re-handshake of an already-pinned id replaces in place
+            # (no eviction), so pin churn can't be forced that way.
+            for old_pid in self.peer_statics:
+                if old_pid not in self.conns:
+                    self.peer_statics.pop(old_pid)
+                    break
         self.peer_statics[pid] = rs
         return True
 

@@ -445,10 +445,14 @@ def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
         fast = _shuffle_rounds_xla(count, seed, blocks_all)
         if fast is not None:
             return fast
-    # int32 lanes + branch-free bit ops per round: count < 2^31 always
-    # (VALIDATOR_REGISTRY_LIMIT fits), and the only non-power-of-two
+    # int32 lanes + branch-free bit ops per round. VALIDATOR_REGISTRY_
+    # LIMIT is 2^40, so int32 is NOT spec-guaranteed — it is guarded
+    # here (any registry that large is far beyond practical reach; the
+    # int64 reference path below handles it). The only non-power-of-two
     # modulo ((pivot - idx) mod count) reduces to one conditional add
-    # since pivot - idx is in (-count, count)
+    # since pivot - idx is in (-count, count).
+    if count >= 2**31:
+        return _compute_shuffling_int64(count, seed, blocks_all)
     idx32 = idx.astype(np.int32)
     cnt = np.int32(count)
     for r in range(rounds):
@@ -481,6 +485,40 @@ def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
         bit = (byte >> (position & 7).astype(np.uint8)) & 1
         idx32 = np.where(bit == 1, flip, idx32)
     return idx32.astype(np.int64)
+
+
+def _compute_shuffling_int64(
+    count: int, seed: bytes, blocks_all
+) -> np.ndarray:
+    """int64 swap-or-not rounds for registries >= 2^31 (spec limit is
+    2^40). Same algorithm as the int32 fast path, per-round hashlib
+    decision bytes (a registry this size is not a practical target)."""
+    p = preset()
+    idx = np.arange(count, dtype=np.int64)
+    n_blocks = (count + 255) // 256
+    for r in range(p.SHUFFLE_ROUND_COUNT):
+        rh = hash32(seed + bytes([r]))
+        pivot = np.int64(int.from_bytes(rh[:8], "little") % count)
+        flip = (pivot - idx) % count
+        position = np.maximum(idx, flip)
+        if blocks_all is not None:
+            flat = blocks_all[r].reshape(-1)
+        else:
+            flat = np.concatenate(
+                [
+                    np.frombuffer(
+                        hash32(
+                            seed + bytes([r]) + int(b).to_bytes(4, "little")
+                        ),
+                        np.uint8,
+                    )
+                    for b in range(n_blocks)
+                ]
+            )
+        byte = flat[((position >> 8) << 5) + ((position & 255) >> 3)]
+        bit = (byte >> (position & 7).astype(np.uint8)) & 1
+        idx = np.where(bit == 1, flip, idx)
+    return idx
 
 
 # ---------------------------------------------------------------------------

@@ -95,6 +95,64 @@ class TestDepositTree:
                 )
 
 
+    def test_finalized_roots_reconstruct(self):
+        """EIP-4881: the snapshot's finalized subtree roots + count must
+        reconstruct the deposit root (one root per set bit of count,
+        left-to-right, descending subtree size)."""
+        from lodestar_tpu.ssz.core import zero_hash
+
+        tree = DepositTree()
+        for i in range(13):  # 0b1101: subtrees of 8, 4, 1 leaves
+            tree.push(sha256(bytes([i])).digest())
+        for size in (13, 8, 5, 1):
+            fin = tree.finalized_roots(size)
+            assert len(fin) == bin(size).count("1")
+            # rebuild: place each finalized root at its level, then
+            # hash up to depth 32 padding with zero subtrees
+            levels = [lv for lv in range(32, -1, -1) if (size >> lv) & 1]
+            # fold right-to-left: start from the smallest subtree
+            acc = None
+            acc_level = None
+            for root_h, lv in zip(reversed(fin), reversed(levels)):
+                if acc is None:
+                    acc, acc_level = root_h, lv
+                else:
+                    # raise acc to lv by padding with zero subtrees
+                    while acc_level < lv:
+                        acc = sha256(acc + zero_hash(acc_level)).digest()
+                        acc_level += 1
+                    acc = sha256(root_h + acc).digest()
+                    acc_level += 1
+            while acc_level < 32:
+                acc = sha256(acc + zero_hash(acc_level)).digest()
+                acc_level += 1
+            expected = sha256(
+                acc + size.to_bytes(32, "little")
+            ).digest()
+            assert expected == tree.root_at(size)
+
+    def test_snapshot_endpoint_nonempty(self):
+        """get_deposit_snapshot must serve a non-empty tree (round-4
+        advisor: tree.root is a property — calling it raised TypeError)."""
+        from types import SimpleNamespace
+
+        from lodestar_tpu.api.impl import BeaconApiImpl
+
+        tree = DepositTree()
+        for i in range(5):
+            tree.push(sha256(bytes([i])).digest())
+        eth1 = SimpleNamespace(
+            tree=tree, latest_block_hash=b"\x22" * 32, latest_block_number=77
+        )
+        impl = BeaconApiImpl.__new__(BeaconApiImpl)
+        impl.chain = SimpleNamespace(eth1=eth1)
+        snap = impl.get_deposit_snapshot()
+        assert snap["deposit_count"] == "5"
+        assert snap["deposit_root"] == "0x" + tree.root.hex()
+        assert len(snap["finalized"]) == 2  # 5 = 0b101
+        assert snap["execution_block_height"] == "77"
+
+
 class TestAbiParse:
     def test_parse_deposit_event(self):
         pubkey = b"\x0a" * 48

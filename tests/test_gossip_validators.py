@@ -349,6 +349,43 @@ class TestGossipBlockValidation:
 
         asyncio.run(go())
 
+    def test_fork_boundary_block_signature_verified(self, types):
+        """First block after a fork boundary: the parent state view is
+        still on the previous fork, but the proposer signature MUST be
+        verified (round-4 advisor: the old skip opened a signature-free
+        forwarding window) — a tampered boundary block is REJECTed and
+        the genuine one ACCEPTed via the fork-advanced clone."""
+        cfg, node = _devnode(types, ALTAIR_FORK_EPOCH=1)
+
+        async def go():
+            p = preset()
+            # advance into epoch 1 so the head block is the first
+            # altair block whose PARENT post-state is phase0
+            root = None
+            while node.slot < p.SLOTS_PER_EPOCH:
+                root = await node.advance_slot()
+            blk = node.chain.get_block(root)
+            assert int(blk.message.slot) == p.SLOTS_PER_EPOCH
+            parent_view = node.chain.get_state(
+                bytes(blk.message.parent_root)
+            )
+            assert parent_view.fork == "phase0"  # pre-upgrade parent
+            bv = GossipBlockValidator(
+                cfg, types, node.chain, node.chain.verifier
+            )
+            bv.on_slot(node.slot)
+            t = types.by_fork["altair"].SignedBeaconBlock
+            tampered = t.deserialize(t.serialize(blk))
+            tampered.signature = bytes(96)
+            with pytest.raises(GossipValidationError) as ei:
+                await bv.validate(tampered, "altair")
+            assert ei.value.action == GossipAction.REJECT
+            action = await bv.validate(blk, "altair")
+            assert action == GossipAction.ACCEPT
+            await node.close()
+
+        asyncio.run(go())
+
     def test_future_slot_and_unknown_parent_ignored(self, types):
         cfg, node = _devnode(types)
 

@@ -197,3 +197,39 @@ class TestEncryptedHost:
             await imposter.close()
 
         asyncio.run(go())
+
+    def test_pin_eviction_spares_live_peers(self):
+        """Filling the pin table must not evict a CONNECTED peer's pin
+        (round-4 advisor: FIFO eviction let an attacker flush a live
+        victim's pin and reclaim its peer_id under a new key)."""
+
+        async def go():
+            target = TcpHost("t", b"\x04" * 4)
+            victim = TcpHost("victim", b"\x04" * 4)
+            await target.listen()
+            await victim.listen()
+            await victim.dial("127.0.0.1", target.port)
+            await asyncio.sleep(0.1)
+            assert "victim" in target.conns
+            pinned = target.peer_statics["victim"]
+            # shrink the cap so two disconnected handshakes overflow it
+            target._peer_statics_max = 2
+            for name in ("x1", "x2", "x3"):
+                h = TcpHost(name, b"\x04" * 4)
+                await h.listen()
+                await h.dial("127.0.0.1", target.port)
+                await asyncio.sleep(0.05)
+                await h.close()  # disconnect releases the pin slot
+                await asyncio.sleep(0.05)
+            # victim's pin survived the churn; an imposter still fails
+            assert target.peer_statics.get("victim") == pinned
+            imposter = TcpHost("victim", b"\x04" * 4)
+            await imposter.listen()
+            with pytest.raises(TransportError):
+                await imposter.dial("127.0.0.1", target.port)
+            assert target.conns["victim"].remote_static == pinned
+            await target.close()
+            await victim.close()
+            await imposter.close()
+
+        asyncio.run(go())

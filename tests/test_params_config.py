@@ -99,3 +99,74 @@ def test_beacon_config_domain_cache_and_digests():
 def test_minimal_config_distinct():
     assert MINIMAL_CONFIG.SECONDS_PER_SLOT == 6
     assert MINIMAL_CONFIG.GENESIS_FORK_VERSION != MAINNET_CONFIG.GENESIS_FORK_VERSION
+
+
+def test_gnosis_preset_spec_values():
+    p = presets.GNOSIS_PRESET if hasattr(presets, "GNOSIS_PRESET") else presets.PRESETS["gnosis"]
+    # diff values (gnosischain/specs consensus/preset/gnosis)
+    assert p.BASE_REWARD_FACTOR == 25
+    assert p.SLOTS_PER_EPOCH == 16
+    assert p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 512
+    assert p.MAX_WITHDRAWALS_PER_PAYLOAD == 8
+    assert p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP == 8192
+    # everything else inherits mainnet
+    assert p.SHUFFLE_ROUND_COUNT == 90
+    assert p.SYNC_COMMITTEE_SIZE == 512
+
+
+def test_gnosis_config_distinct():
+    from lodestar_tpu.config import GNOSIS_CONFIG
+
+    assert GNOSIS_CONFIG.PRESET_BASE == "gnosis"
+    assert GNOSIS_CONFIG.SECONDS_PER_SLOT == 5
+    assert GNOSIS_CONFIG.DEPOSIT_CHAIN_ID == 100
+    assert GNOSIS_CONFIG.GENESIS_FORK_VERSION == bytes.fromhex("00000064")
+    fc = ChainForkConfig(GNOSIS_CONFIG)
+    assert fc.get_fork_name(511) == "phase0"
+    assert fc.get_fork_name(512) == "altair"
+    assert fc.get_fork_name(889856) == "deneb"
+
+
+def test_gnosis_preset_shuffle_epoch_smoke():
+    """Spawn a LODESTAR_PRESET=gnosis process (presets freeze on first
+    use) and run a shuffle + one epoch transition under gnosis sizes."""
+    import subprocess
+    import sys
+    import os
+
+    code = """
+import os
+assert os.environ["LODESTAR_PRESET"] == "gnosis"
+from lodestar_tpu import params
+p = params.preset()
+assert p.SLOTS_PER_EPOCH == 16 and p.BASE_REWARD_FACTOR == 25
+from lodestar_tpu.statetransition import util
+shuffled = util.compute_shuffling(500, b"\\x07" * 32)
+import numpy as np
+assert sorted(shuffled.tolist()) == list(range(500))
+# scalar spec cross-check: vectorized shuffle matches per-index spec
+for i in (0, 13, 499):
+    assert int(shuffled[i]) == util.compute_shuffled_index(i, 500, b"\\x07" * 32)
+assert (shuffled == util.compute_shuffling(500, b"\\x07" * 32)).all()
+from lodestar_tpu.config import GNOSIS_CONFIG
+from lodestar_tpu.types.factory import ssz_types
+from lodestar_tpu.statetransition.genesis import create_interop_genesis_state
+from lodestar_tpu.statetransition.slot import process_slots
+types = ssz_types()
+cfg = GNOSIS_CONFIG.with_overrides(ALTAIR_FORK_EPOCH=2**64 - 1)
+view = create_interop_genesis_state(cfg, types, 64, genesis_time=0)
+process_slots(cfg, view, p.SLOTS_PER_EPOCH + 1, types)
+assert int(view.state.slot) == p.SLOTS_PER_EPOCH + 1
+print("gnosis-smoke-ok")
+"""
+    env = dict(os.environ, LODESTAR_PRESET="gnosis", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "gnosis-smoke-ok" in out.stdout
