@@ -509,6 +509,92 @@ class BeaconApiImpl:
         await self.chain.process_block(block)
         return {}
 
+    async def publish_blinded_block_json(self, body: dict) -> dict:
+        """POST /eth/v{1,2}/beacon/blinded_blocks: the unblinding path
+        (routes/beacon/block.ts publishBlindedBlock → chain unblinds via
+        the builder, execution/builder/http.ts:60 submitBlindedBlock).
+        The VC-signed blinded block goes to the relay, which reveals
+        the full ExecutionPayload; the reconstructed full block must
+        match the header commitment, then imports + publishes."""
+        from ..statetransition.slot import fork_at_epoch
+        from .json_codec import from_json, to_json  # noqa: F401
+
+        builder = (
+            getattr(self.node, "builder", None) if self.node else None
+        )
+        if builder is None:
+            raise ApiError(503, "no builder configured to unblind")
+        try:
+            slot = int(body["message"]["slot"])
+            fork = fork_at_epoch(
+                self.cfg, slot // preset().SLOTS_PER_EPOCH
+            )
+            ns = self.types.by_fork[fork]
+            signed_blinded = from_json(
+                ns.SignedBlindedBeaconBlock, body
+            )
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            # AttributeError: pre-bellatrix forks have no blinded types
+            raise ApiError(400, f"malformed blinded block: {e}") from e
+        try:
+            revealed = await builder.submit_blinded_block(
+                fork, signed_blinded
+            )
+        except Exception as e:
+            raise ApiError(502, f"relay reveal failed: {e}") from e
+        # deneb+ reveals carry the blobs bundle alongside the payload
+        payload, bundle = (
+            revealed if isinstance(revealed, tuple) else (revealed, None)
+        )
+        # the revealed payload must hash to the committed header
+        hdr = signed_blinded.message.body.execution_payload_header
+        if bytes(payload.block_hash) != bytes(hdr.block_hash):
+            raise ApiError(
+                400, "revealed payload does not match bid header"
+            )
+        full = self._unblind(ns, fork, signed_blinded, payload)
+        sidecars = None
+        comms = list(
+            getattr(
+                signed_blinded.message.body, "blob_kzg_commitments", []
+            )
+            or []
+        )
+        if comms:
+            from ..chain.blobs import blob_sidecars_from_block
+
+            bundle = bundle or {}
+            sidecars = blob_sidecars_from_block(
+                self.types,
+                fork,
+                full,
+                list(bundle.get("blobs") or []),
+                list(bundle.get("proofs") or []),
+            )
+        await self.chain.process_block(full, blob_sidecars=sidecars)
+        if self.node is not None and self.node.network is not None:
+            await self.node.network.publish_block(fork, full)
+        return {}
+
+    def _unblind(self, ns, fork, signed_blinded, payload):
+        """SignedBlindedBeaconBlock + revealed payload -> full
+        SignedBeaconBlock (same signature: the roots are identical)."""
+        blinded = signed_blinded.message
+        full = ns.SignedBeaconBlock.default()
+        msg = full.message
+        msg.slot = blinded.slot
+        msg.proposer_index = blinded.proposer_index
+        msg.parent_root = bytes(blinded.parent_root)
+        msg.state_root = bytes(blinded.state_root)
+        body = msg.body
+        for name, _ in ns.BlindedBeaconBlockBody.fields:
+            if name == "execution_payload_header":
+                body.execution_payload = payload
+            else:
+                setattr(body, name, getattr(blinded.body, name))
+        full.signature = bytes(signed_blinded.signature)
+        return full
+
     # -- pool namespace ---------------------------------------------------
 
     def _pools(self):
@@ -685,6 +771,189 @@ class BeaconApiImpl:
         from .json_codec import to_json
 
         slot_i = int(slot)
+        pool = self._produce_pool_inputs(slot_i)
+        block, post = self.chain.produce_block(
+            slot_i,
+            bytes.fromhex(randao_reveal.removeprefix("0x")),
+            attestations=pool["atts"],
+            sync_aggregate=pool["sync_aggregate"],
+            graffiti=(
+                bytes.fromhex(graffiti.removeprefix("0x")).ljust(32, b"\x00")
+                if graffiti
+                else b"\x00" * 32
+            ),
+        )
+        t = self.types.by_fork[post.fork].BeaconBlock
+        return {"version": post.fork, **{"data": to_json(t, block)}}
+
+    async def produce_block_v3(
+        self,
+        slot: str,
+        randao_reveal: str,
+        graffiti: str = "",
+        skip_randao_verification: str = "",
+        builder_boost_factor: str = "",
+    ) -> dict:
+        """routes/validator.ts produceBlockV3 (api/impl/validator/
+        index.ts:837): when a builder relay is wired, its getHeader bid
+        RACES the engine's getPayload and the winner is chosen by
+        bid_value * builder_boost_factor / 100 vs the engine's block
+        value — a builder win returns a BLINDED block
+        (Eth-Execution-Payload-Blinded: true) for the VC to sign and
+        feed back through publish_blinded_block (the unblinding path).
+        Pre-deneb `data` is the BeaconBlock; deneb+ full responses are
+        BlockContents {block, kzg_proofs, blobs}; blinded responses are
+        the blinded block alone (builder holds the blobs). The spec's
+        envelope response headers ride the __headers__ convention
+        (api/server.py emits + strips them)."""
+        import asyncio as _asyncio
+
+        from .json_codec import to_json
+
+        if skip_randao_verification in ("1", "true", "True"):
+            # spec: stub reveal, production must not verify it — this
+            # node's production path never verifies the reveal against
+            # the proposer key (the SIGNED block gets full validation
+            # on import), so the flag is accepted as a no-op
+            pass
+        slot_i = int(slot)
+        boost = (
+            int(builder_boost_factor) if builder_boost_factor else 100
+        )
+        chain = self.chain
+        builder = (
+            getattr(self.node, "builder", None) if self.node else None
+        )
+        if builder is not None and not getattr(builder, "enabled", True):
+            builder = None
+
+        # advance a scratch view once: proposer pubkey (builder bid
+        # key), parent exec hash, and the engine's fcU attributes all
+        # need the state AT the slot
+        from ..chain.chain import _clone
+        from ..statetransition.slot import process_slots
+
+        work = _clone(chain.get_or_regen_state(chain.head_root), self.types)
+        process_slots(self.cfg, work, slot_i, self.types)
+        post_merge = work.fork_seq >= ForkSeq.bellatrix
+
+        async def engine_side():
+            if chain.execution_engine is None or not post_merge:
+                return None, None, 0
+            return await chain.prepare_execution_payload(slot_i, work)
+
+        async def builder_side():
+            if builder is None or boost == 0 or not post_merge:
+                return None
+            proposer = util.get_beacon_proposer_index(
+                work.state, electra=work.fork_seq >= ForkSeq.electra
+            )
+            parent_hash = bytes(
+                work.state.latest_execution_payload_header.block_hash
+            )
+            pubkey = bytes(work.state.validators[proposer].pubkey)
+            try:
+                return await builder.get_header(
+                    slot_i, parent_hash, pubkey
+                )
+            except Exception:
+                return None  # relay fault -> local block wins
+
+        (engine_payload, bundle, engine_value), bid = await _asyncio.gather(
+            engine_side(), builder_side()
+        )
+        use_builder = bid is not None and (
+            engine_payload is None
+            or bid.value * boost // 100 > engine_value
+        )
+        if (
+            use_builder
+            and work.fork_seq >= ForkSeq.deneb
+            and getattr(bid, "blob_kzg_commitments", None) is None
+            and engine_payload is not None
+        ):
+            # deneb+: a bid without blob commitments cannot be trusted
+            # to carry none — fall back to the local block rather than
+            # sign a possibly-invalid commitment set (the reference
+            # requires the bid's blinded blobs bundle)
+            use_builder = False
+
+        pool = self._produce_pool_inputs(slot_i)
+        common = dict(
+            attestations=pool["atts"],
+            sync_aggregate=pool["sync_aggregate"],
+            graffiti=(
+                bytes.fromhex(graffiti.removeprefix("0x")).ljust(32, b"\x00")
+                if graffiti
+                else b"\x00" * 32
+            ),
+        )
+        reveal = bytes.fromhex(randao_reveal.removeprefix("0x"))
+        if use_builder:
+            block, post = chain.produce_block(
+                slot_i,
+                reveal,
+                execution_payload_header=bid.header,
+                blob_kzg_commitments=bid.blob_kzg_commitments,
+                work=work,
+                **common,
+            )
+            t = self.types.by_fork[post.fork].BlindedBeaconBlock
+            val = str(bid.value)
+            return {
+                "version": post.fork,
+                "data": to_json(t, block),
+                "execution_payload_blinded": True,
+                "execution_payload_value": val,
+                "consensus_block_value": "0",
+                "__headers__": {
+                    "Eth-Consensus-Version": post.fork,
+                    "Eth-Execution-Payload-Blinded": "true",
+                    "Eth-Execution-Payload-Value": val,
+                    "Eth-Consensus-Block-Value": "0",
+                },
+            }
+        # blobs_bundle is a plain dict {commitments, proofs, blobs}
+        # (execution/engine.py GetPayloadResponse)
+        bundle = bundle or {}
+        blobs = list(bundle.get("blobs") or [])
+        block, post = chain.produce_block(
+            slot_i,
+            reveal,
+            execution_payload=engine_payload,
+            blobs=blobs or None,
+            work=work,
+            **common,
+        )
+        t = self.types.by_fork[post.fork].BeaconBlock
+        data = to_json(t, block)
+        fork = post.fork
+        if ForkSeq[fork] >= ForkSeq.deneb:
+            data = {
+                "block": data,
+                "kzg_proofs": [
+                    "0x" + bytes(p).hex()
+                    for p in (bundle.get("proofs") or [])
+                ],
+                "blobs": ["0x" + bytes(b).hex() for b in blobs],
+            }
+        val = str(engine_value)
+        return {
+            "version": fork,
+            "data": data,
+            "execution_payload_blinded": False,
+            "execution_payload_value": val,
+            "consensus_block_value": "0",
+            "__headers__": {
+                "Eth-Consensus-Version": fork,
+                "Eth-Execution-Payload-Blinded": "false",
+                "Eth-Execution-Payload-Value": val,
+                "Eth-Consensus-Block-Value": "0",
+            },
+        }
+
+    def _produce_pool_inputs(self, slot_i: int) -> dict:
+        """Op-pool harvest shared by produceBlockV2/V3."""
         atts = []
         sync_aggregate = None
         if self.node is not None:
@@ -700,61 +969,7 @@ class BeaconApiImpl:
                 sync_aggregate = contrib.get_sync_aggregate(
                     slot_i - 1, self.chain.head_root
                 )
-        block, post = self.chain.produce_block(
-            slot_i,
-            bytes.fromhex(randao_reveal.removeprefix("0x")),
-            attestations=atts,
-            sync_aggregate=sync_aggregate,
-            graffiti=(
-                bytes.fromhex(graffiti.removeprefix("0x")).ljust(32, b"\x00")
-                if graffiti
-                else b"\x00" * 32
-            ),
-        )
-        t = self.types.by_fork[post.fork].BeaconBlock
-        return {"version": post.fork, **{"data": to_json(t, block)}}
-
-    def produce_block_v3(
-        self,
-        slot: str,
-        randao_reveal: str,
-        graffiti: str = "",
-        skip_randao_verification: str = "",
-        builder_boost_factor: str = "",
-    ) -> dict:
-        """routes/validator.ts produceBlockV3. This node builds full
-        (non-blinded) local blocks, so Eth-Execution-Payload-Blinded is
-        always false and builder_boost_factor (a relative builder-bid
-        weighting) never changes the choice. Pre-deneb `data` is the
-        BeaconBlock; deneb+ it is BlockContents {block, kzg_proofs,
-        blobs} (this chain's local production carries no mempool
-        blobs, so both lists are empty unless the EL supplied some).
-        The spec's envelope response headers ride the __headers__
-        convention (api/server.py emits + strips them)."""
-        if skip_randao_verification in ("1", "true", "True"):
-            # spec: stub reveal, production must not verify it — this
-            # node's production path never verifies the reveal against
-            # the proposer key (the SIGNED block gets full validation
-            # on import), so the flag is accepted as a no-op
-            pass
-        out = self.produce_block_v2(slot, randao_reveal, graffiti)
-        fork = out["version"]
-        if ForkSeq[fork] >= ForkSeq.deneb:
-            out["data"] = {
-                "block": out["data"],
-                "kzg_proofs": [],
-                "blobs": [],
-            }
-        out["execution_payload_blinded"] = False
-        out["execution_payload_value"] = "0"
-        out["consensus_block_value"] = "0"
-        out["__headers__"] = {
-            "Eth-Consensus-Version": fork,
-            "Eth-Execution-Payload-Blinded": "false",
-            "Eth-Execution-Payload-Value": "0",
-            "Eth-Consensus-Block-Value": "0",
-        }
-        return out
+        return {"atts": atts, "sync_aggregate": sync_aggregate}
 
     # -- node: identity / peers -------------------------------------------
 

@@ -84,6 +84,20 @@ ROUTES: list[Route] = [
         "publish_block_json",
         raw_body=True,
     ),
+    Route(
+        "publishBlindedBlock",
+        "POST",
+        "/eth/v1/beacon/blinded_blocks",
+        "publish_blinded_block_json",
+        raw_body=True,
+    ),
+    Route(
+        "publishBlindedBlockV2",
+        "POST",
+        "/eth/v2/beacon/blinded_blocks",
+        "publish_blinded_block_json",
+        raw_body=True,
+    ),
     # pools
     Route(
         "submitPoolAttestations",

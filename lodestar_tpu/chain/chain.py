@@ -617,12 +617,13 @@ class BeaconChain:
     async def prepare_execution_payload(self, slot: int, work):
         """fcU with attributes + getPayload for block production
         (reference: prepareExecutionPayload, produceBlockBody.ts:373).
-        Returns (payload, blobs_bundle|None)."""
+        Returns (payload, blobs_bundle|None, block_value) — the value
+        weighs against builder bids in produceBlockV3's race."""
         payload_id = await self.send_payload_attributes(slot, work)
         if payload_id is None:
-            return None, None
+            return None, None, 0
         got = await self.execution_engine.get_payload(work.fork, payload_id)
-        return got.execution_payload, got.blobs_bundle
+        return got.execution_payload, got.blobs_bundle, got.block_value
 
     def _persist_import(self, block_root, signed_block, work) -> None:
         """Write-through on import (importBlock.ts writeBlockInputToDb +
@@ -701,7 +702,10 @@ class BeaconChain:
         voluntary_exits=(),
         bls_to_execution_changes=(),
         execution_payload=None,
+        execution_payload_header=None,
         blobs=None,
+        blob_kzg_commitments=None,
+        work=None,
     ):
         """Assemble + run the unsigned block, returning (block, post_view).
         Reference: produceBlockWrapper/produceBlockBody (chain.ts:648,
@@ -709,15 +713,27 @@ class BeaconChain:
         strings) get committed into body.blob_kzg_commitments; the
         caller wraps them into sidecars after signing
         (chain/blobs.blob_sidecars_from_block — the reference returns
-        block contents from produceBlockV3 the same way)."""
+        block contents from produceBlockV3 the same way).
+        `execution_payload_header` (a builder bid's header,
+        produceBlockBody.ts:192 blinded path) produces a
+        BlindedBeaconBlock instead — mutually exclusive with
+        `execution_payload`; `blob_kzg_commitments` sets the blinded
+        body's commitments from the bid. `work` (a PRIVATE clone
+        already advanced to `slot`) skips the re-advance — callers
+        like produce_block_v3 already paid that epoch transition."""
         types = self.types
-        head = self.get_or_regen_state(self.head_root)
-        work = _clone(head, types)
-        process_slots(self.cfg, work, slot, types)
+        if work is None:
+            head = self.get_or_regen_state(self.head_root)
+            work = _clone(head, types)
+            process_slots(self.cfg, work, slot, types)
         st = work.state
         ns = types.by_fork[work.fork]
+        blinded = execution_payload_header is not None
+        assert not (blinded and execution_payload is not None)
 
-        block = ns.BeaconBlock.default()
+        block = (
+            ns.BlindedBeaconBlock if blinded else ns.BeaconBlock
+        ).default()
         block.slot = slot
         block.proposer_index = util.get_beacon_proposer_index(
             st, electra=work.fork_seq >= ForkSeq.electra
@@ -725,7 +741,9 @@ class BeaconChain:
         block.parent_root = types.BeaconBlockHeader.hash_tree_root(
             st.latest_block_header
         )
-        body = ns.BeaconBlockBody.default()
+        body = (
+            ns.BlindedBeaconBlockBody if blinded else ns.BeaconBlockBody
+        ).default()
         body.randao_reveal = randao_reveal
         body.eth1_data = st.eth1_data
         body.graffiti = graffiti
@@ -744,20 +762,28 @@ class BeaconChain:
         if work.fork_seq >= ForkSeq.capella:
             body.bls_to_execution_changes = list(bls_to_execution_changes)
         if work.fork_seq >= ForkSeq.bellatrix:
-            body.execution_payload = (
-                execution_payload
-                if execution_payload is not None
-                else self._build_dev_payload(work, slot)
-            )
-        if work.fork_seq >= ForkSeq.deneb and blobs:
-            from ..crypto import kzg as _kzg
+            if blinded:
+                body.execution_payload_header = execution_payload_header
+            else:
+                body.execution_payload = (
+                    execution_payload
+                    if execution_payload is not None
+                    else self._build_dev_payload(work, slot)
+                )
+        if work.fork_seq >= ForkSeq.deneb:
+            if blob_kzg_commitments is not None:
+                body.blob_kzg_commitments = list(blob_kzg_commitments)
+            elif blobs:
+                from ..crypto import kzg as _kzg
 
-            body.blob_kzg_commitments = [
-                _kzg.blob_to_kzg_commitment(b) for b in blobs
-            ]
+                body.blob_kzg_commitments = [
+                    _kzg.blob_to_kzg_commitment(b) for b in blobs
+                ]
         block.body = body
 
-        signed = ns.SignedBeaconBlock.default()
+        signed = (
+            ns.SignedBlindedBeaconBlock if blinded else ns.SignedBeaconBlock
+        ).default()
         signed.message = block
         state_transition(
             self.cfg,

@@ -54,9 +54,13 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     beacon.add_argument("--discovery-port", type=int, default=0)
     beacon.add_argument(
-        "--network-core-thread", action="store_true",
+        "--network-core-thread",
+        action=argparse.BooleanOptionalAction,
+        default=True,
         help="run the wire stack on a dedicated thread "
-        "(networkCoreWorker analog)",
+        "(networkCoreWorker analog; default ON, matching the "
+        "reference's useWorker=true — network/options.ts:36; "
+        "--no-network-core-thread for in-loop)",
     )
     beacon.add_argument(
         "--bootnodes", default=None,
@@ -274,7 +278,7 @@ async def _run_beacon(args) -> int:
         metrics_port=args.metrics_port,
         tcp_port=args.port,
         udp_port=args.discovery_port,
-        network_isolated=getattr(args, "network_core_thread", False),
+        network_isolated=getattr(args, "network_core_thread", True),
         bootnodes=bootnodes,
         execution_url=args.execution_url,
         jwt_secret=jwt_secret,

@@ -24,6 +24,9 @@ class BuilderBid:
     header: object  # ExecutionPayloadHeader value
     value: int
     pubkey: bytes
+    # deneb+: the bid commits to its blob set (builder-specs
+    # BuilderBid.blob_kzg_commitments); None = pre-deneb / not provided
+    blob_kzg_commitments: list | None = None
 
 
 class ExecutionBuilderHttp:
@@ -87,14 +90,25 @@ class ExecutionBuilderHttp:
         hdr = msg["header"]
         fork = out.get("version", "bellatrix")
         header = self._header_from_json(fork, hdr)
+        comms = msg.get("blob_kzg_commitments")
         return BuilderBid(
             header=header,
             value=int(msg["value"]),
             pubkey=bytes.fromhex(msg["pubkey"].removeprefix("0x")),
+            blob_kzg_commitments=(
+                [
+                    bytes.fromhex(c.removeprefix("0x"))
+                    for c in comms
+                ]
+                if comms is not None
+                else None
+            ),
         )
 
-    async def submit_blinded_block(self, fork: str, signed_blinded) -> object:
-        """Reveal: returns the full ExecutionPayload."""
+    async def submit_blinded_block(self, fork: str, signed_blinded):
+        """Reveal: returns the full ExecutionPayload, or (payload,
+        blobs_bundle dict) when the relay answers with deneb's
+        ExecutionPayloadAndBlobsBundle."""
         from .engine import payload_from_json
 
         t = self.types.by_fork[fork].SignedBlindedBeaconBlock
@@ -104,7 +118,25 @@ class ExecutionBuilderHttp:
             {"signature": "0x" + bytes(signed_blinded.signature).hex(),
              "message_ssz": t.serialize(signed_blinded).hex()},
         )
-        return payload_from_json(self.types, fork, out["data"])
+        data = out["data"]
+        if isinstance(data, dict) and "execution_payload" in data:
+            payload = payload_from_json(
+                self.types, fork, data["execution_payload"]
+            )
+            bb = data.get("blobs_bundle") or {}
+
+            def _unhex(xs):
+                return [
+                    bytes.fromhex(x.removeprefix("0x")) for x in xs
+                ]
+
+            bundle = {
+                "commitments": _unhex(bb.get("commitments", [])),
+                "proofs": _unhex(bb.get("proofs", [])),
+                "blobs": _unhex(bb.get("blobs", [])),
+            }
+            return payload, bundle
+        return payload_from_json(self.types, fork, data)
 
     def _header_from_json(self, fork: str, obj: dict):
         from .engine import from_data, from_quantity
@@ -127,19 +159,56 @@ class ExecutionBuilderHttp:
 
 class MockRelay:
     """In-process relay double for tests: serves bids built from a
-    template payload header and records registrations/submissions."""
+    template payload header and records registrations/submissions.
+    With `chain=` the relay builds bids from the chain's own dev
+    payload for the slot, so the unblinded block passes the real
+    state transition end-to-end (the reveal returns the stashed
+    payload the header committed to)."""
 
-    def __init__(self, types, fork: str = "bellatrix", value: int = 10**9):
+    def __init__(
+        self, types, fork: str = "bellatrix", value: int = 10**9,
+        chain=None,
+    ):
         self.types = types
         self.fork = fork
         self.value = value
+        self.chain = chain
+        self.enabled = True
         self.registrations: list = []
         self.submissions: list = []
+        self._payloads: dict[bytes, object] = {}
 
     async def register_validators(self, registrations) -> None:
         self.registrations.extend(registrations)
 
+    def _header_of(self, fork: str, payload):
+        from ..statetransition.block import payload_to_header
+
+        return payload_to_header(self.types.by_fork[fork], payload)
+
     async def get_header(self, slot, parent_hash, pubkey):
+        if self.chain is not None:
+            from ..chain.chain import _clone
+            from ..statetransition.slot import process_slots
+
+            work = _clone(
+                self.chain.get_or_regen_state(self.chain.head_root),
+                self.types,
+            )
+            process_slots(self.chain.cfg, work, int(slot), self.types)
+            payload = self.chain._build_dev_payload(work, int(slot))
+            self._payloads[bytes(payload.block_hash)] = (
+                work.fork, payload
+            )
+            hdr = self._header_of(work.fork, payload)
+            from ..params import ForkSeq
+
+            return BuilderBid(
+                header=hdr, value=self.value, pubkey=b"\x00" * 48,
+                blob_kzg_commitments=(
+                    [] if work.fork_seq >= ForkSeq.deneb else None
+                ),
+            )
         hdr = self.types.by_fork[self.fork].ExecutionPayloadHeader.default()
         hdr.parent_hash = bytes(parent_hash)
         hdr.block_number = slot
@@ -148,6 +217,11 @@ class MockRelay:
 
     async def submit_blinded_block(self, fork, signed_blinded):
         self.submissions.append(signed_blinded)
+        want = bytes(
+            signed_blinded.message.body.execution_payload_header.block_hash
+        )
+        if want in self._payloads:
+            return self._payloads[want][1]
         payload = self.types.by_fork[fork].ExecutionPayload.default()
         payload.block_hash = b"\x42" * 32
         payload.block_number = int(signed_blinded.message.slot)

@@ -47,7 +47,9 @@ class BeaconNode:
         tcp_port: int | None = None,
         udp_port: int = 0,
         bootnodes: list[tuple[str, int]] | None = None,
-        network_isolated: bool = False,
+        # isolation is the production default, matching the
+        # reference's useWorker=true (network/options.ts:36)
+        network_isolated: bool = True,
         # -- execution layer --
         execution_url: str | None = None,
         jwt_secret: bytes | None = None,

@@ -182,7 +182,15 @@ def process_block_header(ctx, block) -> None:
     header.proposer_index = block.proposer_index
     header.parent_root = block.parent_root
     header.state_root = b"\x00" * 32
-    body_type = types.by_fork[_fork_name(ctx.fork_seq)].BeaconBlockBody
+    ns = types.by_fork[_fork_name(ctx.fork_seq)]
+    # blinded bodies hash with the blinded type — the root is identical
+    # to the full body's (header commits to the payload field-by-field)
+    body_type = (
+        ns.BeaconBlockBody
+        if hasattr(block.body, "execution_payload")
+        or not hasattr(ns, "BlindedBeaconBlockBody")
+        else ns.BlindedBeaconBlockBody
+    )
     header.body_root = body_type.hash_tree_root(block.body)
     state.latest_block_header = header
     _req(
@@ -845,9 +853,18 @@ def compute_timestamp_at_slot(cfg, state, slot: int) -> int:
 
 
 def process_execution_payload(ctx, body, execution_engine=None) -> None:
+    """Handles full AND blinded bodies: a blinded body carries the
+    ExecutionPayloadHeader whose parent/randao/timestamp fields are
+    checked identically and which becomes latest_execution_payload_
+    header directly (reference: processExecutionPayload over
+    FullOrBlindedExecutionPayload)."""
     state, cfg, types = ctx.state, ctx.cfg, ctx.types
     p = preset()
-    payload = body.execution_payload
+    blinded = not hasattr(body, "execution_payload")
+    payload = (
+        body.execution_payload_header if blinded
+        else body.execution_payload
+    )
     if ctx.fork_seq >= ForkSeq.capella or is_merge_transition_complete(ctx):
         _req(
             bytes(payload.parent_hash)
@@ -873,12 +890,25 @@ def process_execution_payload(ctx, body, execution_engine=None) -> None:
             len(body.blob_kzg_commitments) <= max_blobs,
             "too many blobs",
         )
-    if execution_engine is not None:
+    if execution_engine is not None and not blinded:
         _req(
             execution_engine.notify_new_payload(payload),
             "execution engine rejected payload",
         )
     ns = types.by_fork[_fork_name(ctx.fork_seq)]
+    if blinded:
+        header = ns.ExecutionPayloadHeader.default()
+        for name, _ in ns.ExecutionPayloadHeader.fields:
+            setattr(header, name, getattr(payload, name))
+    else:
+        header = payload_to_header(ns, payload)
+    state.latest_execution_payload_header = header
+
+
+def payload_to_header(ns, payload):
+    """ExecutionPayload -> ExecutionPayloadHeader (list fields become
+    their hash-tree-roots). Shared by the state transition and the
+    builder/relay machinery — the commitment rules must never drift."""
     header = ns.ExecutionPayloadHeader.default()
     for name, _ in ns.ExecutionPayloadHeader.fields:
         if name == "transactions_root":
@@ -888,10 +918,12 @@ def process_execution_payload(ctx, body, execution_engine=None) -> None:
             )
         elif name == "withdrawals_root":
             w_t = ns.ExecutionPayload.field_types["withdrawals"]
-            header.withdrawals_root = w_t.hash_tree_root(payload.withdrawals)
+            header.withdrawals_root = w_t.hash_tree_root(
+                payload.withdrawals
+            )
         else:
             setattr(header, name, getattr(payload, name))
-    state.latest_execution_payload_header = header
+    return header
 
 
 def is_fully_withdrawable_validator(
@@ -1001,15 +1033,29 @@ def get_expected_withdrawals(ctx):
 
 
 def process_withdrawals(ctx, payload) -> None:
+    """`payload` is an ExecutionPayload OR (blinded blocks) an
+    ExecutionPayloadHeader — the header commits to the withdrawals via
+    withdrawals_root, checked against the expected list's root
+    (reference: processWithdrawals over BlindedBeaconBlock bodies)."""
     state, types = ctx.state, ctx.types
     p = preset()
     expected, partial_count = get_expected_withdrawals(ctx)
-    got = list(payload.withdrawals)
-    _req(len(got) == len(expected), "withdrawals count mismatch")
-    for a, b in zip(got, expected):
+    if hasattr(payload, "withdrawals"):
+        got = list(payload.withdrawals)
+        _req(len(got) == len(expected), "withdrawals count mismatch")
+        for a, b in zip(got, expected):
+            _req(
+                types.Withdrawal.serialize(a)
+                == types.Withdrawal.serialize(b),
+                "withdrawal mismatch",
+            )
+    else:
+        ns = types.by_fork[_fork_name(ctx.fork_seq)]
+        w_t = ns.ExecutionPayload.field_types["withdrawals"]
         _req(
-            types.Withdrawal.serialize(a) == types.Withdrawal.serialize(b),
-            "withdrawal mismatch",
+            bytes(payload.withdrawals_root)
+            == w_t.hash_tree_root(expected),
+            "withdrawals root mismatch",
         )
     for w in expected:
         decrease_balance(state, int(w.validator_index), int(w.amount))
@@ -1323,8 +1369,16 @@ def process_block(
     """Spec process_block for the given fork."""
     ctx = BlockCtx(cfg, state, types, fork_seq, verify_signatures)
     process_block_header(ctx, block)
+    blinded = fork_seq >= ForkSeq.bellatrix and not hasattr(
+        block.body, "execution_payload"
+    )
     if fork_seq >= ForkSeq.capella:
-        process_withdrawals(ctx, block.body.execution_payload)
+        process_withdrawals(
+            ctx,
+            block.body.execution_payload_header
+            if blinded
+            else block.body.execution_payload,
+        )
     if fork_seq >= ForkSeq.bellatrix and (
         fork_seq >= ForkSeq.capella or is_merge_transition_complete(ctx)
         or _has_execution_payload(ctx, block.body)
@@ -1339,7 +1393,12 @@ def process_block(
 
 def _has_execution_payload(ctx, body) -> bool:
     """bellatrix is_execution_enabled: payload present (non-default) or
-    merge already complete."""
+    merge already complete. Blinded bodies compare the header."""
     ns = ctx.types.by_fork[_fork_name(ctx.fork_seq)]
+    if not hasattr(body, "execution_payload"):
+        t = ns.ExecutionPayloadHeader
+        return t.serialize(body.execution_payload_header) != t.serialize(
+            t.default()
+        )
     t = ns.ExecutionPayload
     return t.serialize(body.execution_payload) != t.serialize(t.default())

@@ -413,6 +413,10 @@ def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
     if count == 0:
         return np.zeros(0, np.int64)
     p = preset()
+    if count >= 2**31:
+        # int64 reference path BEFORE the int32 XLA fast path, which
+        # would overflow (VALIDATOR_REGISTRY_LIMIT is 2^40)
+        return _compute_shuffling_int64(count, seed, None)
     idx = np.arange(count, dtype=np.int64)
     n_blocks = (count + 255) // 256
     rounds = p.SHUFFLE_ROUND_COUNT
@@ -446,13 +450,11 @@ def compute_shuffling(count: int, seed: bytes) -> np.ndarray:
         if fast is not None:
             return fast
     # int32 lanes + branch-free bit ops per round. VALIDATOR_REGISTRY_
-    # LIMIT is 2^40, so int32 is NOT spec-guaranteed — it is guarded
-    # here (any registry that large is far beyond practical reach; the
-    # int64 reference path below handles it). The only non-power-of-two
-    # modulo ((pivot - idx) mod count) reduces to one conditional add
-    # since pivot - idx is in (-count, count).
-    if count >= 2**31:
-        return _compute_shuffling_int64(count, seed, blocks_all)
+    # LIMIT is 2^40, so int32 is NOT spec-guaranteed — registries
+    # >= 2^31 were diverted to the int64 path at the top of this
+    # function before any int32 work. The only non-power-of-two modulo
+    # ((pivot - idx) mod count) reduces to one conditional add since
+    # pivot - idx is in (-count, count).
     idx32 = idx.astype(np.int32)
     cnt = np.int32(count)
     for r in range(rounds):

@@ -59,16 +59,24 @@ class ValidatorStore:
     # -- signing ---------------------------------------------------------
 
     def sign_block(self, index: int, block, fork_name: str):
+        """Signs full AND blinded blocks (validatorStore.ts
+        signBlock over allForks.FullOrBlindedBeaconBlock): the blinded
+        root equals the full root, so slashing protection and the
+        domain are identical — only the SSZ type differs."""
         epoch = int(block.slot) // preset().SLOTS_PER_EPOCH
         self._check_doppelganger(index, epoch)
         ns = self.types.by_fork[fork_name]
-        root = ns.BeaconBlock.hash_tree_root(block)
+        blinded = hasattr(block.body, "execution_payload_header")
+        block_t = ns.BlindedBeaconBlock if blinded else ns.BeaconBlock
+        root = block_t.hash_tree_root(block)
         domain = self.beacon_cfg.get_domain(DOMAIN_BEACON_PROPOSER, epoch)
         signing_root = compute_signing_root_from_roots(root, domain)
         self.slashing_protection.check_and_insert_block_proposal(
             self.pubkeys[index], int(block.slot), signing_root
         )
-        signed = ns.SignedBeaconBlock.default()
+        signed = (
+            ns.SignedBlindedBeaconBlock if blinded else ns.SignedBeaconBlock
+        ).default()
         signed.message = block
         signed.signature = sign(self.sks[index], signing_root)
         return signed

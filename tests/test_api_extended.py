@@ -381,7 +381,9 @@ class TestProduceBlockV3:
                     ssz_uint64.hash_tree_root(epoch), domain
                 ),
             )
-            out = impl.produce_block_v3(str(slot), "0x" + randao.hex())
+            out = await impl.produce_block_v3(
+                str(slot), "0x" + randao.hex()
+            )
             assert out["execution_payload_blinded"] is False
             assert (
                 out["__headers__"]["Eth-Execution-Payload-Blinded"]

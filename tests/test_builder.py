@@ -63,6 +63,103 @@ class TestBlindedTypes:
         assert t.deserialize(t.serialize(b)).message.slot == 77
 
 
+class TestBlindedProductionRace:
+    def test_builder_wins_vc_signs_unblinded_imports(self, types):
+        """VERDICT r4 next #4 done-criterion: the relay wins the race
+        (no engine -> bid wins), produce_block_v3 returns a BLINDED
+        block with the spec envelope headers, the VC signs it, and the
+        publish_blinded_block unblinding path imports the full block
+        into the chain."""
+        from types import SimpleNamespace
+
+        from lodestar_tpu.api.impl import BeaconApiImpl
+        from lodestar_tpu.api.json_codec import to_json
+        from lodestar_tpu.chain import DevNode
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.config.beacon_config import (
+            BeaconConfig,
+            compute_signing_root_from_roots,
+        )
+        from lodestar_tpu.crypto.bls.signature import sign
+        from lodestar_tpu.params import DOMAIN_RANDAO, preset
+        from lodestar_tpu.ssz import uint64 as ssz_uint64
+        from lodestar_tpu.validator.store import ValidatorStore
+
+        FAR = 2**64 - 1
+        cfg = ChainConfig(
+            ALTAIR_FORK_EPOCH=0,
+            BELLATRIX_FORK_EPOCH=0,
+            CAPELLA_FORK_EPOCH=FAR,
+            DENEB_FORK_EPOCH=FAR,
+            ELECTRA_FORK_EPOCH=FAR,
+            SHARD_COMMITTEE_PERIOD=0,
+        )
+
+        async def go():
+            node = DevNode(cfg, types, 16, verify_attestations=False)
+            chain = node.chain
+            relay = MockRelay(types, chain=chain, value=10**9)
+            fake_node = SimpleNamespace(
+                builder=relay, att_pool=None, contrib_pool=None,
+                network=None, processor=None,
+            )
+            impl = BeaconApiImpl(cfg, types, chain, node=fake_node)
+            await node.advance_slot()
+            slot = node.slot + 1
+            epoch = slot // preset().SLOTS_PER_EPOCH
+            duties = impl.get_proposer_duties(epoch)
+            vi = next(
+                int(d["validator_index"])
+                for d in duties
+                if int(d["slot"]) == slot
+            )
+            gvr = bytes(
+                chain.head_state.state.genesis_validators_root
+            )
+            bc = BeaconConfig(cfg, gvr)
+            domain = bc.get_domain(DOMAIN_RANDAO, epoch)
+            randao = sign(
+                node.sks[vi],
+                compute_signing_root_from_roots(
+                    ssz_uint64.hash_tree_root(epoch), domain
+                ),
+            )
+            out = await impl.produce_block_v3(
+                str(slot), "0x" + randao.hex()
+            )
+            assert out["execution_payload_blinded"] is True
+            assert (
+                out["__headers__"]["Eth-Execution-Payload-Blinded"]
+                == "true"
+            )
+            assert out["execution_payload_value"] == str(10**9)
+            fork = out["version"]
+            assert fork == "bellatrix"
+            # VC signs the blinded block (same signing root as full)
+            from lodestar_tpu.api.json_codec import from_json
+
+            ns = types.by_fork[fork]
+            blinded = from_json(ns.BlindedBeaconBlock, out["data"])
+            store = ValidatorStore(bc, types, node.sks)
+            signed_blinded = store.sign_block(vi, blinded, fork)
+            assert hasattr(
+                signed_blinded.message.body, "execution_payload_header"
+            )
+            # unblinding publish path: relay reveals, full block imports
+            body = to_json(ns.SignedBlindedBeaconBlock, signed_blinded)
+            before = chain.head_root
+            await impl.publish_blinded_block_json(body)
+            assert relay.submissions, "relay never saw the reveal"
+            assert chain.head_root != before
+            head_blk = chain.get_block(chain.head_root)
+            assert int(head_blk.message.slot) == slot
+            # the imported block is FULL (payload, not header)
+            assert hasattr(head_blk.message.body, "execution_payload")
+            await node.close()
+
+        asyncio.run(go())
+
+
 class TestMockRelayFlow:
     def test_bid_and_reveal(self, types):
         relay = MockRelay(types, fork="capella")

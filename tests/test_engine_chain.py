@@ -108,11 +108,12 @@ class TestEngineIntegration:
 
         async def go():
             # seed the engine head with genesis exec hash
-            payload, bundle = await chain.prepare_execution_payload(
+            payload, bundle, value = await chain.prepare_execution_payload(
                 1, _advanced(chain, 1)
             )
             assert payload is not None
             assert bundle is None
+            assert value == 10**9  # MockExecutionEngine block value
             # devnode flow with the engine payload
             await node.advance_slot()
             await node.close()
