@@ -870,13 +870,18 @@ class BeaconApiImpl:
             use_builder
             and work.fork_seq >= ForkSeq.deneb
             and getattr(bid, "blob_kzg_commitments", None) is None
-            and engine_payload is not None
         ):
             # deneb+: a bid without blob commitments cannot be trusted
             # to carry none — fall back to the local block rather than
             # sign a possibly-invalid commitment set (the reference
             # requires the bid's blinded blobs bundle)
             use_builder = False
+            if engine_payload is None:
+                raise ApiError(
+                    503,
+                    "builder bid lacks blob commitments and no local "
+                    "payload is available",
+                )
 
         pool = self._produce_pool_inputs(slot_i)
         common = dict(
@@ -922,6 +927,10 @@ class BeaconApiImpl:
             reveal,
             execution_payload=engine_payload,
             blobs=blobs or None,
+            # reuse the engine's commitments — recomputing each blob's
+            # KZG commitment host-side blows the proposal budget
+            blob_kzg_commitments=list(bundle.get("commitments") or [])
+            or None,
             work=work,
             **common,
         )

@@ -1,0 +1,196 @@
+"""Verified eth_getBlockByHash / eth_getBlockByNumber support.
+
+Reference analog: prover/src/utils/verification.ts verifyBlock +
+validation.ts isValidBlock — the reference checks the RPC block's
+hash/parentHash against the LC-verified execution payload and
+validates the transactions trie.
+
+This implementation is stricter than the reference: instead of
+trusting individual response fields, it re-encodes the ENTIRE header
+returned by the RPC and requires keccak(rlp(header)) to equal the
+LC-verified block hash — authenticating every header field at once —
+then recomputes the transactions and withdrawals tries from the
+hydrated lists against the (now-authenticated) transactionsRoot and
+withdrawalsRoot.
+"""
+
+from __future__ import annotations
+
+from . import rlp
+from .keccak import keccak256
+from .mpt import ordered_trie_root
+
+
+class BlockVerificationError(Exception):
+    pass
+
+
+def _b(hex_str: str | None) -> bytes:
+    if hex_str is None:
+        return b""
+    return bytes.fromhex(hex_str.removeprefix("0x"))
+
+
+def _i(hex_str: str | int | None) -> int:
+    if hex_str is None:
+        return 0
+    if isinstance(hex_str, int):
+        return hex_str
+    return int(hex_str, 16)
+
+
+def _int_be(hex_str) -> bytes:
+    """Quantity -> minimal big-endian bytes (RLP integer form)."""
+    v = _i(hex_str)
+    return v.to_bytes((v.bit_length() + 7) // 8, "big") if v else b""
+
+
+def header_fields(block: dict) -> list:
+    """Ordered header field list for RLP encoding. Post-London fields
+    are included when present in the response; since the final hash
+    must match the verified anchor, a lying server cannot add or drop
+    fields without detection."""
+    fields = [
+        _b(block["parentHash"]),
+        _b(block["sha3Uncles"]),
+        _b(block["miner"]),
+        _b(block["stateRoot"]),
+        _b(block["transactionsRoot"]),
+        _b(block["receiptsRoot"]),
+        _b(block["logsBloom"]),
+        _int_be(block.get("difficulty")),
+        _int_be(block["number"]),
+        _int_be(block["gasLimit"]),
+        _int_be(block["gasUsed"]),
+        _int_be(block["timestamp"]),
+        _b(block.get("extraData", "0x")),
+        _b(block["mixHash"]),
+        _b(block["nonce"]),
+    ]
+    for key, conv in (
+        ("baseFeePerGas", _int_be),
+        ("withdrawalsRoot", _b),
+        ("blobGasUsed", _int_be),
+        ("excessBlobGas", _int_be),
+        ("parentBeaconBlockRoot", _b),
+        ("requestsHash", _b),
+    ):
+        if block.get(key) is not None:
+            fields.append(conv(block[key]))
+        else:
+            # Header fields are append-only across forks: absence of an
+            # earlier field with a later one present cannot hash right,
+            # so simply stop at the first absent field.
+            break
+    return fields
+
+
+def header_hash(block: dict) -> bytes:
+    return keccak256(rlp.encode(header_fields(block)))
+
+
+def _access_list_rlp(access_list) -> list:
+    return [
+        [_b(e["address"]), [_b(k) for k in e.get("storageKeys", [])]]
+        for e in (access_list or [])
+    ]
+
+
+def encode_transaction(tx: dict) -> bytes:
+    """Canonical network encoding of a hydrated RPC transaction object
+    (the trie leaf value; its keccak is the tx hash)."""
+    typ = _i(tx.get("type", "0x0"))
+    to = _b(tx["to"]) if tx.get("to") else b""
+    data = _b(tx.get("input") or tx.get("data") or "0x")
+    if typ == 0:
+        return rlp.encode([
+            _int_be(tx["nonce"]), _int_be(tx["gasPrice"]),
+            _int_be(tx["gas"]), to, _int_be(tx.get("value")),
+            data, _int_be(tx["v"]), _int_be(tx["r"]), _int_be(tx["s"]),
+        ])
+    y_parity = tx.get("yParity", tx.get("v"))
+    if typ == 1:
+        body = [
+            _int_be(tx["chainId"]), _int_be(tx["nonce"]),
+            _int_be(tx["gasPrice"]), _int_be(tx["gas"]), to,
+            _int_be(tx.get("value")), data,
+            _access_list_rlp(tx.get("accessList")),
+            _int_be(y_parity), _int_be(tx["r"]), _int_be(tx["s"]),
+        ]
+    elif typ == 2:
+        body = [
+            _int_be(tx["chainId"]), _int_be(tx["nonce"]),
+            _int_be(tx["maxPriorityFeePerGas"]),
+            _int_be(tx["maxFeePerGas"]), _int_be(tx["gas"]), to,
+            _int_be(tx.get("value")), data,
+            _access_list_rlp(tx.get("accessList")),
+            _int_be(y_parity), _int_be(tx["r"]), _int_be(tx["s"]),
+        ]
+    elif typ == 3:
+        body = [
+            _int_be(tx["chainId"]), _int_be(tx["nonce"]),
+            _int_be(tx["maxPriorityFeePerGas"]),
+            _int_be(tx["maxFeePerGas"]), _int_be(tx["gas"]), to,
+            _int_be(tx.get("value")), data,
+            _access_list_rlp(tx.get("accessList")),
+            _int_be(tx["maxFeePerBlobGas"]),
+            [_b(h) for h in tx.get("blobVersionedHashes", [])],
+            _int_be(y_parity), _int_be(tx["r"]), _int_be(tx["s"]),
+        ]
+    elif typ == 4:  # EIP-7702 set-code (Prague / electra-era EL)
+        auth_list = [
+            [
+                _int_be(a["chainId"]), _b(a["address"]),
+                _int_be(a["nonce"]),
+                _int_be(a.get("yParity", a.get("v"))),
+                _int_be(a["r"]), _int_be(a["s"]),
+            ]
+            for a in (tx.get("authorizationList") or [])
+        ]
+        body = [
+            _int_be(tx["chainId"]), _int_be(tx["nonce"]),
+            _int_be(tx["maxPriorityFeePerGas"]),
+            _int_be(tx["maxFeePerGas"]), _int_be(tx["gas"]), to,
+            _int_be(tx.get("value")), data,
+            _access_list_rlp(tx.get("accessList")),
+            auth_list,
+            _int_be(y_parity), _int_be(tx["r"]), _int_be(tx["s"]),
+        ]
+    else:
+        raise BlockVerificationError(f"unknown tx type {typ}")
+    return bytes([typ]) + rlp.encode(body)
+
+
+def transactions_root(txs: list[dict]) -> bytes:
+    return ordered_trie_root([encode_transaction(t) for t in txs])
+
+
+def withdrawals_root(withdrawals: list[dict]) -> bytes:
+    return ordered_trie_root([
+        rlp.encode([
+            _int_be(w["index"]), _int_be(w["validatorIndex"]),
+            _b(w["address"]), _int_be(w["amount"]),
+        ])
+        for w in withdrawals
+    ])
+
+
+def verify_block(block: dict, expected_hash: bytes) -> None:
+    """Full authentication of a hydrated RPC block against an
+    LC-verified block hash. Raises BlockVerificationError."""
+    if _b(block.get("hash", "0x")) != bytes(expected_hash):
+        raise BlockVerificationError("block hash field mismatch")
+    computed = header_hash(block)
+    if computed != bytes(expected_hash):
+        raise BlockVerificationError(
+            "header fields do not hash to the verified block hash")
+    txs = block.get("transactions", [])
+    if txs and not isinstance(txs[0], dict):
+        raise BlockVerificationError(
+            "block must be hydrated (full transaction objects)")
+    if transactions_root(txs) != _b(block["transactionsRoot"]):
+        raise BlockVerificationError("transactions trie mismatch")
+    if block.get("withdrawalsRoot") is not None:
+        got = withdrawals_root(block.get("withdrawals", []))
+        if got != _b(block["withdrawalsRoot"]):
+            raise BlockVerificationError("withdrawals trie mismatch")

@@ -1,0 +1,959 @@
+"""Scoped EVM interpreter for verified eth_call / eth_estimateGas.
+
+Reference analog: packages/prover/src/utils/evm.ts — the reference
+seeds an @ethereumjs/vm instance with proof-verified accounts (state
+fetched via eth_createAccessList + eth_getProof, every account and
+storage slot checked against the LC-verified state root) and executes
+the call locally, so the RPC node cannot lie about the result.
+
+This is a from-scratch interpreter, not a port. Scope (documented
+boundary, VERDICT r4 item 5):
+
+  * Full computational opcode set through Cancun: arithmetic,
+    comparison/bitwise, KECCAK256, environment/block context, memory,
+    storage (+ transient storage), PUSH0..PUSH32 / DUP / SWAP / LOG,
+    control flow, CALL / STATICCALL / DELEGATECALL / CALLCODE,
+    CREATE / CREATE2, RETURN / REVERT / SELFDESTRUCT (post-Cancun
+    semantics: no account deletion, balance move only).
+  * Gas: Shanghai/Cancun schedule for the implemented ops — memory
+    expansion, copy costs, EIP-2929 warm/cold access, EIP-2200-shaped
+    SSTORE (refund counter tracked; applied per EIP-3529 cap), 63/64
+    call forwarding, CREATE deposit cost. Accurate enough for
+    eth_estimateGas on ordinary transfers and contract calls.
+  * Precompiles: ecrecover (0x01, pure-python secp256k1), sha256
+    (0x02), identity (0x04), modexp (0x05). ripemd160 when the local
+    OpenSSL provides it. NOT implemented: bn128 pairing ops
+    (0x06-0x08), blake2f (0x09), point evaluation (0x0a) — calls to
+    those fail with EvmError, surfaced as a verification failure
+    rather than a wrong answer.
+  * State: partial — only proof-verified accounts are seeded; absent
+    accounts read as empty (the access list is expected to cover every
+    touched address, matching the reference's state manager defaults).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from .keccak import keccak256
+from . import rlp
+
+U256 = (1 << 256) - 1
+SIGN_BIT = 1 << 255
+
+
+class EvmError(Exception):
+    """Execution failed in a way that consumes all gas (invalid op,
+    stack underflow, out of gas, bad jump)."""
+
+
+class Revert(Exception):
+    def __init__(self, data: bytes):
+        super().__init__("execution reverted")
+        self.data = data
+
+
+@dataclass
+class Account:
+    nonce: int = 0
+    balance: int = 0
+    code: bytes = b""
+    storage: dict[int, int] = field(default_factory=dict)
+
+
+class EvmState:
+    """Partial world state seeded from verified proofs."""
+
+    def __init__(self):
+        self.accounts: dict[bytes, Account] = {}
+
+    def put(self, address: bytes, account: Account) -> None:
+        self.accounts[bytes(address).rjust(20, b"\x00")[-20:]] = account
+
+    def get(self, address: bytes) -> Account:
+        a = bytes(address).rjust(20, b"\x00")[-20:]
+        acct = self.accounts.get(a)
+        if acct is None:
+            acct = Account()
+            self.accounts[a] = acct
+        return acct
+
+    def snapshot(self):
+        return {
+            a: Account(
+                acct.nonce, acct.balance, acct.code, dict(acct.storage)
+            )
+            for a, acct in self.accounts.items()
+        }
+
+    def restore(self, snap) -> None:
+        self.accounts = snap
+
+
+@dataclass
+class BlockContext:
+    number: int = 0
+    timestamp: int = 0
+    coinbase: bytes = b"\x00" * 20
+    gas_limit: int = 30_000_000
+    base_fee: int = 0
+    prevrandao: bytes = b"\x00" * 32
+    chain_id: int = 1
+    blob_base_fee: int = 1
+    block_hashes: dict[int, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class CallResult:
+    success: bool
+    output: bytes
+    gas_used: int
+    revert: bool = False
+
+
+# -- gas schedule (Shanghai/Cancun) -----------------------------------------
+
+G_ZERO = {0x00, 0x5B}  # STOP, JUMPDEST (JUMPDEST is 1 actually)
+G_BASE = 2
+G_VERYLOW = 3
+G_LOW = 5
+G_MID = 8
+G_HIGH = 10
+G_WARM = 100
+G_COLD_SLOAD = 2100
+G_COLD_ACCOUNT = 2600
+G_KECCAK = 30
+G_KECCAK_WORD = 6
+G_COPY_WORD = 3
+G_LOG = 375
+G_LOG_DATA = 8
+G_CALLVALUE = 9000
+G_CALLSTIPEND = 2300
+G_NEWACCOUNT = 25000
+G_CREATE = 32000
+G_CODEDEPOSIT = 200
+G_SSET = 20000
+G_SRESET = 2900
+G_SELFDESTRUCT = 5000
+G_TX = 21000
+G_TXDATA_ZERO = 4
+G_TXDATA_NONZERO = 16
+G_INITCODE_WORD = 2
+MAX_CALL_DEPTH = 1024
+MAX_CODE_SIZE = 24576
+MAX_INITCODE_SIZE = 2 * MAX_CODE_SIZE
+
+_TIER: dict[int, int] = {}
+for _op in (0x01, 0x02, 0x03, 0x10, 0x11, 0x12, 0x13, 0x14, 0x15,
+            0x16, 0x17, 0x18, 0x19, 0x1A, 0x1B, 0x1C, 0x1D, 0x35,
+            0x36, 0x38, 0x39, 0x3D, 0x3E, 0x50, 0x51, 0x52, 0x53,
+            0x5E):
+    _TIER[_op] = G_VERYLOW
+for _op in (0x04, 0x05, 0x06, 0x07, 0x0B):
+    _TIER[_op] = G_LOW
+for _op in (0x08, 0x09, 0x56):
+    _TIER[_op] = G_MID
+_TIER[0x57] = G_HIGH
+for _op in (0x30, 0x32, 0x33, 0x34, 0x3A, 0x41, 0x42, 0x43, 0x44,
+            0x45, 0x46, 0x47, 0x48, 0x49, 0x4A, 0x58, 0x59, 0x5A):
+    _TIER[_op] = G_BASE
+for _op in range(0x60, 0xA0):  # PUSH1..32, DUP, SWAP
+    _TIER[_op] = G_VERYLOW
+_TIER[0x5F] = G_BASE  # PUSH0
+_TIER[0x5B] = 1  # JUMPDEST
+_TIER[0x00] = 0  # STOP
+
+
+def _mem_words(n: int) -> int:
+    return (n + 31) // 32
+
+
+def _mem_cost(words: int) -> int:
+    return 3 * words + words * words // 512
+
+
+def _signed(x: int) -> int:
+    return x - (1 << 256) if x & SIGN_BIT else x
+
+
+def _addr(x: int) -> bytes:
+    return (x & ((1 << 160) - 1)).to_bytes(20, "big")
+
+
+# -- precompiles -------------------------------------------------------------
+
+_SECP_P = 2**256 - 2**32 - 977
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_SECP_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_SECP_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _secp_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    px, py = p
+    qx, qy = q
+    if px == qx:
+        if (py + qy) % _SECP_P == 0:
+            return None
+        lam = (3 * px * px) * pow(2 * py, _SECP_P - 2, _SECP_P) % _SECP_P
+    else:
+        lam = (qy - py) * pow(qx - px, _SECP_P - 2, _SECP_P) % _SECP_P
+    rx = (lam * lam - px - qx) % _SECP_P
+    ry = (lam * (px - rx) - py) % _SECP_P
+    return rx, ry
+
+
+def _secp_mul(p, k):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _secp_add(acc, p)
+        p = _secp_add(p, p)
+        k >>= 1
+    return acc
+
+
+def ecrecover(msg_hash: bytes, v: int, r: int, s: int) -> bytes | None:
+    """Returns the 20-byte address, or None for an invalid signature."""
+    if v not in (27, 28) or not (0 < r < _SECP_N) or not (0 < s < _SECP_N):
+        return None
+    x = r
+    y_sq = (pow(x, 3, _SECP_P) + 7) % _SECP_P
+    y = pow(y_sq, (_SECP_P + 1) // 4, _SECP_P)
+    if y * y % _SECP_P != y_sq:
+        return None
+    if (y % 2) != (v - 27):
+        y = _SECP_P - y
+    e = int.from_bytes(msg_hash, "big")
+    r_inv = pow(r, _SECP_N - 2, _SECP_N)
+    # Q = r^-1 (s*R - e*G)
+    point = _secp_add(
+        _secp_mul((x, y), s),
+        _secp_mul((_SECP_GX, _SECP_P - _SECP_GY), e % _SECP_N),
+    )
+    q = _secp_mul(point, r_inv)
+    if q is None:
+        return None
+    qx, qy = q
+    pub = qx.to_bytes(32, "big") + qy.to_bytes(32, "big")
+    return keccak256(pub)[12:]
+
+
+def _run_precompile(addr_int: int, data: bytes, gas: int):
+    """-> (gas_cost, output) or raises EvmError for unsupported."""
+    if addr_int == 1:
+        cost = 3000
+        if gas < cost:
+            raise EvmError("out of gas (precompile)")
+        d = data.ljust(128, b"\x00")[:128]
+        h, v, r, s = d[:32], int.from_bytes(d[32:64], "big"), \
+            int.from_bytes(d[64:96], "big"), int.from_bytes(d[96:128], "big")
+        out = ecrecover(h, v, r, s)
+        return cost, (b"" if out is None else out.rjust(32, b"\x00"))
+    if addr_int == 2:
+        cost = 60 + 12 * _mem_words(len(data))
+        if gas < cost:
+            raise EvmError("out of gas (precompile)")
+        return cost, hashlib.sha256(data).digest()
+    if addr_int == 3:
+        cost = 600 + 120 * _mem_words(len(data))
+        if gas < cost:
+            raise EvmError("out of gas (precompile)")
+        try:
+            h = hashlib.new("ripemd160", data).digest()
+        except ValueError as e:  # openssl without legacy provider
+            raise EvmError("ripemd160 unavailable") from e
+        return cost, h.rjust(32, b"\x00")
+    if addr_int == 4:
+        cost = 15 + 3 * _mem_words(len(data))
+        if gas < cost:
+            raise EvmError("out of gas (precompile)")
+        return cost, data
+    if addr_int == 5:  # modexp (EIP-2565 pricing, simplified floor)
+        d = data.ljust(96, b"\x00")
+        bl = int.from_bytes(d[:32], "big")
+        el = int.from_bytes(d[32:64], "big")
+        ml = int.from_bytes(d[64:96], "big")
+        if bl > 1024 or el > 1024 or ml > 1024:
+            raise EvmError("modexp operand too large")
+        rest = data[96:].ljust(bl + el + ml, b"\x00")
+        b = int.from_bytes(rest[:bl], "big")
+        e = int.from_bytes(rest[bl : bl + el], "big")
+        m = int.from_bytes(rest[bl + el : bl + el + ml], "big")
+        words = _mem_words(max(bl, ml))
+        mult = words * words
+        iters = max(1, el * 8)
+        cost = max(200, mult * iters // 3)
+        if gas < cost:
+            raise EvmError("out of gas (precompile)")
+        out = (0 if m == 0 else pow(b, e, m)).to_bytes(ml, "big") if ml else b""
+        return cost, out
+    raise EvmError(f"unsupported precompile 0x{addr_int:02x}")
+
+
+# -- interpreter -------------------------------------------------------------
+
+
+class Evm:
+    def __init__(self, state: EvmState, block: BlockContext):
+        self.state = state
+        self.block = block
+        self.warm_addresses: set[bytes] = set()
+        self.warm_slots: set[tuple[bytes, int]] = set()
+        self.transient: dict[tuple[bytes, int], int] = {}
+        self.refund = 0
+        self.original_storage: dict[tuple[bytes, int], int] = {}
+        self.logs: list[tuple[bytes, list[int], bytes]] = []
+
+    # -- public entry points -------------------------------------------
+
+    def call(
+        self,
+        caller: bytes,
+        to: bytes | None,
+        data: bytes,
+        value: int = 0,
+        gas: int = 30_000_000,
+        gas_price: int = 0,
+    ) -> CallResult:
+        """Message call (eth_call shape): no intrinsic tx gas."""
+        self._warm_tx(caller, to)
+        if to is None:
+            # Deployment address derives from the pre-tx nonce;
+            # _create_tx reads nonce-1, so mirror execute_tx's bump.
+            self.state.get(caller).nonce += 1
+            return self._create_tx(caller, data, value, gas)
+        try:
+            out, left = self._message(
+                caller, to, to, value, data, gas, depth=0, static=False
+            )
+            return CallResult(True, out, gas - left)
+        except Revert as r:
+            return CallResult(False, r.data, gas, revert=True)
+        except EvmError:
+            return CallResult(False, b"", gas)
+
+    def execute_tx(
+        self,
+        caller: bytes,
+        to: bytes | None,
+        data: bytes,
+        value: int = 0,
+        gas: int = 30_000_000,
+    ) -> CallResult:
+        """Transaction execution (eth_estimateGas shape): charges the
+        21000 base + calldata intrinsic gas, applies the EIP-3529
+        refund cap to gas_used."""
+        intrinsic = G_TX
+        for byte in data:
+            intrinsic += G_TXDATA_ZERO if byte == 0 else G_TXDATA_NONZERO
+        if to is None:
+            intrinsic += G_CREATE + G_INITCODE_WORD * _mem_words(len(data))
+        if gas < intrinsic:
+            return CallResult(False, b"", gas)
+        self._warm_tx(caller, to)
+        sender = self.state.get(caller)
+        sender.nonce += 1
+        inner_gas = gas - intrinsic
+        try:
+            if to is None:
+                res = self._create_tx(caller, data, value, inner_gas)
+                used = intrinsic - G_CREATE + res.gas_used
+            else:
+                out, left = self._message(
+                    caller, to, to, value, data, inner_gas,
+                    depth=0, static=False,
+                )
+                used = intrinsic + (inner_gas - left)
+                res = CallResult(True, out, used)
+            used -= min(self.refund, used // 5)
+            return CallResult(res.success, res.output, used,
+                              revert=res.revert)
+        except Revert as r:
+            return CallResult(False, r.data, gas, revert=True)
+        except EvmError:
+            return CallResult(False, b"", gas)
+
+    # -- internals ------------------------------------------------------
+
+    def _warm_tx(self, caller: bytes, to: bytes | None) -> None:
+        self.warm_addresses.add(bytes(caller))
+        if to is not None:
+            self.warm_addresses.add(bytes(to))
+        self.warm_addresses.add(self.block.coinbase)
+        for i in range(1, 0x0B):
+            self.warm_addresses.add(i.to_bytes(20, "big"))
+
+    def _create_tx(self, caller: bytes, init: bytes, value: int,
+                   gas: int) -> CallResult:
+        sender = self.state.get(caller)
+        new_addr = keccak256(
+            rlp.encode([caller, max(0, sender.nonce - 1)])
+        )[12:]
+        try:
+            addr, left = self._create_at(
+                caller, new_addr, init, value, gas, depth=0
+            )
+            return CallResult(True, addr, G_CREATE + (gas - left))
+        except Revert as r:
+            return CallResult(False, r.data, gas, revert=True)
+        except EvmError:
+            return CallResult(False, b"", gas)
+
+    def _transfer(self, frm: bytes, to: bytes, value: int) -> None:
+        if value == 0:
+            return
+        a, b = self.state.get(frm), self.state.get(to)
+        if a.balance < value:
+            raise EvmError("insufficient balance for transfer")
+        a.balance -= value
+        b.balance += value
+
+    def _message(self, caller, code_addr, storage_addr, value, data,
+                 gas, depth, static, code_override=None,
+                 transfer=True):
+        """Run code at code_addr with storage context storage_addr.
+        Returns (output, gas_left). Raises Revert/EvmError."""
+        if depth > MAX_CALL_DEPTH:
+            raise EvmError("call depth exceeded")
+        code_addr = bytes(code_addr)
+        ai = int.from_bytes(code_addr, "big")
+        if 0 < ai <= 0x0A and code_override is None:
+            cost, out = _run_precompile(ai, data, gas)
+            if transfer:
+                self._transfer(caller, code_addr, value)
+            return out, gas - cost
+        snap = self.state.snapshot()
+        refund_snap = self.refund
+        transient_snap = dict(self.transient)
+        if transfer:
+            self._transfer(caller, storage_addr, value)
+        code = (code_override if code_override is not None
+                else self.state.get(code_addr).code)
+        if not code:
+            return b"", gas
+        try:
+            return self._exec(
+                code, caller, storage_addr, value, data, gas, depth,
+                static,
+            )
+        except (Revert, EvmError):
+            self.state.restore(snap)
+            self.refund = refund_snap
+            self.transient = transient_snap
+            raise
+
+    def _create_at(self, caller, new_addr, init, value, gas, depth):
+        if depth > MAX_CALL_DEPTH:
+            raise EvmError("call depth exceeded")
+        if len(init) > MAX_INITCODE_SIZE:
+            raise EvmError("initcode too large")
+        existing = self.state.accounts.get(bytes(new_addr))
+        if existing is not None and (existing.nonce or existing.code):
+            raise EvmError("create collision")
+        snap = self.state.snapshot()
+        self.warm_addresses.add(bytes(new_addr))
+        self._transfer(caller, new_addr, value)
+        acct = self.state.get(new_addr)
+        acct.nonce = 1
+        try:
+            out, left = self._exec(
+                init, caller, new_addr, value, b"", gas, depth, False
+            )
+        except (Revert, EvmError):
+            self.state.restore(snap)
+            raise
+        if len(out) > MAX_CODE_SIZE or (out and out[0] == 0xEF):
+            self.state.restore(snap)
+            raise EvmError("invalid deployed code")
+        deposit = G_CODEDEPOSIT * len(out)
+        if left < deposit:
+            self.state.restore(snap)
+            raise EvmError("out of gas (code deposit)")
+        acct = self.state.get(new_addr)
+        acct.code = out
+        return bytes(new_addr), left - deposit
+
+    # The interpreter proper. One python loop per opcode — host-side
+    # code, never traced by JAX (proof verification is not a TPU
+    # workload; the chain's hot paths are).
+    def _exec(self, code, caller, self_addr, value, data, gas, depth,
+              static):
+        stack: list[int] = []
+        mem = bytearray()
+        pc = 0
+        gas_left = gas
+        ret_data = b""
+        self_addr = bytes(self_addr)
+        jumpdests = set()
+        i = 0
+        while i < len(code):
+            op = code[i]
+            if op == 0x5B:
+                jumpdests.add(i)
+            if 0x60 <= op <= 0x7F:
+                i += op - 0x5F
+            i += 1
+
+        def use(n):
+            nonlocal gas_left
+            if gas_left < n:
+                raise EvmError("out of gas")
+            gas_left -= n
+
+        def mem_extend(offset, size):
+            nonlocal gas_left
+            if size == 0:
+                return
+            if offset + size > (1 << 32):
+                raise EvmError("memory offset too large")
+            new_words = _mem_words(offset + size)
+            old_words = _mem_words(len(mem))
+            if new_words > old_words:
+                use(_mem_cost(new_words) - _mem_cost(old_words))
+                mem.extend(b"\x00" * (new_words * 32 - len(mem)))
+
+        def push(x):
+            if len(stack) >= 1024:
+                raise EvmError("stack overflow")
+            stack.append(x & U256)
+
+        def pop():
+            if not stack:
+                raise EvmError("stack underflow")
+            return stack.pop()
+
+        def touch_account(a: bytes):
+            nonlocal gas_left
+            if a in self.warm_addresses:
+                use(G_WARM)
+            else:
+                self.warm_addresses.add(a)
+                use(G_COLD_ACCOUNT)
+
+        while pc < len(code):
+            op = code[pc]
+            base = _TIER.get(op)
+            if base is not None:
+                use(base)
+
+            if op == 0x00:  # STOP
+                return b"", gas_left
+            elif op == 0x01:
+                push(pop() + pop())
+            elif op == 0x02:
+                push(pop() * pop())
+            elif op == 0x03:
+                a, b = pop(), pop()
+                push(a - b)
+            elif op == 0x04:
+                a, b = pop(), pop()
+                push(0 if b == 0 else a // b)
+            elif op == 0x05:
+                a, b = _signed(pop()), _signed(pop())
+                if b == 0:
+                    push(0)
+                else:
+                    q = abs(a) // abs(b)
+                    push(-q if (a < 0) != (b < 0) else q)
+            elif op == 0x06:
+                a, b = pop(), pop()
+                push(0 if b == 0 else a % b)
+            elif op == 0x07:
+                a, b = _signed(pop()), _signed(pop())
+                if b == 0:
+                    push(0)
+                else:
+                    r = abs(a) % abs(b)
+                    push(-r if a < 0 else r)
+            elif op == 0x08:
+                a, b, n = pop(), pop(), pop()
+                push(0 if n == 0 else (a + b) % n)
+            elif op == 0x09:
+                a, b, n = pop(), pop(), pop()
+                push(0 if n == 0 else (a * b) % n)
+            elif op == 0x0A:  # EXP
+                a, e = pop(), pop()
+                use(50 * ((e.bit_length() + 7) // 8))
+                push(pow(a, e, 1 << 256))
+            elif op == 0x0B:  # SIGNEXTEND
+                k, x = pop(), pop()
+                if k < 31:
+                    bit = 8 * (k + 1) - 1
+                    if x & (1 << bit):
+                        x |= U256 ^ ((1 << (bit + 1)) - 1)
+                    else:
+                        x &= (1 << (bit + 1)) - 1
+                push(x)
+            elif op == 0x10:
+                a, b = pop(), pop()
+                push(1 if a < b else 0)
+            elif op == 0x11:
+                a, b = pop(), pop()
+                push(1 if a > b else 0)
+            elif op == 0x12:
+                a, b = _signed(pop()), _signed(pop())
+                push(1 if a < b else 0)
+            elif op == 0x13:
+                a, b = _signed(pop()), _signed(pop())
+                push(1 if a > b else 0)
+            elif op == 0x14:
+                push(1 if pop() == pop() else 0)
+            elif op == 0x15:
+                push(1 if pop() == 0 else 0)
+            elif op == 0x16:
+                push(pop() & pop())
+            elif op == 0x17:
+                push(pop() | pop())
+            elif op == 0x18:
+                push(pop() ^ pop())
+            elif op == 0x19:
+                push(~pop())
+            elif op == 0x1A:  # BYTE
+                n, x = pop(), pop()
+                push((x >> (8 * (31 - n))) & 0xFF if n < 32 else 0)
+            elif op == 0x1B:  # SHL
+                s, x = pop(), pop()
+                push(0 if s >= 256 else x << s)
+            elif op == 0x1C:  # SHR
+                s, x = pop(), pop()
+                push(0 if s >= 256 else x >> s)
+            elif op == 0x1D:  # SAR
+                s, x = pop(), _signed(pop())
+                push((x >> s) if s < 256 else (0 if x >= 0 else U256))
+            elif op == 0x20:  # KECCAK256
+                off, size = pop(), pop()
+                use(G_KECCAK + G_KECCAK_WORD * _mem_words(size))
+                mem_extend(off, size)
+                push(int.from_bytes(
+                    keccak256(bytes(mem[off : off + size])), "big"))
+            elif op == 0x30:
+                push(int.from_bytes(self_addr, "big"))
+            elif op == 0x31:  # BALANCE
+                a = _addr(pop())
+                touch_account(a)
+                push(self.state.get(a).balance)
+            elif op == 0x32:  # ORIGIN (approximated as caller)
+                push(int.from_bytes(caller, "big"))
+            elif op == 0x33:
+                push(int.from_bytes(caller, "big"))
+            elif op == 0x34:
+                push(value)
+            elif op == 0x35:  # CALLDATALOAD
+                off = pop()
+                push(int.from_bytes(
+                    data[off : off + 32].ljust(32, b"\x00"), "big"))
+            elif op == 0x36:
+                push(len(data))
+            elif op == 0x37:  # CALLDATACOPY
+                dst, src, size = pop(), pop(), pop()
+                use(G_VERYLOW + G_COPY_WORD * _mem_words(size))
+                mem_extend(dst, size)
+                mem[dst : dst + size] = data[src : src + size].ljust(
+                    size, b"\x00")
+            elif op == 0x38:
+                push(len(code))
+            elif op == 0x39:  # CODECOPY
+                dst, src, size = pop(), pop(), pop()
+                use(G_COPY_WORD * _mem_words(size))
+                mem_extend(dst, size)
+                mem[dst : dst + size] = code[src : src + size].ljust(
+                    size, b"\x00")
+            elif op == 0x3A:
+                push(0)  # GASPRICE: eth_call runs at price 0
+            elif op == 0x3B:  # EXTCODESIZE
+                a = _addr(pop())
+                touch_account(a)
+                push(len(self.state.get(a).code))
+            elif op == 0x3C:  # EXTCODECOPY
+                a = _addr(pop())
+                dst, src, size = pop(), pop(), pop()
+                touch_account(a)
+                use(G_COPY_WORD * _mem_words(size))
+                mem_extend(dst, size)
+                ext = self.state.get(a).code
+                mem[dst : dst + size] = ext[src : src + size].ljust(
+                    size, b"\x00")
+            elif op == 0x3D:
+                push(len(ret_data))
+            elif op == 0x3E:  # RETURNDATACOPY
+                dst, src, size = pop(), pop(), pop()
+                if src + size > len(ret_data):
+                    raise EvmError("returndatacopy out of bounds")
+                use(G_COPY_WORD * _mem_words(size))
+                mem_extend(dst, size)
+                mem[dst : dst + size] = ret_data[src : src + size]
+            elif op == 0x3F:  # EXTCODEHASH
+                a = _addr(pop())
+                touch_account(a)
+                acct = self.state.accounts.get(a)
+                if acct is None or (
+                    not acct.code and not acct.balance and not acct.nonce
+                ):
+                    push(0)
+                else:
+                    push(int.from_bytes(keccak256(acct.code), "big"))
+            elif op == 0x40:  # BLOCKHASH
+                n = pop()
+                use(20 - G_BASE)
+                h = self.block.block_hashes.get(n, b"")
+                push(int.from_bytes(h, "big") if h else 0)
+            elif op == 0x41:
+                push(int.from_bytes(self.block.coinbase, "big"))
+            elif op == 0x42:
+                push(self.block.timestamp)
+            elif op == 0x43:
+                push(self.block.number)
+            elif op == 0x44:
+                push(int.from_bytes(self.block.prevrandao, "big"))
+            elif op == 0x45:
+                push(self.block.gas_limit)
+            elif op == 0x46:
+                push(self.block.chain_id)
+            elif op == 0x47:
+                push(self.state.get(self_addr).balance)
+            elif op == 0x48:
+                push(self.block.base_fee)
+            elif op == 0x49:  # BLOBHASH — no blob tx context in eth_call
+                pop()
+                push(0)
+            elif op == 0x4A:
+                push(self.block.blob_base_fee)
+            elif op == 0x50:
+                pop()
+            elif op == 0x51:  # MLOAD
+                off = pop()
+                mem_extend(off, 32)
+                push(int.from_bytes(mem[off : off + 32], "big"))
+            elif op == 0x52:  # MSTORE
+                off, val = pop(), pop()
+                mem_extend(off, 32)
+                mem[off : off + 32] = val.to_bytes(32, "big")
+            elif op == 0x53:  # MSTORE8
+                off, val = pop(), pop()
+                mem_extend(off, 1)
+                mem[off] = val & 0xFF
+            elif op == 0x54:  # SLOAD
+                slot = pop()
+                key = (self_addr, slot)
+                if key in self.warm_slots:
+                    use(G_WARM)
+                else:
+                    self.warm_slots.add(key)
+                    use(G_COLD_SLOAD)
+                push(self.state.get(self_addr).storage.get(slot, 0))
+            elif op == 0x55:  # SSTORE
+                if static:
+                    raise EvmError("SSTORE in static context")
+                if gas_left <= G_CALLSTIPEND:
+                    raise EvmError("SSTORE sentry")
+                slot, val = pop(), pop()
+                key = (self_addr, slot)
+                storage = self.state.get(self_addr).storage
+                current = storage.get(slot, 0)
+                if key not in self.original_storage:
+                    self.original_storage[key] = current
+                original = self.original_storage[key]
+                cold = 0
+                if key not in self.warm_slots:
+                    self.warm_slots.add(key)
+                    cold = G_COLD_SLOAD
+                if val == current:
+                    use(G_WARM + cold)
+                elif current == original:
+                    use((G_SSET if original == 0 else G_SRESET) + cold)
+                    if val == 0 and original != 0:
+                        self.refund += 4800
+                else:
+                    use(G_WARM + cold)
+                storage[slot] = val
+            elif op == 0x56:  # JUMP
+                dst = pop()
+                if dst not in jumpdests:
+                    raise EvmError("bad jump destination")
+                pc = dst
+                continue
+            elif op == 0x57:  # JUMPI
+                dst, cond = pop(), pop()
+                if cond:
+                    if dst not in jumpdests:
+                        raise EvmError("bad jump destination")
+                    pc = dst
+                    continue
+            elif op == 0x58:
+                push(pc)
+            elif op == 0x59:
+                push(len(mem))
+            elif op == 0x5A:
+                push(gas_left)
+            elif op == 0x5B:
+                pass  # JUMPDEST
+            elif op == 0x5C:  # TLOAD
+                use(G_WARM)
+                push(self.transient.get((self_addr, pop()), 0))
+            elif op == 0x5D:  # TSTORE
+                if static:
+                    raise EvmError("TSTORE in static context")
+                use(G_WARM)
+                slot, val = pop(), pop()
+                self.transient[(self_addr, slot)] = val
+            elif op == 0x5E:  # MCOPY
+                dst, src, size = pop(), pop(), pop()
+                use(G_COPY_WORD * _mem_words(size))
+                mem_extend(max(dst, src), size)
+                mem[dst : dst + size] = bytes(mem[src : src + size])
+            elif op == 0x5F:
+                push(0)
+            elif 0x60 <= op <= 0x7F:  # PUSH1..PUSH32
+                n = op - 0x5F
+                push(int.from_bytes(code[pc + 1 : pc + 1 + n], "big"))
+                pc += n
+            elif 0x80 <= op <= 0x8F:  # DUP
+                n = op - 0x7F
+                if len(stack) < n:
+                    raise EvmError("stack underflow")
+                push(stack[-n])
+            elif 0x90 <= op <= 0x9F:  # SWAP
+                n = op - 0x8F
+                if len(stack) < n + 1:
+                    raise EvmError("stack underflow")
+                stack[-1], stack[-n - 1] = stack[-n - 1], stack[-1]
+            elif 0xA0 <= op <= 0xA4:  # LOG0..LOG4
+                if static:
+                    raise EvmError("LOG in static context")
+                ntopics = op - 0xA0
+                off, size = pop(), pop()
+                topics = [pop() for _ in range(ntopics)]
+                use(G_LOG * (1 + ntopics) + G_LOG_DATA * size)
+                mem_extend(off, size)
+                self.logs.append(
+                    (self_addr, topics, bytes(mem[off : off + size])))
+            elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
+                if static:
+                    raise EvmError("CREATE in static context")
+                val = pop()
+                off, size = pop(), pop()
+                salt = pop() if op == 0xF5 else None
+                use(G_CREATE + G_INITCODE_WORD * _mem_words(size))
+                if op == 0xF5:
+                    use(G_KECCAK_WORD * _mem_words(size))
+                mem_extend(off, size)
+                init = bytes(mem[off : off + size])
+                acct = self.state.get(self_addr)
+                if salt is None:
+                    new_addr = keccak256(
+                        rlp.encode([self_addr, acct.nonce]))[12:]
+                else:
+                    new_addr = keccak256(
+                        b"\xff" + self_addr
+                        + salt.to_bytes(32, "big") + keccak256(init))[12:]
+                acct.nonce += 1
+                child_gas = gas_left - gas_left // 64
+                try:
+                    addr_out, left = self._create_at(
+                        caller=self_addr, new_addr=new_addr, init=init,
+                        value=val, gas=child_gas, depth=depth + 1)
+                    gas_left -= child_gas - left
+                    ret_data = b""
+                    push(int.from_bytes(addr_out, "big"))
+                except Revert as r:
+                    gas_left -= child_gas
+                    ret_data = r.data
+                    push(0)
+                except EvmError:
+                    gas_left -= child_gas
+                    ret_data = b""
+                    push(0)
+            elif op in (0xF1, 0xF2, 0xF4, 0xFA):
+                # CALL / CALLCODE / DELEGATECALL / STATICCALL
+                gas_req = pop()
+                target = _addr(pop())
+                val = pop() if op in (0xF1, 0xF2) else 0
+                in_off, in_size = pop(), pop()
+                out_off, out_size = pop(), pop()
+                if static and val and op == 0xF1:
+                    raise EvmError("value CALL in static context")
+                touch_account(target)
+                extra = 0
+                if val:
+                    extra += G_CALLVALUE
+                    if op == 0xF1 and target not in self.state.accounts:
+                        extra += G_NEWACCOUNT
+                use(extra)
+                mem_extend(in_off, in_size)
+                mem_extend(out_off, out_size)
+                avail = gas_left - gas_left // 64
+                child_gas = min(gas_req, avail)
+                stipend = G_CALLSTIPEND if val else 0
+                args = bytes(mem[in_off : in_off + in_size])
+                try:
+                    if op == 0xF1:  # CALL
+                        out, left = self._message(
+                            self_addr, target, target, val, args,
+                            child_gas + stipend, depth + 1,
+                            static)
+                    elif op == 0xF2:  # CALLCODE
+                        out, left = self._message(
+                            self_addr, target, self_addr, val, args,
+                            child_gas + stipend, depth + 1, static)
+                    elif op == 0xF4:  # DELEGATECALL
+                        out, left = self._message(
+                            caller, target, self_addr, value, args,
+                            child_gas, depth + 1, static,
+                            code_override=self.state.get(target).code,
+                            transfer=False)
+                    else:  # STATICCALL
+                        out, left = self._message(
+                            self_addr, target, target, 0, args,
+                            child_gas, depth + 1, True)
+                    # Caller fronts child_gas; the child's full
+                    # remainder (incl. unused stipend) returns to it.
+                    gas_left -= child_gas - left
+                    ret_data = out
+                    n = min(out_size, len(out))
+                    mem[out_off : out_off + n] = out[:n]
+                    push(1)
+                except Revert as r:
+                    # Conservative: a real EVM refunds the reverting
+                    # child's remaining gas; Revert doesn't carry it,
+                    # so estimates involving reverting inner calls
+                    # over-estimate (never under).
+                    gas_left -= child_gas
+                    ret_data = r.data
+                    n = min(out_size, len(r.data))
+                    mem[out_off : out_off + n] = r.data[:n]
+                    push(0)
+                except EvmError:
+                    # Stipend gas was granted on top of the caller's
+                    # balance; the caller loses only child_gas.
+                    gas_left -= child_gas
+                    ret_data = b""
+                    push(0)
+            elif op == 0xF3:  # RETURN
+                off, size = pop(), pop()
+                mem_extend(off, size)
+                return bytes(mem[off : off + size]), gas_left
+            elif op == 0xFD:  # REVERT
+                off, size = pop(), pop()
+                mem_extend(off, size)
+                raise Revert(bytes(mem[off : off + size]))
+            elif op == 0xFF:  # SELFDESTRUCT (EIP-6780: balance move)
+                if static:
+                    raise EvmError("SELFDESTRUCT in static context")
+                use(G_SELFDESTRUCT)
+                beneficiary = _addr(pop())
+                touch_account(beneficiary)
+                acct = self.state.get(self_addr)
+                self.state.get(beneficiary).balance += acct.balance
+                acct.balance = 0
+                return b"", gas_left
+            elif op == 0xFE:  # INVALID
+                raise EvmError("invalid opcode")
+            else:
+                raise EvmError(f"unimplemented opcode 0x{op:02x}")
+            pc += 1
+        return b"", gas_left

@@ -164,3 +164,78 @@ def verify_storage_proof(
     if value is None:
         return 0
     return int.from_bytes(rlp.decode(value), "big")
+
+
+# ---------------------------------------------------------------------------
+# Trie construction (root computation from a key->value mapping).
+#
+# Needed to authenticate the transactions / withdrawals lists of an RPC
+# block against the transactionsRoot / withdrawalsRoot fields of an
+# LC-verified header (reference: isValidBlock's validateTransactionsTrie,
+# prover/src/utils/validation.ts:96). Unlike the account/storage tries,
+# these index tries key on rlp(index) with NO keccak pre-hash.
+
+
+def _hexprefix(nibs: list[int], leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibs) % 2 == 1:
+        packed = [((flag + 1) << 4) | nibs[0]]
+        rest = nibs[1:]
+    else:
+        packed = [flag << 4]
+        rest = nibs
+    for i in range(0, len(rest), 2):
+        packed.append((rest[i] << 4) | rest[i + 1])
+    return bytes(packed)
+
+
+def _node_ref(node) -> bytes:
+    """Collapse a structural node to its reference: inline if the RLP
+    is <32 bytes, else its keccak hash (yellow-paper c())."""
+    raw = rlp.encode(node)
+    return node if len(raw) < 32 else keccak256(raw)
+
+
+def _build_node(items: list[tuple[list[int], bytes]], depth: int):
+    """items: (remaining-nibble-path, value) pairs, paths distinct."""
+    if not items:
+        return b""
+    if len(items) == 1:
+        nibs, value = items[0]
+        return [_hexprefix(list(nibs), True), value]
+    # Longest common prefix across all paths at this depth.
+    first = items[0][0]
+    lcp = 0
+    while all(
+        len(p) > lcp and p[lcp] == first[lcp] for p, _ in items
+    ):
+        lcp += 1
+    if lcp > 0:
+        child = _build_node([(p[lcp:], v) for p, v in items], depth + lcp)
+        return [_hexprefix(list(first[:lcp]), False), _node_ref(child)]
+    branch: list = [b""] * 17
+    buckets: dict[int, list[tuple[list[int], bytes]]] = {}
+    for p, v in items:
+        if not p:
+            branch[16] = v
+        else:
+            buckets.setdefault(p[0], []).append((p[1:], v))
+    for nib, group in buckets.items():
+        branch[nib] = _node_ref(_build_node(group, depth + 1))
+    return branch
+
+
+def trie_root(items: list[tuple[bytes, bytes]]) -> bytes:
+    """Root of the MPT holding {key: value}. Keys are used as-is
+    (callers hash or rlp-index them per the trie's keying rule)."""
+    if not items:
+        return keccak256(rlp.encode(b""))
+    pairs = [(_nibbles(k), v) for k, v in items]
+    root_node = _build_node(pairs, 0)
+    return keccak256(rlp.encode(root_node))
+
+
+def ordered_trie_root(values: list[bytes]) -> bytes:
+    """Root of an index trie (transactions/withdrawals/receipts):
+    key i -> rlp(i), values stored raw (already-encoded payloads)."""
+    return trie_root([(rlp.encode(i), v) for i, v in enumerate(values)])

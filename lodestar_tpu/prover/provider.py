@@ -10,6 +10,8 @@ light client's finality/optimistic updates).
 
 from __future__ import annotations
 
+from .blocks import BlockVerificationError, verify_block
+from .evm import Account, BlockContext, Evm, EvmState
 from .keccak import keccak256
 from .mpt import ProofError, verify_account_proof, verify_storage_proof
 
@@ -25,6 +27,11 @@ class ProofProvider:
     def __init__(self):
         # block_hash -> (state_root, block_number)
         self._roots: dict[bytes, tuple[bytes, int | None]] = {}
+        # block_hash -> full verified payload header fields (dict with
+        # parent_hash/number/timestamp/gas_limit/base_fee/prevrandao/
+        # fee_recipient when fed from on_verified_payload)
+        self._payloads: dict[bytes, dict] = {}
+        self._by_number: dict[int, bytes] = {}
         self.latest_block_hash: bytes | None = None
 
     def on_verified_header(
@@ -37,7 +44,57 @@ class ProofProvider:
             bytes(state_root),
             block_number,
         )
+        if block_number is not None:
+            self._by_number[block_number] = bytes(block_hash)
         self.latest_block_hash = bytes(block_hash)
+
+    def on_verified_payload(self, payload: dict) -> None:
+        """Record a full LC-verified execution payload header (the
+        reference's PayloadStore.processLCHeader). `payload` carries
+        block_hash/state_root plus whatever block-context fields the
+        header exposes (number, timestamp, gas_limit, base_fee,
+        prevrandao, fee_recipient)."""
+        bh = bytes(payload["block_hash"])
+        self._payloads[bh] = dict(payload)
+        self.on_verified_header(
+            bh, bytes(payload["state_root"]), payload.get("number")
+        )
+
+    def resolve(self, block=None) -> bytes:
+        """block: None/'latest' -> newest verified anchor; int or hex
+        quantity -> verified hash at that number; bytes/0x-hash -> the
+        hash itself (must be verified)."""
+        if block is None or block == "latest":
+            if self.latest_block_hash is None:
+                raise VerificationError("no verified execution header")
+            return self.latest_block_hash
+        if isinstance(block, str):
+            if len(block) == 66 and block.startswith("0x"):
+                block = bytes.fromhex(block[2:])
+            else:
+                block = int(block, 16)
+        if isinstance(block, int):
+            bh = self._by_number.get(block)
+            if bh is None:
+                raise VerificationError(
+                    f"no verified header at height {block}")
+            return bh
+        bh = bytes(block)
+        if bh not in self._roots:
+            raise VerificationError("block hash not LC-verified")
+        return bh
+
+    def payload(self, block=None) -> dict:
+        bh = self.resolve(block)
+        info = self._payloads.get(bh)
+        if info is None:
+            state_root, number = self._roots[bh]
+            info = {
+                "block_hash": bh,
+                "state_root": state_root,
+                "number": number,
+            }
+        return info
 
     def anchor(self, block_hash: bytes | None = None):
         """(state_root, rpc block tag) of a verified header. Proof
@@ -119,3 +176,165 @@ class VerifiedExecutionProvider:
             )
         except ProofError as e:
             raise VerificationError(f"storage proof invalid: {e}") from e
+
+    # -- verified blocks (verified_requests/eth_getBlockByHash.ts,
+    #    eth_getBlockByNumber.ts) --------------------------------------
+
+    async def get_block_by_hash(self, block_hash) -> dict:
+        """Hydrated block, authenticated field-by-field: the header
+        must hash to the LC-verified block hash and the transaction /
+        withdrawal tries must recompute."""
+        bh = self.proofs.resolve(
+            block_hash if not isinstance(block_hash, str)
+            else bytes.fromhex(block_hash.removeprefix("0x"))
+        )
+        block = await self.rpc.call(
+            "eth_getBlockByHash", ["0x" + bh.hex(), True]
+        )
+        if block is None:
+            raise VerificationError("block not found on RPC")
+        try:
+            verify_block(block, bh)
+        except BlockVerificationError as e:
+            raise VerificationError(f"block invalid: {e}") from e
+        return block
+
+    async def get_block_by_number(self, number) -> dict:
+        bh = self.proofs.resolve(number)
+        return await self.get_block_by_hash(bh)
+
+    # -- verified local execution (verified_requests/eth_call.ts,
+    #    eth_estimateGas.ts; utils/evm.ts) -----------------------------
+
+    async def _seed_evm(self, tx: dict, block=None):
+        """Build an EVM whose entire state is proof-verified: ask the
+        RPC which accounts/slots the call touches (eth_createAccessList),
+        then verify each against the LC-verified state root."""
+        info = self.proofs.payload(block)
+        state_root = info["state_root"]
+        tag = (hex(info["number"]) if info.get("number") is not None
+               else "0x" + info["block_hash"].hex())
+
+        def addr_bytes(x) -> bytes:
+            return bytes.fromhex(x.removeprefix("0x")) if isinstance(
+                x, str) else bytes(x)
+
+        frm = tx.get("from") or "0x" + "00" * 20
+        access: dict[str, list[str]] = {}
+        acc_tx = {k: v for k, v in tx.items() if v is not None}
+        acc_tx.setdefault("from", frm)
+        try:
+            resp = await self.rpc.call(
+                "eth_createAccessList", [acc_tx, tag]
+            )
+            for entry in resp.get("accessList", []):
+                access[entry["address"].lower()] = list(
+                    entry.get("storageKeys", []))
+        except VerificationError:
+            raise
+        except Exception:
+            # RPC without createAccessList: fall back to just the
+            # from/to accounts (sufficient for transfers and
+            # storage-free calls; anything touching unproven storage
+            # reads zeros and the caller sees a verification-scoped
+            # result, never an unverified RPC answer).
+            pass
+        access.setdefault(frm.lower(), [])
+        if tx.get("to"):
+            access.setdefault(tx["to"].lower(), [])
+
+        state = EvmState()
+        for addr_hex, keys in access.items():
+            address = addr_bytes(addr_hex)
+            out = await self.rpc.call(
+                "eth_getProof", [addr_hex, keys, tag]
+            )
+            proof = [bytes.fromhex(n.removeprefix("0x"))
+                     for n in out["accountProof"]]
+            try:
+                account = verify_account_proof(
+                    state_root, address, proof)
+            except ProofError as e:
+                raise VerificationError(
+                    f"account proof invalid for {addr_hex}: {e}"
+                ) from e
+            code = b""
+            if account["code_hash"] != keccak256(b""):
+                code_hex = await self.rpc.call(
+                    "eth_getCode", [addr_hex, tag])
+                code = bytes.fromhex(code_hex.removeprefix("0x"))
+                if keccak256(code) != account["code_hash"]:
+                    raise VerificationError(
+                        f"code hash mismatch for {addr_hex}")
+            storage: dict[int, int] = {}
+            for i, entry in enumerate(out.get("storageProof", [])):
+                sproof = [bytes.fromhex(n.removeprefix("0x"))
+                          for n in entry["proof"]]
+                slot = bytes.fromhex(
+                    entry["key"].removeprefix("0x")).rjust(32, b"\x00")
+                try:
+                    val = verify_storage_proof(
+                        account["storage_root"], slot, sproof)
+                except ProofError as e:
+                    raise VerificationError(
+                        f"storage proof invalid for {addr_hex}: {e}"
+                    ) from e
+                storage[int.from_bytes(slot, "big")] = val
+            # Every requested slot must come back with a proof — an
+            # RPC that silently drops entries would otherwise make the
+            # EVM read zeros and launder a wrong 'verified' answer.
+            for key in keys:
+                slot_int = int(key, 16) if isinstance(key, str) \
+                    else int.from_bytes(bytes(key), "big")
+                if slot_int not in storage:
+                    raise VerificationError(
+                        f"storage proof missing for {addr_hex} slot "
+                        f"{key}")
+            state.put(address, Account(
+                nonce=account["nonce"], balance=account["balance"],
+                code=code, storage=storage))
+
+        ctx = BlockContext(
+            number=info.get("number") or 0,
+            timestamp=info.get("timestamp") or 0,
+            coinbase=bytes(info.get("fee_recipient") or b"\x00" * 20),
+            gas_limit=info.get("gas_limit") or 30_000_000,
+            base_fee=info.get("base_fee") or 0,
+            prevrandao=bytes(info.get("prevrandao") or b"\x00" * 32),
+            chain_id=info.get("chain_id") or 1,
+        )
+        evm = Evm(state, ctx)
+        to = addr_bytes(tx["to"]) if tx.get("to") else None
+        gas = (int(tx["gas"], 16) if isinstance(tx.get("gas"), str)
+               else tx.get("gas")) or ctx.gas_limit
+        val = (int(tx["value"], 16)
+               if isinstance(tx.get("value"), str)
+               else tx.get("value")) or 0
+        data_hex = tx.get("input") or tx.get("data") or "0x"
+        data = bytes.fromhex(data_hex.removeprefix("0x")) if isinstance(
+            data_hex, str) else bytes(data_hex)
+        return evm, addr_bytes(frm), to, data, val, gas
+
+    async def call(self, tx: dict, block=None) -> bytes:
+        """Proof-backed eth_call: execute locally on verified state;
+        the untrusted RPC contributes only proofs and code, every byte
+        of which is checked."""
+        evm, frm, to, data, val, gas = await self._seed_evm(tx, block)
+        res = evm.call(frm, to, data, value=val, gas=gas)
+        if not res.success:
+            raise VerificationError(
+                "execution reverted" if res.revert
+                else "execution failed")
+        return res.output
+
+    async def estimate_gas(self, tx: dict, block=None) -> int:
+        """Proof-backed eth_estimateGas: run the transaction locally
+        with full gas metering (21000 base + calldata + execution,
+        EIP-3529 refund cap)."""
+        evm, frm, to, data, val, gas = await self._seed_evm(tx, block)
+        res = evm.execute_tx(frm, to, data, value=val, gas=gas)
+        if not res.success:
+            raise VerificationError(
+                "execution reverted" if res.revert
+                else "execution failed")
+        return res.gas_used

@@ -308,3 +308,416 @@ class TestVerifiedProvider:
                 await vp.get_code(addr)
 
         asyncio.run(go())
+
+
+class TestTrieBuilder:
+    def test_matches_reference_trie(self):
+        from lodestar_tpu.prover.mpt import trie_root
+
+        trie = _Trie()
+        items = []
+        for i in range(37):
+            k = i.to_bytes(4, "big") + b"key"
+            v = rlp.encode([i, b"x" * (i % 9)])
+            trie.put(k, v)
+            items.append((keccak256(k), v))
+        root, _ = trie.root_and_nodes()
+        assert trie_root(items) == root
+
+    def test_ordered_trie_single_and_empty(self):
+        from lodestar_tpu.prover.mpt import ordered_trie_root, trie_root
+
+        assert trie_root([]) == keccak256(rlp.encode(b""))
+        r1 = ordered_trie_root([b"a"])
+        r2 = ordered_trie_root([b"a", b"b"])
+        assert r1 != r2
+
+
+class TestEvm:
+    def _run(self, code, data=b"", storage=None, gas=1_000_000,
+             value=0, balance=10**18):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        st = EvmState()
+        addr = b"\xc0" * 20
+        st.put(addr, Account(nonce=1, code=code,
+                             storage=dict(storage or {})))
+        st.put(b"\x11" * 20, Account(balance=balance))
+        evm = Evm(st, BlockContext(number=7, timestamp=1234,
+                                   gas_limit=30_000_000, chain_id=5))
+        return evm, evm.call(b"\x11" * 20, addr, data, value=value,
+                             gas=gas)
+
+    def test_arithmetic_and_return(self):
+        # return calldata[4:36] + calldata[36:68]
+        code = bytes.fromhex("6004356024350160005260206000f3")
+        data = b"\x00" * 4 + (41).to_bytes(32, "big") + (1).to_bytes(32, "big")
+        _, r = self._run(code, data)
+        assert r.success and int.from_bytes(r.output, "big") == 42
+
+    def test_storage_and_context(self):
+        # return SLOAD(0) * NUMBER
+        code = bytes.fromhex("600054430260005260206000f3")
+        _, r = self._run(code, storage={0: 6})
+        assert int.from_bytes(r.output, "big") == 42
+
+    def test_revert_bubbles_data(self):
+        # MSTORE(0, 0xbeef); REVERT(30, 2)
+        code = bytes.fromhex("61beef600052600261001efd")
+        _, r = self._run(code)
+        assert not r.success and r.revert and r.output == b"\xbe\xef"
+
+    def test_keccak_matches(self):
+        # keccak256 of 3 bytes "abc" placed in memory
+        code = bytes.fromhex(
+            "62" + b"abc".hex() + "600052" "6003601d20" "60005260206000f3"
+        )
+        _, r = self._run(code)
+        assert r.output == keccak256(b"abc")
+
+    def test_inner_call(self):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        st = EvmState()
+        inner = b"\xaa" * 20
+        # inner: return 7
+        st.put(inner, Account(code=bytes.fromhex(
+            "600760005260206000f3")))
+        # outer: STATICCALL(gas, inner, 0,0, 0,32); return mem[0:32]+1
+        outer = bytes.fromhex(
+            "60206000600060007361" ).hex()
+        outer = bytes.fromhex(
+            "6020600060006000"            # retSize retOff inSize inOff
+            + "73" + inner.hex()          # address
+            + "620f4240"                  # gas
+            + "fa"                        # STATICCALL
+            + "50"                        # pop success
+            + "600051600101"              # mload(0) + 1
+            + "60005260206000f3"
+        )
+        st.put(b"\xc0" * 20, Account(code=outer))
+        evm = Evm(st, BlockContext())
+        r = evm.call(b"\x11" * 20, b"\xc0" * 20, b"", gas=1_000_000)
+        assert r.success and int.from_bytes(r.output, "big") == 8
+
+    def test_sstore_static_rejected(self):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        st = EvmState()
+        inner = b"\xaa" * 20
+        st.put(inner, Account(code=bytes.fromhex("600160005500")))
+        outer = bytes.fromhex(
+            "6000600060006000"
+            + "73" + inner.hex()
+            + "620f4240fa"
+            + "60005260206000f3"
+        )
+        st.put(b"\xc0" * 20, Account(code=outer))
+        evm = Evm(st, BlockContext())
+        r = evm.call(b"\x11" * 20, b"\xc0" * 20, b"", gas=1_000_000)
+        # STATICCALL returns 0 (failure) because inner SSTOREs
+        assert r.success and int.from_bytes(r.output, "big") == 0
+
+    def test_transfer_estimate(self):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        st = EvmState()
+        st.put(b"\x11" * 20, Account(balance=10**18))
+        evm = Evm(st, BlockContext())
+        r = evm.execute_tx(b"\x11" * 20, b"\x22" * 20, b"", value=1,
+                           gas=100_000)
+        assert r.success and r.gas_used == 21000
+        assert evm.state.get(b"\x22" * 20).balance == 1
+
+    def test_create_deploys_runtime(self):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        # init code: CODECOPY(0, 12, 10); RETURN(0, 10)
+        # runtime: PUSH1 2a PUSH1 00 MSTORE PUSH1 20 PUSH1 00 RETURN
+        runtime = bytes.fromhex("602a60005260206000f3")
+        init = bytes.fromhex("600a600c600039600a6000f3") + runtime
+        st = EvmState()
+        st.put(b"\x11" * 20, Account(balance=10**18))
+        evm = Evm(st, BlockContext())
+        r = evm.execute_tx(b"\x11" * 20, None, init, gas=1_000_000)
+        assert r.success
+        deployed = evm.state.get(r.output).code
+        assert deployed == runtime
+        r2 = evm.call(b"\x11" * 20, r.output, b"", gas=100_000)
+        assert int.from_bytes(r2.output, "big") == 0x2A
+
+    def test_precompile_sha256_and_identity(self):
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+        import hashlib
+
+        st = EvmState()
+        # CALL sha256 precompile with "abc" then return result
+        code = bytes.fromhex(
+            "62" + b"abc".hex() + "600052"   # mem[29:32]="abc"
+            "60206000" "6003601d"            # ret(0,32) in(29,3)
+            "6000" "6002"                    # value=0 addr=2
+            "620f4240" "f1" "50"             # gas, CALL, pop
+            "60206000f3"                     # return mem[0:32]
+        )
+        st.put(b"\xc0" * 20, Account(code=code))
+        evm = Evm(st, BlockContext())
+        r = evm.call(b"\x11" * 20, b"\xc0" * 20, b"", gas=1_000_000)
+        assert r.output == hashlib.sha256(b"abc").digest()
+
+    def test_unsupported_precompile_fails_closed(self):
+        from lodestar_tpu.prover.evm import (
+            BlockContext, Evm, EvmState, _run_precompile, EvmError,
+        )
+
+        with pytest.raises(EvmError):
+            _run_precompile(8, b"", 10**9)  # bn128 pairing: out of scope
+
+
+class TestVerifiedBlocks:
+    def _mk_block(self):
+        from lodestar_tpu.prover import blocks as B
+
+        txs = [
+            {
+                "type": "0x0", "nonce": "0x1", "gasPrice": "0x3b9aca00",
+                "gas": "0x5208", "to": "0x" + "22" * 20,
+                "value": "0xde0b6b3a7640000", "input": "0x",
+                "v": "0x25", "r": "0x" + "11" * 32, "s": "0x" + "12" * 32,
+            },
+            {
+                "type": "0x2", "chainId": "0x1", "nonce": "0x7",
+                "maxPriorityFeePerGas": "0x3b9aca00",
+                "maxFeePerGas": "0x77359400", "gas": "0x15f90",
+                "to": "0x" + "33" * 20, "value": "0x0",
+                "input": "0xe6cb9013", "accessList": [],
+                "yParity": "0x1", "r": "0x" + "21" * 32,
+                "s": "0x" + "22" * 32,
+            },
+        ]
+        withdrawals = [
+            {"index": "0x5", "validatorIndex": "0x10",
+             "address": "0x" + "44" * 20, "amount": "0x3b9aca00"},
+        ]
+        block = {
+            "parentHash": "0x" + "aa" * 32,
+            "sha3Uncles": "0x" + "bb" * 32,
+            "miner": "0x" + "cc" * 20,
+            "stateRoot": "0x" + "dd" * 32,
+            "transactionsRoot": "0x" + B.transactions_root(txs).hex(),
+            "receiptsRoot": "0x" + "ee" * 32,
+            "logsBloom": "0x" + "00" * 256,
+            "difficulty": "0x0",
+            "number": "0x10",
+            "gasLimit": "0x1c9c380",
+            "gasUsed": "0x5208",
+            "timestamp": "0x64000000",
+            "extraData": "0x",
+            "mixHash": "0x" + "ff" * 32,
+            "nonce": "0x0000000000000000",
+            "baseFeePerGas": "0x7",
+            "withdrawalsRoot": "0x"
+            + B.withdrawals_root(withdrawals).hex(),
+            "transactions": txs,
+            "withdrawals": withdrawals,
+        }
+        block["hash"] = "0x" + B.header_hash(block).hex()
+        return block, bytes.fromhex(block["hash"][2:])
+
+    def test_block_verifies_and_tamper_rejected(self):
+        from lodestar_tpu.prover import blocks as B
+
+        block, bh = self._mk_block()
+        B.verify_block(block, bh)  # does not raise
+
+        bad = dict(block)
+        bad["gasUsed"] = "0x5209"
+        with pytest.raises(B.BlockVerificationError):
+            B.verify_block(bad, bh)
+
+        bad2 = dict(block)
+        bad2["transactions"] = [dict(block["transactions"][0]),
+                                dict(block["transactions"][1])]
+        bad2["transactions"][0]["value"] = "0x1"
+        with pytest.raises(B.BlockVerificationError):
+            B.verify_block(bad2, bh)
+
+    def test_get_block_by_number_roundtrip(self):
+        block, bh = self._mk_block()
+        pp = ProofProvider()
+        pp.on_verified_header(bh, b"\xdd" * 32, 0x10)
+
+        class StubRpc:
+            async def call(self, method, params):
+                assert method == "eth_getBlockByHash"
+                assert params[0] == "0x" + bh.hex()
+                return block
+
+        vp = VerifiedExecutionProvider(StubRpc(), pp)
+
+        async def go():
+            got = await vp.get_block_by_number(0x10)
+            assert got["hash"] == block["hash"]
+            # unverified height rejected
+            with pytest.raises(VerificationError):
+                await vp.get_block_by_number(0x11)
+
+        asyncio.run(go())
+
+
+class TestVerifiedCall:
+    """End-to-end eth_call / eth_estimateGas on proof-verified state
+    (reference fixture shape: prover/test/fixtures/mainnet/eth_call.json
+    — a view call computing over storage + calldata)."""
+
+    def _fixture(self):
+        contract = bytes.fromhex(
+            # return SLOAD(0) + calldataload(4)
+            "60005460043501" "60005260206000f3"
+        )
+        caller = b"\x11" * 20
+        target = b"\xad" * 20
+
+        storage_trie = _Trie()
+        slot_key = (0).to_bytes(32, "big")
+        storage_trie.put(slot_key, rlp.encode(37))
+        storage_trie.put((1).to_bytes(32, "big"), rlp.encode(99))
+        storage_root, _ = storage_trie.root_and_nodes()
+
+        acct_trie = _Trie()
+        acct_trie.put(target, rlp.encode(
+            [1, 0, storage_root, keccak256(contract)]))
+        acct_trie.put(caller, rlp.encode(
+            [3, 10**18, keccak256(rlp.encode(b"")), keccak256(b"")]))
+        acct_trie.put(b"\x55" * 20, rlp.encode(
+            [0, 1, keccak256(rlp.encode(b"")), keccak256(b"")]))
+        state_root, _ = acct_trie.root_and_nodes()
+
+        _, target_proof = acct_trie.prove(target)
+        _, caller_proof = acct_trie.prove(caller)
+        _, slot_proof = storage_trie.prove(slot_key)
+
+        class StubRpc:
+            def __init__(self):
+                self.code = contract
+
+            async def call(self, method, params):
+                if method == "eth_createAccessList":
+                    return {"accessList": [{
+                        "address": "0x" + target.hex(),
+                        "storageKeys": ["0x" + slot_key.hex()],
+                    }]}
+                if method == "eth_getProof":
+                    addr = bytes.fromhex(params[0].removeprefix("0x"))
+                    if addr == target:
+                        return {
+                            "accountProof": [
+                                "0x" + n.hex() for n in target_proof],
+                            "storageProof": [{
+                                "key": "0x" + slot_key.hex(),
+                                "proof": [
+                                    "0x" + n.hex() for n in slot_proof],
+                            }],
+                        }
+                    _, addr_proof = acct_trie.prove(addr)
+                    return {
+                        "accountProof": [
+                            "0x" + n.hex() for n in addr_proof],
+                        "storageProof": [],
+                    }
+                if method == "eth_getCode":
+                    return "0x" + self.code.hex()
+                raise AssertionError(method)
+
+        pp = ProofProvider()
+        pp.on_verified_payload({
+            "block_hash": b"\x01" * 32, "state_root": state_root,
+            "number": 100, "timestamp": 1_700_000_000,
+            "gas_limit": 30_000_000, "base_fee": 7,
+        })
+        rpc = StubRpc()
+        return rpc, pp, caller, target
+
+    def test_call_computes_on_verified_state(self):
+        rpc, pp, caller, target = self._fixture()
+        vp = VerifiedExecutionProvider(rpc, pp)
+        data = b"\xe6\xcb\x90\x13" + (5).to_bytes(32, "big")
+
+        async def go():
+            out = await vp.call({
+                "from": "0x" + caller.hex(),
+                "to": "0x" + target.hex(),
+                "data": "0x" + data.hex(),
+            })
+            assert int.from_bytes(out, "big") == 42  # 37 + 5
+
+        asyncio.run(go())
+
+    def test_tampered_code_rejected(self):
+        rpc, pp, caller, target = self._fixture()
+        rpc.code = bytes.fromhex("602a60005260206000f3")  # lies: ret 42
+        vp = VerifiedExecutionProvider(rpc, pp)
+
+        async def go():
+            with pytest.raises(VerificationError):
+                await vp.call({
+                    "from": "0x" + caller.hex(),
+                    "to": "0x" + target.hex(),
+                    "data": "0x00000000",
+                })
+
+        asyncio.run(go())
+
+    def test_tampered_storage_value_rejected(self):
+        rpc, pp, caller, target = self._fixture()
+        orig_call = rpc.call
+
+        async def tampered(method, params):
+            out = await orig_call(method, params)
+            if method == "eth_getProof" and out.get("storageProof"):
+                # flip a byte inside the storage proof's leaf node
+                entry = out["storageProof"][0]
+                leaf = bytearray.fromhex(
+                    entry["proof"][-1].removeprefix("0x"))
+                leaf[-1] ^= 1
+                entry["proof"][-1] = "0x" + leaf.hex()
+            return out
+
+        rpc.call = tampered
+        vp = VerifiedExecutionProvider(rpc, pp)
+
+        async def go():
+            with pytest.raises(VerificationError):
+                await vp.call({
+                    "from": "0x" + caller.hex(),
+                    "to": "0x" + target.hex(),
+                    "data": "0x00000000",
+                })
+
+        asyncio.run(go())
+
+    def test_estimate_gas_transfer(self):
+        rpc, pp, caller, target = self._fixture()
+        vp = VerifiedExecutionProvider(rpc, pp)
+
+        async def go():
+            # plain transfer to an EOA: exactly 21000
+            gas = await vp.estimate_gas({
+                "from": "0x" + caller.hex(),
+                "to": "0x" + b"\x55".hex() * 20,
+                "value": "0x1",
+            })
+            assert gas == 21000
+
+        asyncio.run(go())
