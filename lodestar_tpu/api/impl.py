@@ -968,7 +968,8 @@ class BeaconApiImpl:
         if self.node is not None:
             if self.node.att_pool is not None:
                 atts = self.node.att_pool.get_attestations_for_block(
-                    slot_i
+                    slot_i,
+                    state=self.chain.head_state.state,
                 )
             contrib = getattr(self.node, "contrib_pool", None)
             if (

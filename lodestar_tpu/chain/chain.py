@@ -508,7 +508,60 @@ class BeaconChain:
                         )
                 except Exception:
                     pass  # monitoring must never fail an import
+            if vm.count:
+                try:
+                    self._register_attestations_in_block(
+                        vm, work, block
+                    )
+                except Exception:
+                    pass  # monitoring must never fail an import
         return block_root
+
+    def _register_attestations_in_block(self, vm, work, block) -> None:
+        """Feed on-chain attestation performance for monitored
+        validators from an imported block: inclusion distance plus
+        head/target correctness judged against THIS chain's roots
+        (reference validatorMonitor.registerAttestationInBlock,
+        metrics/validatorMonitor.ts:255 family)."""
+        from ..statetransition.block import BlockCtx, get_attesting_indices
+        from ..statetransition.util import (
+            get_block_root,
+            get_block_root_at_slot,
+        )
+
+        p = preset()
+        st = work.state
+        ctx = BlockCtx(self.cfg, st, self.types, work.fork_seq, False)
+        monitored = vm.validators.keys()
+        for att in block.body.attestations:
+            data = att.data
+            try:
+                indices = get_attesting_indices(ctx, att)
+            except Exception:
+                continue
+            hit = [i for i in indices if i in monitored]
+            if not hit:
+                continue
+            delay = int(block.slot) - int(data.slot)
+            try:
+                correct_target = bytes(data.target.root) == get_block_root(
+                    st, int(data.target.epoch)
+                )
+            except Exception:
+                correct_target = False
+            try:
+                correct_head = bytes(
+                    data.beacon_block_root
+                ) == get_block_root_at_slot(st, int(data.slot))
+            except Exception:
+                correct_head = False
+            vm.on_attestation_included(
+                hit,
+                int(data.slot) // p.SLOTS_PER_EPOCH,
+                delay,
+                correct_head,
+                correct_target,
+            )
 
     async def _notify_new_payload(self, work, block, block_root):
         """engine_newPayload -> fork-choice ExecutionStatus. INVALID

@@ -205,7 +205,9 @@ class DevNode:
                 ssz_uint64, epoch, get_domain(self.cfg, st, DOMAIN_RANDAO)
             ),
         )
-        attestations = self.att_pool.get_attestations_for_block(slot)
+        attestations = self.att_pool.get_attestations_for_block(
+            slot, state=st
+        )
         sync_aggregate = self._sync_aggregate_for(scratch, slot)
 
         blobs = self._make_blobs(slot, scratch)

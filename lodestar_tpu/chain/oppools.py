@@ -106,9 +106,18 @@ class AggregatedAttestationPool:
                 }
             )
 
-    def get_attestations_for_block(self, state_slot: int, max_atts=None):
+    def get_attestations_for_block(
+        self, state_slot: int, max_atts=None, state=None
+    ):
         """Best-coverage attestations includable at `state_slot`
-        (aggregatedAttestationPool.getAttestationsForBlock)."""
+        (aggregatedAttestationPool.getAttestationsForBlock). When the
+        proposal's (slot-advanced) state is passed, aggregates whose
+        attesters ALL already have their timely-target flag set on
+        chain are skipped — the reference's notSeenValidatorsFn filter.
+        Deriving "already included" from the proposal state (instead
+        of subtracting at import) is reorg-safe: a reorg to a chain
+        that never included an attestation automatically un-filters
+        it."""
         p = preset()
         if max_atts is None:
             max_atts = p.MAX_ATTESTATIONS
@@ -124,6 +133,10 @@ class AggregatedAttestationPool:
             for e in sorted(
                 group, key=lambda e: -sum(e["bits"])
             ):
+                if state is not None and self._fully_on_chain(
+                    state, slot, e
+                ):
+                    continue
                 a = self.types.Attestation.default()
                 a.data = e["data"]
                 a.aggregation_bits = list(e["bits"])
@@ -133,6 +146,49 @@ class AggregatedAttestationPool:
                     return out
         return out
 
+    @staticmethod
+    def _fully_on_chain(state, att_slot: int, entry) -> bool:
+        """True when every attester of a pooled aggregate already has
+        the timely-target participation flag for the attestation's
+        epoch in `state` (altair+; phase0 states have no participation
+        lists and are never filtered). Fail-open: any lookup error
+        keeps the attestation includable."""
+        try:
+            from ..statetransition import util as st_util
+            from ..statetransition.util import TIMELY_TARGET_FLAG_INDEX
+
+            p = preset()
+            att_epoch = att_slot // p.SLOTS_PER_EPOCH
+            state_epoch = int(state.slot) // p.SLOTS_PER_EPOCH
+            if att_epoch == state_epoch:
+                part = getattr(
+                    state, "current_epoch_participation", None
+                )
+            elif att_epoch == state_epoch - 1:
+                part = getattr(
+                    state, "previous_epoch_participation", None
+                )
+            else:
+                return False
+            if part is None:
+                return False
+            data = entry["data"]
+            committee = st_util.get_shuffling(
+                state, att_epoch
+            ).committee(att_slot, int(data.index))
+            bits = entry["bits"]
+            attesters = [
+                int(v)
+                for i, v in enumerate(committee)
+                if i < len(bits) and bits[i]
+            ]
+            if not attesters:
+                return False
+            flag = 1 << TIMELY_TARGET_FLAG_INDEX
+            return all(int(part[v]) & flag for v in attesters)
+        except Exception:
+            return False
+
     def prune(self, current_slot: int) -> None:
         p = preset()
         cutoff = current_slot - p.SLOTS_PER_EPOCH
@@ -140,6 +196,7 @@ class AggregatedAttestationPool:
             list,
             {k: v for k, v in self._groups.items() if k[0] > cutoff},
         )
+
 
 
 def _is_subset(a: list[bool], b: list[bool]) -> bool:

@@ -33,6 +33,7 @@ class _EpochSummary:
     blocks_proposed: int = 0
     blocks_missed: int = 0
     # sync committee
+    sync_committee_member: bool = False
     sync_messages_seen: int = 0
     sync_signatures_included: int = 0
     # balances (gwei)
@@ -112,6 +113,12 @@ class ValidatorMonitor:
                 "Latest observed balance of a monitored validator",
                 label_names=("index",),
             )
+            self._m_sync_hit_rate = reg.gauge(
+                "validator_monitor_sync_committee_hit_rate",
+                "Per-epoch fraction of slots a monitored sync-committee"
+                " member's signature landed in imported blocks",
+                label_names=("index",),
+            )
         else:
             self._m_att_hit = self._m_att_miss = None
             self._m_head_hit = self._m_target_hit = None
@@ -120,6 +127,7 @@ class ValidatorMonitor:
             self._m_proposals = self._m_proposals_missed = None
             self._m_sync_seen = self._m_sync_included = None
             self._m_balance = None
+            self._m_sync_hit_rate = None
 
     # -- registration -----------------------------------------------------
 
@@ -201,6 +209,17 @@ class ValidatorMonitor:
             s.attestation_correct_head |= correct_head
             s.attestation_correct_target |= correct_target
 
+    def on_sync_committee_membership(
+        self, member_indices, epoch: int
+    ) -> None:
+        """Record which monitored validators sit in the current sync
+        committee for `epoch`, so the epoch rollup can report a hit
+        RATE (included / expected slots) instead of a bare count."""
+        for idx in member_indices:
+            mv = self.validators.get(int(idx))
+            if mv is not None:
+                mv.summary(int(epoch)).sync_committee_member = True
+
     def on_sync_committee_message(
         self, validator_index: int, slot: int
     ) -> None:
@@ -267,6 +286,15 @@ class ValidatorMonitor:
                         )
                 else:
                     self._m_att_miss.inc()
+            if (
+                self._m_sync_hit_rate is not None
+                and s.sync_committee_member
+            ):
+                self._m_sync_hit_rate.set(
+                    s.sync_signatures_included
+                    / preset().SLOTS_PER_EPOCH,
+                    index=str(idx),
+                )
             if self.log is not None:
                 self.log.info(
                     "validator epoch summary",
@@ -281,6 +309,7 @@ class ValidatorMonitor:
                         "agg_seen": s.attestation_seen_aggregate,
                         "proposed": s.blocks_proposed,
                         "missed": s.blocks_missed,
+                        "sync_member": s.sync_committee_member,
                         "sync_seen": s.sync_messages_seen,
                         "sync_included": s.sync_signatures_included,
                         "balance": s.balance,

@@ -139,7 +139,24 @@ class BeaconNode:
                         vm.on_missed_block(proposer, prev)
             if slot % p.SLOTS_PER_EPOCH == 0 and slot > 0:
                 epoch = slot // p.SLOTS_PER_EPOCH
-                vm.on_balances(self.chain.head_state.state, epoch - 1)
+                st = self.chain.head_state.state
+                vm.on_balances(st, epoch - 1)
+                sc = getattr(st, "current_sync_committee", None)
+                if sc is not None:
+                    from .statetransition.util import PubkeyIndexView
+
+                    pk2i = PubkeyIndexView(st)
+                    members = [
+                        i
+                        for i in (
+                            pk2i.get(bytes(pk)) for pk in sc.pubkeys
+                        )
+                        if i is not None and i in vm.validators
+                    ]
+                    if members:
+                        vm.on_sync_committee_membership(
+                            members, epoch - 1
+                        )
                 vm.on_epoch_summary(epoch - 1)
         except Exception:
             pass  # monitoring must never break the clock tick

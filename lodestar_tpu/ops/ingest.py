@@ -234,10 +234,9 @@ def _mul_x(p: C.JacPoint, batch) -> C.JacPoint:
         from . import pallas_ladder as PL
 
         ax, ay = _jac_to_affine(p)
-        bits = jnp.broadcast_to(
-            jnp.asarray(_x_bits()), tuple(batch) + (64,)
+        return jac_neg(
+            PL.g2_scalar_mul_static(ax, ay, X_ABS, p.inf)
         )
-        return jac_neg(PL.g2_scalar_mul(ax, ay, bits, p.inf))
     return jac_neg(_mul_x_abs(p, batch))
 
 
@@ -267,10 +266,9 @@ def g2_in_subgroup(p: C.JacPoint, batch) -> jax.Array:
     if jax.default_backend() == "tpu" and len(tuple(batch)) == 1:
         from . import pallas_ladder as PL
 
-        bits = jnp.broadcast_to(
-            jnp.asarray(_x_bits()), tuple(batch) + (64,)
+        xq = jac_neg(
+            PL.g2_scalar_mul_static(p.x, p.y, X_ABS, p.inf)
         )
-        xq = jac_neg(PL.g2_scalar_mul(p.x, p.y, bits, p.inf))
         return jac_eq(jac_psi(p), xq)
     return jac_eq(jac_psi(p), _mul_x(p, batch))
 

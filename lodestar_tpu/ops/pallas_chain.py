@@ -123,30 +123,81 @@ def _modmul(a, b, fold_const):
     return lo
 
 
-def _chain_kernel(bits_ref, fold_ref, base_ref, out_ref, *, nbits: int):
+WINDOW = 4  # fixed-window width for pow chains (15-entry table)
+
+
+def window_schedule(e: int, w: int) -> np.ndarray:
+    """MSB-first `w`-bit windows of e, zero-padded at the top so the
+    first window is the leading 1..w bits (always nonzero)."""
+    nb = e.bit_length()
+    nwin = -(-nb // w)
+    padded = nwin * w
+    return np.array(
+        [(e >> (padded - w * (i + 1))) & ((1 << w) - 1)
+         for i in range(nwin)],
+        np.int32,
+    )
+
+
+def make_windowed_powc(mm, window: int):
+    """Windowed fixed-exponent power chain for in-kernel use.
+
+    Square-and-multiply costs 2 modmuls per exponent bit (the multiply
+    runs even for 0 bits, then a select drops it). Fixed `window`-bit
+    windows cost `window` squarings + ONE table multiply per window:
+    ~1.25 modmuls/bit at window 4 — a ~1.55x cut on the chain-dominated
+    ingest stages. The table select is a (2^w-1)-way jnp.where chain on
+    (ROWS, W) planes, trivial next to a modmul; table[0] is the
+    canonical 1 so zero windows multiply by one instead of branching.
+
+    Returns powc(base, win_ref, n_windows) where win_ref holds the
+    int32 window values (SMEM) computed by window_schedule()."""
+
+    def powc(base, win_ref, n_windows):
+        W = base.shape[-1]
+        one = jnp.concatenate(
+            [jnp.ones((1, W), jnp.int32),
+             jnp.zeros((base.shape[0] - 1, W), jnp.int32)],
+            axis=0,
+        )
+        table = [one, base]
+        for _ in range(2, 1 << window):
+            table.append(mm(table[-1], base))
+
+        def sel(wv):
+            acc = table[0]
+            for k in range(1, 1 << window):
+                acc = jnp.where(wv == k, table[k], acc)
+            return acc
+
+        def body(i, acc):
+            for _ in range(window):
+                acc = mm(acc, acc)
+            return mm(acc, sel(win_ref[i]))
+
+        return jax.lax.fori_loop(1, n_windows, body, sel(win_ref[0]))
+
+    return powc
+
+
+def _chain_kernel(win_ref, fold_ref, base_ref, out_ref, *, nwin: int):
     fold_const = fold_ref[:]
     base = base_ref[:]
 
-    def body(i, acc):
-        acc = _modmul(acc, acc, fold_const)
-        prod = _modmul(acc, base, fold_const)
-        bit = bits_ref[i + 1]  # MSB consumed by the init
-        return jnp.where(bit == 1, prod, acc)
+    def mm(a, b):
+        return _modmul(a, b, fold_const)
 
-    acc = jax.lax.fori_loop(0, nbits - 1, body, base)
-    out_ref[:] = acc
+    powc = make_windowed_powc(mm, WINDOW)
+    out_ref[:] = powc(base, win_ref, nwin)
 
 
 @functools.lru_cache(maxsize=None)
 def _chain_call(e: int, n_blocks: int):
-    nbits = e.bit_length()
-    bits = np.array(
-        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], np.int32
-    )
+    wins = window_schedule(e, WINDOW)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    kernel = functools.partial(_chain_kernel, nbits=nbits)
+    kernel = functools.partial(_chain_kernel, nwin=len(wins))
 
     @jax.jit
     def run(base):  # base: (40, n_blocks*128), limbs on sublanes
@@ -170,7 +221,7 @@ def _chain_call(e: int, n_blocks: int):
             out_shape=jax.ShapeDtypeStruct(
                 (ROWS, n_blocks * LANES), jnp.int32
             ),
-        )(jnp.asarray(bits), jnp.asarray(_fold_rows()), base)
+        )(jnp.asarray(wins), jnp.asarray(_fold_rows()), base)
 
     return run
 

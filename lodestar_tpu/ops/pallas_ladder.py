@@ -510,3 +510,200 @@ def g2_scalar_mul(qx, qy, bits, q_inf=None):
         (lv(outs[4]), lv(outs[5])),
         jnp.transpose(outs[6])[:batch, 0] != 0,
     )
+
+
+# ---------------------------------------------------------------------------
+# Static-scalar ladder: [k]Q for a scalar known at trace time.
+#
+# The per-element ladder above computes double + mixed-add EVERY step
+# and selects — right for random blinding scalars, 2x wasteful for the
+# curve parameter |x| = 0xd201000000010000 (popcount 6) that the
+# subgroup check and cofactor clearing multiply by (ingest._mul_x).
+# Here the whole double/add schedule is baked from the static scalar:
+# 63 doubles + 5 adds instead of 63 doubles + 63 adds.
+# ---------------------------------------------------------------------------
+
+
+def _static_ladder_kernel(
+    e,
+    fold_ref,
+    off_ref,
+    qx0_ref, qx1_ref, qy0_ref, qy1_ref, qinf_ref,
+    ox0_ref, ox1_ref, oy0_ref, oy1_ref, oz0_ref, oz1_ref, oinf_ref,
+):
+    fold_const = fold_ref[:]
+    off_const = off_ref[0:1, :].reshape(ROWS)
+    (mm, f2_mul, f2_sqr, f2_sub, f2_add, f2_small, f2_sel) = _mk_field(
+        fold_const, off_const
+    )
+    qx = (qx0_ref[:], qx1_ref[:])
+    qy = (qy0_ref[:], qy1_ref[:])
+    q_inf = qinf_ref[:]
+
+    def jac_double(X, Y, Z):
+        A = f2_sqr(X)
+        Bv = f2_sqr(Y)
+        Cv = f2_sqr(Bv)
+        t = f2_sqr(f2_add(X, Bv))
+        D = f2_small(f2_sub(f2_sub(t, A), Cv), 2)
+        E = f2_small(A, 3)
+        F = f2_sqr(E)
+        x3 = f2_sub(F, f2_small(D, 2))
+        y3 = f2_sub(f2_mul(E, f2_sub(D, x3)), f2_small(Cv, 8))
+        z3 = f2_small(f2_mul(Y, Z), 2)
+        return x3, y3, z3
+
+    def jac_mixed_add(X, Y, Z, inf):
+        z2 = f2_sqr(Z)
+        z3 = f2_mul(z2, Z)
+        mu = f2_sub(f2_mul(qx, z2), X)
+        th = f2_sub(f2_mul(qy, z3), Y)
+        mu2 = f2_sqr(mu)
+        mu3 = f2_mul(mu2, mu)
+        xmu2 = f2_mul(X, mu2)
+        x3 = f2_sub(f2_sub(f2_sqr(th), mu3), f2_small(xmu2, 2))
+        y3 = f2_sub(
+            f2_mul(th, f2_sub(xmu2, x3)), f2_mul(Y, mu3)
+        )
+        z3v = f2_mul(Z, mu)
+        one = jnp.concatenate(
+            [jnp.ones((1, LANES), jnp.int32),
+             jnp.zeros((ROWS - 1, LANES), jnp.int32)],
+            axis=0,
+        )
+        x3 = f2_sel(inf, qx, x3)
+        y3 = f2_sel(inf, qy, y3)
+        z3v = f2_sel(inf, (one, jnp.zeros((ROWS, LANES), jnp.int32)), z3v)
+        return x3, y3, z3v, inf * q_inf
+
+    one = jnp.concatenate(
+        [jnp.ones((1, LANES), jnp.int32),
+         jnp.zeros((ROWS - 1, LANES), jnp.int32)],
+        axis=0,
+    )
+    zero = jnp.zeros((ROWS, LANES), jnp.int32)
+    # acc = Q (consumes the MSB); Z = 1, infinity tracked from q_inf
+    X, Y, Z = (qx[0], qx[1]), (qy[0], qy[1]), (one, zero)
+    inf = q_inf
+
+    def dbl_body(_, st):
+        X = (st[0], st[1]); Y = (st[2], st[3]); Z = (st[4], st[5])
+        inf = st[6]
+        dX, dY, dZ = jac_double(X, Y, Z)
+        dX = f2_sel(inf, X, dX)
+        dY = f2_sel(inf, Y, dY)
+        dZ = f2_sel(inf, Z, dZ)
+        return (dX[0], dX[1], dY[0], dY[1], dZ[0], dZ[1], inf)
+
+    # static schedule: runs of doubles + adds at set bits
+    bits = bin(e)[3:]  # MSB consumed by init
+    i = 0
+    while i < len(bits):
+        # one segment = the doubles up to AND INCLUDING the next set
+        # bit (or the trailing zero run), then one add if it was set
+        nxt = bits.find("1", i)
+        run = (nxt - i + 1) if nxt >= 0 else (len(bits) - i)
+        add_here = nxt >= 0
+        st = (X[0], X[1], Y[0], Y[1], Z[0], Z[1], inf)
+        st = jax.lax.fori_loop(0, run, dbl_body, st)
+        X = (st[0], st[1]); Y = (st[2], st[3]); Z = (st[4], st[5])
+        inf = st[6]
+        if add_here:
+            X, Y, Z, inf = jac_mixed_add(X, Y, Z, inf)
+        i += run
+
+    ox0_ref[:] = X[0]
+    ox1_ref[:] = X[1]
+    oy0_ref[:] = Y[0]
+    oy1_ref[:] = Y[1]
+    oz0_ref[:] = Z[0]
+    oz1_ref[:] = Z[1]
+    oinf_ref[:] = inf
+
+
+@functools.lru_cache(maxsize=None)
+def _static_ladder_call(e: int, n_blocks: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_static_ladder_kernel, e)
+    FOLD_ROWS = _fold_rows().shape[0]
+    vec = lambda: pl.BlockSpec(  # noqa: E731
+        (ROWS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    flag = lambda: pl.BlockSpec(  # noqa: E731
+        (1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    @jax.jit
+    def run(qx0, qx1, qy0, qy1, qinf):
+        n = n_blocks * LANES
+        return pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (FOLD_ROWS, ROWS),
+                    lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ROWS), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+                vec(), vec(), vec(), vec(), flag(),
+            ],
+            out_specs=[vec(), vec(), vec(), vec(), vec(), vec(), flag()],
+            out_shape=[
+                jax.ShapeDtypeStruct((ROWS, n), jnp.int32)
+                for _ in range(6)
+            ]
+            + [jax.ShapeDtypeStruct((1, n), jnp.int32)],
+        )(
+            jnp.asarray(_fold_rows()),
+            jnp.asarray(_sub_offset()).reshape(1, ROWS),
+            qx0, qx1, qy0, qy1, qinf,
+        )
+
+    return run
+
+
+def g2_scalar_mul_static(qx, qy, e: int, q_inf=None):
+    """[e]Q for a trace-time scalar (drop-in for g2_scalar_mul with a
+    shared static scalar such as the BLS parameter |x|)."""
+    from . import curve as C
+
+    x0 = L.normalize(qx[0]).v
+    x1 = L.normalize(qx[1]).v
+    y0 = L.normalize(qy[0]).v
+    y1 = L.normalize(qy[1]).v
+    batch = x0.shape[0]
+    n_blocks = -(-batch // LANES)
+    padded = n_blocks * LANES
+
+    def prep(v):
+        return jnp.transpose(jnp.pad(v, ((0, padded - batch), (0, 0))))
+
+    if q_inf is None:
+        qinf_arr = jnp.zeros((1, padded), jnp.int32)
+    else:
+        qinf_arr = jnp.pad(
+            q_inf.astype(jnp.int32), (0, padded - batch),
+            constant_values=1,
+        ).reshape(1, padded)
+    outs = _static_ladder_call(e, n_blocks)(
+        prep(x0), prep(x1), prep(y0), prep(y1), qinf_arr
+    )
+
+    def lv(v):
+        return L.Lv(
+            jnp.transpose(v)[:batch, :],
+            tuple([0] * L.NCANON),
+            tuple([L.B + 2] * L.NCANON),
+        )
+
+    return C.JacPoint(
+        (lv(outs[0]), lv(outs[1])),
+        (lv(outs[2]), lv(outs[3])),
+        (lv(outs[4]), lv(outs[5])),
+        jnp.transpose(outs[6])[:batch, 0] != 0,
+    )

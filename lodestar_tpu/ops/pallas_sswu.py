@@ -36,7 +36,12 @@ import numpy as np
 from ..crypto.bls import fields as OF
 from ..crypto.bls.fields import P
 from . import limbs as L
-from .pallas_chain import LANES, ROWS, _fold_rows, _modmul
+from .pallas_chain import (
+    LANES, ROWS, _fold_rows, _modmul, make_windowed_powc,
+    window_schedule,
+)
+
+SSWU_WINDOW = 3  # 3-bit windows: 6-entry table, low VMEM pressure
 from .pallas_ladder import _norm2, _sub_offset
 from .pallas_pairing import _mk_tower
 
@@ -106,18 +111,13 @@ def _sswu_kernel(sqrt_bits, inv_bits, fold_ref, off_ref, *refs):
     z_u2 = (ins[-3], ins[-2])
     tvz = ins[-1]  # (ROWS, LANES) broadcast 0/1 mask
 
-    def powc(base, bits_ref, nbits):
-        """base^e (Fq plane), square-and-multiply MSB-first."""
+    # windowed chains (~1.3 modmuls/bit vs 2 for square-and-multiply);
+    # SSWU_WINDOW=3 keeps the 6-entry table's VMEM footprint small in
+    # this many-live-plane kernel (pallas_chain.make_windowed_powc)
+    powc = make_windowed_powc(F.mm, SSWU_WINDOW)
 
-        def body(i, acc):
-            sq = F.mm(acc, acc)
-            pr = F.mm(sq, base)
-            return jnp.where(bits_ref[i] == 1, pr, sq)
-
-        return jax.lax.fori_loop(1, nbits, body, base)
-
-    n_sqrt = len(_bits(E_SQRT))
-    n_inv = len(_bits(E_INV))
+    n_sqrt = len(window_schedule(E_SQRT, SSWU_WINDOW))
+    n_inv = len(window_schedule(E_INV, SSWU_WINDOW))
 
     # tv = (Z u^2)^2 + Z u^2 over Fq2, recomputed in-kernel (cheaper
     # than 2 more input planes); exceptional-case select via the
@@ -228,8 +228,8 @@ def _sswu_call(n_blocks: int):
                 for _ in S_OUTS
             ],
         )(
-            jnp.asarray(_bits(E_SQRT)),
-            jnp.asarray(_bits(E_INV)),
+            jnp.asarray(window_schedule(E_SQRT, SSWU_WINDOW)),
+            jnp.asarray(window_schedule(E_INV, SSWU_WINDOW)),
             jnp.asarray(_fold_rows()),
             jnp.asarray(_sub_offset()).reshape(1, ROWS),
             *[jnp.asarray(consts[k]) for k in _CONST_KEYS],
@@ -331,15 +331,8 @@ def _iso_kernel(inv_bits, fold_ref, off_ref, const_ref, *refs):
     K3 = [kc2(i) for i in range(7, 11)]
     K4 = [kc2(i) for i in range(11, 15)]
 
-    n_inv = len(_bits(E_INV))
-
-    def powc(base, bits_ref, nbits):
-        def body(i, acc):
-            sq = F.mm(acc, acc)
-            pr = F.mm(sq, base)
-            return jnp.where(bits_ref[i] == 1, pr, sq)
-
-        return jax.lax.fori_loop(1, nbits, body, base)
+    n_inv = len(window_schedule(E_INV, SSWU_WINDOW))
+    powc = make_windowed_powc(F.mm, SSWU_WINDOW)
 
     def horner(coeffs, x):
         acc = coeffs[-1]
@@ -414,7 +407,7 @@ def _iso_call(n_blocks: int):
                 for _ in range(8)
             ],
         )(
-            jnp.asarray(_bits(E_INV)),
+            jnp.asarray(window_schedule(E_INV, SSWU_WINDOW)),
             jnp.asarray(_fold_rows()),
             jnp.asarray(_sub_offset()).reshape(1, ROWS),
             jnp.asarray(_iso_const_rows()),

@@ -147,17 +147,19 @@ MAX_INITCODE_SIZE = 2 * MAX_CODE_SIZE
 _TIER: dict[int, int] = {}
 for _op in (0x01, 0x02, 0x03, 0x10, 0x11, 0x12, 0x13, 0x14, 0x15,
             0x16, 0x17, 0x18, 0x19, 0x1A, 0x1B, 0x1C, 0x1D, 0x35,
-            0x36, 0x38, 0x39, 0x3D, 0x3E, 0x50, 0x51, 0x52, 0x53,
-            0x5E):
+            0x39, 0x3E, 0x51, 0x52, 0x53, 0x5E):
     _TIER[_op] = G_VERYLOW
 for _op in (0x04, 0x05, 0x06, 0x07, 0x0B):
     _TIER[_op] = G_LOW
 for _op in (0x08, 0x09, 0x56):
     _TIER[_op] = G_MID
 _TIER[0x57] = G_HIGH
-for _op in (0x30, 0x32, 0x33, 0x34, 0x3A, 0x41, 0x42, 0x43, 0x44,
-            0x45, 0x46, 0x47, 0x48, 0x49, 0x4A, 0x58, 0x59, 0x5A):
+for _op in (0x30, 0x32, 0x33, 0x34, 0x36, 0x38, 0x3A, 0x3D, 0x41,
+            0x42, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48, 0x49, 0x4A,
+            0x50, 0x58, 0x59, 0x5A):
     _TIER[_op] = G_BASE
+_TIER[0x0A] = G_HIGH  # EXP: 10 + 50/exponent byte
+_TIER[0x40] = 20  # BLOCKHASH
 for _op in range(0x60, 0xA0):  # PUSH1..32, DUP, SWAP
     _TIER[_op] = G_VERYLOW
 _TIER[0x5F] = G_BASE  # PUSH0
@@ -370,7 +372,12 @@ class Evm:
                 )
                 used = intrinsic + (inner_gas - left)
                 res = CallResult(True, out, used)
-            used -= min(self.refund, used // 5)
+            # NOTE: EIP-3529 refunds (self.refund) are deliberately
+            # NOT subtracted — refunds are credited after execution
+            # and never reduce the limit a tx needs to run, so
+            # estimate_gas must report the pre-refund requirement
+            # (geth's estimator searches for the minimal succeeding
+            # limit, which is likewise pre-refund).
             return CallResult(res.success, res.output, used,
                               revert=res.revert)
         except Revert as r:
@@ -697,9 +704,8 @@ class Evm:
                     push(0)
                 else:
                     push(int.from_bytes(keccak256(acct.code), "big"))
-            elif op == 0x40:  # BLOCKHASH
+            elif op == 0x40:  # BLOCKHASH (20 charged via _TIER)
                 n = pop()
-                use(20 - G_BASE)
                 h = self.block.block_hashes.get(n, b"")
                 push(int.from_bytes(h, "big") if h else 0)
             elif op == 0x41:

@@ -64,7 +64,13 @@ class ProofProvider:
         """block: None/'latest' -> newest verified anchor; int or hex
         quantity -> verified hash at that number; bytes/0x-hash -> the
         hash itself (must be verified)."""
-        if block is None or block == "latest":
+        # 'finalized'/'safe'/'pending' collapse to the newest
+        # LC-verified anchor: the ProofProvider is fed from verified
+        # finality/optimistic updates, so "latest verified" is the
+        # strongest statement this provider can make for any of them
+        if block is None or block in (
+            "latest", "finalized", "safe", "pending"
+        ):
             if self.latest_block_hash is None:
                 raise VerificationError("no verified execution header")
             return self.latest_block_hash
@@ -72,7 +78,12 @@ class ProofProvider:
             if len(block) == 66 and block.startswith("0x"):
                 block = bytes.fromhex(block[2:])
             else:
-                block = int(block, 16)
+                try:
+                    block = int(block, 16)
+                except ValueError as e:
+                    raise VerificationError(
+                        f"unsupported block tag {block!r}"
+                    ) from e
         if isinstance(block, int):
             bh = self._by_number.get(block)
             if bh is None:
@@ -221,6 +232,7 @@ class VerifiedExecutionProvider:
 
         frm = tx.get("from") or "0x" + "00" * 20
         access: dict[str, list[str]] = {}
+        access_list_ok = False
         acc_tx = {k: v for k, v in tx.items() if v is not None}
         acc_tx.setdefault("from", frm)
         try:
@@ -230,14 +242,15 @@ class VerifiedExecutionProvider:
             for entry in resp.get("accessList", []):
                 access[entry["address"].lower()] = list(
                     entry.get("storageKeys", []))
+            access_list_ok = True
         except VerificationError:
             raise
         except Exception:
-            # RPC without createAccessList: fall back to just the
-            # from/to accounts (sufficient for transfers and
-            # storage-free calls; anything touching unproven storage
-            # reads zeros and the caller sees a verification-scoped
-            # result, never an unverified RPC answer).
+            # RPC without createAccessList: proceed with only the
+            # from/to accounts, but FAIL CLOSED below if the target
+            # turns out to hold code — a contract call without a
+            # storage access list would read unproven slots as zero
+            # and launder a wrong answer as verified.
             pass
         access.setdefault(frm.lower(), [])
         if tx.get("to"):
@@ -290,6 +303,11 @@ class VerifiedExecutionProvider:
                     raise VerificationError(
                         f"storage proof missing for {addr_hex} slot "
                         f"{key}")
+            if code and not access_list_ok:
+                raise VerificationError(
+                    "RPC lacks eth_createAccessList; storage coverage "
+                    "for a contract call cannot be verified"
+                )
             state.put(address, Account(
                 nonce=account["nonce"], balance=account["balance"],
                 code=code, storage=storage))

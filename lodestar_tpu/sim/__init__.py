@@ -11,7 +11,10 @@ from .simulation import Simulation, SimNode
 from .assertions import (
     assert_finalized,
     assert_heads_consistent,
+    assert_inclusion_delay,
+    assert_no_missed_blocks,
     assert_participation,
+    assert_sync_committee_participation,
 )
 
 __all__ = [
@@ -19,5 +22,8 @@ __all__ = [
     "SimNode",
     "assert_finalized",
     "assert_heads_consistent",
+    "assert_inclusion_delay",
+    "assert_no_missed_blocks",
     "assert_participation",
+    "assert_sync_committee_participation",
 ]

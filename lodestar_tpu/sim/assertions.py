@@ -48,3 +48,87 @@ def assert_participation(sim, min_ratio: float) -> None:
         assert ratio >= min_ratio, (
             f"{node.name} participation {ratio:.2f} < {min_ratio}"
         )
+
+
+def _canonical_blocks(node):
+    """Canonical (root, block) pairs from head back to the anchor via
+    fork choice parent links."""
+    chain = node.chain
+    out = []
+    root = chain.head_root
+    proto = chain.fork_choice.proto
+    while root is not None:
+        blk = chain.get_block(root)
+        if blk is None:
+            break
+        out.append((root, blk))
+        n = proto.get_node(root)
+        if n is None or n.parent_root is None:
+            break
+        root = bytes(n.parent_root)
+    out.reverse()
+    return out
+
+
+def assert_inclusion_delay(sim, max_avg: float = 1.1) -> None:
+    """Average attestation inclusion distance over every canonical
+    block (crucible inclusionDelayAssertion: regression that delays
+    inclusion by a slot must fail the sim)."""
+    for node in sim.nodes:
+        delays = []
+        for _, signed in _canonical_blocks(node):
+            blk = getattr(signed, "message", signed)
+            for att in blk.body.attestations:
+                if len(getattr(att, "aggregation_bits", ())) == 0:
+                    continue
+                delays.append(int(blk.slot) - int(att.data.slot))
+        if not delays:
+            continue
+        avg = sum(delays) / len(delays)
+        assert avg <= max_avg, (
+            f"{node.name} avg inclusion delay {avg:.2f} > {max_avg} "
+            f"({len(delays)} attestations)"
+        )
+
+
+def assert_no_missed_blocks(sim, start_slot: int = 1, end_slot=None) -> None:
+    """Every slot in [start_slot, end_slot] has a canonical block
+    (crucible missedBlocksAssertion with 0 tolerated misses)."""
+    for node in sim.nodes:
+        blocks = _canonical_blocks(node)
+        have = {
+            int(getattr(s, "message", s).slot) for _, s in blocks
+        }
+        end = end_slot
+        if end is None:
+            end = max(have) if have else 0
+        missing = [
+            s for s in range(start_slot, end + 1) if s not in have
+        ]
+        assert not missing, (
+            f"{node.name} missed proposals at slots {missing}"
+        )
+
+
+def assert_sync_committee_participation(
+    sim, min_ratio: float = 0.9
+) -> None:
+    """Average SyncAggregate bit participation across canonical altair+
+    blocks (crucible syncCommitteeParticipationAssertion)."""
+    for node in sim.nodes:
+        ratios = []
+        for _, signed in _canonical_blocks(node):
+            blk = getattr(signed, "message", signed)
+            agg = getattr(blk.body, "sync_aggregate", None)
+            if agg is None:
+                continue
+            bits = [bool(b) for b in agg.sync_committee_bits]
+            if not bits:
+                continue
+            ratios.append(sum(bits) / len(bits))
+        if not ratios:
+            continue
+        avg = sum(ratios) / len(ratios)
+        assert avg >= min_ratio, (
+            f"{node.name} sync participation {avg:.2f} < {min_ratio}"
+        )

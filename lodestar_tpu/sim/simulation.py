@@ -26,6 +26,7 @@ from ..network.facade import Network
 from ..params import (
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
     ForkSeq,
     preset,
 )
@@ -50,6 +51,9 @@ class SimNode:
         self.chain = BeaconChain(cfg, types, anchor)
         self.keys = {i: interop_secret_key(i) for i in key_range}
         self.att_pool = AggregatedAttestationPool(types)
+        # (slot, head_root) -> {validator_index: signature} — fed by
+        # own duties + the sync_committee gossip topic
+        self.sync_pool: dict[tuple, dict[int, bytes]] = {}
         self.network = Network(
             self.chain, beacon_cfg, types, peer_id=name
         )
@@ -92,6 +96,22 @@ class SimNode:
             self.network._t("beacon_attestation_0"), on_att
         )
 
+        async def on_sync_msg(peer_id, ssz_bytes):
+            try:
+                msg = self.types.SyncCommitteeMessage.deserialize(
+                    ssz_bytes
+                )
+            except Exception:
+                return ValidationResult.REJECT
+            self.sync_pool.setdefault(
+                (int(msg.slot), bytes(msg.beacon_block_root)), {}
+            )[int(msg.validator_index)] = bytes(msg.signature)
+            return ValidationResult.ACCEPT
+
+        self.network.gossip.subscribe(
+            self.network._t("sync_committee_0"), on_sync_msg
+        )
+
     # -- duties ----------------------------------------------------------
 
     async def maybe_propose(self, slot: int) -> bytes | None:
@@ -113,9 +133,13 @@ class SimNode:
                 get_domain(self.cfg, st, DOMAIN_RANDAO),
             ),
         )
-        atts = self.att_pool.get_attestations_for_block(slot)
+        atts = self.att_pool.get_attestations_for_block(slot, state=st)
+        sync_aggregate = self._sync_aggregate_for(st, slot)
         block, post = self.chain.produce_block(
-            slot, randao, attestations=atts
+            slot,
+            randao,
+            attestations=atts,
+            sync_aggregate=sync_aggregate,
         )
         from ..params import DOMAIN_BEACON_PROPOSER
 
@@ -129,6 +153,72 @@ class SimNode:
         await self.network.publish_block(post.fork, signed)
         self.blocks_proposed += 1
         return self.chain.head_root
+
+    def _sync_aggregate_for(self, st, block_slot: int):
+        """SyncAggregate over the pooled messages for the parent root
+        (SyncCommitteeMessagePool -> produceSyncAggregate analog, fed
+        by every node's sync_commit duty over gossip)."""
+        sc = getattr(st, "current_sync_committee", None)
+        if sc is None:
+            return None
+        msgs = self.sync_pool.get(
+            (block_slot - 1, self.chain.head_root), {}
+        )
+        pk2i = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        bits, sigs = [], []
+        for pk in sc.pubkeys:
+            idx = pk2i.get(bytes(pk))
+            sig = msgs.get(idx) if idx is not None else None
+            bits.append(sig is not None)
+            if sig is not None:
+                sigs.append(sig)
+        sa = self.types.SyncAggregate.default()
+        sa.sync_committee_bits = bits
+        sa.sync_committee_signature = (
+            aggregate_signatures(sigs) if sigs else b"\xc0" + b"\x00" * 95
+        )
+        return sa
+
+    async def sync_commit(self, slot: int) -> None:
+        """Sign sync-committee messages over the current head for OWN
+        validators in the committee, pool + gossip them
+        (SyncCommitteeService analog)."""
+        head_root = self.chain.head_root
+        st = self.chain.get_or_regen_state(head_root).state
+        sc = getattr(st, "current_sync_committee", None)
+        if sc is None:
+            return
+        domain = get_domain(
+            self.cfg,
+            st,
+            DOMAIN_SYNC_COMMITTEE,
+            util.compute_epoch_at_slot(slot),
+        )
+        root = compute_signing_root_from_roots(head_root, domain)
+        # bound memory: only the previous slot's messages are ever
+        # aggregated, so drop anything older
+        self.sync_pool = {
+            k: v for k, v in self.sync_pool.items() if k[0] >= slot - 2
+        }
+        pk2i = {
+            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+        }
+        for pk in sc.pubkeys:
+            idx = pk2i.get(bytes(pk))
+            if idx is None or idx not in self.keys:
+                continue
+            sig = sign(self.keys[idx], root)
+            self.sync_pool.setdefault((slot, head_root), {})[idx] = sig
+            msg = self.types.SyncCommitteeMessage.default()
+            msg.slot = slot
+            msg.beacon_block_root = head_root
+            msg.validator_index = idx
+            msg.signature = sig
+            await self.network.publish_sync_committee_message(
+                msg, subnet=0
+            )
 
     async def attest(self, slot: int) -> None:
         """Sign partial attestations for OWN validators only."""
@@ -236,6 +326,8 @@ class Simulation:
         await asyncio.sleep(0.15 if proposed else 0.02)
         for node in self.nodes:
             await node.attest(self.slot)
+        for node in self.nodes:
+            await node.sync_commit(self.slot)
         await asyncio.sleep(0.1)
 
     async def run_until_slot(self, slot: int) -> None:

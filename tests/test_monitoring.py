@@ -98,3 +98,84 @@ class TestMonitoringService:
         svc = MonitoringService("http://127.0.0.1:1/nope")
         ok = asyncio.run(svc.push_once())
         assert not ok and svc.pushes_failed == 1
+
+
+class TestSyncCommitteeHitRate:
+    def test_membership_and_rate(self):
+        from lodestar_tpu.params import preset
+
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(4)
+        vm.on_sync_committee_membership([4], epoch=2)
+        slots = preset().SLOTS_PER_EPOCH
+        start = 2 * slots
+        # included in half the epoch's blocks
+        for s in range(start, start + slots // 2):
+            vm.on_sync_aggregate_included([4], s)
+        summary = vm.on_epoch_summary(2)
+        assert summary[4].sync_committee_member
+        assert summary[4].sync_signatures_included == slots // 2
+        text = reg.expose()
+        assert 'validator_monitor_sync_committee_hit_rate{index="4"} 0.5' in text
+
+    def test_non_member_no_rate(self):
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(9)
+        vm.on_epoch_summary(1)
+        assert (
+            "validator_monitor_sync_committee_hit_rate" not in reg.expose()
+            or 'index="9"' not in reg.expose()
+        )
+
+
+class TestAttestationInBlockFeed:
+    def test_devchain_feeds_inclusion_metrics(self):
+        """Imported blocks' attestations must reach the monitor with
+        inclusion distance + head/target correctness (chain.
+        _register_attestations_in_block; reference
+        registerAttestationInBlock)."""
+        from lodestar_tpu.chain import DevNode
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.params import preset
+
+        far = 2**64 - 1
+        cfg = ChainConfig(
+            ALTAIR_FORK_EPOCH=far,
+            BELLATRIX_FORK_EPOCH=far,
+            CAPELLA_FORK_EPOCH=far,
+            DENEB_FORK_EPOCH=far,
+            ELECTRA_FORK_EPOCH=far,
+            SHARD_COMMITTEE_PERIOD=0,
+        )
+        from lodestar_tpu.types import ssz_types
+
+        types = ssz_types()
+        node = DevNode(cfg, types, 16, verify_attestations=False)
+        vm = ValidatorMonitor()
+        for i in range(16):
+            vm.register_local_validator(i)
+        node.chain.validator_monitor = vm
+        p = preset()
+
+        async def go():
+            await node.run_until(p.SLOTS_PER_EPOCH + 2)
+            await node.close()
+
+        asyncio.run(go())
+        included = [
+            (idx, s)
+            for idx, mv in vm.validators.items()
+            for s in mv.summaries.values()
+            if s.attestation_included
+        ]
+        assert included, "no attestation inclusion reached the monitor"
+        # a healthy single-chain devnet attests and includes next slot
+        # with correct head + target
+        assert any(
+            s.attestation_inclusion_delay == 1
+            and s.attestation_correct_head
+            and s.attestation_correct_target
+            for _, s in included
+        )

@@ -81,3 +81,38 @@ class TestSimulation:
                 await sim.stop()
 
         asyncio.run(go())
+
+
+class TestCrucibleAssertions:
+    def test_fork_transition_sim_full_assertion_set(self, types):
+        """phase0 -> altair fork transition under the full crucible
+        default assertion set: heads consistent, finalized,
+        participation, avg inclusion delay <= 1.1 slots, zero missed
+        proposals, sync-committee participation >= 0.9 post-fork
+        (cli/test/utils/crucible/assertions/defaults)."""
+        from lodestar_tpu.sim import (
+            assert_inclusion_delay,
+            assert_no_missed_blocks,
+            assert_sync_committee_participation,
+        )
+
+        sim = Simulation(
+            _cfg(ALTAIR_FORK_EPOCH=1), types, n_nodes=2, n_validators=16
+        )
+        p = preset()
+        end = 4 * p.SLOTS_PER_EPOCH + 1
+
+        async def go():
+            await sim.start()
+            try:
+                await sim.run_until_slot(end)
+                assert_heads_consistent(sim)
+                assert_finalized(sim, 1)
+                assert_participation(sim, 0.9)
+                assert_inclusion_delay(sim, 1.1)
+                assert_no_missed_blocks(sim, 1, end)
+                assert_sync_committee_participation(sim, 0.9)
+            finally:
+                await sim.stop()
+
+        asyncio.run(go())
