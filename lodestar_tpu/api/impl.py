@@ -541,6 +541,10 @@ class BeaconApiImpl:
                 fork, signed_blinded
             )
         except Exception as e:
+            # a reveal failure is the worst builder fault: the slot is
+            # likely lost — feed the fault-inspection-window breaker
+            if hasattr(builder, "register_fault"):
+                builder.register_fault(slot, kind="missed_slot")
             raise ApiError(502, f"relay reveal failed: {e}") from e
         # deneb+ reveals carry the blobs bundle alongside the payload
         payload, bundle = (
@@ -549,10 +553,16 @@ class BeaconApiImpl:
         # the revealed payload must hash to the committed header
         hdr = signed_blinded.message.body.execution_payload_header
         if bytes(payload.block_hash) != bytes(hdr.block_hash):
+            # a mismatched reveal loses the slot just like a failed
+            # one — it must feed the inspection window too
+            if hasattr(builder, "register_fault"):
+                builder.register_fault(slot, kind="missed_slot")
             raise ApiError(
                 400, "revealed payload does not match bid header"
             )
-        full = self._unblind(ns, fork, signed_blinded, payload)
+        from ..execution.builder import unblind_signed_block
+
+        full = unblind_signed_block(ns, signed_blinded, payload)
         sidecars = None
         comms = list(
             getattr(
@@ -572,28 +582,11 @@ class BeaconApiImpl:
                 list(bundle.get("proofs") or []),
             )
         await self.chain.process_block(full, blob_sidecars=sidecars)
+        if hasattr(builder, "register_success"):
+            builder.register_success(slot)
         if self.node is not None and self.node.network is not None:
             await self.node.network.publish_block(fork, full)
         return {}
-
-    def _unblind(self, ns, fork, signed_blinded, payload):
-        """SignedBlindedBeaconBlock + revealed payload -> full
-        SignedBeaconBlock (same signature: the roots are identical)."""
-        blinded = signed_blinded.message
-        full = ns.SignedBeaconBlock.default()
-        msg = full.message
-        msg.slot = blinded.slot
-        msg.proposer_index = blinded.proposer_index
-        msg.parent_root = bytes(blinded.parent_root)
-        msg.state_root = bytes(blinded.state_root)
-        body = msg.body
-        for name, _ in ns.BlindedBeaconBlockBody.fields:
-            if name == "execution_payload_header":
-                body.execution_payload = payload
-            else:
-                setattr(body, name, getattr(blinded.body, name))
-        full.signature = bytes(signed_blinded.signature)
-        return full
 
     # -- pool namespace ---------------------------------------------------
 
@@ -786,6 +779,27 @@ class BeaconApiImpl:
         t = self.types.by_fork[post.fork].BeaconBlock
         return {"version": post.fork, **{"data": to_json(t, block)}}
 
+    def _builder_usable(self, builder, slot: int) -> bool:
+        """Gate the builder race (reference: the proposal-time circuit
+        breaker): operator kill-switch, the relay-error inspection
+        window, and the chain's own recent missed slots all veto the
+        race — a relay that wins bids and withholds payloads shows up
+        as missed proposals, not client errors."""
+        if not getattr(builder, "enabled", True):
+            return False
+        cb = getattr(builder, "circuit_breaker", None)
+        if cb is None:
+            return True
+        if not builder.available(slot):
+            return False
+        from ..execution.builder import missed_slots_in_window
+
+        try:
+            missed = missed_slots_in_window(self.chain, slot, cb.window)
+        except Exception:
+            return True  # breaker must never veto on bookkeeping bugs
+        return missed <= cb.allowed_faults
+
     async def produce_block_v3(
         self,
         slot: str,
@@ -824,7 +838,9 @@ class BeaconApiImpl:
         builder = (
             getattr(self.node, "builder", None) if self.node else None
         )
-        if builder is not None and not getattr(builder, "enabled", True):
+        if builder is not None and not self._builder_usable(
+            builder, slot_i
+        ):
             builder = None
 
         # advance a scratch view once: proposer pubkey (builder bid
@@ -853,11 +869,19 @@ class BeaconApiImpl:
             )
             pubkey = bytes(work.state.validators[proposer].pubkey)
             try:
-                return await builder.get_header(
+                bid = await builder.get_header(
                     slot_i, parent_hash, pubkey
                 )
             except Exception:
-                return None  # relay fault -> local block wins
+                # relay fault -> local block wins; the fault feeds the
+                # inspection-window breaker so repeated errors skip
+                # the race on upcoming slots
+                if hasattr(builder, "register_fault"):
+                    builder.register_fault(slot_i)
+                return None
+            if bid is not None and hasattr(builder, "register_success"):
+                builder.register_success(slot_i)
+            return bid
 
         (engine_payload, bundle, engine_value), bid = await _asyncio.gather(
             engine_side(), builder_side()

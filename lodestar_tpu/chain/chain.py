@@ -592,13 +592,33 @@ class BeaconChain:
                 )
                 if len(getattr(er, name))
             ]
-        st = await self.execution_engine.notify_new_payload(
-            work.fork,
-            payload,
-            versioned_hashes=versioned_hashes,
-            parent_root=bytes(block.parent_root),
-            execution_requests=execution_requests,
-        )
+        from ..execution.engine import ExecutionEngineError
+
+        try:
+            st = await self.execution_engine.notify_new_payload(
+                work.fork,
+                payload,
+                versioned_hashes=versioned_hashes,
+                parent_root=bytes(block.parent_root),
+                execution_requests=execution_requests,
+            )
+        except ExecutionEngineError as e:
+            if getattr(e, "auth_failed", False):
+                # Wrong JWT secret: retrying/degrading cannot help and
+                # silently importing everything optimistically would
+                # mask a fatal misconfiguration — fail the import
+                # loudly (reference: AUTH_FAILED is surfaced, not
+                # absorbed).
+                raise ChainError(
+                    "execution engine authentication failed — check "
+                    f"the JWT secret: {e}"
+                ) from e
+            # Engine unreachable (or its breaker is open): degrade to
+            # an optimistic import instead of failing the block — the
+            # reference's ELERROR handling keeps the node following
+            # the chain while the EL flaps, and fork choice marks the
+            # block syncing so it is re-judged once the EL returns.
+            return ExecutionStatus.syncing
         if st.status in (EPS.VALID,):
             return ExecutionStatus.valid
         if st.status in (EPS.INVALID, EPS.INVALID_BLOCK_HASH):
@@ -632,11 +652,19 @@ class BeaconChain:
             if fin is not None and fin.fork_seq >= ForkSeq.bellatrix
             else b"\x00" * 32
         )
-        resp = await self.execution_engine.notify_forkchoice_update(
-            head.fork,
-            ForkchoiceState(head_hash, head_hash, fin_hash),
-            attributes,
-        )
+        from ..execution.engine import ExecutionEngineError
+
+        try:
+            resp = await self.execution_engine.notify_forkchoice_update(
+                head.fork,
+                ForkchoiceState(head_hash, head_hash, fin_hash),
+                attributes,
+            )
+        except ExecutionEngineError:
+            # fcU is advisory: an unreachable engine must not crash the
+            # import/prepare loops. Callers treat a None payload_id as
+            # "no engine build available" and fall back locally.
+            return None
         return resp.payload_id
 
     async def send_payload_attributes(self, slot: int, work):
@@ -672,10 +700,19 @@ class BeaconChain:
         (reference: prepareExecutionPayload, produceBlockBody.ts:373).
         Returns (payload, blobs_bundle|None, block_value) — the value
         weighs against builder bids in produceBlockV3's race."""
+        from ..execution.engine import ExecutionEngineError
+
         payload_id = await self.send_payload_attributes(slot, work)
         if payload_id is None:
             return None, None, 0
-        got = await self.execution_engine.get_payload(work.fork, payload_id)
+        try:
+            got = await self.execution_engine.get_payload(
+                work.fork, payload_id
+            )
+        except ExecutionEngineError:
+            # engine died between fcU and getPayload — report "no
+            # engine payload" and let production fall back locally
+            return None, None, 0
         return got.execution_payload, got.blobs_bundle, got.block_value
 
     def _persist_import(self, block_root, signed_block, work) -> None:

@@ -87,22 +87,39 @@ class AggregatedAttestationPool:
             self.types.AttestationData.hash_tree_root(data),
         )
         bits = list(attestation.aggregation_bits)
+        cb = getattr(attestation, "committee_bits", None)
+        cb = list(cb) if cb is not None else None
         group = self._groups[key]
+        # electra+: aggregates for DIFFERENT committee selections share
+        # the (slot, data_root) key (data.index is 0) but their
+        # aggregation_bits index different validator sets — dedup and
+        # subset pruning are only meaningful between aggregates with
+        # the SAME committee_bits
+        def same_committees(e):
+            return e.get("committee_bits") == cb
+
         for existing in group:
-            if existing["bits"] == bits:
+            if same_committees(existing) and existing["bits"] == bits:
                 return  # exact duplicate
         # keep only non-subset aggregates (MatchingDataAttestationGroup)
         group[:] = [
             e
             for e in group
-            if not _is_subset(e["bits"], bits)
+            if not (same_committees(e) and _is_subset(e["bits"], bits))
         ]
-        if not any(_is_subset(bits, e["bits"]) for e in group):
+        if not any(
+            same_committees(e) and _is_subset(bits, e["bits"])
+            for e in group
+        ):
             group.append(
                 {
                     "bits": bits,
                     "sig": bytes(attestation.signature),
                     "data": data,
+                    # electra+ aggregates span committees; keep the
+                    # bits so packing can rebuild them and the
+                    # on-chain filter knows to stand down
+                    "committee_bits": cb,
                 }
             )
 
@@ -141,6 +158,10 @@ class AggregatedAttestationPool:
                 a.data = e["data"]
                 a.aggregation_bits = list(e["bits"])
                 a.signature = e["sig"]
+                if e.get("committee_bits") is not None and hasattr(
+                    a, "committee_bits"
+                ):
+                    a.committee_bits = list(e["committee_bits"])
                 out.append(a)
                 if len(out) >= max_atts:
                     return out
@@ -157,6 +178,13 @@ class AggregatedAttestationPool:
             from ..statetransition import util as st_util
             from ..statetransition.util import TIMELY_TARGET_FLAG_INDEX
 
+            if entry.get("committee_bits"):
+                # electra aggregates: data.index is 0 and the bits span
+                # EVERY committee selected by committee_bits — the
+                # single-committee mapping below would derive the wrong
+                # attesters and silently drop includable aggregates.
+                # Don't filter until the electra offset mapping exists.
+                return False
             p = preset()
             att_epoch = att_slot // p.SLOTS_PER_EPOCH
             state_epoch = int(state.slot) // p.SLOTS_PER_EPOCH

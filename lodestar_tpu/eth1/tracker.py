@@ -173,14 +173,25 @@ class Eth1DepositDataTracker:
     production's get_eth1_data_and_deposits (spec get_eth1_vote +
     deposit proof assembly)."""
 
-    def __init__(self, cfg, types, provider):
+    # polling backoff bounds (seconds): first failure waits BASE, each
+    # consecutive failure doubles up to MAX (jittered), mirroring the
+    # reference follow loop's error backoff
+    BACKOFF_BASE = 1.0
+    BACKOFF_MAX = 60.0
+
+    def __init__(self, cfg, types, provider, clock=None):
+        from ..resilience.clock import SYSTEM_CLOCK
+
         self.cfg = cfg
         self.types = types
         self.provider = provider
+        self.clock = clock or SYSTEM_CLOCK
         self.tree = DepositTree()
         self.metrics = None  # lodestar_eth1_* family (node wiring)
         self.deposits: list[DepositLog] = []
         self.blocks: dict[int, Eth1Block] = {}  # followed eth1 blocks
+        self._consecutive_failures = 0
+        self._next_poll_at = 0.0  # monotonic deadline while backing off
         # Log-follow starts at the deposit contract's deployment block —
         # there can be no logs before it (ref eth1 follow loop seeds
         # from depositContractDeployBlock).
@@ -190,17 +201,36 @@ class Eth1DepositDataTracker:
 
     # -- log following -----------------------------------------------------
 
+    def _record_poll_failure(self) -> None:
+        """Exponential backoff between failed polling rounds so a dead
+        provider isn't hammered every slot; the next update() inside
+        the window is a no-op instead of another doomed request."""
+        from ..resilience import backoff_delay
+
+        if self.metrics is not None:
+            self.metrics.update_errors_total.inc()
+        delay = backoff_delay(
+            self._consecutive_failures,
+            self.BACKOFF_BASE,
+            self.BACKOFF_MAX,
+            jitter="none",
+        )
+        self._consecutive_failures += 1
+        self._next_poll_at = self.clock.monotonic() + delay
+
     async def update(self) -> None:
         """One polling round: fetch new logs up to the follow distance
         (eth1DepositDataTracker.ts update loop). getLogs is chunked
         (providers reject unbounded ranges) and headers are fetched only
         inside the eth1-vote candidate window, not for every followed
-        block."""
+        block. Failed rounds back off exponentially (injectable clock)
+        before the provider is polled again."""
+        if self.clock.monotonic() < self._next_poll_at:
+            return  # still backing off a previous provider failure
         try:
             head = await self.provider.get_block_number()
         except Exception:
-            if self.metrics is not None:
-                self.metrics.update_errors_total.inc()
+            self._record_poll_failure()
             raise
         followed = max(0, head - self.cfg.ETH1_FOLLOW_DISTANCE)
         if self.metrics is not None:
@@ -213,32 +243,40 @@ class Eth1DepositDataTracker:
         # re-delivered logs (index < len) are skipped idempotently.
         hdr_floor = max(followed - MAX_FOLLOWED_BLOCKS + 1, 0)
         start = self._synced_to + 1
-        while start <= followed:
-            end = min(start + GET_LOGS_CHUNK - 1, followed)
-            logs = await self.provider.get_deposit_logs(start, end)
-            for log in sorted(logs, key=lambda x: x.index):
-                if log.index < len(self.deposits):
-                    continue  # re-delivered after a partial round
-                if log.index != len(self.deposits):
-                    raise Eth1Error(
-                        f"deposit log gap: got {log.index}, "
-                        f"expected {len(self.deposits)}"
+        try:
+            while start <= followed:
+                end = min(start + GET_LOGS_CHUNK - 1, followed)
+                logs = await self.provider.get_deposit_logs(start, end)
+                for log in sorted(logs, key=lambda x: x.index):
+                    if log.index < len(self.deposits):
+                        continue  # re-delivered after a partial round
+                    if log.index != len(self.deposits):
+                        raise Eth1Error(
+                            f"deposit log gap: got {log.index}, "
+                            f"expected {len(self.deposits)}"
+                        )
+                    self.deposits.append(log)
+                    self.tree.push(self._deposit_data_root(log))
+                # Headers for this chunk's slice of the candidate window
+                # (only the tail that can ever be an eth1-vote candidate),
+                # fetched concurrently in bounded waves.
+                h0 = max(start, hdr_floor)
+                for wave in range(h0, end + 1, 64):
+                    nums = range(wave, min(wave + 64, end + 1))
+                    got = await asyncio.gather(
+                        *(self.provider.get_block(bn) for bn in nums)
                     )
-                self.deposits.append(log)
-                self.tree.push(self._deposit_data_root(log))
-            # Headers for this chunk's slice of the candidate window
-            # (only the tail that can ever be an eth1-vote candidate),
-            # fetched concurrently in bounded waves.
-            h0 = max(start, hdr_floor)
-            for wave in range(h0, end + 1, 64):
-                nums = range(wave, min(wave + 64, end + 1))
-                got = await asyncio.gather(
-                    *(self.provider.get_block(bn) for bn in nums)
-                )
-                for blk in got:
-                    self.blocks[blk.number] = blk
-            self._synced_to = end
-            start = end + 1
+                    for blk in got:
+                        self.blocks[blk.number] = blk
+                self._synced_to = end
+                start = end + 1
+        except Exception:
+            # _synced_to already advanced per completed chunk, so the
+            # next round resumes where this one failed
+            self._record_poll_failure()
+            raise
+        self._consecutive_failures = 0
+        self._next_poll_at = 0.0
         while len(self.blocks) > MAX_FOLLOWED_BLOCKS:
             self.blocks.pop(min(self.blocks))
 
@@ -325,8 +363,17 @@ class Eth1DepositDataTracker:
 
     async def get_eth1_data_and_deposits(self, state):
         """(eth1_data, deposits) for produceBlockBody (reference:
-        Eth1ForBlockProduction.getEth1DataAndDeposits)."""
-        await self.update()
+        Eth1ForBlockProduction.getEth1DataAndDeposits). A failed
+        polling round must not fail block production: the vote falls
+        back to what the tracker already follows (worst case the
+        state's own eth1_data — the spec default when no candidates
+        qualify)."""
+        try:
+            await self.update()
+        except Exception:
+            # already metered + backoff-scheduled by update(); serve
+            # from the last synced window
+            pass
         eth1_data = self.get_eth1_vote(state)
         deposits = self.get_deposits(state, eth1_data)
         return eth1_data, deposits

@@ -7,17 +7,23 @@ builder client (builder/http.ts:60).
 """
 
 from .engine import (
+    EngineOfflineError,
+    ExecutionEngineError,
     ExecutionPayloadStatus,
     ForkchoiceState,
     PayloadAttributes,
     PayloadStatus,
+    ResilientEngine,
 )
 from .mock import MockExecutionEngine
 
 __all__ = [
+    "EngineOfflineError",
+    "ExecutionEngineError",
     "ExecutionPayloadStatus",
     "ForkchoiceState",
     "PayloadAttributes",
     "PayloadStatus",
     "MockExecutionEngine",
+    "ResilientEngine",
 ]

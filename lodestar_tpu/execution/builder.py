@@ -4,6 +4,14 @@ Reference analog: ExecutionBuilderHttp (execution/builder/http.ts:60)
 over the builder-specs REST API: registerValidator, getHeader (bid for
 a blinded block), submitBlindedBlock (reveal). `MockRelay` is the test
 double (reference uses mocked relays in unit tests).
+
+Fault handling mirrors the reference's builder circuit breaker: faults
+(relay errors, missed proposals) are recorded per slot into a
+`FaultInspectionWindow`; while more than `allowed_faults` slots of the
+trailing window carry faults, `available()` is False and the proposal
+path skips the builder race entirely, producing locally. The
+reference's knobs are the `faultInspectionWindow` / `allowedFaults`
+CLI flags; here they are constructor params.
 """
 
 from __future__ import annotations
@@ -14,9 +22,51 @@ import urllib.error
 import urllib.request
 from dataclasses import dataclass
 
+from ..resilience import FaultInspectionWindow
+
 
 class BuilderError(Exception):
     pass
+
+
+def unblind_signed_block(ns, signed_blinded, payload):
+    """SignedBlindedBeaconBlock + revealed payload -> full
+    SignedBeaconBlock. The signature carries over because the blinded
+    and full blocks hash to the same root when the header commits to
+    the payload (shared by the API unblinding route and the sim's
+    builder proposal path)."""
+    blinded = signed_blinded.message
+    full = ns.SignedBeaconBlock.default()
+    msg = full.message
+    msg.slot = blinded.slot
+    msg.proposer_index = blinded.proposer_index
+    msg.parent_root = bytes(blinded.parent_root)
+    msg.state_root = bytes(blinded.state_root)
+    body = msg.body
+    for name, _ in ns.BlindedBeaconBlockBody.fields:
+        if name == "execution_payload_header":
+            body.execution_payload = payload
+        else:
+            setattr(body, name, getattr(blinded.body, name))
+    full.signature = bytes(signed_blinded.signature)
+    return full
+
+
+def missed_slots_in_window(chain, current_slot: int, window: int) -> int:
+    """Count slots in (current_slot - window, current_slot) without a
+    canonical block — the reference breaker's fault signal (a relay
+    that wins bids and then withholds payloads shows up as missed
+    proposals, not client-side errors)."""
+    lo = max(0, current_slot - window)
+    have = set()
+    proto = chain.fork_choice.proto
+    for n in proto.iter_chain(chain.head_root):
+        if n.slot <= lo:
+            break
+        have.add(n.slot)
+    return sum(
+        1 for s in range(lo + 1, current_slot) if s not in have
+    )
 
 
 @dataclass
@@ -30,24 +80,45 @@ class BuilderBid:
 
 
 class ExecutionBuilderHttp:
-    """builder-specs REST client (http.ts:60). Faulty relays are
-    circuit-broken like the reference: after `max_faults` consecutive
-    errors the builder is disabled until re-enabled."""
+    """builder-specs REST client (http.ts:60) behind a fault-
+    inspection-window circuit breaker: relay errors and missed slots
+    are recorded per slot; when more than `allowed_faults` of the
+    trailing `fault_inspection_window` slots are faulty, `available()`
+    goes False (the proposal path then skips the builder race and
+    produces locally) until the faults age out and a probe bid
+    succeeds."""
 
     def __init__(self, base_url: str, types, timeout: float = 5.0,
-                 max_faults: int = 3):
+                 fault_inspection_window: int = 32,
+                 allowed_faults: int = 4, metrics=None):
         self.base_url = base_url.rstrip("/")
         self.types = types
         self.timeout = timeout
-        self.enabled = True
-        self.faults = 0
-        self.max_faults = max_faults
+        self.enabled = True  # operator kill-switch, not the breaker
+        self.circuit_breaker = FaultInspectionWindow(
+            name="builder",
+            window=fault_inspection_window,
+            allowed_faults=allowed_faults,
+        )
+        self.metrics = metrics  # resilience family (node wiring)
+
+    # -- breaker bookkeeping (callers know the slot; the HTTP layer
+    #    doesn't) -----------------------------------------------------
+
+    def available(self, slot: int) -> bool:
+        return self.enabled and self.circuit_breaker.available(slot)
+
+    def register_fault(self, slot: int, kind: str = "relay_error") -> None:
+        self.circuit_breaker.record_fault(slot)
+        if self.metrics is not None:
+            self.metrics.builder_faults_total.inc(kind=kind)
+
+    def register_success(self, slot: int) -> None:
+        self.circuit_breaker.record_success(slot)
 
     async def _call(self, method: str, path: str, body=None):
         if not self.enabled:
-            raise BuilderError(
-                "builder circuit-broken after repeated faults"
-            )
+            raise BuilderError("builder disabled")
 
         def _do():
             data = json.dumps(body).encode() if body is not None else None
@@ -62,13 +133,10 @@ class ExecutionBuilderHttp:
                 return json.loads(raw) if raw else None
 
         try:
-            out = await asyncio.get_event_loop().run_in_executor(None, _do)
-            self.faults = 0
-            return out
+            return await asyncio.get_event_loop().run_in_executor(
+                None, _do
+            )
         except (urllib.error.URLError, OSError, TimeoutError) as e:
-            self.faults += 1
-            if self.faults >= self.max_faults:
-                self.enabled = False
             raise BuilderError(str(e)) from e
 
     async def register_validators(self, registrations: list[dict]) -> None:

@@ -12,6 +12,21 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 
+class ExecutionEngineError(Exception):
+    """Base for engine-availability failures (transport, auth, circuit
+    open). Chain import treats these as 'engine unreachable' and falls
+    back to optimistic handling instead of crashing the import."""
+
+    retryable = True
+    auth_failed = False
+
+
+class EngineOfflineError(ExecutionEngineError):
+    """Fail-fast signal: the engine breaker is OPEN, no call was made."""
+
+    retryable = False
+
+
 class ExecutionPayloadStatus(str, Enum):
     """engine_newPayload verdicts (interface.ts:23-60)."""
 
@@ -154,3 +169,103 @@ def payload_from_json(types, fork: str, obj: dict):
             obj.get("excessBlobGas", "0x0")
         )
     return payload
+
+
+# ---------------------------------------------------------------------------
+# Availability wrapper
+# ---------------------------------------------------------------------------
+
+
+class ResilientEngine:
+    """IExecutionEngine wrapper adding engine-state tracking and a
+    fail-fast circuit breaker around ANY inner engine (HTTP client,
+    in-process mock, or a sim fault injector).
+
+    Reference analog: the updateEngineState bookkeeping inside
+    ExecutionEngineHttp (engine/http.ts) — every exchange drives the
+    ONLINE/SYNCED/SYNCING/OFFLINE/AUTH_FAILED machine. On top of that,
+    when the breaker is OPEN (the engine has been failing and its
+    reset window hasn't elapsed) calls raise EngineOfflineError
+    immediately instead of burning a transport timeout per call — the
+    fail-fast the block-import and proposal hot paths need while the
+    EL is down.
+    """
+
+    def __init__(self, inner, tracker=None, breaker=None):
+        from ..resilience import CircuitBreaker, EngineStateTracker
+
+        self.inner = inner
+        self.tracker = tracker if tracker is not None else (
+            EngineStateTracker()
+        )
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            name="engine", failure_threshold=3, reset_timeout=12.0
+        )
+
+    @property
+    def state(self):
+        return self.tracker.state
+
+    async def _guarded(self, coro_fn, status_of=None):
+        if not self.breaker.allows():
+            raise EngineOfflineError(
+                "execution engine offline (circuit open, state "
+                f"{self.tracker.state.value})"
+            )
+        try:
+            result = await coro_fn()
+        except Exception as e:
+            if getattr(e, "answered", False):
+                # the engine RESPONDED (JSON-RPC error object): it is
+                # reachable — availability-wise this is a success, the
+                # caller still sees the error
+                self.breaker.on_success()
+                self.tracker.on_success(None)
+            else:
+                self.tracker.on_error(e)
+                self.breaker.on_failure()
+            raise
+        except BaseException:
+            # cancellation (proposal deadline, shutdown): no verdict on
+            # engine health either way, but a half-open probe slot must
+            # be handed back or the breaker would deny calls forever
+            self.breaker.release_probe()
+            raise
+        self.breaker.on_success()
+        self.tracker.on_success(
+            status_of(result) if status_of is not None else None
+        )
+        return result
+
+    async def notify_new_payload(self, fork, payload, **kw):
+        return await self._guarded(
+            lambda: self.inner.notify_new_payload(fork, payload, **kw),
+            status_of=lambda r: r.status,
+        )
+
+    async def notify_forkchoice_update(self, fork, state, attributes=None):
+        return await self._guarded(
+            lambda: self.inner.notify_forkchoice_update(
+                fork, state, attributes
+            ),
+            status_of=lambda r: r.payload_status.status,
+        )
+
+    async def get_payload(self, fork, payload_id, *a, **kw):
+        return await self._guarded(
+            lambda: self.inner.get_payload(fork, payload_id, *a, **kw)
+        )
+
+    async def get_payload_bodies_by_hash(self, fork, block_hashes):
+        return await self._guarded(
+            lambda: self.inner.get_payload_bodies_by_hash(
+                fork, block_hashes
+            )
+        )
+
+    async def get_payload_bodies_by_range(self, fork, start, count):
+        return await self._guarded(
+            lambda: self.inner.get_payload_bodies_by_range(
+                fork, start, count
+            )
+        )

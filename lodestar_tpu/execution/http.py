@@ -21,7 +21,9 @@ import urllib.request
 from hashlib import sha256
 
 from ..params import ForkSeq
+from ..resilience import RetryOptions, retry, retry_sync
 from .engine import (
+    ExecutionEngineError,
     ExecutionPayloadStatus,
     ForkchoiceResponse,
     ForkchoiceState,
@@ -37,8 +39,38 @@ from .engine import (
 )
 
 
-class EngineApiError(Exception):
+class EngineApiError(ExecutionEngineError):
     pass
+
+
+class RpcTransportError(EngineApiError):
+    """The wire failed (refused/reset/timeout) — worth retrying."""
+
+    retryable = True
+
+
+class EngineRpcError(EngineApiError):
+    """The server ANSWERED with a JSON-RPC error object. The call was
+    delivered; retrying the identical request cannot change the
+    verdict (jsonRpcHttpClient.ts treats these as terminal too).
+    `answered = True` tells the availability layer the engine is
+    reachable — an error answer must not open the circuit breaker or
+    mark the engine OFFLINE."""
+
+    retryable = False
+    answered = True
+
+    def __init__(self, method: str, message, code):
+        super().__init__(f"{method}: {message} (code {code})")
+        self.code = code
+
+
+class EngineAuthError(EngineApiError):
+    """HTTP 401/403 — JWT rejected. Never retried; drives the
+    AUTH_FAILED engine state."""
+
+    retryable = False
+    auth_failed = True
 
 
 def _b64url(b: bytes) -> str:
@@ -58,10 +90,14 @@ def jwt_token(secret: bytes, now: float | None = None) -> str:
 
 
 class JsonRpcHttpClient:
-    """Minimal JSON-RPC 2.0 over HTTP with retries + JWT.
+    """JSON-RPC 2.0 over HTTP with classified retries + JWT.
 
     Reference: eth1/provider/jsonRpcHttpClient.ts:76 (retry/timeout/
-    metrics wrapper around fetch)."""
+    metrics wrapper around fetch). Retry policy: transport failures
+    (refused/reset/per-attempt timeout) are retried with capped
+    exponential backoff + full jitter; JSON-RPC error responses and
+    auth rejections are terminal. The clock/rng are injectable so the
+    retry schedule is unit-testable without sleeping."""
 
     def __init__(
         self,
@@ -69,16 +105,72 @@ class JsonRpcHttpClient:
         jwt_secret: bytes | None = None,
         timeout: float = 12.0,
         retries: int = 1,
+        base_delay: float = 0.05,
+        max_delay: float = 2.0,
+        clock=None,
+        rng=None,
+        name: str = "engine",
+        metrics=None,  # resilience metric family (node wiring)
     ):
         self.url = url
         self.jwt_secret = jwt_secret
         self.timeout = timeout
         self.retries = retries
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.clock = clock
+        self.rng = rng
+        self.name = name
+        self.metrics = metrics
         self._id = 0
 
-    def call_sync(self, method: str, params: list):
+    def _request_once(self, method: str, payload: bytes):
+        """One HTTP exchange; raises the classified error family."""
+        headers = {"Content-Type": "application/json"}
+        if self.jwt_secret is not None:
+            headers["Authorization"] = (
+                "Bearer " + jwt_token(self.jwt_secret)
+            )
+        req = urllib.request.Request(
+            self.url, data=payload, headers=headers, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout
+            ) as resp:
+                try:
+                    out = json.loads(resp.read())
+                except (ValueError, OSError) as e:
+                    # HTTP 200 with a non-JSON/truncated body (proxy
+                    # error page, cut connection): transport-shaped,
+                    # retryable — must stay inside the error taxonomy
+                    # so chain-side degradation matches it
+                    raise RpcTransportError(
+                        f"{method}: malformed response body: {e}"
+                    ) from e
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 403):
+                raise EngineAuthError(
+                    f"{method}: auth rejected (HTTP {e.code})"
+                ) from e
+            raise RpcTransportError(
+                f"{method}: HTTP {e.code}"
+            ) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise RpcTransportError(
+                f"{method}: transport failed: {e}"
+            ) from e
+        if "error" in out and out["error"]:
+            raise EngineRpcError(
+                method,
+                out["error"].get("message"),
+                out["error"].get("code"),
+            )
+        return out.get("result")
+
+    def _payload_for(self, method: str, params: list) -> bytes:
         self._id += 1
-        payload = json.dumps(
+        return json.dumps(
             {
                 "jsonrpc": "2.0",
                 "id": self._id,
@@ -86,35 +178,63 @@ class JsonRpcHttpClient:
                 "params": params,
             }
         ).encode()
-        headers = {"Content-Type": "application/json"}
-        last = None
-        for _ in range(self.retries + 1):
-            if self.jwt_secret is not None:
-                headers["Authorization"] = (
-                    "Bearer " + jwt_token(self.jwt_secret)
-                )
-            req = urllib.request.Request(
-                self.url, data=payload, headers=headers, method="POST"
+
+    def _retry_opts(self) -> RetryOptions:
+        opts = RetryOptions(
+            retries=self.retries,
+            base_delay=self.base_delay,
+            max_delay=self.max_delay,
+        )
+        if self.metrics is not None:
+            from ..resilience import make_retry_hook
+
+            opts.on_retry = make_retry_hook(self.metrics, self.name)
+        return opts
+
+    def _count_giveup(self, exc) -> None:
+        # "gave up" means retries were actually exhausted — terminal
+        # first-attempt answers (RPC error objects, auth rejections)
+        # were never retried and must not inflate the counter
+        if self.metrics is not None and getattr(
+            exc, "retryable", False
+        ):
+            self.metrics.retry_giveups_total.inc(client=self.name)
+
+    def call_sync(self, method: str, params: list):
+        payload = self._payload_for(method, params)
+        try:
+            return retry_sync(
+                lambda: self._request_once(method, payload),
+                self._retry_opts(),
+                clock=self.clock,
+                rng=self.rng,
             )
-            try:
-                with urllib.request.urlopen(
-                    req, timeout=self.timeout
-                ) as resp:
-                    out = json.loads(resp.read())
-                if "error" in out and out["error"]:
-                    raise EngineApiError(
-                        f"{method}: {out['error'].get('message')} "
-                        f"(code {out['error'].get('code')})"
-                    )
-                return out.get("result")
-            except (urllib.error.URLError, TimeoutError, OSError) as e:
-                last = e
-        raise EngineApiError(f"{method}: transport failed: {last}")
+        except EngineApiError as e:
+            self._count_giveup(e)
+            raise
 
     async def call(self, method: str, params: list):
-        return await asyncio.get_event_loop().run_in_executor(
-            None, self.call_sync, method, params
-        )
+        """Async path: each attempt runs the blocking exchange in the
+        executor; backoff sleeps ride the (injectable) async clock so
+        the event loop is never blocked between attempts."""
+        payload = self._payload_for(method, params)
+        loop = asyncio.get_event_loop()
+
+        def attempt():
+            return loop.run_in_executor(
+                None, self._request_once, method, payload
+            )
+
+        try:
+            return await retry(
+                attempt,
+                self._retry_opts(),
+                clock=self.clock,
+                rng=self.rng,
+            )
+        except EngineApiError as e:
+            self._count_giveup(e)
+            raise
 
 
 def _status_from_json(obj: dict) -> PayloadStatus:

@@ -60,6 +60,23 @@ class ResponseChunk:
     payload: bytes  # ssz bytes (already unframed)
 
 
+@dataclass
+class PeerRequestStats:
+    """Per-peer outgoing-request accounting (reference: the peer score
+    inputs from reqresp outcomes, score.ts). Consumers (range sync's
+    peer balancer, the peer manager) read `consecutive_failures` to
+    deprioritize or drop flaky peers."""
+
+    requests: int = 0
+    failures: int = 0
+    consecutive_failures: int = 0
+    last_error: str = ""
+
+    @property
+    def failure_rate(self) -> float:
+        return self.failures / self.requests if self.requests else 0.0
+
+
 class GRCARateLimiter:
     """Generic cell rate limiter (reqresp/src/rate_limiter/
     rateLimiterGRCA.ts:22): allows `quota` units per `quota_time`
@@ -124,7 +141,17 @@ class ReqResp:
         self._handlers: dict[str, object] = {}
         self._limiter = GRCARateLimiter(*rate_limit_quota)
         self.metrics = None  # lodestar_reqresp_* family (node wiring)
+        self.peer_stats: dict[str, PeerRequestStats] = {}
         transport.register(peer_id, self)
+
+    def unhealthy_peers(self, max_consecutive: int = 3) -> list[str]:
+        """Peers whose recent requests keep failing — candidates for
+        disconnect/downscore by the caller."""
+        return [
+            p
+            for p, s in self.peer_stats.items()
+            if s.consecutive_failures >= max_consecutive
+        ]
 
     def register_handler(self, protocol: str, handler) -> None:
         """handler: async generator fn(peer_id, request_payload: bytes)
@@ -141,6 +168,8 @@ class ReqResp:
         timeout: float = DEFAULT_TIMEOUT,
     ) -> list[ResponseChunk]:
         data = snappy.frame_compress(payload)
+        stats = self.peer_stats.setdefault(peer, PeerRequestStats())
+        stats.requests += 1
         if self.metrics is not None:
             self.metrics.outgoing_requests_total.inc(
                 protocol=_short_proto(protocol)
@@ -155,13 +184,18 @@ class ReqResp:
             # decode INSIDE the instrumented block: server-returned
             # error chunks (rate limited, invalid request) raise here
             # and are the most common outgoing-error class
-            return _decode_response(raw, _context_len(protocol))
-        except Exception:
+            chunks = _decode_response(raw, _context_len(protocol))
+        except Exception as e:
+            stats.failures += 1
+            stats.consecutive_failures += 1
+            stats.last_error = repr(e)
             if self.metrics is not None:
                 self.metrics.request_errors_total.inc(
                     protocol=_short_proto(protocol)
                 )
             raise
+        stats.consecutive_failures = 0
+        return chunks
 
     # -- server side ----------------------------------------------------
 

@@ -150,10 +150,24 @@ class TcpHost:
     are event hooks the network facade installs.
     """
 
+    _warned_pure_python_crypto = False
+
     def __init__(self, peer_id: str, fork_digest: bytes, host="127.0.0.1"):
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
-            X25519PrivateKey,
-        )
+        # noise re-exports X25519PrivateKey (native `cryptography` when
+        # installed, pure-python fallback otherwise)
+        from .noise import HAVE_CRYPTOGRAPHY, X25519PrivateKey
+
+        if not HAVE_CRYPTOGRAPHY and not TcpHost._warned_pure_python_crypto:
+            TcpHost._warned_pure_python_crypto = True
+            from ..logger import get_logger
+
+            get_logger("network").warn(
+                "`cryptography` not installed: Noise transport is "
+                "using pure-python X25519/ChaCha20-Poly1305 — "
+                "NOT constant-time and much slower. Fine for tests "
+                "and sims; install `cryptography` for production.",
+                {},
+            )
 
         self.peer_id = peer_id
         self.fork_digest = fork_digest

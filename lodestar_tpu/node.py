@@ -69,6 +69,12 @@ class BeaconNode:
         self.log = logger or get_logger("node")
         self.metrics_registry = RegistryMetricCreator()
         self.metrics = create_lodestar_metrics(self.metrics_registry)
+        from .resilience import create_resilience_metrics
+
+        # retry counters + breaker/engine-state gauges on /metrics
+        self.resilience_metrics = create_resilience_metrics(
+            self.metrics_registry
+        )
         self.db = db
         self.anchor = anchor_state_view
         self.verifier = verifier
@@ -270,15 +276,26 @@ class BeaconNode:
                 "Provide the Ethereum KZG ceremony file for production.",
                 {"config": node.cfg.CONFIG_NAME},
             )
-        # execution engine (engine API over JSON-RPC + JWT)
+        # execution engine (engine API over JSON-RPC + JWT), wrapped in
+        # the resilience layer: classified retries in the RPC client,
+        # engine-state tracking + fail-fast breaker around every call
         if node.execution_url is not None:
-            from .execution.http import ExecutionEngineHttp
+            from .execution.http import ExecutionEngineHttp, JsonRpcHttpClient
+            from .execution.engine import ResilientEngine
+            from .resilience import bind_breaker, bind_engine_tracker
 
-            node.chain.execution_engine = ExecutionEngineHttp.connect(
+            rpc = JsonRpcHttpClient(
                 node.execution_url,
                 jwt_secret=node.jwt_secret,
-                types=node.types,
+                retries=2,
+                metrics=node.resilience_metrics,
             )
+            engine = ResilientEngine(
+                ExecutionEngineHttp(rpc, types=node.types)
+            )
+            bind_breaker(engine.breaker, node.resilience_metrics)
+            bind_engine_tracker(engine.tracker, node.resilience_metrics)
+            node.chain.execution_engine = engine
             node.chain.trusted_execution = False
             log.info("execution engine attached",
                      {"url": node.execution_url})
@@ -289,12 +306,18 @@ class BeaconNode:
             node.chain.eth1 = Eth1DepositDataTracker(
                 node.cfg, node.types, node.eth1_provider
             )
-        # external builder (MEV-boost relay)
+        # external builder (MEV-boost relay) behind the fault-
+        # inspection-window circuit breaker
         if node.builder_url is not None:
             from .execution.builder import ExecutionBuilderHttp
+            from .resilience import bind_breaker
 
             node.builder = ExecutionBuilderHttp(
-                node.builder_url, node.types
+                node.builder_url, node.types,
+                metrics=node.resilience_metrics,
+            )
+            bind_breaker(
+                node.builder.circuit_breaker, node.resilience_metrics
             )
         # chain auxiliaries
         from .chain.historical import HistoricalStateRegen

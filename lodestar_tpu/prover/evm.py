@@ -4,7 +4,13 @@ Reference analog: packages/prover/src/utils/evm.ts — the reference
 seeds an @ethereumjs/vm instance with proof-verified accounts (state
 fetched via eth_createAccessList + eth_getProof, every account and
 storage slot checked against the LC-verified state root) and executes
-the call locally, so the RPC node cannot lie about the result.
+the call locally. Trust model: every VALUE the RPC supplies is proven
+against the verified state root, but state COMPLETENESS rests on the
+RPC's eth_createAccessList response — an RPC that omits a touched
+account or slot from the access list makes the local EVM read it as
+empty. The reference shares this assumption; treat results as
+"verified under the access-list completeness assumption", not as
+unconditional proof.
 
 This is a from-scratch interpreter, not a port. Scope (documented
 boundary, VERDICT r4 item 5):
@@ -24,8 +30,9 @@ boundary, VERDICT r4 item 5):
     (0x02), identity (0x04), modexp (0x05). ripemd160 when the local
     OpenSSL provides it. NOT implemented: bn128 pairing ops
     (0x06-0x08), blake2f (0x09), point evaluation (0x0a) — calls to
-    those fail with EvmError, surfaced as a verification failure
-    rather than a wrong answer.
+    those raise UnsupportedFeatureError, which propagates uncaught
+    through the CALL-family handlers and aborts the whole execution
+    as a verification failure rather than a wrong answer.
   * State: partial — only proof-verified accounts are seeded; absent
     accounts read as empty (the access list is expected to cover every
     touched address, matching the reference's state manager defaults).
@@ -46,6 +53,16 @@ SIGN_BIT = 1 << 255
 class EvmError(Exception):
     """Execution failed in a way that consumes all gas (invalid op,
     stack underflow, out of gas, bad jump)."""
+
+
+class UnsupportedFeatureError(Exception):
+    """The bytecode needs a feature this interpreter does not
+    implement (bn128 pairing, blake2f, point evaluation). Deliberately
+    NOT an EvmError subclass: EvmError is a defined in-EVM outcome
+    (call failure, push 0) that contracts can branch on, while this
+    must abort the whole verification — it propagates uncaught through
+    the CALL/STATICCALL/DELEGATECALL handlers so the provider surfaces
+    a VerificationError instead of a divergent 'verified' result."""
 
 
 class Revert(Exception):
@@ -268,7 +285,9 @@ def _run_precompile(addr_int: int, data: bytes, gas: int):
         try:
             h = hashlib.new("ripemd160", data).digest()
         except ValueError as e:  # openssl without legacy provider
-            raise EvmError("ripemd160 unavailable") from e
+            # environment limitation, not an in-EVM outcome: must
+            # abort verification, not fake a failed call
+            raise UnsupportedFeatureError("ripemd160 unavailable") from e
         return cost, h.rjust(32, b"\x00")
     if addr_int == 4:
         cost = 15 + 3 * _mem_words(len(data))
@@ -294,7 +313,9 @@ def _run_precompile(addr_int: int, data: bytes, gas: int):
             raise EvmError("out of gas (precompile)")
         out = (0 if m == 0 else pow(b, e, m)).to_bytes(ml, "big") if ml else b""
         return cost, out
-    raise EvmError(f"unsupported precompile 0x{addr_int:02x}")
+    raise UnsupportedFeatureError(
+        f"unsupported precompile 0x{addr_int:02x}"
+    )
 
 
 # -- interpreter -------------------------------------------------------------
@@ -310,6 +331,12 @@ class Evm:
         self.refund = 0
         self.original_storage: dict[tuple[bytes, int], int] = {}
         self.logs: list[tuple[bytes, list[int], bytes]] = []
+        # debug: when capture_stack is set, the stack at an implicit
+        # stop (running off the end of code) is kept for inspection —
+        # adversarial-bytecode tests assert on values that are
+        # otherwise dropped (e.g. truncated PUSH immediates)
+        self.capture_stack = False
+        self.last_stack: list[int] | None = None
 
     # -- public entry points -------------------------------------------
 
@@ -429,7 +456,12 @@ class Evm:
             raise EvmError("call depth exceeded")
         code_addr = bytes(code_addr)
         ai = int.from_bytes(code_addr, "big")
-        if 0 < ai <= 0x0A and code_override is None:
+        if 0 < ai <= 0x0A:
+            # Precompile addresses are special for EVERY message kind:
+            # DELEGATECALL/CALLCODE to 0x01..0x0a run the precompile
+            # too (their "code" is the builtin, never account code) —
+            # the previous code_override guard made DELEGATECALL to a
+            # precompile a silent empty success.
             cost, out = _run_precompile(ai, data, gas)
             if transfer:
                 self._transfer(caller, code_addr, value)
@@ -816,7 +848,11 @@ class Evm:
                 push(0)
             elif 0x60 <= op <= 0x7F:  # PUSH1..PUSH32
                 n = op - 0x5F
-                push(int.from_bytes(code[pc + 1 : pc + 1 + n], "big"))
+                # immediates past the end of code zero-pad on the
+                # RIGHT (yellow paper: code is implicitly zero-extended)
+                push(int.from_bytes(
+                    code[pc + 1 : pc + 1 + n].ljust(n, b"\x00"), "big"
+                ))
                 pc += n
             elif 0x80 <= op <= 0x8F:  # DUP
                 n = op - 0x7F
@@ -962,4 +998,6 @@ class Evm:
             else:
                 raise EvmError(f"unimplemented opcode 0x{op:02x}")
             pc += 1
+        if self.capture_stack:
+            self.last_stack = list(stack)
         return b"", gas_left

@@ -11,7 +11,13 @@ light client's finality/optimistic updates).
 from __future__ import annotations
 
 from .blocks import BlockVerificationError, verify_block
-from .evm import Account, BlockContext, Evm, EvmState
+from .evm import (
+    Account,
+    BlockContext,
+    Evm,
+    EvmState,
+    UnsupportedFeatureError,
+)
 from .keccak import keccak256
 from .mpt import ProofError, verify_account_proof, verify_storage_proof
 
@@ -252,6 +258,17 @@ class VerifiedExecutionProvider:
             # storage access list would read unproven slots as zero
             # and launder a wrong answer as verified.
             pass
+        if not access_list_ok and tx.get("to") is None:
+            # Contract creation runs arbitrary init code from calldata
+            # against state we cannot enumerate without an access list
+            # — every external read would silently see zeros. The
+            # code-bearing guard below never fires for to=None, so
+            # fail closed here (reference getVMWithState throws on an
+            # unusable createAccessList response).
+            raise VerificationError(
+                "RPC lacks eth_createAccessList; state coverage for a "
+                "contract-creation tx cannot be verified"
+            )
         access.setdefault(frm.lower(), [])
         if tx.get("to"):
             access.setdefault(tx["to"].lower(), [])
@@ -334,11 +351,20 @@ class VerifiedExecutionProvider:
         return evm, addr_bytes(frm), to, data, val, gas
 
     async def call(self, tx: dict, block=None) -> bytes:
-        """Proof-backed eth_call: execute locally on verified state;
-        the untrusted RPC contributes only proofs and code, every byte
-        of which is checked."""
+        """Proof-backed eth_call: execute locally, with every account,
+        slot, and code byte the RPC contributed checked against the
+        LC-verified state root. Trust model caveat: state COMPLETENESS
+        rests on the RPC's eth_createAccessList answer — an omitted
+        account/slot reads as empty locally (the reference shares this
+        assumption). Touching an unimplemented feature aborts with
+        VerificationError rather than returning a divergent result."""
         evm, frm, to, data, val, gas = await self._seed_evm(tx, block)
-        res = evm.call(frm, to, data, value=val, gas=gas)
+        try:
+            res = evm.call(frm, to, data, value=val, gas=gas)
+        except UnsupportedFeatureError as e:
+            raise VerificationError(
+                f"unverifiable execution: {e}"
+            ) from e
         if not res.success:
             raise VerificationError(
                 "execution reverted" if res.revert
@@ -348,9 +374,15 @@ class VerifiedExecutionProvider:
     async def estimate_gas(self, tx: dict, block=None) -> int:
         """Proof-backed eth_estimateGas: run the transaction locally
         with full gas metering (21000 base + calldata + execution,
-        EIP-3529 refund cap)."""
+        EIP-3529 refund cap). Same access-list completeness assumption
+        and unsupported-feature behavior as `call`."""
         evm, frm, to, data, val, gas = await self._seed_evm(tx, block)
-        res = evm.execute_tx(frm, to, data, value=val, gas=gas)
+        try:
+            res = evm.execute_tx(frm, to, data, value=val, gas=gas)
+        except UnsupportedFeatureError as e:
+            raise VerificationError(
+                f"unverifiable execution: {e}"
+            ) from e
         if not res.success:
             raise VerificationError(
                 "execution reverted" if res.revert

@@ -1,0 +1,62 @@
+"""Resilience layer: retries, circuit breakers, engine-state tracking.
+
+Reference analogs: the retry/timeout wrapper of JsonRpcHttpClient
+(eth1/provider/jsonRpcHttpClient.ts:76), the engine API's
+ONLINE/OFFLINE/SYNCING/AUTH_FAILED availability machine
+(execution/engine/http.ts), and the builder flow's missed-slot circuit
+breaker (`faultInspectionWindow`/`allowedFaults`). Every external
+dependency path — engine API, builder relay, eth1 polling, checkpoint
+and range sync, reqresp — routes its failure handling through these
+primitives so behavior under faults is uniform, observable on
+`/metrics`, and testable with injected clocks (no wall-clock sleeps
+in tests).
+"""
+
+from .breaker import (
+    BREAKER_STATE_INDEX,
+    BreakerState,
+    CircuitBreaker,
+    FaultInspectionWindow,
+)
+from .clock import ManualClock, SystemClock
+from .engine_state import (
+    ENGINE_STATE_INDEX,
+    EngineStateTracker,
+    ExecutionEngineState,
+)
+from .metrics import (
+    bind_breaker,
+    bind_engine_tracker,
+    create_resilience_metrics,
+    make_retry_hook,
+)
+from .retry import (
+    RetryError,
+    RetryOptions,
+    backoff_delay,
+    default_retryable,
+    retry,
+    retry_sync,
+)
+
+__all__ = [
+    "BREAKER_STATE_INDEX",
+    "BreakerState",
+    "CircuitBreaker",
+    "ENGINE_STATE_INDEX",
+    "EngineStateTracker",
+    "ExecutionEngineState",
+    "FaultInspectionWindow",
+    "ManualClock",
+    "RetryError",
+    "RetryOptions",
+    "SystemClock",
+    "backoff_delay",
+    "bind_breaker",
+    "bind_engine_tracker",
+    "create_resilience_metrics",
+    "default_retryable",
+    "make_retry_hook",
+    "retry",
+    "retry_sync",
+]

@@ -1,0 +1,186 @@
+"""Circuit breakers: time-based (engine API) and slot-window (builder).
+
+Two shapes, matching the two dependency profiles:
+
+* `CircuitBreaker` — classic closed/open/half-open machine for RPC
+  dependencies (the engine API): N consecutive failures open it, after
+  `reset_timeout` a bounded number of half-open probes are let through,
+  one success closes it again. Time comes from an injectable clock, so
+  a sim can measure the window in slots and unit tests in virtual
+  seconds.
+
+* `FaultInspectionWindow` — the builder flow's breaker (reference:
+  chain.ts shouldOverrideBuilder / the `faultInspectionWindow` +
+  `allowedFaults` CLI knobs): faults are recorded per SLOT (missed
+  proposals, relay errors); while more than `allowed_faults` slots in
+  the trailing `window` carry faults the builder race is skipped and
+  blocks are produced locally. When the faults age out the breaker
+  goes half-open until a recorded success closes it.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+from .clock import SYSTEM_CLOCK
+
+
+class BreakerState(str, Enum):
+    closed = "closed"
+    open = "open"
+    half_open = "half_open"
+
+
+# stable gauge encoding for metrics (resilience/metrics.py)
+BREAKER_STATE_INDEX = {
+    BreakerState.closed: 0,
+    BreakerState.open: 1,
+    BreakerState.half_open: 2,
+}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probes."""
+
+    def __init__(
+        self,
+        name: str = "breaker",
+        failure_threshold: int = 5,
+        reset_timeout: float = 30.0,
+        half_open_max: int = 1,
+        clock=None,
+        on_transition=None,  # fn(name, old: BreakerState, new)
+    ):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.half_open_max = half_open_max
+        self.clock = clock or SYSTEM_CLOCK
+        self.on_transition = on_transition
+        self.state = BreakerState.closed
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self._half_open_inflight = 0
+        # full audit trail of (time, old, new) — sim assertions read it
+        self.transitions: list[tuple[float, BreakerState, BreakerState]] = []
+
+    def _transition(self, new: BreakerState) -> None:
+        if new is self.state:
+            return
+        old = self.state
+        self.state = new
+        self.transitions.append((self.clock.monotonic(), old, new))
+        if self.on_transition is not None:
+            self.on_transition(self.name, old, new)
+
+    def allows(self) -> bool:
+        """Gate a call: True = go ahead (and report the outcome back
+        via on_success/on_failure), False = fail fast."""
+        if self.state is BreakerState.closed:
+            return True
+        if self.state is BreakerState.open:
+            if (
+                self.clock.monotonic() - self.opened_at
+                >= self.reset_timeout
+            ):
+                self._transition(BreakerState.half_open)
+                self._half_open_inflight = 1
+                return True
+            return False
+        # half-open: bounded probe budget
+        if self._half_open_inflight < self.half_open_max:
+            self._half_open_inflight += 1
+            return True
+        return False
+
+    def release_probe(self) -> None:
+        """Hand back a probe slot without judging the call (the call
+        was cancelled, not answered). Without this, a cancelled
+        half-open probe would pin `_half_open_inflight` at the budget
+        and the breaker would deny every future call."""
+        if self._half_open_inflight > 0:
+            self._half_open_inflight -= 1
+
+    def on_success(self) -> None:
+        self.consecutive_failures = 0
+        self._half_open_inflight = 0
+        self._transition(BreakerState.closed)
+
+    def on_failure(self) -> None:
+        self.consecutive_failures += 1
+        self._half_open_inflight = 0
+        if self.state is BreakerState.half_open or (
+            self.state is BreakerState.closed
+            and self.consecutive_failures >= self.failure_threshold
+        ):
+            self.opened_at = self.clock.monotonic()
+            self._transition(BreakerState.open)
+
+
+class FaultInspectionWindow:
+    """Slot-window breaker for the builder race."""
+
+    def __init__(
+        self,
+        name: str = "builder",
+        window: int = 32,
+        allowed_faults: int = 4,
+        on_transition=None,
+    ):
+        self.name = name
+        self.window = window
+        self.allowed_faults = allowed_faults
+        self.on_transition = on_transition
+        self.fault_slots: dict[int, int] = {}  # slot -> fault count
+        self.state = BreakerState.closed
+        self.transitions: list[tuple[int, BreakerState, BreakerState]] = []
+        self._last_slot = 0
+
+    def _transition(self, slot: int, new: BreakerState) -> None:
+        if new is self.state:
+            return
+        old = self.state
+        self.state = new
+        self.transitions.append((slot, old, new))
+        if self.on_transition is not None:
+            self.on_transition(self.name, old, new)
+
+    def _faulty_slots_in_window(self, slot: int) -> int:
+        lo = slot - self.window
+        return sum(1 for s in self.fault_slots if lo < s <= slot)
+
+    def _prune(self, slot: int) -> None:
+        lo = slot - self.window
+        for s in [s for s in self.fault_slots if s <= lo]:
+            del self.fault_slots[s]
+
+    def record_fault(self, slot: int) -> None:
+        """A missed proposal or relay error at `slot`."""
+        slot = int(slot)
+        self._last_slot = max(self._last_slot, slot)
+        self.fault_slots[slot] = self.fault_slots.get(slot, 0) + 1
+        self._prune(slot)
+        if self._faulty_slots_in_window(slot) > self.allowed_faults:
+            self._transition(slot, BreakerState.open)
+
+    def record_success(self, slot: int) -> None:
+        """A builder block produced + accepted at `slot`."""
+        slot = int(slot)
+        self._last_slot = max(self._last_slot, slot)
+        self._prune(slot)
+        if self.state is BreakerState.half_open:
+            self._transition(slot, BreakerState.closed)
+
+    def available(self, slot: int) -> bool:
+        """Should the builder race run at `slot`? Open falls back to
+        local production; once faults age out of the window one probe
+        bid is allowed (half-open) and a success closes the breaker."""
+        slot = int(slot)
+        self._last_slot = max(self._last_slot, slot)
+        self._prune(slot)
+        over = self._faulty_slots_in_window(slot) > self.allowed_faults
+        if self.state is BreakerState.open and not over:
+            self._transition(slot, BreakerState.half_open)
+        elif self.state is not BreakerState.open and over:
+            self._transition(slot, BreakerState.open)
+        return self.state is not BreakerState.open

@@ -1,0 +1,54 @@
+"""Injectable clocks for the resilience primitives.
+
+Every retry delay, breaker reset window, and poll backoff in this
+package reads time through one of these objects instead of the `time` /
+`asyncio` modules directly, so unit tests drive schedules with
+`ManualClock` and never wall-clock sleep (the reference achieves the
+same with sinon fake timers in its retry/backoff unit tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class SystemClock:
+    """Real time: `time.monotonic` + `asyncio.sleep`/`time.sleep`."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            await asyncio.sleep(seconds)
+
+    def sleep_sync(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class ManualClock:
+    """Deterministic test clock: sleeps advance virtual time instantly
+    and are recorded, `advance()` moves time for breaker windows."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+        self.sleeps: list[float] = []
+
+    def monotonic(self) -> float:
+        return self.now
+
+    async def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += max(0.0, seconds)
+
+    def sleep_sync(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += max(0.0, seconds)
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+SYSTEM_CLOCK = SystemClock()

@@ -1,0 +1,102 @@
+"""Execution-engine availability state machine.
+
+Reference analog: `ExecutionEngineState` and `getExecutionEngineState`
+(execution/engine/http.ts + utils.ts in the reference): every engine
+API exchange updates one of five states —
+
+  ONLINE       reachable, no payload verdict seen yet (startup)
+  SYNCED       responding and payload statuses are conclusive
+  SYNCING      responding but still syncing (SYNCING/ACCEPTED verdicts)
+  OFFLINE      transport failures (connection refused, timeout)
+  AUTH_FAILED  HTTP 401/403 — the JWT secret is wrong; retrying with
+               the same credentials cannot help
+
+The tracker is transport-agnostic: it classifies exceptions by shape
+(an `auth_failed` attribute marks auth rejections, everything else is
+a transport fault) and payload statuses by the engine API verdict
+enum, so the HTTP client, the in-process mock, and the sim's fault
+injectors all drive the same machine.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class ExecutionEngineState(str, Enum):
+    ONLINE = "ONLINE"
+    SYNCED = "SYNCED"
+    SYNCING = "SYNCING"
+    OFFLINE = "OFFLINE"
+    AUTH_FAILED = "AUTH_FAILED"
+
+
+# stable gauge encoding for metrics (resilience/metrics.py)
+ENGINE_STATE_INDEX = {
+    ExecutionEngineState.ONLINE: 0,
+    ExecutionEngineState.SYNCED: 1,
+    ExecutionEngineState.SYNCING: 2,
+    ExecutionEngineState.OFFLINE: 3,
+    ExecutionEngineState.AUTH_FAILED: 4,
+}
+
+# payload statuses that mean "engine is responding but not synced"
+_SYNCING_STATUSES = frozenset({"SYNCING", "ACCEPTED"})
+_OFFLINE_STATUSES = frozenset({"ELERROR", "UNAVAILABLE"})
+
+
+class EngineStateTracker:
+    """Drives ExecutionEngineState from call outcomes."""
+
+    def __init__(self, on_transition=None):
+        # on_transition(old: ExecutionEngineState, new)
+        self.state = ExecutionEngineState.ONLINE
+        self.on_transition = on_transition
+        self.transitions: list[
+            tuple[ExecutionEngineState, ExecutionEngineState]
+        ] = []
+
+    def _set(self, new: ExecutionEngineState) -> None:
+        if new is self.state:
+            return
+        old = self.state
+        self.state = new
+        self.transitions.append((old, new))
+        if self.on_transition is not None:
+            self.on_transition(old, new)
+
+    def on_success(self, payload_status=None) -> ExecutionEngineState:
+        """A call returned. `payload_status` is the engine verdict
+        string/enum for newPayload/fcU responses, None for calls that
+        carry no verdict (getPayload etc. → ONLINE family only)."""
+        if payload_status is None:
+            if self.state in (
+                ExecutionEngineState.OFFLINE,
+                ExecutionEngineState.AUTH_FAILED,
+            ):
+                self._set(ExecutionEngineState.ONLINE)
+            return self.state
+        status = str(
+            getattr(payload_status, "value", payload_status)
+        )
+        if status in _OFFLINE_STATUSES:
+            self._set(ExecutionEngineState.OFFLINE)
+        elif status in _SYNCING_STATUSES:
+            self._set(ExecutionEngineState.SYNCING)
+        else:  # VALID / INVALID / INVALID_BLOCK_HASH: conclusive
+            self._set(ExecutionEngineState.SYNCED)
+        return self.state
+
+    def on_error(self, exc: BaseException) -> ExecutionEngineState:
+        if getattr(exc, "auth_failed", False):
+            self._set(ExecutionEngineState.AUTH_FAILED)
+        else:
+            self._set(ExecutionEngineState.OFFLINE)
+        return self.state
+
+    @property
+    def is_online(self) -> bool:
+        return self.state not in (
+            ExecutionEngineState.OFFLINE,
+            ExecutionEngineState.AUTH_FAILED,
+        )

@@ -1,0 +1,90 @@
+"""Resilience metric family + binding helpers.
+
+Registers retry counters, breaker-state gauges, and the engine-state
+gauge on the node's existing RegistryMetricCreator so they ride the
+same `/metrics` endpoint as the lodestar catalog (metrics/beacon.py).
+`bind_breaker` / `bind_engine_tracker` attach the live objects'
+transition hooks to the gauges so scrapes always see current state.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from .breaker import BREAKER_STATE_INDEX
+from .engine_state import ENGINE_STATE_INDEX
+
+
+def create_resilience_metrics(reg) -> SimpleNamespace:
+    m = SimpleNamespace()
+    m.retries_total = reg.counter(
+        "lodestar_resilience_retries_total",
+        "Retried attempts against external dependencies",
+        label_names=("client",),
+    )
+    m.retry_giveups_total = reg.counter(
+        "lodestar_resilience_retry_giveups_total",
+        "Calls that exhausted all retry attempts",
+        label_names=("client",),
+    )
+    m.breaker_state = reg.gauge(
+        "lodestar_resilience_breaker_state",
+        "Circuit breaker state: 0 closed, 1 open, 2 half-open",
+        label_names=("name",),
+    )
+    m.breaker_transitions_total = reg.counter(
+        "lodestar_resilience_breaker_transitions_total",
+        "Circuit breaker state transitions",
+        label_names=("name", "state"),
+    )
+    m.engine_state = reg.gauge(
+        "lodestar_execution_engine_state",
+        "Engine availability: 0 ONLINE, 1 SYNCED, 2 SYNCING, "
+        "3 OFFLINE, 4 AUTH_FAILED",
+    )
+    m.engine_state_transitions_total = reg.counter(
+        "lodestar_execution_engine_state_transitions_total",
+        "Engine availability state transitions",
+        label_names=("state",),
+    )
+    m.builder_faults_total = reg.counter(
+        "lodestar_builder_faults_total",
+        "Builder circuit-breaker faults recorded",
+        label_names=("kind",),  # relay_error | missed_slot
+    )
+    return m
+
+
+def bind_breaker(breaker, metrics) -> None:
+    """Wire a CircuitBreaker/FaultInspectionWindow's transitions into
+    the gauges; seeds the gauge with the current state."""
+    metrics.breaker_state.set(
+        BREAKER_STATE_INDEX[breaker.state], name=breaker.name
+    )
+
+    def hook(name, old, new):
+        metrics.breaker_state.set(BREAKER_STATE_INDEX[new], name=name)
+        metrics.breaker_transitions_total.inc(
+            name=name, state=new.value
+        )
+
+    breaker.on_transition = hook
+
+
+def bind_engine_tracker(tracker, metrics) -> None:
+    metrics.engine_state.set(ENGINE_STATE_INDEX[tracker.state])
+
+    def hook(old, new):
+        metrics.engine_state.set(ENGINE_STATE_INDEX[new])
+        metrics.engine_state_transitions_total.inc(state=new.value)
+
+    tracker.on_transition = hook
+
+
+def make_retry_hook(metrics, client: str):
+    """RetryOptions.on_retry callback bumping the retry counter."""
+
+    def hook(attempt, exc, delay):
+        metrics.retries_total.inc(client=client)
+
+    return hook

@@ -1,0 +1,133 @@
+"""Retry with exponential backoff + full jitter.
+
+Reference analog: the `retry` util behind JsonRpcHttpClient
+(eth1/provider/jsonRpcHttpClient.ts:76 `retryAttempts`/`retryDelay` and
+utils/src/retry.ts): a bounded number of re-attempts, a retryable-error
+classifier (`shouldRetry`), and a growing delay between attempts. The
+delay here is capped exponential with FULL jitter (delay = U(0, cap)),
+the AWS-architecture-blog schedule that avoids thundering-herd
+re-connects when many nodes lose the same dependency at once.
+
+Everything is injectable: the clock (so tests never sleep), the RNG
+(so schedules are reproducible), and the classifier (so JSON-RPC
+"server answered with an error" is never retried while transport
+failures are).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .clock import SYSTEM_CLOCK
+
+
+class RetryError(Exception):
+    """All attempts exhausted; `last` carries the final failure."""
+
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(
+            f"giving up after {attempts} attempts: {last!r}"
+        )
+        self.attempts = attempts
+        self.last = last
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """Transport-shaped failures retry; everything else is a real
+    answer (an RPC error object, an auth rejection) and must not."""
+    if getattr(exc, "auth_failed", False):
+        return False
+    retryable = getattr(exc, "retryable", None)
+    if retryable is not None:
+        return bool(retryable)
+    return isinstance(
+        exc, (ConnectionError, TimeoutError, asyncio.TimeoutError, OSError)
+    )
+
+
+def backoff_delay(
+    attempt: int,
+    base_delay: float,
+    max_delay: float,
+    rng: random.Random | None = None,
+    jitter: str = "full",
+) -> float:
+    """Delay before re-attempt number `attempt` (0-based: the delay
+    after the first failure is attempt 0). cap = min(max, base * 2^n);
+    full jitter draws U(0, cap], no jitter returns the cap itself."""
+    cap = min(max_delay, base_delay * (2.0 ** attempt))
+    if jitter == "none":
+        return cap
+    r = rng.random() if rng is not None else random.random()
+    return r * cap
+
+
+@dataclass
+class RetryOptions:
+    """Knobs mirroring the reference client's opts (retries = number of
+    RE-attempts, so total attempts = retries + 1)."""
+
+    retries: int = 2
+    base_delay: float = 0.1
+    max_delay: float = 10.0
+    jitter: str = "full"  # "full" | "none"
+    attempt_timeout: float | None = None  # per-attempt (async only)
+    retryable: Callable[[BaseException], bool] = field(
+        default=default_retryable
+    )
+    # on_retry(attempt_index, exc, delay) — metrics/log hook, fired for
+    # every failed attempt that will be retried
+    on_retry: Callable | None = None
+
+
+async def retry(fn, opts: RetryOptions | None = None, clock=None,
+                rng: random.Random | None = None):
+    """Run async `fn()` up to opts.retries + 1 times. Raises the last
+    error once attempts are exhausted or the error is non-retryable."""
+    opts = opts or RetryOptions()
+    clock = clock or SYSTEM_CLOCK
+    last: BaseException | None = None
+    for attempt in range(opts.retries + 1):
+        try:
+            if opts.attempt_timeout is not None:
+                return await asyncio.wait_for(
+                    fn(), timeout=opts.attempt_timeout
+                )
+            return await fn()
+        except BaseException as e:
+            last = e
+            if attempt >= opts.retries or not opts.retryable(e):
+                raise
+            delay = backoff_delay(
+                attempt, opts.base_delay, opts.max_delay, rng, opts.jitter
+            )
+            if opts.on_retry is not None:
+                opts.on_retry(attempt, e, delay)
+            await clock.sleep(delay)
+    raise RetryError(opts.retries + 1, last)  # pragma: no cover
+
+
+def retry_sync(fn, opts: RetryOptions | None = None, clock=None,
+               rng: random.Random | None = None):
+    """Blocking twin of `retry` for sync call paths (checkpoint sync,
+    call_sync); per-attempt timeouts are the callee's responsibility."""
+    opts = opts or RetryOptions()
+    clock = clock or SYSTEM_CLOCK
+    last: BaseException | None = None
+    for attempt in range(opts.retries + 1):
+        try:
+            return fn()
+        except BaseException as e:
+            last = e
+            if attempt >= opts.retries or not opts.retryable(e):
+                raise
+            delay = backoff_delay(
+                attempt, opts.base_delay, opts.max_delay, rng, opts.jitter
+            )
+            if opts.on_retry is not None:
+                opts.on_retry(attempt, e, delay)
+            clock.sleep_sync(delay)
+    raise RetryError(opts.retries + 1, last)  # pragma: no cover

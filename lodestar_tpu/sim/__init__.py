@@ -8,6 +8,16 @@ nodes, attestation participation.
 """
 
 from .simulation import Simulation, SimNode
+from .faults import (
+    FaultSchedule,
+    FlakyEngine,
+    FlakyRelay,
+    GossipFaultInjector,
+    SimBuilder,
+    catch_up,
+    kill_node,
+    restart_node,
+)
 from .assertions import (
     assert_finalized,
     assert_heads_consistent,
@@ -18,8 +28,16 @@ from .assertions import (
 )
 
 __all__ = [
+    "FaultSchedule",
+    "FlakyEngine",
+    "FlakyRelay",
+    "GossipFaultInjector",
+    "SimBuilder",
     "Simulation",
     "SimNode",
+    "catch_up",
+    "kill_node",
+    "restart_node",
     "assert_finalized",
     "assert_heads_consistent",
     "assert_inclusion_delay",

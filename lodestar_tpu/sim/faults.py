@@ -1,0 +1,291 @@
+"""Fault injection for the multi-node simulation.
+
+Reference analog: crucible's fault tooling (cli/test/utils/crucible —
+the sim harness kills nodes, stalls ELs, and asserts the network
+recovers). Everything here wraps an existing seam rather than patching
+internals:
+
+* `FlakyEngine` — IExecutionEngine wrapper that raises transport-shaped
+  errors while a fault window is active (engine timeout/error
+  flapping). Wrapped in `ResilientEngine`, the chain's import path
+  degrades to optimistic imports and block production falls back to
+  local payloads; the engine breaker runs its open→half-open→closed
+  cycle on recovery.
+* `FlakyRelay` — builder relay wrapper with an outage switch
+  (builder outage / relay errors).
+* `SimBuilder` — relay + fault-inspection-window breaker, the object a
+  `SimNode.builder` expects (`available`/`register_fault`/
+  `register_success` + the relay API).
+* `GossipFaultInjector` — drop / delay / duplicate outbound gossip
+  frames of one node, by wrapping its GossipNode's mesh send.
+* `kill_node` / `restart_node` — take a node's network down
+  mid-run and bring it back, resyncing its chain from a healthy peer.
+* `FaultSchedule` — slot-driven fault windows riding the simulation's
+  `on_slot_hooks`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..execution.engine import ExecutionEngineError
+from ..resilience import FaultInspectionWindow
+
+
+class InjectedEngineError(ExecutionEngineError):
+    """Transport-shaped (retryable) injected engine fault."""
+
+    retryable = True
+
+
+class FlakyEngine:
+    """IExecutionEngine wrapper: while `failing`, every call raises an
+    InjectedEngineError (the shape of a connect timeout)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.failing = False
+        self.injected_errors = 0
+        self.calls_passed = 0
+
+    def set_failing(self, failing: bool) -> None:
+        self.failing = bool(failing)
+
+    def _gate(self) -> None:
+        if self.failing:
+            self.injected_errors += 1
+            raise InjectedEngineError("injected engine timeout")
+        self.calls_passed += 1
+
+    async def notify_new_payload(self, fork, payload, **kw):
+        self._gate()
+        return await self.inner.notify_new_payload(fork, payload, **kw)
+
+    async def notify_forkchoice_update(self, fork, state, attributes=None):
+        self._gate()
+        return await self.inner.notify_forkchoice_update(
+            fork, state, attributes
+        )
+
+    async def get_payload(self, fork, payload_id, *a, **kw):
+        self._gate()
+        return await self.inner.get_payload(fork, payload_id, *a, **kw)
+
+    async def get_payload_bodies_by_hash(self, fork, block_hashes):
+        self._gate()
+        return await self.inner.get_payload_bodies_by_hash(
+            fork, block_hashes
+        )
+
+
+class FlakyRelay:
+    """Builder relay wrapper: while `outage`, bids and reveals fail
+    with BuilderError (the relay is down / erroring)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.outage = False
+        self.injected_errors = 0
+
+    def set_outage(self, outage: bool) -> None:
+        self.outage = bool(outage)
+
+    def _gate(self) -> None:
+        from ..execution.builder import BuilderError
+
+        if self.outage:
+            self.injected_errors += 1
+            raise BuilderError("injected relay outage")
+
+    async def register_validators(self, registrations):
+        self._gate()
+        return await self.inner.register_validators(registrations)
+
+    async def get_header(self, slot, parent_hash, pubkey):
+        self._gate()
+        return await self.inner.get_header(slot, parent_hash, pubkey)
+
+    async def submit_blinded_block(self, fork, signed_blinded):
+        self._gate()
+        return await self.inner.submit_blinded_block(fork, signed_blinded)
+
+
+class SimBuilder:
+    """Relay + the builder circuit breaker, in the interface
+    SimNode.maybe_propose consumes (mirrors ExecutionBuilderHttp's
+    breaker surface without the HTTP layer)."""
+
+    def __init__(self, relay, window: int = 8, allowed_faults: int = 2,
+                 breaker: FaultInspectionWindow | None = None):
+        self.relay = relay
+        self.enabled = True
+        # `breaker` lets several nodes share one inspection window
+        # (they are all judging the same relay)
+        self.circuit_breaker = breaker or FaultInspectionWindow(
+            name="builder", window=window, allowed_faults=allowed_faults
+        )
+
+    def available(self, slot: int) -> bool:
+        return self.enabled and self.circuit_breaker.available(slot)
+
+    def register_fault(self, slot: int, kind: str = "relay_error") -> None:
+        self.circuit_breaker.record_fault(slot)
+
+    def register_success(self, slot: int) -> None:
+        self.circuit_breaker.record_success(slot)
+
+    async def get_header(self, slot, parent_hash, pubkey):
+        return await self.relay.get_header(slot, parent_hash, pubkey)
+
+    async def submit_blinded_block(self, fork, signed_blinded):
+        return await self.relay.submit_blinded_block(fork, signed_blinded)
+
+
+class GossipFaultInjector:
+    """Wraps one node's GossipNode outbound mesh send with a lossy
+    policy: fraction/flags for drop, delay (seconds), duplicate.
+    Deterministic when given an rng."""
+
+    def __init__(self, gossip_node, rng=None, drop: float = 0.0,
+                 delay: float = 0.0, duplicate: float = 0.0):
+        self.gossip = gossip_node
+        self.rng = rng
+        self.drop = drop
+        self.delay = delay
+        self.duplicate = duplicate
+        self.dropped = 0
+        self.delayed = 0
+        self.duplicated = 0
+        self._orig = gossip_node._send_to_mesh
+        gossip_node._send_to_mesh = self._send
+
+    def detach(self) -> None:
+        self.gossip._send_to_mesh = self._orig
+
+    def _roll(self) -> float:
+        import random
+
+        return (self.rng or random).random()
+
+    async def _send(self, topic, data, exclude):
+        if self.drop and self._roll() < self.drop:
+            self.dropped += 1
+            return 0  # message never leaves this node
+        if self.duplicate and self._roll() < self.duplicate:
+            self.duplicated += 1
+            await self._orig(topic, data, exclude)
+        if self.delay:
+            self.delayed += 1
+
+            async def later():
+                await asyncio.sleep(self.delay)
+                try:
+                    await self._orig(topic, data, exclude)
+                except Exception:
+                    pass
+
+            asyncio.ensure_future(later())
+            return 1
+        return await self._orig(topic, data, exclude)
+
+
+async def kill_node(sim, index: int) -> None:
+    """Take a node off the network mid-run (process kill analog: its
+    chain state survives, its sockets don't, its duties stop)."""
+    node = sim.nodes[index]
+    node.alive = False
+    await node.network.stop()
+
+
+async def restart_node(sim, index: int, resync_from: int | None = None
+                       ) -> None:
+    """Bring a killed node back: restart its network, reconnect the
+    mesh, and catch its chain up from a healthy peer's canonical chain
+    (the range-sync step, collapsed to direct imports since both nodes
+    live in this process)."""
+    node = sim.nodes[index]
+    node.alive = True
+    await node.network.start()
+    for i, other in enumerate(sim.nodes):
+        if i == index:
+            continue
+        try:
+            await node.network.connect(
+                "127.0.0.1", other.network.host.port
+            )
+        except Exception:
+            pass
+    if resync_from is not None:
+        await catch_up(node, sim.nodes[resync_from])
+    await asyncio.sleep(0.05)
+
+
+async def catch_up(node, healthy) -> None:
+    """Import the healthy node's canonical blocks that `node` missed,
+    oldest first (BeaconBlocksByRange over an in-process shortcut)."""
+    chain = healthy.chain
+    blocks = []
+    root = chain.head_root
+    proto = chain.fork_choice.proto
+    while root is not None:
+        if node.chain.get_block(root) is not None:
+            break  # shared history reached
+        blk = chain.get_block(root)
+        if blk is None:
+            break
+        blocks.append(blk)
+        n = proto.get_node(root)
+        if n is None or n.parent_root is None:
+            break
+        root = bytes(n.parent_root)
+    for blk in reversed(blocks):
+        try:
+            await node.chain.process_block(blk, is_timely=False)
+        except Exception:
+            pass  # already known / pre-anchor
+
+
+class FaultSchedule:
+    """Slot-scheduled fault windows for a Simulation: register
+    (start_slot, end_slot, on_enter, on_exit) windows; tick() rides
+    sim.on_slot_hooks."""
+
+    def __init__(self, sim):
+        self.sim = sim
+        self.windows: list[dict] = []
+        sim.on_slot_hooks.append(self.tick)
+
+    def window(self, start_slot: int, end_slot: int, on_enter,
+               on_exit=None) -> None:
+        self.windows.append(
+            {
+                "start": start_slot,
+                "end": end_slot,
+                "enter": on_enter,
+                "exit": on_exit,
+                "active": False,
+            }
+        )
+
+    def tick(self, slot: int):
+        coros = []
+        for w in self.windows:
+            if not w["active"] and w["start"] <= slot <= w["end"]:
+                w["active"] = True
+                got = w["enter"]()
+                if asyncio.iscoroutine(got):
+                    coros.append(got)
+            elif w["active"] and slot > w["end"]:
+                w["active"] = False
+                if w["exit"] is not None:
+                    got = w["exit"]()
+                    if asyncio.iscoroutine(got):
+                        coros.append(got)
+        if not coros:
+            return None
+
+        async def run():
+            for c in coros:
+                await c
+
+        return run()

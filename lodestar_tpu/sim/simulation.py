@@ -60,6 +60,16 @@ class SimNode:
         self._install_gossip_handlers()
         self.blocks_proposed = 0
         self.atts_published = 0
+        # optional external-dependency seams (sim/faults.py wires
+        # these): a builder relay behind a fault-inspection-window
+        # breaker, and chain.execution_engine may carry a
+        # ResilientEngine. Counters split production by payload source.
+        self.builder = None
+        self.blocks_via_builder = 0
+        self.blocks_via_local = 0
+        # cleared by sim/faults.kill_node: a dead node neither proposes
+        # nor attests until restarted
+        self.alive = True
 
     def _install_gossip_handlers(self) -> None:
         from ..network.gossip import ValidationResult
@@ -135,11 +145,50 @@ class SimNode:
         )
         atts = self.att_pool.get_attestations_for_block(slot, state=st)
         sync_aggregate = self._sync_aggregate_for(st, slot)
+        common = dict(attestations=atts, sync_aggregate=sync_aggregate)
+        post_merge = scratch.fork_seq >= ForkSeq.bellatrix
+
+        # builder race (produceBlockV3 analog, breaker-gated): a relay
+        # fault falls back to local production and feeds the
+        # fault-inspection-window breaker; while the breaker is open
+        # the race is skipped entirely
+        if post_merge and self.builder is not None and (
+            self.builder.available(slot)
+            if hasattr(self.builder, "available")
+            else getattr(self.builder, "enabled", True)
+        ):
+            try:
+                got = await self._propose_via_builder(
+                    slot, scratch, proposer, randao, common
+                )
+            except Exception:
+                got = None
+                if hasattr(self.builder, "register_fault"):
+                    self.builder.register_fault(slot)
+            if got is not None:
+                fork, signed = got
+                await self.chain.process_block(signed, is_timely=True)
+                await self.network.publish_block(fork, signed)
+                if hasattr(self.builder, "register_success"):
+                    self.builder.register_success(slot)
+                self.blocks_proposed += 1
+                self.blocks_via_builder += 1
+                return self.chain.head_root
+
+        # local production: engine payload when the engine is up,
+        # dev payload otherwise (prepare_execution_payload degrades to
+        # (None, ...) on engine faults / open breaker — fail-fast)
+        execution_payload = None
+        if post_merge and self.chain.execution_engine is not None:
+            payload, _bundle, _value = (
+                await self.chain.prepare_execution_payload(slot, scratch)
+            )
+            execution_payload = payload
         block, post = self.chain.produce_block(
             slot,
             randao,
-            attestations=atts,
-            sync_aggregate=sync_aggregate,
+            execution_payload=execution_payload,
+            **common,
         )
         from ..params import DOMAIN_BEACON_PROPOSER
 
@@ -152,7 +201,49 @@ class SimNode:
         await self.chain.process_block(signed, is_timely=True)
         await self.network.publish_block(post.fork, signed)
         self.blocks_proposed += 1
+        if post_merge:
+            self.blocks_via_local += 1
         return self.chain.head_root
+
+    async def _propose_via_builder(self, slot, scratch, proposer,
+                                   randao, common):
+        """Blinded-block flow against the attached relay: bid -> sign
+        blinded -> reveal -> unblind (the produceBlockV3 +
+        publish_blinded_block path, collapsed into the sim proposer).
+        Returns (fork, SignedBeaconBlock) or None when no bid."""
+        from ..execution.builder import unblind_signed_block
+        from ..params import DOMAIN_BEACON_PROPOSER
+
+        st = scratch.state
+        parent_hash = bytes(
+            st.latest_execution_payload_header.block_hash
+        )
+        pubkey = bytes(st.validators[proposer].pubkey)
+        bid = await self.builder.get_header(slot, parent_hash, pubkey)
+        if bid is None:
+            return None
+        block, post = self.chain.produce_block(
+            slot,
+            randao,
+            execution_payload_header=bid.header,
+            blob_kzg_commitments=bid.blob_kzg_commitments,
+            **common,
+        )
+        ns = self.types.by_fork[post.fork]
+        signed_blinded = ns.SignedBlindedBeaconBlock.default()
+        signed_blinded.message = block
+        domain = get_domain(self.cfg, post.state, DOMAIN_BEACON_PROPOSER)
+        root = compute_signing_root(ns.BlindedBeaconBlock, block, domain)
+        signed_blinded.signature = sign(self.keys[proposer], root)
+        revealed = await self.builder.submit_blinded_block(
+            post.fork, signed_blinded
+        )
+        payload = revealed[0] if isinstance(revealed, tuple) else revealed
+        if bytes(payload.block_hash) != bytes(
+            block.body.execution_payload_header.block_hash
+        ):
+            raise ValueError("revealed payload does not match bid header")
+        return post.fork, unblind_signed_block(ns, signed_blinded, payload)
 
     def _sync_aggregate_for(self, st, block_slot: int):
         """SyncAggregate over the pooled messages for the parent root
@@ -283,6 +374,9 @@ class Simulation:
         self.n_validators = n_validators
         self.nodes: list[SimNode] = []
         self.slot = 0
+        # slot hooks fire at the top of run_slot (before proposals) —
+        # sim/faults.py schedules fault windows through these
+        self.on_slot_hooks: list = []
 
     async def start(self) -> None:
         genesis = create_interop_genesis_state(
@@ -316,8 +410,14 @@ class Simulation:
 
     async def run_slot(self) -> None:
         self.slot += 1
+        for hook in self.on_slot_hooks:
+            got = hook(self.slot)
+            if asyncio.iscoroutine(got):
+                await got
         proposed = None
         for node in self.nodes:
+            if not node.alive:
+                continue
             got = await node.maybe_propose(self.slot)
             if got is not None:
                 proposed = got
@@ -325,9 +425,11 @@ class Simulation:
         # let the block propagate before attesting to it
         await asyncio.sleep(0.15 if proposed else 0.02)
         for node in self.nodes:
-            await node.attest(self.slot)
+            if node.alive:
+                await node.attest(self.slot)
         for node in self.nodes:
-            await node.sync_commit(self.slot)
+            if node.alive:
+                await node.sync_commit(self.slot)
         await asyncio.sleep(0.1)
 
     async def run_until_slot(self, slot: int) -> None:

@@ -27,6 +27,9 @@ def fetch_checkpoint_state(
     state_id: str = "finalized",
     expected_root: bytes | None = None,
     now: float | None = None,
+    retries: int = 2,
+    clock=None,
+    rng=None,
 ) -> BeaconStateView:
     """Download + validate a trusted anchor state.
 
@@ -36,12 +39,34 @@ def fetch_checkpoint_state(
     - the state's clock position must not be in the future;
     - when `expected_root` (a user-supplied weak-subjectivity state
       root) is given, the downloaded state's hashTreeRoot must match.
+
+    The download retries transport failures with backoff (the anchor
+    endpoint is a remote dependency like any other); validation
+    failures are terminal — a wrong-network or tampered state does not
+    become right on re-download.
     """
     from ..api.client import ApiClient
     from ..params import preset
+    from ..resilience import RetryOptions, retry_sync
 
     client = ApiClient(url)
-    got = client.call("getStateV2", {"state_id": state_id})
+    got = retry_sync(
+        lambda: client.call("getStateV2", {"state_id": state_id}),
+        RetryOptions(
+            retries=retries,
+            base_delay=0.5,
+            max_delay=10.0,
+            # the API client surfaces transport failures and 5xx as
+            # ApiError(status>=500); 4xx verdicts (bad state_id, wrong
+            # route) are terminal
+            retryable=lambda e: (
+                isinstance(e, (OSError, TimeoutError))
+                or getattr(e, "status", 0) >= 500
+            ),
+        ),
+        clock=clock,
+        rng=rng,
+    )
     fork = got["version"]
     raw = bytes.fromhex(got["data_ssz"])
     try:

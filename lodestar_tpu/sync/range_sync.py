@@ -28,6 +28,18 @@ EPOCHS_PER_BATCH = 2  # range/batch.ts EPOCHS_PER_BATCH
 MAX_BATCH_DOWNLOAD_ATTEMPTS = 5
 MAX_BATCH_PROCESSING_ATTEMPTS = 3
 
+# peer scoring (score.ts simplified): batch failures downscore, enough
+# of them remove the peer from the rotation entirely. The floor allows
+# MORE failures than one batch's retry budget
+# (MAX_BATCH_DOWNLOAD_ATTEMPTS = 5), so a single flaky batch against a
+# lone peer can exhaust its retries without banning the peer.
+PEER_SCORE_BATCH_FAILURE = -10
+PEER_SCORE_SUCCESS = 1
+PEER_SCORE_MIN = -60
+# backoff between batch retry attempts (seconds; full jitter)
+BATCH_RETRY_BASE_DELAY = 0.05
+BATCH_RETRY_MAX_DELAY = 2.0
+
 
 def decode_block_chunks(beacon_cfg, types, chunks):
     """reqresp response chunks -> [(fork, SignedBeaconBlock)] using the
@@ -389,18 +401,40 @@ class RangeSync:
     through the full verify pipeline, retry failed batches on another
     peer, stop at the target head."""
 
-    def __init__(self, chain, beacon_cfg, types, node: rr.ReqResp):
+    def __init__(self, chain, beacon_cfg, types, node: rr.ReqResp,
+                 clock=None, rng=None):
+        from ..resilience.clock import SYSTEM_CLOCK
+
         self.chain = chain
         self.beacon_cfg = beacon_cfg
         self.types = types
         self.node = node
+        self.clock = clock or SYSTEM_CLOCK
+        self.rng = rng
         self.peers: list[str] = []
+        self.peer_scores: dict[str, int] = {}
+        self.banned_peers: set[str] = set()
         self.batches_processed = 0
         self.blocks_imported = 0
 
     def add_peer(self, peer_id: str) -> None:
-        if peer_id not in self.peers:
+        if peer_id not in self.peers and peer_id not in self.banned_peers:
             self.peers.append(peer_id)
+            self.peer_scores.setdefault(peer_id, 0)
+
+    def _downscore(self, peer: str, amount: int) -> None:
+        """Repeated batch failures remove the peer from the rotation
+        (reference: peer score -> goodbye/ban in peerManager)."""
+        score = self.peer_scores.get(peer, 0) + amount
+        self.peer_scores[peer] = score
+        if score <= PEER_SCORE_MIN and peer in self.peers:
+            self.peers.remove(peer)
+            self.banned_peers.add(peer)
+
+    def _upscore(self, peer: str) -> None:
+        self.peer_scores[peer] = min(
+            0, self.peer_scores.get(peer, 0) + PEER_SCORE_SUCCESS
+        )
 
     async def status_handshake(self, peer: str):
         chunks = await self.node.request(
@@ -438,6 +472,23 @@ class RangeSync:
             self.batches_processed += 1
         return imported_total
 
+    async def _backoff(self, batch: Batch) -> None:
+        """Jittered exponential pause before re-attempting a failed
+        batch — peers that just failed get breathing room instead of
+        an immediate identical request (batch.ts retry semantics +
+        jsonRpcHttpClient-style backoff)."""
+        from ..resilience import backoff_delay
+
+        attempt = batch.download_attempts + batch.processing_attempts - 1
+        await self.clock.sleep(
+            backoff_delay(
+                max(0, attempt),
+                BATCH_RETRY_BASE_DELAY,
+                BATCH_RETRY_MAX_DELAY,
+                rng=self.rng,
+            )
+        )
+
     async def _run_batch(self, batch: Batch) -> bool:
         while batch.download_attempts < MAX_BATCH_DOWNLOAD_ATTEMPTS:
             peer = self._pick_peer(batch)
@@ -449,7 +500,9 @@ class RangeSync:
                 blocks = await self._download(peer, batch)
             except (rr.ReqRespError, asyncio.TimeoutError):
                 batch.failed_peers.add(peer)
+                self._downscore(peer, PEER_SCORE_BATCH_FAILURE)
                 batch.status = BatchStatus.awaiting_download
+                await self._backoff(batch)
                 continue
             batch.blocks = blocks
             batch.status = BatchStatus.processing
@@ -458,23 +511,36 @@ class RangeSync:
             except Exception:
                 batch.processing_attempts += 1
                 batch.failed_peers.add(peer)
+                self._downscore(peer, PEER_SCORE_BATCH_FAILURE)
                 batch.status = BatchStatus.awaiting_download
                 if batch.processing_attempts >= MAX_BATCH_PROCESSING_ATTEMPTS:
                     batch.status = BatchStatus.failed
                     return False
+                await self._backoff(batch)
                 continue
+            self._upscore(peer)
             batch.status = BatchStatus.done
             return True
         batch.status = BatchStatus.failed
         return False
 
     def _pick_peer(self, batch: Batch) -> str | None:
-        """Prefer peers that haven't failed this batch
+        """Prefer peers that haven't failed this batch, then peers the
+        reqresp layer hasn't been seeing failures from
         (peerBalancer.ts:10)."""
         fresh = [p for p in self.peers if p not in batch.failed_peers]
         pool = fresh or self.peers
         if not pool:
             return None
+        stats = getattr(self.node, "peer_stats", None)
+        if stats:
+            # stable sort: healthy (no consecutive failures) first
+            pool = sorted(
+                pool,
+                key=lambda p: stats[p].consecutive_failures
+                if p in stats
+                else 0,
+            )
         return pool[batch.download_attempts % len(pool)]
 
     async def _download(self, peer: str, batch: Batch) -> list:

@@ -1,0 +1,485 @@
+"""Resilience primitives: backoff, retry, breakers, engine state.
+
+All wall-clock deterministic: every schedule runs on ManualClock (no
+real sleeps anywhere in this module) and every jitter draw on a seeded
+rng.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from lodestar_tpu.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    EngineStateTracker,
+    ExecutionEngineState,
+    FaultInspectionWindow,
+    ManualClock,
+    RetryOptions,
+    backoff_delay,
+    bind_breaker,
+    bind_engine_tracker,
+    create_resilience_metrics,
+    default_retryable,
+    retry,
+    retry_sync,
+)
+
+
+class TestBackoff:
+    def test_cap_growth_without_jitter(self):
+        delays = [
+            backoff_delay(n, 0.1, 2.0, jitter="none") for n in range(6)
+        ]
+        assert delays == [0.1, 0.2, 0.4, 0.8, 1.6, 2.0]
+
+    def test_full_jitter_within_cap_and_reproducible(self):
+        rng = random.Random(42)
+        seen = [backoff_delay(n, 0.1, 2.0, rng=rng) for n in range(20)]
+        for n, d in enumerate(seen):
+            assert 0.0 <= d <= min(2.0, 0.1 * 2**n)
+        rng2 = random.Random(42)
+        again = [backoff_delay(n, 0.1, 2.0, rng=rng2) for n in range(20)]
+        assert seen == again
+
+    def test_jitter_actually_varies(self):
+        rng = random.Random(7)
+        draws = {backoff_delay(5, 1.0, 100.0, rng=rng) for _ in range(8)}
+        assert len(draws) > 1
+
+
+class _Flaky:
+    """Callable failing `fails` times then returning `value`."""
+
+    def __init__(self, fails, value="ok", exc=ConnectionError):
+        self.fails = fails
+        self.value = value
+        self.exc = exc
+        self.calls = 0
+
+    def sync(self):
+        self.calls += 1
+        if self.calls <= self.fails:
+            raise self.exc(f"attempt {self.calls}")
+        return self.value
+
+    async def async_(self):
+        return self.sync()
+
+
+class TestRetry:
+    def test_sync_succeeds_after_failures_no_real_sleep(self):
+        clock = ManualClock()
+        f = _Flaky(2)
+        got = retry_sync(
+            f.sync,
+            RetryOptions(retries=3, base_delay=0.5, jitter="none"),
+            clock=clock,
+        )
+        assert got == "ok" and f.calls == 3
+        assert clock.sleeps == [0.5, 1.0]  # one per failed attempt
+
+    def test_sync_exhausts_and_raises_last(self):
+        clock = ManualClock()
+        f = _Flaky(10)
+        with pytest.raises(ConnectionError, match="attempt 3"):
+            retry_sync(
+                f.sync, RetryOptions(retries=2, jitter="none"),
+                clock=clock,
+            )
+        assert f.calls == 3
+
+    def test_non_retryable_fails_immediately(self):
+        clock = ManualClock()
+        f = _Flaky(5, exc=ValueError)
+        with pytest.raises(ValueError):
+            retry_sync(f.sync, RetryOptions(retries=5), clock=clock)
+        assert f.calls == 1 and clock.sleeps == []
+
+    def test_async_retry_with_manual_clock(self):
+        clock = ManualClock()
+        f = _Flaky(2)
+        seen = []
+        opts = RetryOptions(
+            retries=4,
+            base_delay=0.25,
+            jitter="none",
+            on_retry=lambda a, e, d: seen.append((a, d)),
+        )
+        got = asyncio.run(retry(f.async_, opts, clock=clock))
+        assert got == "ok" and f.calls == 3
+        assert seen == [(0, 0.25), (1, 0.5)]
+        assert clock.sleeps == [0.25, 0.5]
+
+    def test_default_classifier(self):
+        assert default_retryable(ConnectionError())
+        assert default_retryable(TimeoutError())
+        assert not default_retryable(ValueError())
+
+        class Auth(Exception):
+            auth_failed = True
+
+        class MarkedRetryable(Exception):
+            retryable = True
+
+        class MarkedTerminal(Exception):
+            retryable = False
+
+        assert not default_retryable(Auth())
+        assert default_retryable(MarkedRetryable())
+        assert not default_retryable(MarkedTerminal())
+
+
+class TestCircuitBreaker:
+    def _mk(self, **kw):
+        clock = ManualClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout", 10.0)
+        return clock, CircuitBreaker(clock=clock, **kw)
+
+    def test_closed_to_open_to_half_open_to_closed(self):
+        clock, b = self._mk()
+        for _ in range(3):
+            assert b.allows()
+            b.on_failure()
+        assert b.state is BreakerState.open
+        assert not b.allows()  # fail-fast while open
+        clock.advance(10.0)
+        assert b.allows()  # half-open probe
+        assert b.state is BreakerState.half_open
+        assert not b.allows()  # probe budget is 1
+        b.on_success()
+        assert b.state is BreakerState.closed
+        states = [new for _, _, new in b.transitions]
+        assert states == [
+            BreakerState.open,
+            BreakerState.half_open,
+            BreakerState.closed,
+        ]
+
+    def test_half_open_failure_reopens(self):
+        clock, b = self._mk(failure_threshold=1)
+        b.on_failure()
+        assert b.state is BreakerState.open
+        clock.advance(10.0)
+        assert b.allows()
+        b.on_failure()
+        assert b.state is BreakerState.open
+        assert not b.allows()  # reset window restarts
+        clock.advance(10.0)
+        assert b.allows()
+        b.on_success()
+        assert b.state is BreakerState.closed
+
+    def test_success_resets_failure_streak(self):
+        _, b = self._mk(failure_threshold=3)
+        b.on_failure()
+        b.on_failure()
+        b.on_success()
+        b.on_failure()
+        b.on_failure()
+        assert b.state is BreakerState.closed
+
+
+class TestFaultInspectionWindow:
+    def test_opens_on_excess_faults_and_recloses(self):
+        w = FaultInspectionWindow(window=8, allowed_faults=2)
+        for slot in (1, 2, 3):
+            w.record_fault(slot)
+        assert w.state is BreakerState.open
+        assert not w.available(4)  # race skipped while open
+        # faults age out of the trailing window -> half-open probe
+        assert w.available(12)
+        assert w.state is BreakerState.half_open
+        w.record_success(12)
+        assert w.state is BreakerState.closed
+        states = [new for _, _, new in w.transitions]
+        assert states == [
+            BreakerState.open,
+            BreakerState.half_open,
+            BreakerState.closed,
+        ]
+
+    def test_faults_within_budget_keep_closed(self):
+        w = FaultInspectionWindow(window=8, allowed_faults=2)
+        w.record_fault(1)
+        w.record_fault(5)
+        assert w.available(6) and w.state is BreakerState.closed
+
+
+class TestEngineState:
+    def test_transitions(self):
+        t = EngineStateTracker()
+        assert t.state is ExecutionEngineState.ONLINE
+        t.on_success("VALID")
+        assert t.state is ExecutionEngineState.SYNCED
+        t.on_success("SYNCING")
+        assert t.state is ExecutionEngineState.SYNCING
+        t.on_error(ConnectionError("refused"))
+        assert t.state is ExecutionEngineState.OFFLINE
+        assert not t.is_online
+        t.on_success(None)  # any response -> back online
+        assert t.state is ExecutionEngineState.ONLINE
+
+        class Auth(Exception):
+            auth_failed = True
+
+        t.on_error(Auth())
+        assert t.state is ExecutionEngineState.AUTH_FAILED
+        assert not t.is_online
+        t.on_success("VALID")
+        assert t.state is ExecutionEngineState.SYNCED
+        assert (
+            ExecutionEngineState.OFFLINE,
+            ExecutionEngineState.ONLINE,
+        ) in t.transitions
+
+    def test_enum_verdicts_accepted(self):
+        from lodestar_tpu.execution import ExecutionPayloadStatus
+
+        t = EngineStateTracker()
+        t.on_success(ExecutionPayloadStatus.ACCEPTED)
+        assert t.state is ExecutionEngineState.SYNCING
+        t.on_success(ExecutionPayloadStatus.INVALID)
+        assert t.state is ExecutionEngineState.SYNCED  # conclusive
+
+
+class TestMetricsBinding:
+    def test_breaker_and_engine_gauges(self):
+        from lodestar_tpu.metrics.registry import RegistryMetricCreator
+
+        reg = RegistryMetricCreator()
+        m = create_resilience_metrics(reg)
+        clock = ManualClock()
+        b = CircuitBreaker(
+            name="engine", failure_threshold=1, reset_timeout=5.0,
+            clock=clock,
+        )
+        bind_breaker(b, m)
+        t = EngineStateTracker()
+        bind_engine_tracker(t, m)
+        assert m.breaker_state.get(name="engine") == 0
+        b.on_failure()
+        assert m.breaker_state.get(name="engine") == 1
+        clock.advance(5.0)
+        b.allows()
+        assert m.breaker_state.get(name="engine") == 2
+        b.on_success()
+        assert m.breaker_state.get(name="engine") == 0
+        assert (
+            m.breaker_transitions_total.get(name="engine", state="open")
+            == 1
+        )
+        t.on_error(ConnectionError())
+        assert m.engine_state.get() == 3  # OFFLINE
+        out = reg.expose()
+        assert "lodestar_resilience_breaker_state" in out
+        assert "lodestar_execution_engine_state" in out
+
+
+class TestRetryingRpcClient:
+    def test_transport_failures_retried_then_succeed(self):
+        from lodestar_tpu.execution.http import (
+            JsonRpcHttpClient,
+            RpcTransportError,
+        )
+
+        clock = ManualClock()
+        client = JsonRpcHttpClient(
+            "http://unused.invalid", retries=3, clock=clock,
+            rng=random.Random(1),
+        )
+        attempts = []
+
+        def fake(method, payload):
+            attempts.append(method)
+            if len(attempts) <= 2:
+                raise RpcTransportError("boom")
+            return {"ok": True}
+
+        client._request_once = fake
+        got = asyncio.run(client.call("eth_chainId", []))
+        assert got == {"ok": True}
+        assert len(attempts) == 3
+        assert len(clock.sleeps) == 2  # backed off twice, virtually
+
+    def test_rpc_error_not_retried(self):
+        from lodestar_tpu.execution.http import (
+            EngineRpcError,
+            JsonRpcHttpClient,
+        )
+
+        clock = ManualClock()
+        client = JsonRpcHttpClient(
+            "http://unused.invalid", retries=5, clock=clock
+        )
+        calls = []
+
+        def fake(method, payload):
+            calls.append(method)
+            raise EngineRpcError(method, "execution error", -32000)
+
+        client._request_once = fake
+        with pytest.raises(EngineRpcError):
+            client.call_sync("engine_newPayloadV2", [{}])
+        assert len(calls) == 1 and clock.sleeps == []
+
+    def test_auth_error_not_retried(self):
+        from lodestar_tpu.execution.http import (
+            EngineAuthError,
+            JsonRpcHttpClient,
+        )
+
+        clock = ManualClock()
+        client = JsonRpcHttpClient(
+            "http://unused.invalid", retries=5, clock=clock
+        )
+
+        def fake(method, payload):
+            raise EngineAuthError("auth rejected (HTTP 401)")
+
+        client._request_once = fake
+        with pytest.raises(EngineAuthError):
+            client.call_sync("engine_newPayloadV2", [{}])
+        assert clock.sleeps == []
+
+
+class TestEth1PollBackoff:
+    def test_failed_rounds_back_off_exponentially(self):
+        from lodestar_tpu.eth1.tracker import Eth1DepositDataTracker
+
+        class Cfg:
+            ETH1_FOLLOW_DISTANCE = 8
+
+        class DeadProvider:
+            calls = 0
+
+            async def get_block_number(self):
+                self.calls += 1
+                raise ConnectionError("eth1 down")
+
+        clock = ManualClock()
+        provider = DeadProvider()
+        t = Eth1DepositDataTracker(Cfg(), None, provider, clock=clock)
+        with pytest.raises(ConnectionError):
+            asyncio.run(t.update())
+        assert provider.calls == 1
+        # inside the backoff window: the provider is NOT hammered
+        asyncio.run(t.update())
+        assert provider.calls == 1
+        clock.advance(1.01)  # BACKOFF_BASE elapsed
+        with pytest.raises(ConnectionError):
+            asyncio.run(t.update())
+        assert provider.calls == 2
+        # window doubled: 1s later still inside
+        clock.advance(1.01)
+        asyncio.run(t.update())
+        assert provider.calls == 2
+        clock.advance(1.0)
+        with pytest.raises(ConnectionError):
+            asyncio.run(t.update())
+        assert provider.calls == 3
+
+
+class TestRangeSyncScoring:
+    def _bare(self):
+        from lodestar_tpu.sync.range_sync import RangeSync
+
+        rs = RangeSync.__new__(RangeSync)
+        rs.peers = []
+        rs.peer_scores = {}
+        rs.banned_peers = set()
+        return rs
+
+    def test_repeated_batch_failures_drop_the_peer(self):
+        from lodestar_tpu.sync.range_sync import (
+            PEER_SCORE_BATCH_FAILURE,
+        )
+
+        rs = self._bare()
+        rs.add_peer("a")
+        rs.add_peer("b")
+        # one batch's full retry budget (5 failures) must NOT ban a
+        # peer — the floor only triggers beyond it
+        for _ in range(5):
+            rs._downscore("a", PEER_SCORE_BATCH_FAILURE)
+        assert "a" in rs.peers
+        rs._downscore("a", PEER_SCORE_BATCH_FAILURE)
+        assert "a" not in rs.peers and "a" in rs.banned_peers
+        rs.add_peer("a")  # banned peers do not rejoin
+        assert "a" not in rs.peers
+        rs._upscore("b")
+        assert rs.peer_scores["b"] == 0  # capped at 0
+
+
+class TestReqRespPeerAccounting:
+    def test_failures_tracked_per_peer(self):
+        from lodestar_tpu.network import reqresp as rr
+
+        transport = rr.InProcessTransport()
+        node = rr.ReqResp("me", transport)
+
+        async def go():
+            for _ in range(2):
+                with pytest.raises(rr.ReqRespError):
+                    await node.request("ghost", rr.PROTOCOL_PING, b"")
+
+        asyncio.run(go())
+        stats = node.peer_stats["ghost"]
+        assert stats.requests == 2 and stats.failures == 2
+        assert stats.consecutive_failures == 2
+        assert stats.failure_rate == 1.0
+        assert node.unhealthy_peers(max_consecutive=2) == ["ghost"]
+
+
+class TestResilientEngineWrapper:
+    def test_fail_fast_when_open_and_recovery(self):
+        from lodestar_tpu.execution.engine import (
+            EngineOfflineError,
+            ResilientEngine,
+        )
+        from lodestar_tpu.sim.faults import FlakyEngine
+
+        class _Status:
+            def __init__(self, status):
+                self.status = status
+
+        class _Inner:
+            async def notify_new_payload(self, fork, payload, **kw):
+                return _Status("VALID")
+
+        clock = ManualClock()
+        flaky = FlakyEngine(_Inner())
+        eng = ResilientEngine(
+            flaky,
+            breaker=CircuitBreaker(
+                name="engine", failure_threshold=2, reset_timeout=4.0,
+                clock=clock,
+            ),
+        )
+
+        async def go():
+            flaky.set_failing(True)
+            for _ in range(2):
+                with pytest.raises(Exception):
+                    await eng.notify_new_payload("bellatrix", None)
+            assert eng.breaker.state is BreakerState.open
+            # fail-fast: no inner call happens while open
+            before = flaky.injected_errors
+            with pytest.raises(EngineOfflineError):
+                await eng.notify_new_payload("bellatrix", None)
+            assert flaky.injected_errors == before
+            assert eng.state is ExecutionEngineState.OFFLINE
+            # recovery: reset window elapses, probe succeeds
+            flaky.set_failing(False)
+            clock.advance(4.0)
+            st = await eng.notify_new_payload("bellatrix", None)
+            assert st.status == "VALID"
+            assert eng.breaker.state is BreakerState.closed
+            assert eng.state is ExecutionEngineState.SYNCED
+
+        asyncio.run(go())

@@ -1,0 +1,288 @@
+"""Fault-injection sims: the chain must stay live through dependency
+faults.
+
+Reference analog: crucible sim tests with deliberate fault windows.
+The acceptance scenario: engine flapping (timeouts then recovery) plus
+a mid-run builder outage — the chain keeps finalizing, block
+production falls back to local payloads while the builder breaker is
+open, and the breaker / engine-state metrics walk the
+open→half-open→closed cycle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.execution import MockExecutionEngine, ResilientEngine
+from lodestar_tpu.execution.builder import MockRelay
+from lodestar_tpu.params import preset
+from lodestar_tpu.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    ExecutionEngineState,
+    FaultInspectionWindow,
+    bind_breaker,
+    bind_engine_tracker,
+    create_resilience_metrics,
+)
+from lodestar_tpu.sim import (
+    FaultSchedule,
+    FlakyEngine,
+    FlakyRelay,
+    GossipFaultInjector,
+    SimBuilder,
+    Simulation,
+    assert_finalized,
+    assert_heads_consistent,
+    assert_no_missed_blocks,
+    catch_up,
+    kill_node,
+    restart_node,
+)
+from lodestar_tpu.types import ssz_types
+
+FAR = 2**64 - 1
+
+
+@pytest.fixture(scope="module")
+def types():
+    return ssz_types()
+
+
+def _cfg(**forks):
+    base = dict(
+        ALTAIR_FORK_EPOCH=FAR,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    base.update(forks)
+    return ChainConfig(**base)
+
+
+class _SlotClock:
+    """Breaker clock measured in sim slots: reset windows are slot
+    counts and the test never wall-clock sleeps for them."""
+
+    def __init__(self, sim):
+        self.sim = sim
+
+    def monotonic(self) -> float:
+        return float(self.sim.slot)
+
+    async def sleep(self, seconds):  # pragma: no cover - unused
+        pass
+
+    def sleep_sync(self, seconds):  # pragma: no cover - unused
+        pass
+
+
+class TestEngineAndBuilderFaults:
+    def test_finalizes_through_engine_flap_and_builder_outage(
+        self, types
+    ):
+        """Slots 1-9 healthy (builder blocks). Slots 10-16: relay
+        outage; slots 10-14: engine flapping. Production must fall
+        back to local payloads, the chain must keep producing every
+        slot and finalize, and both breakers must walk
+        open→half-open→closed."""
+        cfg = _cfg(ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0)
+        sim = Simulation(cfg, types, n_nodes=2, n_validators=8)
+        p = preset()
+        end_slot = 4 * p.SLOTS_PER_EPOCH + 1
+
+        from lodestar_tpu.metrics.registry import RegistryMetricCreator
+
+        reg = RegistryMetricCreator()
+        metrics = create_resilience_metrics(reg)
+        slot_clock = _SlotClock(sim)
+        flaky_engines: list[FlakyEngine] = []
+        flaky_relays: list[FlakyRelay] = []
+        # one shared inspection window: both nodes judge the same relay
+        builder_breaker = FaultInspectionWindow(
+            name="builder", window=6, allowed_faults=1
+        )
+
+        async def go():
+            await sim.start()
+            try:
+                for i, node in enumerate(sim.nodes):
+                    flaky = FlakyEngine(MockExecutionEngine(types))
+                    flaky_engines.append(flaky)
+                    engine = ResilientEngine(
+                        flaky,
+                        breaker=CircuitBreaker(
+                            name="engine",
+                            failure_threshold=2,
+                            reset_timeout=2.0,  # slots, via _SlotClock
+                            clock=slot_clock,
+                        ),
+                    )
+                    node.chain.execution_engine = engine
+                    relay = FlakyRelay(
+                        MockRelay(
+                            types, fork="bellatrix", chain=node.chain
+                        )
+                    )
+                    flaky_relays.append(relay)
+                    node.builder = SimBuilder(
+                        relay, breaker=builder_breaker
+                    )
+                    if i == 0:
+                        bind_breaker(engine.breaker, metrics)
+                        bind_engine_tracker(engine.tracker, metrics)
+                bind_breaker(builder_breaker, metrics)
+
+                faults = FaultSchedule(sim)
+                faults.window(
+                    10, 16,
+                    lambda: [r.set_outage(True) for r in flaky_relays],
+                    lambda: [r.set_outage(False) for r in flaky_relays],
+                )
+                faults.window(
+                    10, 14,
+                    lambda: [
+                        e.set_failing(True) for e in flaky_engines
+                    ],
+                    lambda: [
+                        e.set_failing(False) for e in flaky_engines
+                    ],
+                )
+                await sim.run_until_slot(end_slot)
+
+                # liveness: every slot got a block, the chain finalized
+                assert_heads_consistent(sim)
+                assert_finalized(sim, 2)
+                assert_no_missed_blocks(sim, 1, end_slot)
+            finally:
+                await sim.stop()
+
+        asyncio.run(go())
+
+        # production fell back to local payloads during the outage:
+        # no relay submission carries an outage-window slot, builder
+        # blocks exist both before the outage and after recovery
+        submitted_slots = sorted(
+            int(s.message.slot)
+            for r in flaky_relays
+            for s in r.inner.submissions
+        )
+        assert submitted_slots, "builder never produced"
+        assert all(
+            not (10 <= s <= 16) for s in submitted_slots
+        ), submitted_slots
+        assert any(s < 10 for s in submitted_slots)
+        assert any(s > 16 for s in submitted_slots)
+        assert sum(n.blocks_via_local for n in sim.nodes) >= 7
+        assert sum(n.blocks_via_builder for n in sim.nodes) >= 2
+        # relay faults were actually injected and recorded
+        assert sum(r.injected_errors for r in flaky_relays) >= 2
+
+        # builder breaker walked open -> half-open -> closed
+        b_states = [new for _, _, new in builder_breaker.transitions]
+        assert BreakerState.open in b_states
+        assert b_states[-1] is BreakerState.closed
+        i_open = b_states.index(BreakerState.open)
+        assert BreakerState.half_open in b_states[i_open:]
+        assert builder_breaker.state is BreakerState.closed
+
+        # node0's engine breaker cycle + engine-state machine
+        eng = sim.nodes[0].chain.execution_engine
+        e_states = [new for _, _, new in eng.breaker.transitions]
+        assert BreakerState.open in e_states
+        assert BreakerState.half_open in e_states
+        assert e_states[-1] is BreakerState.closed
+        assert flaky_engines[0].injected_errors >= 2
+        visited = {new for _, new in eng.tracker.transitions}
+        assert ExecutionEngineState.OFFLINE in visited
+        assert eng.tracker.state in (
+            ExecutionEngineState.SYNCED,
+            ExecutionEngineState.SYNCING,
+        )
+
+        # metrics on the registry reflect the cycle and final states
+        assert metrics.breaker_state.get(name="engine") == 0
+        assert metrics.breaker_state.get(name="builder") == 0
+        for name in ("engine", "builder"):
+            assert (
+                metrics.breaker_transitions_total.get(
+                    name=name, state="open"
+                )
+                >= 1
+            )
+            assert (
+                metrics.breaker_transitions_total.get(
+                    name=name, state="closed"
+                )
+                >= 1
+            )
+        assert metrics.engine_state.get() in (1.0, 2.0)  # SYNCED/SYNCING
+        exposed = reg.expose()
+        assert "lodestar_resilience_breaker_state" in exposed
+        assert "lodestar_execution_engine_state" in exposed
+
+
+class TestGossipFaults:
+    @pytest.mark.slow
+    def test_duplicate_and_delay_gossip_tolerated(self, types):
+        """Duplicated + delayed gossip from one node must not fork the
+        network: seen-cache dedup and late delivery keep heads
+        consistent."""
+        sim = Simulation(_cfg(), types, n_nodes=2, n_validators=8)
+        p = preset()
+        end_slot = p.SLOTS_PER_EPOCH + 2
+
+        async def go():
+            await sim.start()
+            injector = GossipFaultInjector(
+                sim.nodes[0].network.gossip,
+                rng=random.Random(1234),
+                duplicate=0.6,
+                delay=0.02,
+            )
+            try:
+                await sim.run_until_slot(end_slot)
+                await asyncio.sleep(0.3)  # drain delayed sends
+                assert injector.duplicated > 0
+                assert injector.delayed > 0
+                assert_heads_consistent(sim)
+                assert_no_missed_blocks(sim, 1, end_slot)
+            finally:
+                injector.detach()
+                await sim.stop()
+
+        asyncio.run(go())
+
+
+class TestNodeKillRestart:
+    @pytest.mark.slow
+    def test_killed_node_restarts_and_catches_up(self, types):
+        """Kill a node mid-run; the survivor keeps building. After
+        restart + catch-up the network converges again."""
+        sim = Simulation(_cfg(), types, n_nodes=2, n_validators=8)
+
+        async def go():
+            await sim.start()
+            try:
+                await sim.run_until_slot(4)
+                await kill_node(sim, 1)
+                assert not sim.nodes[1].alive
+                await sim.run_until_slot(8)
+                # survivor kept extending its chain
+                n0 = sim.nodes[0].chain
+                head0 = n0.fork_choice.proto.get_node(n0.head_root)
+                assert head0 is not None and head0.slot >= 5
+                await restart_node(sim, 1, resync_from=0)
+                await catch_up(sim.nodes[1], sim.nodes[0])
+                await sim.run_until_slot(10)
+                assert_heads_consistent(sim)
+            finally:
+                await sim.stop()
+
+        asyncio.run(go())

@@ -15,9 +15,7 @@ from lodestar_tpu.network.transport import TcpHost, TransportError
 
 class TestHandshakeState:
     def test_xx_roundtrip_and_transport_keys(self):
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
-            X25519PrivateKey,
-        )
+        from lodestar_tpu.network.noise import X25519PrivateKey
 
         si = X25519PrivateKey.generate()
         sr = X25519PrivateKey.generate()
@@ -38,9 +36,7 @@ class TestHandshakeState:
         assert i_recv.decrypt(b"", ct2) == b"pong"
 
     def test_tampered_handshake_fails(self):
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
-            X25519PrivateKey,
-        )
+        from lodestar_tpu.network.noise import X25519PrivateKey
 
         i = noise.HandshakeState(True, X25519PrivateKey.generate())
         r = noise.HandshakeState(False, X25519PrivateKey.generate())
@@ -51,9 +47,7 @@ class TestHandshakeState:
             i.read_msg_b(bytes(msg_b))
 
     def test_tampered_transport_frame_fails(self):
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
-            X25519PrivateKey,
-        )
+        from lodestar_tpu.network.noise import X25519PrivateKey
 
         i = noise.HandshakeState(True, X25519PrivateKey.generate())
         r = noise.HandshakeState(False, X25519PrivateKey.generate())

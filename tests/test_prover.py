@@ -478,11 +478,72 @@ class TestEvm:
 
     def test_unsupported_precompile_fails_closed(self):
         from lodestar_tpu.prover.evm import (
-            BlockContext, Evm, EvmState, _run_precompile, EvmError,
+            EvmError, UnsupportedFeatureError, _run_precompile,
         )
 
-        with pytest.raises(EvmError):
+        with pytest.raises(UnsupportedFeatureError):
             _run_precompile(8, b"", 10**9)  # bn128 pairing: out of scope
+        # deliberately NOT an EvmError: the CALL handlers swallow
+        # EvmError (push 0, continue) — that would turn "can't verify"
+        # into a divergent result
+        assert not issubclass(UnsupportedFeatureError, EvmError)
+
+    def test_unsupported_precompile_escapes_nested_call(self):
+        """Regression (ADVICE r5 high): a contract CALLing a bn128
+        precompile must abort the WHOLE execution, not take the
+        failure branch and keep running."""
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+            UnsupportedFeatureError,
+        )
+
+        for call_op in ("f1", "fa", "f4"):  # CALL, STATICCALL, DELEGATECALL
+            # outer: <CALL-family> to address 0x08, push success flag,
+            # return it — if the failure leaked in-EVM we'd get output 0
+            args = (
+                "6000600060006000"          # ret/in sizes+offsets
+                + ("6000" if call_op == "f1" else "")  # value (CALL)
+                + "6008"                    # address 0x08: bn128 pairing
+                + "620f4240"                # gas
+                + call_op
+                + "60005260206000f3"
+            )
+            st = EvmState()
+            st.put(b"\xc0" * 20, Account(code=bytes.fromhex(args)))
+            evm = Evm(st, BlockContext())
+            with pytest.raises(UnsupportedFeatureError):
+                evm._message(
+                    b"\x11" * 20, b"\xc0" * 20, b"\xc0" * 20, 0, b"",
+                    1_000_000, depth=0, static=False,
+                )
+
+    def test_push_immediate_zero_pads_right(self):
+        """Regression (ADVICE r5 low): a PUSH immediate truncated by
+        the end of code zero-pads on the RIGHT (yellow paper: code is
+        implicitly zero-extended), so PUSH2 with one byte remaining
+        yields 0xAB00 — not 0xAB. The value lands on the stack at the
+        implicit stop; the capture_stack debug hook makes it
+        observable."""
+        from lodestar_tpu.prover.evm import (
+            Account, BlockContext, Evm, EvmState,
+        )
+
+        cases = [
+            (bytes.fromhex("61ab"), 0xAB00),      # PUSH2, 1 of 2 bytes
+            (bytes.fromhex("62abcd"), 0xABCD00),  # PUSH3, 2 of 3 bytes
+            (bytes.fromhex("7fab"), 0xAB << 248), # PUSH32, 1 of 32
+            (bytes.fromhex("61"), 0),             # PUSH2, 0 bytes
+        ]
+        for code, want in cases:
+            st = EvmState()
+            st.put(b"\xc0" * 20, Account(code=code))
+            evm = Evm(st, BlockContext())
+            evm.capture_stack = True
+            r = evm.call(b"\x11" * 20, b"\xc0" * 20, b"", gas=100_000)
+            assert r.success and r.output == b""
+            assert evm.last_stack == [want], (
+                code.hex(), evm.last_stack, hex(want)
+            )
 
 
 class TestVerifiedBlocks:
@@ -581,8 +642,8 @@ class TestVerifiedCall:
     (reference fixture shape: prover/test/fixtures/mainnet/eth_call.json
     — a view call computing over storage + calldata)."""
 
-    def _fixture(self):
-        contract = bytes.fromhex(
+    def _fixture(self, contract: bytes | None = None):
+        contract = contract if contract is not None else bytes.fromhex(
             # return SLOAD(0) + calldataload(4)
             "60005460043501" "60005260206000f3"
         )
@@ -676,6 +737,68 @@ class TestVerifiedCall:
                     "to": "0x" + target.hex(),
                     "data": "0x00000000",
                 })
+
+        asyncio.run(go())
+
+    def test_nested_unsupported_precompile_is_verification_error(self):
+        """Regression (ADVICE r5 high): a contract that CALLs an
+        unimplemented precompile (bn128 pairing 0x08) must surface a
+        VerificationError from vp.call/estimate_gas — never a
+        divergent 'verified' result from the failure branch."""
+        # CALL(gas, 0x08, 0, in(0,0), out(0,0)); push result; return it
+        contract = bytes.fromhex(
+            "6000600060006000" "6000" "6008" "620f4240" "f1"
+            "60005260206000f3"
+        )
+        rpc, pp, caller, target = self._fixture(contract)
+        vp = VerifiedExecutionProvider(rpc, pp)
+        tx = {
+            "from": "0x" + caller.hex(),
+            "to": "0x" + target.hex(),
+            "data": "0x00000000",
+        }
+
+        async def go():
+            with pytest.raises(VerificationError, match="unverifiable"):
+                await vp.call(tx)
+            with pytest.raises(VerificationError, match="unverifiable"):
+                await vp.estimate_gas(tx)
+
+        asyncio.run(go())
+
+    def test_create_without_access_list_fails_closed(self):
+        """Regression (ADVICE r5 medium): when eth_createAccessList is
+        unavailable, a contract-creation tx (to=None) must fail closed
+        instead of executing init code against zero-filled state."""
+        rpc, pp, caller, target = self._fixture()
+        orig_call = rpc.call
+
+        async def no_access_list(method, params):
+            if method == "eth_createAccessList":
+                raise RuntimeError("method not found")
+            return await orig_call(method, params)
+
+        rpc.call = no_access_list
+        vp = VerifiedExecutionProvider(rpc, pp)
+
+        async def go():
+            with pytest.raises(
+                VerificationError, match="createAccessList"
+            ):
+                await vp.call({
+                    "from": "0x" + caller.hex(),
+                    # to=None: contract creation
+                    "data": "0x600a600c600039600a6000f3",
+                })
+            # a plain transfer (no code at target) still works without
+            # an access list — the fail-closed guard is creation/code
+            # specific
+            out = await vp.estimate_gas({
+                "from": "0x" + caller.hex(),
+                "to": "0x" + (b"\x55" * 20).hex(),
+                "value": "0x1",
+            })
+            assert out == 21000
 
         asyncio.run(go())
 
