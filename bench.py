@@ -96,6 +96,14 @@ async def _run() -> float:
     return N_JOBS * SETS_PER_JOB * WAVES / dt
 
 
+def _actual_limb_backend() -> str:
+    """Report the backend that actually ran — the env var alone (no
+    --limb-backend flag) also selects it at limbs import time."""
+    from lodestar_tpu.ops import limbs as _L
+
+    return _L.get_backend()
+
+
 def main() -> None:
     # --mesh N: multi-chip mode (BASELINE config #5). With >= N real
     # devices a Mesh shards each bucket's batch axis over them; with
@@ -105,6 +113,18 @@ def main() -> None:
     # the sharding correctness are real. Env must be set before jax
     # imports, so we re-exec.
     import os
+
+    # --limb-backend {vpu,mxu}: select the Fq limb arithmetic backend
+    # (ops/limbs.py LimbBackend) BEFORE anything traces, so every jitted
+    # stage and Pallas kernel builds for the requested unit. Exported as
+    # the env var so mesh-mode re-exec children inherit it.
+    limb_backend = None
+    if "--limb-backend" in sys.argv:
+        limb_backend = sys.argv[sys.argv.index("--limb-backend") + 1]
+        os.environ["LODESTAR_TPU_LIMB_BACKEND"] = limb_backend
+        from lodestar_tpu.ops import limbs as _L
+
+        _L.set_backend(limb_backend)
 
     mesh_n = 0
     if "--mesh" in sys.argv:
@@ -162,6 +182,7 @@ def main() -> None:
                     "sets/sec (TpuBlsVerifier.verify_signature_sets, "
                     f"{N_JOBS}x{SETS_PER_JOB}-set jobs/wave, compressed in)"
                 ),
+                "limb_backend": _actual_limb_backend(),
                 "vs_baseline": round(
                     sets_per_sec / BASELINE_SETS_PER_SEC, 4
                 ),

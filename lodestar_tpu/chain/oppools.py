@@ -139,6 +139,11 @@ class AggregatedAttestationPool:
         if max_atts is None:
             max_atts = p.MAX_ATTESTATIONS
         out = []
+        # phase0 seen-bits maps, built at most once per pending list
+        # for this packing pass (not per pooled entry — a full pool
+        # late in an epoch would otherwise rescan every
+        # PendingAttestation's bitlist per entry)
+        seen_cache: dict = {}
         for (slot, _root), group in sorted(
             self._groups.items(), key=lambda kv: -kv[0][0]
         ):
@@ -151,7 +156,7 @@ class AggregatedAttestationPool:
                 group, key=lambda e: -sum(e["bits"])
             ):
                 if state is not None and self._fully_on_chain(
-                    state, slot, e
+                    state, slot, e, seen_cache
                 ):
                     continue
                 a = self.types.Attestation.default()
@@ -168,12 +173,16 @@ class AggregatedAttestationPool:
         return out
 
     @staticmethod
-    def _fully_on_chain(state, att_slot: int, entry) -> bool:
-        """True when every attester of a pooled aggregate already has
-        the timely-target participation flag for the attestation's
-        epoch in `state` (altair+; phase0 states have no participation
-        lists and are never filtered). Fail-open: any lookup error
-        keeps the attestation includable."""
+    def _fully_on_chain(
+        state, att_slot: int, entry, seen_cache: dict | None = None
+    ) -> bool:
+        """True when every attester of a pooled aggregate is already
+        represented on chain for the attestation's epoch in `state` —
+        altair+ via the timely-target participation flag, phase0 via
+        the PendingAttestation lists (the reference's phase0
+        notSeenValidatorsFn; seen_cache memoizes the per-(slot, index)
+        seen-bits maps across one packing pass). Fail-open: any lookup
+        error keeps the attestation includable."""
         try:
             from ..statetransition import util as st_util
             from ..statetransition.util import TIMELY_TARGET_FLAG_INDEX
@@ -199,7 +208,51 @@ class AggregatedAttestationPool:
             else:
                 return False
             if part is None:
-                return False
+                # phase0: no participation flags, but the state's
+                # PendingAttestation lists record exactly which
+                # committee bit positions are already included for
+                # each (slot, index) — compare bit-for-bit (positions
+                # align: both index the same beacon committee).
+                # Without this branch every phase0 block re-includes
+                # the whole pool's last epoch of aggregates, which
+                # inflates average inclusion delay ~1.7x.
+                pend = getattr(
+                    state,
+                    "current_epoch_attestations"
+                    if att_epoch == state_epoch
+                    else "previous_epoch_attestations",
+                    None,
+                )
+                if pend is None:
+                    return False
+                data = entry["data"]
+                bits = list(entry["bits"])
+                epoch_key = att_epoch == state_epoch
+                if seen_cache is None:
+                    seen_cache = {}
+                if ("built", epoch_key) not in seen_cache:
+                    # one sweep over the pending list builds the
+                    # seen-bits union for EVERY (slot, index) at once
+                    for pa in pend:
+                        key = (
+                            epoch_key,
+                            int(pa.data.slot),
+                            int(pa.data.index),
+                        )
+                        dst = seen_cache.setdefault(key, [])
+                        pab = list(pa.aggregation_bits)
+                        if len(pab) > len(dst):
+                            dst.extend([False] * (len(pab) - len(dst)))
+                        for i, b in enumerate(pab):
+                            if b:
+                                dst[i] = True
+                    seen_cache[("built", epoch_key)] = True
+                seen = seen_cache.get(
+                    (epoch_key, att_slot, int(data.index)), []
+                )
+                if len(seen) < len(bits):
+                    seen = seen + [False] * (len(bits) - len(seen))
+                return bool(bits) and _is_subset(bits, seen)
             data = entry["data"]
             committee = st_util.get_shuffling(
                 state, att_epoch

@@ -31,6 +31,13 @@ normalize = L.normalize
 const = L.const
 conv = L.conv
 
+# Limb backend knob (VPU int32 vs MXU int8 — ops/limbs.py): mul/sqr/
+# pow chains and the towers inherit the selection through conv +
+# normalize, so these re-exports are the whole integration surface.
+set_limb_backend = L.set_backend
+get_limb_backend = L.get_backend
+limb_backend = L.limb_backend
+
 # The value of any canonical-profile Lv is non-negative and < 1037*P
 # (limbs <= B+1 over 390 bits plus the small carry limb) < 2^11 * P, so
 # a 12-step binary conditional-subtract ladder fully reduces it.

@@ -16,7 +16,7 @@ representation designed for the TPU VPU:
   - Reduction mod P is a linear fold: 2^(10k) mod P for every overflow
     limb index k is a precomputed constant row; folding high limbs is a
     small constant matrix-multiply that XLA maps onto fused multiply-adds
-    (and later, Pallas can put an int8-decomposed version on the MXU).
+    (the "mxu" backend emits an int8-decomposed version for the MXU).
   - Carry normalization is a handful of data-parallel shift/subtract
     passes (no sequential ripple), correct for signed limbs because the
     int32 right shift is arithmetic.
@@ -27,11 +27,20 @@ arithmetic, auto-normalizing operands when a column sum could leave
 int32. Intervals are static Python data (pytree aux), so this costs
 nothing at runtime, and `normalize()` lands on a fixed canonical profile
 so `lax.scan` carries typecheck.
+
+Two interchangeable backends emit the heavy contractions (see the
+LimbBackend block below): "vpu" keeps conv/fold as int32 einsums for
+the vector unit; "mxu" splits limbs into int8 slices and emits the
+same math as int8 x int8 -> int32 dot_generals for the matrix unit,
+with the slice/accumulator/recombination bounds folded into the same
+trace-time proofs.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import os
 from dataclasses import dataclass
 
 import jax
@@ -45,6 +54,76 @@ B = 1 << BITS  # limb radix
 NLIMB = 39  # 390 bits >= 382 > log2(P)
 NCANON = NLIMB + 1  # canonical length incl. redundant carry limb
 INT32_MAX = 2**31 - 1
+
+# ---------------------------------------------------------------------------
+# Limb backend selection (VPU int32 vs MXU int8)
+# ---------------------------------------------------------------------------
+#
+# "vpu": the original path — conv is a banded int32 einsum, the mod-P
+#   fold an int32 matmul; both run on the TPU vector unit. Stays the
+#   differential reference.
+# "mxu": every limb is split into two int8 slices (lo = x mod 128 in
+#   [0, 128), hi = x >> 7 arithmetic, exact for signed x since
+#   x == lo + 128*hi), and conv/fold are emitted as int8 x int8
+#   contractions with preferred_element_type=int32 — the quantized-GEMM
+#   shape the TPU matrix unit executes natively at ~4x the int32 VPU
+#   MAC rate. Exactness is *proved at trace time*: the interval
+#   machinery bounds every partial contraction and every recombination
+#   intermediate with exact python-int arithmetic and auto-normalizes
+#   (or falls back to the VPU op) whenever a slice would leave int8 or
+#   an accumulator would leave int32; the recombined column sums equal
+#   the int32 path bit-for-bit.
+#
+# Select via LODESTAR_TPU_LIMB_BACKEND, set_backend(), or the
+# limb_backend() context manager. NOTE: jitted pipelines trace once per
+# input shape — select the backend before first use (process start /
+# env var) or clear jit caches; the context manager is meant for
+# direct-op differential tests.
+
+LIMB_BACKENDS = ("vpu", "mxu")
+MXU_SLICE_BITS = 7  # int8 slice split: lo in [0, 128), hi arithmetic
+_SLICE_B = 1 << MXU_SLICE_BITS
+
+_backend = os.environ.get("LODESTAR_TPU_LIMB_BACKEND", "vpu")
+if _backend not in LIMB_BACKENDS:
+    raise ValueError(
+        f"LODESTAR_TPU_LIMB_BACKEND={_backend!r} not in {LIMB_BACKENDS}"
+    )
+
+
+def get_backend() -> str:
+    return _backend
+
+
+def set_backend(name: str, *, clear: bool = True) -> None:
+    """Select the limb backend. The choice is read at TRACE time, so a
+    switch drops every cached jit trace by default (XLA stages and
+    Pallas kernel builders re-trace lazily and re-read the backend);
+    the persistent compile cache keys on the emitted HLO, so both
+    backends' compiled artifacts coexist on disk. clear=False skips
+    the (process-wide, expensive to repopulate) cache drop — only
+    sound for EAGER op use, which reads the backend per call."""
+    global _backend
+    if name not in LIMB_BACKENDS:
+        raise ValueError(f"unknown limb backend {name!r}; want {LIMB_BACKENDS}")
+    if name != _backend:
+        _backend = name
+        if clear:
+            jax.clear_caches()
+
+
+@contextlib.contextmanager
+def limb_backend(name: str, *, clear: bool = False):
+    """Temporarily select a limb backend. Default clear=False: meant
+    for eager differential tests/tools, which must not evict every
+    other jitted pipeline's traces; pass clear=True when the block
+    runs jitted/Pallas code that must re-trace under the backend."""
+    prev = _backend
+    set_backend(name, clear=clear)
+    try:
+        yield
+    finally:
+        set_backend(prev, clear=clear)
 
 # Canonical interval profile: non-negative limbs in [0, B+1] plus a
 # small redundant carry limb. Keeping the canonical domain non-negative
@@ -240,18 +319,140 @@ def _band_index(na: int, nb: int):
     return np.clip(idx, 0, nb - 1), valid.astype(np.int32)
 
 
+def _slice_bounds(lo: tuple, hi: tuple):
+    """Exact per-limb interval bounds of the int8 slice decomposition
+    x = x_lo + 2^MXU_SLICE_BITS * x_hi (x_hi = x >> 7 arithmetic,
+    x_lo = x - (x_hi << 7) in [0, 128))."""
+    s = MXU_SLICE_BITS
+    hi_b = tuple((l >> s, h >> s) for l, h in zip(lo, hi))
+    lo_b = []
+    for l, h in zip(lo, hi):
+        if (l >> s) == (h >> s):  # one hi value: lo interval is exact
+            lo_b.append((l - ((l >> s) << s), h - ((h >> s) << s)))
+        else:
+            lo_b.append((0, _SLICE_B - 1))
+    return tuple(lo_b), hi_b
+
+
+def _iv_ok(lo, hi) -> bool:
+    return min(lo) >= -INT32_MAX and max(hi) <= INT32_MAX
+
+
+def _recombine_ok(c0, clh, chl, c2) -> bool:
+    """Shared int32 proof for the int8 recombination
+    out = c0 + ((c1 + (c2 << s)) << s) with c1 = clh + chl emitted as
+    ONE stacked dot (so its accumulation bound is the sum): checks the
+    per-dot order-independent accumulation bounds and every shifted
+    recombination intermediate. Args are (lo, hi, absmax) triples from
+    _conv_bounds/_const_mat_bounds."""
+    s = MXU_SLICE_BITS
+    if max(c0[2], clh[2] + chl[2], c2[2]) > INT32_MAX:
+        return False
+    c1lo = tuple(x + y for x, y in zip(clh[0], chl[0]))
+    c1hi = tuple(x + y for x, y in zip(clh[1], chl[1]))
+    c2s = (tuple(x << s for x in c2[0]), tuple(x << s for x in c2[1]))
+    if not _iv_ok(*c2s):
+        return False
+    t = (
+        tuple(x + y for x, y in zip(c1lo, c2s[0])),
+        tuple(x + y for x, y in zip(c1hi, c2s[1])),
+    )
+    ts = (tuple(x << s for x in t[0]), tuple(x << s for x in t[1]))
+    if not (_iv_ok(*t) and _iv_ok(*ts)):
+        return False
+    out = (
+        tuple(x + y for x, y in zip(c0[0], ts[0])),
+        tuple(x + y for x, y in zip(c0[1], ts[1])),
+    )
+    return _iv_ok(*out)
+
+
+@functools.lru_cache(maxsize=65536)
+def _mxu_conv_plan(alo, ahi, blo, bhi) -> bool:
+    """Trace-time proof that the int8-sliced conv of values with these
+    interval profiles is exact: every slice fits int8, every partial
+    contraction's order-independent accumulation bound fits int32, and
+    every recombination intermediate fits int32. Returns False when the
+    caller must normalize first (canonical profiles always pass)."""
+    al_b, ah_b = _slice_bounds(alo, ahi)
+    bl_b, bh_b = _slice_bounds(blo, bhi)
+    for (l, h) in ah_b + bh_b:
+        if l < -128 or h > 127:
+            return False  # hi slice leaves int8
+    unzip = lambda bs: (tuple(x[0] for x in bs), tuple(x[1] for x in bs))
+    all_, alh = unzip(al_b)
+    ahl, ahh = unzip(ah_b)
+    bll, blh = unzip(bl_b)
+    bhl, bhh = unzip(bh_b)
+    return _recombine_ok(
+        _conv_bounds(all_, alh, bll, blh),  # a_lo * b_lo
+        _conv_bounds(all_, alh, bhl, bhh),  # a_lo * b_hi
+        _conv_bounds(ahl, ahh, bll, blh),  # a_hi * b_lo
+        _conv_bounds(ahl, ahh, bhl, bhh),  # a_hi * b_hi
+    )
+
+
+def _slice8(v):
+    """Split int32 limbs into (lo8, hi8) with v == lo8 + (hi8 << 7).
+    Caller must have proved both slices fit int8."""
+    hi = v >> MXU_SLICE_BITS  # arithmetic: exact for signed v
+    lo = v - (hi << MXU_SLICE_BITS)
+    return lo.astype(jnp.int8), hi.astype(jnp.int8)
+
+
+def _dot8(a8, m8):
+    """int8 x int8 -> int32 contraction over the shared limb axis —
+    the MXU's native quantized-GEMM shape (lax.dot_general with
+    preferred_element_type=int32)."""
+    return jnp.einsum(
+        "...i,...ik->...k", a8, m8, preferred_element_type=jnp.int32
+    )
+
+
+def _conv_mxu(a: Lv, b: Lv, lo: tuple, hi: tuple) -> Lv:
+    """int8-sliced schoolbook conv: three int8 contractions + a shifted
+    recombination. Exact: with a = al + 128*ah, b = bl + 128*bh,
+    conv(a,b) = conv(al,bl) + 128*(conv(al,bh)+conv(ah,bl))
+              + 128^2*conv(ah,bh); the two cross terms share one
+    stacked contraction. Bounds proved by _mxu_conv_plan."""
+    s = MXU_SLICE_BITS
+    idx, valid = _band_index(a.n, b.n)
+    band = b.v[..., idx] * jnp.asarray(valid)  # (..., na, nout)
+    bl8, bh8 = _slice8(band)
+    al8, ah8 = _slice8(a.v)
+    c0 = _dot8(al8, bl8)
+    c1 = _dot8(
+        jnp.concatenate([al8, ah8], axis=-1),
+        jnp.concatenate([bh8, bl8], axis=-2),
+    )
+    c2 = _dot8(ah8, bh8)
+    out = c0 + ((c1 + (c2 << s)) << s)
+    return Lv(out, lo, hi)
+
+
 def conv(a: Lv, b: Lv) -> Lv:
     """Schoolbook product (length na+nb-1), carry-free accumulation.
 
-    Emitted as one batched int32 matvec against a banded gather of b's
-    limbs (3 XLA ops) rather than na slice-adds, keeping scan bodies that
-    chain hundreds of field muls small enough to compile."""
+    VPU backend: one batched int32 matvec against a banded gather of
+    b's limbs (3 XLA ops) rather than na slice-adds, keeping scan
+    bodies that chain hundreds of field muls small enough to compile.
+    MXU backend: the same banded gather, int8-sliced and emitted as
+    three int8xint8->int32 contractions (see _conv_mxu)."""
     lo, hi, absmax = _conv_bounds(a.lo, a.hi, b.lo, b.hi)
     if _overflows(lo, hi) or absmax > INT32_MAX:
         a2, b2 = normalize(a), normalize(b)
         if (a2.lo, a2.hi, b2.lo, b2.hi) == (a.lo, a.hi, b.lo, b.hi):
             raise OverflowError("conv overflows even on canonical inputs")
         return conv(a2, b2)
+    if _backend == "mxu":
+        if _mxu_conv_plan(a.lo, a.hi, b.lo, b.hi):
+            return _conv_mxu(a, b, lo, hi)
+        a2, b2 = normalize(a), normalize(b)
+        if (a2.lo, a2.hi, b2.lo, b2.hi) != (a.lo, a.hi, b.lo, b.hi):
+            return conv(a2, b2)
+        # canonical profiles always satisfy the int8 plan; anything
+        # that still fails here is a non-normalizable profile — the
+        # int32 VPU op below stays exact for it.
     na, nb = a.n, b.n
     idx, valid = _band_index(na, nb)
     band = b.v[..., idx] * jnp.asarray(valid)  # (..., na, nout)
@@ -352,10 +553,74 @@ def _fold_plan(n: int, lo: tuple, hi: tuple):
     return mat, tuple(olo), tuple(ohi), max(oabs)
 
 
+def _const_mat_bounds(xlo: tuple, xhi: tuple, mat) -> tuple:
+    """Exact per-column bounds + order-independent accumulation bound
+    of x @ mat for a constant non-negative integer matrix."""
+    nk, nj = mat.shape
+    lo = [0] * nj
+    hi = [0] * nj
+    ab = [0] * nj
+    for k in range(nk):
+        for j in range(nj):
+            m = int(mat[k, j])
+            if m == 0:
+                continue
+            lo[j] += min(xlo[k] * m, xhi[k] * m)
+            hi[j] += max(xlo[k] * m, xhi[k] * m)
+            ab[j] += max(abs(xlo[k]), abs(xhi[k])) * m
+    return tuple(lo), tuple(hi), max(ab)
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_plan_mxu(n: int, lo: tuple, hi: tuple) -> bool:
+    """Trace-time proof that the int8-sliced fold matmul is exact for
+    this interval profile (mirrors _mxu_conv_plan; the fold matrix is
+    a non-negative constant < 2^10 so only the value side can fail)."""
+    s = MXU_SLICE_BITS
+    mat = _fold_plan(n, lo, hi)[0]
+    xl_b, xh_b = _slice_bounds(lo[NLIMB:], hi[NLIMB:])
+    if any(l < -128 or h > 127 for l, h in xh_b):
+        return False
+    mat_hi = mat >> s  # entries < 8
+    mat_lo = mat - (mat_hi << s)
+    unzip = lambda bs: (tuple(x[0] for x in bs), tuple(x[1] for x in bs))
+    xll, xlh = unzip(xl_b)
+    xhl, xhh = unzip(xh_b)
+    return _recombine_ok(
+        _const_mat_bounds(xll, xlh, mat_lo),
+        _const_mat_bounds(xll, xlh, mat_hi),
+        _const_mat_bounds(xhl, xhh, mat_lo),
+        _const_mat_bounds(xhl, xhh, mat_hi),
+    )
+
+
+def _fold_mxu(xs, mat) -> jax.Array:
+    """int8-sliced x @ mat: batch on the GEMM M dimension, the constant
+    fold matrix on N — the cleanest MXU mapping in the module (shared
+    weights, unlike conv's per-element band)."""
+    s = MXU_SLICE_BITS
+    ml8, mh8 = _slice8(jnp.asarray(mat, jnp.int32))
+    xl8, xh8 = _slice8(xs)
+
+    def dot(v8, m8):
+        return jnp.einsum(
+            "...k,kj->...j", v8, m8, preferred_element_type=jnp.int32
+        )
+
+    c0 = dot(xl8, ml8)
+    c1 = dot(
+        jnp.concatenate([xl8, xh8], axis=-1),
+        jnp.concatenate([mh8, ml8], axis=0),
+    )
+    c2 = dot(xh8, mh8)
+    return c0 + ((c1 + (c2 << s)) << s)
+
+
 def _fold_overflow(x: Lv) -> Lv:
     """Fold limbs at index >= NLIMB back below P's bit range via the
-    precomputed 2^(10k) mod P rows (one static int32 matmul), except a
-    small interval at the canonical carry slot (index NLIMB), which stays
+    precomputed 2^(10k) mod P rows (one static matmul — int32 on the
+    VPU backend, int8-sliced on the MXU backend), except a small
+    interval at the canonical carry slot (index NLIMB), which stays
     in place."""
     mat, flo, fhi, fabs = _fold_plan(x.n, x.lo, x.hi)
     lo = tuple(a + b for a, b in zip(x.lo[:NLIMB] + (0,), flo))
@@ -363,12 +628,15 @@ def _fold_overflow(x: Lv) -> Lv:
     if _overflows(lo, hi) or fabs > INT32_MAX:
         raise OverflowError("fold overflow — carry before folding")
     keep = jnp.pad(x.v[..., :NLIMB], [(0, 0)] * (x.v.ndim - 1) + [(0, 1)])
-    folded = jnp.einsum(
-        "...k,kj->...j",
-        x.v[..., NLIMB:],
-        jnp.asarray(mat, jnp.int32),
-        preferred_element_type=jnp.int32,
-    )
+    if _backend == "mxu" and _fold_plan_mxu(x.n, x.lo, x.hi):
+        folded = _fold_mxu(x.v[..., NLIMB:], mat)
+    else:
+        folded = jnp.einsum(
+            "...k,kj->...j",
+            x.v[..., NLIMB:],
+            jnp.asarray(mat, jnp.int32),
+            preferred_element_type=jnp.int32,
+        )
     return Lv(keep + folded, lo, hi)
 
 

@@ -63,14 +63,88 @@ def _carry(acc, passes: int):
     return acc
 
 
-def _modmul(a, b, fold_const):
+def _fold_contract_vpu(lo, hi, extra, fold_const):
+    """Fold rows 40..79 + the explicit top carry through the constant
+    2^(10k) mod P rows as 41 broadcast MACs on the VPU."""
+    for k in range(ROWS):
+        lo = lo + fold_const[k].reshape(ROWS, 1) * hi[k : k + 1, :]
+    return lo + fold_const[ROWS].reshape(ROWS, 1) * extra
+
+
+def _fold_contract_mxu(lo, hi, extra, f_lo8, f_hi8):
+    """The same fold as THREE int8 x int8 -> int32 dot_generals on the
+    MXU (quantized-GEMM shape; contraction over the 48-row axis of the
+    CONSTANT fold matrix, so the systolic array sees shared weights).
+
+    Exactness (static): hi rows are <= ~1088 and the captured top
+    carry <= 64 (see _modmul_core's carry analysis), so the value-side
+    hi slice is <= 8; fold rows are canonical limbs < 2^10, so the
+    matrix-side hi slice is <= 7. All three accumulations stay far
+    inside int32 (<= 96*127*127 < 2^21 per column) and the shifted
+    recombination peaks below 2^26 — the same bound as the VPU fold
+    sum. The per-lane schoolbook product CANNOT move to the MXU (both
+    operands vary per lane — there is no shared contraction matrix);
+    the fold is the kernel's one matmul-shaped contraction."""
+    s = L.MXU_SLICE_BITS
+    W = hi.shape[-1]
+    # value side: rows 40..79 + explicit carry, zero-padded to the
+    # fold matrix's 48 rows (rows 41..47 of the matrix are zero too)
+    hi_all = jnp.concatenate(
+        [hi, extra, jnp.zeros((FOLD_ROWS - ROWS - 1, W), jnp.int32)],
+        axis=0,
+    )
+    h_lo, h_hi = L._slice8(hi_all)
+
+    def dg(m8, v8):
+        return jax.lax.dot_general(
+            m8,
+            v8,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    c0 = dg(f_lo8, h_lo)
+    c1 = dg(
+        jnp.concatenate([f_lo8, f_hi8], axis=0),
+        jnp.concatenate([h_hi, h_lo], axis=0),
+    )
+    c2 = dg(f_hi8, h_hi)
+    return lo + c0 + ((c1 + (c2 << s)) << s)
+
+
+def make_modmul(fold_const):
+    """Modular-multiply closure over a loaded fold-constant block,
+    with the fold contraction picked by the limb backend at TRACE
+    time (ops/limbs.get_backend): int8 MXU dots for "mxu", broadcast
+    VPU MACs for "vpu". The int8 constant slices are hoisted out of
+    the returned closure so chained calls (power chains run hundreds)
+    share them."""
+    if L.get_backend() == "mxu":
+        f_lo8, f_hi8 = L._slice8(fold_const)
+
+        def fold(lo, hi, extra):
+            return _fold_contract_mxu(lo, hi, extra, f_lo8, f_hi8)
+
+    else:
+
+        def fold(lo, hi, extra):
+            return _fold_contract_vpu(lo, hi, extra, fold_const)
+
+    def mm(a, b):
+        return _modmul_core(a, b, fold_const, fold)
+
+    return mm
+
+
+def _modmul_core(a, b, fold_const, fold):
     """(40, W) x (40, W) canonical non-negative limbs -> (40, W) for
     any lane width W (128 for full blocks; the lane-halving product
     reduction calls at 64..1).
 
     Schoolbook product into an 80-row accumulator via 40 broadcast
-    MACs (static sublane slices), parallel carries, constant-row fold
-    of limbs 40..78, final carry + one-row refold."""
+    MACs (static sublane slices), parallel carries, `fold` contraction
+    of limbs 40..78 (VPU MACs or MXU int8 dots — see make_modmul),
+    final carry + one-row refold."""
     W = b.shape[-1]
     # Schoolbook accumulation as a sum of zero-padded shifted terms:
     # Mosaic lowers neither scatter-add nor value dynamic_slice, but
@@ -99,9 +173,7 @@ def _modmul(a, b, fold_const):
     )
     lo = acc[:ROWS, :]
     hi = acc[ROWS:, :]  # rows 40..79, limbs <= ~1088
-    for k in range(ROWS):
-        lo = lo + fold_const[k].reshape(ROWS, 1) * hi[k : k + 1, :]
-    lo = lo + fold_const[ROWS].reshape(ROWS, 1) * extra
+    lo = fold(lo, hi, extra)
     # fold sum < 41 * 1088 * 1023 < 2^26. Reduce with capture-and-fold
     # rounds: every carry pass captures the row-39 outgoing carry
     # (weight = limb 40) and folds it straight back through fold row 0
@@ -183,10 +255,7 @@ def make_windowed_powc(mm, window: int):
 def _chain_kernel(win_ref, fold_ref, base_ref, out_ref, *, nwin: int):
     fold_const = fold_ref[:]
     base = base_ref[:]
-
-    def mm(a, b):
-        return _modmul(a, b, fold_const)
-
+    mm = make_modmul(fold_const)
     powc = make_windowed_powc(mm, WINDOW)
     out_ref[:] = powc(base, win_ref, nwin)
 

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..crypto.bls.fields import P
 from . import limbs as L
-from .pallas_chain import LANES, ROWS, _fold_rows, _modmul
+from .pallas_chain import LANES, ROWS, _fold_rows, make_modmul
 
 NBITS = 64  # random-weight ladder width (kernels.RAND_BITS)
 
@@ -76,8 +76,7 @@ def _mk_field(fold_const, off_const):
     fold0 = fold_const[0].reshape(ROWS, 1)
     off = off_const.reshape(ROWS, 1)
 
-    def mm(a, b):
-        return _modmul(a, b, fold_const)
+    mm = make_modmul(fold_const)
 
     def sub(a, b):
         # a <= ~1100 per limb, off >= 1025 >= b's post-norm limbs...
@@ -275,8 +274,7 @@ def _g1_ladder_kernel(
     fold0 = fold_const[0].reshape(ROWS, 1)
     off = off_const.reshape(ROWS, 1)
 
-    def mm(a, b):
-        return _modmul(a, b, fold_const)
+    mm = make_modmul(fold_const)
 
     def nrm(x):
         return _norm2(x, fold0)

@@ -36,7 +36,7 @@ import numpy as np
 from . import limbs as L
 from . import tower
 from .curve import JacPoint
-from .pallas_chain import LANES, ROWS, _fold_rows, _modmul
+from .pallas_chain import LANES, ROWS, _fold_rows, make_modmul
 from .pallas_ladder import _norm2, _sub_offset
 from .pairing import _U_BITS
 
@@ -50,8 +50,7 @@ def _mk_tower(fold_const, off_const):
     fold0 = fold_const[0].reshape(ROWS, 1)
     off = off_const.reshape(ROWS, 1)
 
-    def mm(a, b):
-        return _modmul(a, b, fold_const)
+    mm = make_modmul(fold_const)
 
     def nrm(x):
         return _norm2(x, fold0)

@@ -37,7 +37,7 @@ from ..crypto.bls import fields as OF
 from ..crypto.bls.fields import P
 from . import limbs as L
 from .pallas_chain import (
-    LANES, ROWS, _fold_rows, _modmul, make_windowed_powc,
+    LANES, ROWS, _fold_rows, make_windowed_powc,
     window_schedule,
 )
 

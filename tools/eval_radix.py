@@ -1,0 +1,111 @@
+"""Static limb-radix evaluation: 2^10 vs 2^12 (vs 2^13) for Fq mul.
+
+ISSUE 7 asks whether radix-2^12 limbs (~1.5x fewer conv MACs) should
+replace radix-2^10 alongside the MXU int8 backend. This tool answers
+with the SAME trace-time interval machinery the runtime uses
+(ops/limbs._conv_bounds / _mxu_conv_plan are radix-agnostic — they
+take bound tuples), so the numbers are proofs, not estimates:
+
+  - conv MAC counts (int32 VPU and int8 MXU, 2-slice decomposition);
+  - LAZY-ADD DEPTH: the largest k such that a conv of operands that
+    are sums of k canonical values still fits int32 without a
+    normalize. The Karatsuba towers lean on this — fq2_mul feeds
+    conv(add(a0,a1), add(b0,b1)) (depth 2) and fq6/fq12 stack more —
+    so a radix whose depth collapses to <2 forces extra normalizes
+    (each one a carry cascade + fold matmul) before most tower convs,
+    which costs more than the MAC savings recover.
+
+No device required; pure python. Run: python tools/eval_radix.py
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lodestar_tpu.crypto.bls.fields import P  # noqa: E402
+from lodestar_tpu.ops import limbs as L  # noqa: E402
+
+
+def lazy_add_depth(nlimb: int, canon_hi: int, check) -> int:
+    """Max k with conv(k-sum, k-sum) admissible under `check`."""
+    k = 0
+    while k < 64:
+        hi = tuple([(k + 1) * canon_hi] * nlimb)
+        lo = tuple([0] * nlimb)
+        if not check(lo, hi, lo, hi):
+            break
+        k += 1
+    return k
+
+
+def vpu_ok(alo, ahi, blo, bhi) -> bool:
+    lo, hi, absmax = L._conv_bounds(alo, ahi, blo, bhi)
+    return not L._overflows(lo, hi) and absmax <= L.INT32_MAX
+
+
+def mxu_ok(alo, ahi, blo, bhi) -> bool:
+    return vpu_ok(alo, ahi, blo, bhi) and L._mxu_conv_plan(
+        alo, ahi, blo, bhi
+    )
+
+
+def evaluate(bits: int) -> dict:
+    b = 1 << bits
+    nlimb = math.ceil(P.bit_length() / bits)
+    canon_hi = b + 1  # canonical profile analog (limbs <= B+1)
+    nout = 2 * nlimb - 1
+    int32_macs = nlimb * nout
+    # 2-slice int8 decomposition (lo7 + hi<<7); hi slice spans
+    # bits-7 bits for canonical values — representable iff limb
+    # magnitude < 2^15 (hi slice in int8), true for both radices.
+    int8_macs = 4 * nlimb * nout
+    return {
+        "bits": bits,
+        "nlimb": nlimb,
+        "int32_macs": int32_macs,
+        "int8_macs": int8_macs,
+        "lazy_depth_vpu": lazy_add_depth(nlimb, canon_hi, vpu_ok),
+        "lazy_depth_mxu": lazy_add_depth(nlimb, canon_hi, mxu_ok),
+    }
+
+
+def main() -> None:
+    rows = [evaluate(b) for b in (10, 12, 13)]
+    base = rows[0]
+    print(
+        "| radix | limbs | int32 MACs/mul | int8 MACs/mul | MAC ratio "
+        "| lazy-add depth (vpu) | lazy-add depth (mxu) |"
+    )
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| 2^{r['bits']} | {r['nlimb']} | {r['int32_macs']} "
+            f"| {r['int8_macs']} "
+            f"| {base['int32_macs'] / r['int32_macs']:.2f}x "
+            f"| {r['lazy_depth_vpu']} | {r['lazy_depth_mxu']} |"
+        )
+    r12 = rows[1]
+    print()
+    if r12["lazy_depth_vpu"] < 2 or r12["lazy_depth_mxu"] < 2:
+        print(
+            "VERDICT: radix-2^12 collapses the lazy-add depth below "
+            "the Karatsuba towers' working depth (fq2_mul needs 2, "
+            "fq6/fq12 stack deeper): nearly every tower conv would "
+            "need a pre-normalize (carry cascade + fold matmul), "
+            "costing more than the "
+            f"{base['int32_macs'] / r12['int32_macs']:.2f}x MAC saving "
+            "recovers. Radix-2^10 stays."
+        )
+    else:
+        print(
+            "VERDICT: radix-2^12 keeps enough lazy-add headroom — "
+            "worth a measured prototype."
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,15 @@ from lodestar_tpu.params import BLS_DST_SIG  # noqa: E402
 N = 2048
 KEYS = 256
 
+# --limb-backend {vpu,mxu}: stage-budget either limb backend through
+# the same compiled artifacts (regressions between backends must be
+# attributable per stage). --n M shrinks the bucket for CPU smokes.
+if "--limb-backend" in sys.argv:
+    L.set_backend(sys.argv[sys.argv.index("--limb-backend") + 1])
+if "--n" in sys.argv:
+    N = int(sys.argv[sys.argv.index("--n") + 1])
+    KEYS = min(KEYS, N)
+
 
 @jax.jit
 def _scalarize(tree):
@@ -55,7 +64,11 @@ def timeit(label, fn, reps=3):
 
 
 def main() -> None:
-    print(f"platform={jax.default_backend()} N={N}", flush=True)
+    print(
+        f"platform={jax.default_backend()} N={N} "
+        f"limb_backend={L.get_backend()}",
+        flush=True,
+    )
     pks, sig_parts, draws = [], [], []
     key_pts = {}
     for i in range(N):
