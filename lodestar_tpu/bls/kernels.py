@@ -21,6 +21,9 @@ axis that lodestar_tpu/parallel shards across chips.
 
 from __future__ import annotations
 
+import os
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -61,18 +64,10 @@ def _to_affine(ops, p: C.JacPoint):
     return C.FQ2_OPS.norm(x), C.FQ2_OPS.norm(y)
 
 
-# Performance state (round 2, one tunneled v5e chip): 2048-set bucket
-# pipeline ~1.7 s -> ~1,200 sets/s (0.54x the 4-core blst baseline; was
-# 0.05x at round start). Cost model measured on-chip: per-HLO-op cost
-# is flat in batch up to ~2048 (fixed ~40 us/op), then bandwidth-bound
-# on the (batch, 40, 79) banded-matrix materialization inside each limb
-# conv (~12.6 KB/element-mul). Roadmap to 10x, in order: (a) a Pallas
-# conv kernel that keeps the band implicit in VMEM (kills ~10x traffic;
-# first attempts were shuffle-bound — needs a lane-shift-free inner
-# loop); (b) slot-stacked tower muls (all 18 fq muls of an fq12_mul as
-# one conv) to amortize fixed op cost; (c) an RNS/Montgomery limb
-# system whose base-extension matmuls are batch-shared constants and
-# therefore MXU-eligible (measured 30 TOP/s int32 matmul headroom).
+# Performance state: see COVERAGE.md's "Device stage budget" table for
+# the LIVE per-stage numbers (that file is re-measured every round;
+# this module's comments are not). The stage split below is the part
+# that stays true by construction.
 #
 # --- staged device programs ------------------------------------------------
 #
@@ -125,12 +120,29 @@ def _stage_prepare_batch(pk: C.JacPoint, hx, hy, sig: C.JacPoint, bits, mask):
     return px, py, qx, qy, full_mask
 
 
-# Device ingest is gated to the big production bucket: each ingest
-# stage is a multi-minute XLA compile per bucket size, so compiling it
-# for 4..128 too would multiply warmup cost for no throughput (small
-# buckets are host-prep-affordable: 128 sets x ~2.5 ms). Tests can
-# lower this to exercise the device path on small CPU batches.
-INGEST_MIN_BUCKET = 2048
+# Device ingest is gated by bucket size: each ingest stage is a
+# multi-minute XLA compile per bucket size, so compiling it for the
+# tiny 4..128 retry buckets would multiply warmup cost for no
+# throughput (small buckets are host-prep-affordable: 128 sets x
+# ~2.5 ms). The gate is a KNOB (LODESTAR_TPU_INGEST_MIN_BUCKET /
+# set_ingest_min_bucket): the default admits the mid {256, 512}
+# buckets the verifier's rolling gossip accumulator flushes, whose
+# compiles warmup_ingest() pre-warms in the background through the
+# persistent cache (utils/jaxcache.py). Tests lower it further to
+# exercise the device path on small CPU batches.
+INGEST_MIN_BUCKET = int(
+    os.environ.get("LODESTAR_TPU_INGEST_MIN_BUCKET", 256)
+)
+
+
+def ingest_min_bucket() -> int:
+    """The live device-ingest gate (module attr so tests can patch)."""
+    return INGEST_MIN_BUCKET
+
+
+def set_ingest_min_bucket(n: int) -> None:
+    global INGEST_MIN_BUCKET
+    INGEST_MIN_BUCKET = int(n)
 
 
 @jax.jit
@@ -392,14 +404,159 @@ def _cat_fq2(a, b):
     return (_cat_fq(a[0], b[0]), _cat_fq(a[1], b[1]))
 
 
-def bucket_size(n: int, buckets=(4, 8, 16, 32, 64, 128, 2048)) -> int:
+# The one bucket ladder: retry-chunk rungs (<=128, reference job
+# granularity), the rolling-accumulator ingest rungs {256, 512}, and
+# the bulk-wave max. bucket_size, default_warmup_sizes, and the
+# verifier's warmup all derive from THIS tuple — add a rung here and
+# warmup covers it automatically.
+BUCKET_LADDER = (4, 8, 16, 32, 64, 128, 256, 512, 2048)
+
+
+def bucket_size(n: int, buckets=BUCKET_LADDER) -> int:
     """Smallest bucket >= n. Small sizes mirror the reference's <=128
-    sets/job chunks (chain/bls/multithread/index.ts:48-56); above that
-    the verifier packs whole waves into one 2048-set device bucket
-    (per-op device cost is batch-flat to ~2048, so the padding is
-    nearly free — and each extra bucket size is an extra multi-minute
-    XLA compile, so the table jumps straight to the max)."""
+    sets/job chunks (chain/bls/multithread/index.ts:48-56). The mid
+    sizes {256, 512} are the device-ingest-eligible rungs the
+    verifier's rolling gossip accumulator flushes into — without them
+    the ladder jumped 128 -> 2048 and steady-state trickle traffic
+    either rode the slow host decompress/hash path or paid 16x
+    padding. Above 512 whole waves pack into one 2048-set device
+    bucket (per-op device cost is batch-flat to ~2048, so padding
+    there is nearly free; each extra bucket size is an extra
+    multi-minute XLA compile, pre-warmed by warmup_ingest)."""
     for b in buckets:
         if n <= b:
             return b
     return buckets[-1]
+
+
+# --- ingest warmup ----------------------------------------------------------
+#
+# Each ingest bucket size is its own multi-minute XLA compile (per
+# stage, per shape). A node that waits for the first gossip lull to
+# pay that compile stalls its verify pipeline, so the verifier can
+# (a) pre-warm the ingest sizes on a background thread at start and
+# (b) route buckets whose compile is still cold to the host
+# decompress/hash path (TpuBlsVerifier host_fallback_when_cold). The
+# registry below tracks which (pipeline, size) pairs are warm — the
+# batch and same-message ingest paths are DISTINCT jit programs, so a
+# dispatch on one must not mark the other's cold compile as warm. A
+# pair also becomes warm the first time a live dispatch completes at
+# it. Marks describe the UNSHARDED single-host executables: jit also
+# specializes on input shardings, so mesh verifiers never consult the
+# registry (TpuBlsVerifier.start_warmup disables their cold fallback
+# and they dispatch directly, paying each size's compile inline once).
+
+_INGEST_WARM: set[tuple[str, int]] = set()
+_WARMUP_LOCK = threading.Lock()
+_WARMUP_THREAD: threading.Thread | None = None
+
+
+def ingest_is_warm(b: int, kind: str = "batch") -> bool:
+    return (kind, b) in _INGEST_WARM
+
+
+def mark_ingest_warm(b: int, kind: str = "batch") -> None:
+    _INGEST_WARM.add((kind, b))
+
+
+def default_warmup_sizes(gate: int | None = None) -> tuple[int, ...]:
+    """Every ingest-eligible rung of the ladder (gate defaults to the
+    module knob; verifiers pass their own override)."""
+    if gate is None:
+        gate = ingest_min_bucket()
+    return tuple(b for b in BUCKET_LADDER if b >= gate)
+
+
+def _warm_one(b: int, same_message: bool) -> None:
+    """Compile (or load from the persistent cache) the ingest pipeline
+    for bucket size b by running one padded dispatch to completion."""
+    import jax.numpy as jnp
+
+    from ..ops import tower
+    from . import api
+
+    from ..crypto.bls.signature import sign, sk_to_pk
+
+    msg = b"\x5a" * 32
+    sig = sign(7, msg)
+    xc0, xc1, s_sign, ok = api.parse_signature(sig)
+    assert ok
+    pk = api.decompress_pubkey(sk_to_pk(7))
+    draws = api.message_draws(msg)
+    pk_dev = C.g1_batch_from_ints([pk] * b)
+    sig_x = tower.fq2_from_ints([(xc0, xc1)] * b)
+    sig_sign = jnp.asarray([s_sign] * b)
+    bits = C.scalars_to_bits([3] * b, RAND_BITS)
+    mask = jnp.asarray([True] * b)
+    if same_message:
+        h = api.message_to_g2(msg)
+        h_dev = C.g2_batch_from_ints([h])
+        out = run_verify_same_message_ingest_async(
+            pk_dev, (h_dev.x, h_dev.y), sig_x, sig_sign, bits, mask
+        )
+    else:
+        u0 = tower.fq2_from_ints([draws[0]] * b)
+        u1 = tower.fq2_from_ints([draws[1]] * b)
+        out = run_verify_batch_ingest_async(
+            pk_dev, sig_x, sig_sign, u0, u1, bits, mask
+        )
+    if not bool(out):  # blocks until the compile + run completes
+        raise RuntimeError(f"ingest warmup verify failed at bucket {b}")
+
+
+def warmup_ingest(
+    sizes: tuple[int, ...] | None = None,
+    block: bool = False,
+    same_message: bool = True,
+) -> threading.Thread | None:
+    """Pre-compile the device-ingest pipeline for the given bucket
+    sizes (default: every ingest-eligible rung) on a background
+    thread, marking each size warm as it completes. The persistent
+    compilation cache (utils/jaxcache.py) makes this a disk load on
+    every process after the first. Idempotent; block=True runs
+    synchronously (tests, tools)."""
+    global _WARMUP_THREAD
+    jaxcache.enable()
+    want = tuple(sizes) if sizes is not None else default_warmup_sizes()
+
+    def run():
+        from ..logger import get_logger
+
+        log = get_logger("bls-warmup")
+        for b in sorted(set(want)):
+            if not ingest_is_warm(b, "batch"):
+                try:
+                    _warm_one(b, same_message=False)
+                    # only the batch pipeline is warm — the
+                    # same-message program is a different compile
+                    mark_ingest_warm(b, "batch")
+                except Exception as e:
+                    # warmup is an optimization: the size stays cold
+                    # and the verifier keeps its host fallback — but
+                    # say so, or the node silently runs degraded
+                    # forever
+                    log.warn(
+                        "ingest warmup failed; bucket stays on host path",
+                        {"bucket": b, "err": repr(e)},
+                    )
+            if same_message and not ingest_is_warm(b, "same_message"):
+                try:
+                    _warm_one(b, same_message=True)
+                    mark_ingest_warm(b, "same_message")
+                except Exception as e:
+                    log.warn(
+                        "same-message ingest warmup failed",
+                        {"bucket": b, "err": repr(e)},
+                    )
+
+    if block:
+        run()
+        return None
+    with _WARMUP_LOCK:
+        if _WARMUP_THREAD is not None and _WARMUP_THREAD.is_alive():
+            return _WARMUP_THREAD
+        _WARMUP_THREAD = threading.Thread(
+            target=run, name="bls-ingest-warmup", daemon=True
+        )
+        _WARMUP_THREAD.start()
+        return _WARMUP_THREAD

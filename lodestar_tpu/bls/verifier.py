@@ -28,6 +28,19 @@ device's execution of the previous wave. With more than one device the
 bucket batch axis is sharded over a `jax.sharding.Mesh`
 (lodestar_tpu/parallel) — the SPMD replacement for the reference's
 worker fan-out.
+
+CONTINUOUS BATCHING (the small-bucket gossip path): bulk waves pack
+themselves, but the production steady state is trickle traffic —
+single aggregates and 32-sig buffer flushes. Those small batchable
+jobs accumulate in a ROLLING device bucket shared across waves
+(inference-server discipline: coalesce until the batch is worth the
+hardware) and flush when the bucket reaches the device-ingest gate,
+when non-batchable work dispatches anyway, or when the oldest job's
+latency budget (default 50 ms past queue admission, on top of the
+100 ms gossip buffer) expires — so trickle coalesces into
+device-ingest-sized buckets without unbounded latency. Dispatches are
+counted per bucket size and per path (ingest / host / host_cold), and
+submit-to-verdict latency feeds p50/p99 histograms on /metrics.
 """
 
 from __future__ import annotations
@@ -35,6 +48,7 @@ from __future__ import annotations
 import asyncio
 import os
 import secrets
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -50,6 +64,11 @@ MAX_BUFFERED_SIGS = 32  # index.ts:65
 MAX_SIGNATURE_SETS_PER_JOB = 128  # index.ts:56 (job granularity for retries)
 DEVICE_BUCKET_MAX = 2048  # sets per device dispatch (batch-flat cost)
 QUEUE_MAX_LENGTH = 512  # canAcceptWork threshold, index.ts:149-155
+# Continuous batching: small batchable jobs accumulate in a ROLLING
+# device bucket shared across waves, flushed when it reaches the
+# device-ingest gate or when the oldest job has waited this long past
+# its queue admission — ~50 ms on top of the 100 ms gossip buffer.
+LATENCY_BUDGET_MS = 50
 
 
 def _rand_scalars(n: int):
@@ -103,8 +122,63 @@ class _Job:
     sets: list  # raw api.SignatureSet list
     future: asyncio.Future
     batchable: bool
+    created_at: float = 0.0  # caller submit time (latency histogram)
     enqueued_at: float = 0.0
     prepared: list | None = None
+
+
+class LatencyHistogram:
+    """Fixed-bound latency histogram with host-side quantile
+    estimation (linear interpolation inside a bucket). Cheap enough to
+    observe per job; the metrics server samples p50/p99 at scrape."""
+
+    BOUNDS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BOUNDS) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, seconds: float) -> None:
+        i = 0
+        for i, b in enumerate(self.BOUNDS):
+            if seconds <= b:
+                break
+        else:
+            i = len(self.BOUNDS)
+        self.counts[i] += 1
+        self.count += 1
+        self.sum += seconds
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile in seconds (0.0 when empty)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= rank and c > 0:
+                lo = 0.0 if i == 0 else self.BOUNDS[i - 1]
+                hi = (
+                    self.BOUNDS[i]
+                    if i < len(self.BOUNDS)
+                    else self.BOUNDS[-1] * 2
+                )
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.BOUNDS[-1] * 2
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": (self.sum / self.count) if self.count else 0.0,
+            "p50_s": self.quantile(0.5),
+            "p99_s": self.quantile(0.99),
+        }
 
 
 class BlsVerifierMetrics:
@@ -127,6 +201,27 @@ class BlsVerifierMetrics:
         self.last_wave_sets = 0
         self.last_wave_duration_s = 0.0
         self.wave_sets_total = 0
+        # continuous batching: per-bucket-size device dispatches, path
+        # split (device ingest vs host decompress/hash vs cold-compile
+        # host fallback), rolling-bucket flush triggers, and the
+        # submit-to-verdict latency histogram
+        self.dispatch_by_bucket: dict[int, int] = {}
+        self.dispatch_by_path = {"ingest": 0, "host": 0, "host_cold": 0}
+        # dispatches count from executor threads; scrapes copy under
+        # the same lock so iteration never races an insertion
+        self.dispatch_lock = threading.Lock()
+        self.rolling_flushes = {"full": 0, "deadline": 0, "merged": 0}
+        self.rolling_sets = 0  # current rolling-bucket occupancy
+        self.host_invalid_jobs = 0
+        self.verify_latency = LatencyHistogram()
+        self.same_message_latency = LatencyHistogram()
+
+    def snapshot_dispatch(self) -> tuple[dict[int, int], dict[str, int]]:
+        """Copies of the dispatch counters taken under the dispatch
+        lock, so a scrape never iterates a dict an executor thread is
+        inserting into."""
+        with self.dispatch_lock:
+            return dict(self.dispatch_by_bucket), dict(self.dispatch_by_path)
 
 
 class TpuBlsVerifier:
@@ -144,12 +239,46 @@ class TpuBlsVerifier:
         queue_max: int = QUEUE_MAX_LENGTH,
         mesh=None,
         prep_workers: int | None = None,
+        ingest_min_bucket: int | None = None,
+        latency_budget_ms: int = LATENCY_BUDGET_MS,
+        warmup: bool = False,
+        host_fallback_when_cold: bool | None = None,
     ):
+        """Continuous-batching knobs:
+
+        ingest_min_bucket: device-ingest gate override (None = the
+          kernels module knob / LODESTAR_TPU_INGEST_MIN_BUCKET).
+        latency_budget_ms: how long the rolling gossip bucket may hold
+          a batchable job past queue admission before a deadline flush
+          (0 disables the rolling bucket — every wave dispatches
+          immediately, the pre-round-6 behavior).
+        warmup: pre-compile the ingest pipeline for every eligible
+          bucket size on a background thread (node start).
+        host_fallback_when_cold: route ingest-eligible buckets to the
+          host decompress/hash path while their XLA compile is still
+          cold (default: enabled iff warmup is — tests and benches
+          without warmup keep the deterministic device path).
+        """
         self.metrics = BlsVerifierMetrics()
         self._max_wait = max_buffer_wait_ms / 1000.0
         self._max_buffered = max_buffered_sigs
         self._max_sets_per_job = MAX_SIGNATURE_SETS_PER_JOB
         self._queue_max = queue_max
+        self._ingest_min = ingest_min_bucket
+        self._latency_budget = latency_budget_ms / 1000.0
+        # None = unset: follows warmup, and start_warmup() (the node
+        # calls it on any verifier it's handed) turns the fallback on
+        # so in-flight compiles never stall a live wave. An explicit
+        # True/False always wins.
+        self._cold_fallback_explicit = host_fallback_when_cold
+        self._cold_fallback = (
+            warmup
+            if host_fallback_when_cold is None
+            else host_fallback_when_cold
+        )
+        self._rolling: list[_Job] = []
+        self._rolling_sets = 0
+        self._rolling_task: asyncio.Task | None = None
         self._buffer: list[_Job] = []
         self._buffer_task: asyncio.Task | None = None
         # priority queue: (priority_class, seq) keeps FIFO within class;
@@ -180,13 +309,91 @@ class TpuBlsVerifier:
             else min(8, os.cpu_count() or 4),
             thread_name_prefix="bls-prep",
         )
+        if warmup:
+            self.start_warmup()
+
+    # -- continuous-batching configuration -----------------------------
+
+    def _ingest_gate(self) -> int:
+        """The live device-ingest bucket gate."""
+        return (
+            self._ingest_min
+            if self._ingest_min is not None
+            else kernels.ingest_min_bucket()
+        )
+
+    def _flush_target(self) -> int:
+        """Rolling-bucket full threshold: the smallest device-ingest-
+        eligible bucket size."""
+        return max(4, self._ingest_gate())
+
+    def _use_ingest(self, b: int, kind: str = "batch") -> bool:
+        """Device ingest for a bucket of size b on the given pipeline
+        (batch / same_message — distinct jit programs)? Gated by size
+        and — when the cold-compile host fallback is on — by whether
+        that pipeline's compile for b is already warm."""
+        if b < self._ingest_gate():
+            return False
+        if self._cold_fallback and not kernels.ingest_is_warm(b, kind):
+            return False
+        return True
+
+    def _count_dispatch(self, b: int, use_ingest: bool):
+        """Per-bucket-size and per-path dispatch counters (the proof
+        that trickle traffic coalesces into device-ingest buckets).
+        Runs on executor threads — the lock keeps concurrent
+        read-modify-write increments from losing counts (scrapers
+        read via snapshot copies, see metrics bridging in node.py)."""
+        m = self.metrics
+        with m.dispatch_lock:
+            m.dispatch_by_bucket[b] = (
+                m.dispatch_by_bucket.get(b, 0) + 1
+            )
+            if use_ingest:
+                path = "ingest"
+            elif b >= self._ingest_gate():
+                path = "host_cold"  # eligible, but compile still cold
+            else:
+                path = "host"
+            m.dispatch_by_path[path] += 1
+
+    def start_warmup(self, block: bool = False):
+        """Pre-compile the ingest pipeline for every eligible bucket
+        size through the persistent cache (background thread unless
+        block). While a size is cold the verifier serves it from the
+        host path — starting warmup turns host_fallback_when_cold on
+        (unless it was explicitly disabled), otherwise the first live
+        ingest bucket would dispatch straight into the very compile
+        the warmup thread is paying for."""
+        if self._mesh is not None:
+            # jit specializes on input shardings: the warmup's
+            # unsharded dispatches would compile a DIFFERENT
+            # executable than this verifier's mesh-sharded buckets
+            # and falsely mark the sizes warm. With no sharded warmup
+            # available, cold fallback would route ingest-eligible
+            # buckets to the host path FOREVER (nothing else marks
+            # them warm), so mesh verifiers keep direct dispatch:
+            # first live bucket per size pays the compile inline,
+            # once, persistent-cached (the pre-round-6 behavior).
+            if self._cold_fallback_explicit is None:
+                self._cold_fallback = False
+            return None
+        if self._cold_fallback_explicit is None:
+            self._cold_fallback = True
+        return kernels.warmup_ingest(
+            kernels.default_warmup_sizes(self._ingest_gate()),
+            block=block,
+        )
 
     # -- IBlsVerifier surface ------------------------------------------
 
     def can_accept_work(self) -> bool:
         return (
             not self._closed
-            and self._queue.qsize() + len(self._buffer) < self._queue_max
+            and self._queue.qsize()
+            + len(self._buffer)
+            + len(self._rolling)
+            < self._queue_max
         )
 
     async def verify_signature_sets(
@@ -201,7 +408,7 @@ class TpuBlsVerifier:
         event loop free."""
         self._ensure_runner()
         fut = asyncio.get_event_loop().create_future()
-        job = _Job(list(sets), fut, batchable)
+        job = _Job(list(sets), fut, batchable, time.monotonic())
         self.metrics.sig_sets_started += len(job.sets)
         if batchable and len(job.sets) < self._max_buffered:
             self._buffer.append(job)
@@ -221,6 +428,17 @@ class TpuBlsVerifier:
     ) -> list[bool]:
         """Per-set verdicts for k (pubkey, signature) pairs on one
         message (jobItem.ts:50-92)."""
+        t0 = time.monotonic()
+        try:
+            return await self._verify_same_message_timed(sets, message)
+        finally:
+            self.metrics.same_message_latency.observe(
+                time.monotonic() - t0
+            )
+
+    async def _verify_same_message_timed(
+        self, sets: list[api.SameMessageSet], message: bytes
+    ) -> list[bool]:
         self._ensure_runner()
         loop = asyncio.get_event_loop()
 
@@ -283,11 +501,20 @@ class TpuBlsVerifier:
         if self._buffer_task:
             self._buffer_task.cancel()
             self._buffer_task = None
+        if self._rolling_task:
+            self._rolling_task.cancel()
+            self._rolling_task = None
         err = RuntimeError("BLS verifier closed")
         for j in self._buffer:
             if not j.future.done():
                 j.future.set_exception(err)
         self._buffer = []
+        for j in self._rolling:
+            if not j.future.done():
+                j.future.set_exception(err)
+        self._rolling = []
+        self._rolling_sets = 0
+        self.metrics.rolling_sets = 0
         while not self._queue.empty():
             _, _, jobs = self._queue.get_nowait()
             for j in jobs:
@@ -349,9 +576,14 @@ class TpuBlsVerifier:
         self._flush_buffer()
 
     async def _run_loop(self):
-        """Drain-everything wave loop. Each iteration collects ALL
-        queued job groups, preps + dispatches them as one wave, then
-        finalizes (readback + retries) in a separate task so the next
+        """Drain-everything wave loop with CONTINUOUS BATCHING. Each
+        iteration collects ALL queued job groups. Batchable jobs join
+        the ROLLING device bucket shared across waves; it flushes when
+        it reaches the device-ingest gate (full), when non-batchable
+        work dispatches anyway (merged — the trickle rides along for
+        free), or when the oldest job's latency budget expires
+        (deadline task). Flushed waves are prepped + dispatched, then
+        finalized (readback + retries) in a separate task so the next
         wave's host prep overlaps this wave's device execution — the
         TPU analog of prepareWork re-filling idle workers
         (index.ts:357-534)."""
@@ -365,26 +597,96 @@ class TpuBlsVerifier:
                     break
                 jobs.extend(more)
             self.metrics.queue_length = self._queue.qsize()
-            self.metrics.waves += 1
-            t0 = time.monotonic()
+            immediate: list[_Job] = []
             for j in jobs:
-                self.metrics.total_job_wait_s += t0 - j.enqueued_at
-            try:
-                wave = await self._prep_and_dispatch(jobs)
-            except asyncio.CancelledError:
-                self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
-                raise
-            except Exception as e:  # defensive: fail the waiters
-                self._fail_jobs(jobs, e)
-                continue
-            task = asyncio.ensure_future(self._finalize_wave(wave, t0))
-            self._finalizers.add(task)
-            task.add_done_callback(self._finalizers.discard)
+                if j.batchable and self._latency_budget > 0:
+                    self._rolling.append(j)
+                    self._rolling_sets += len(j.sets)
+                else:
+                    immediate.append(j)
+            self.metrics.rolling_sets = self._rolling_sets
+            if immediate:
+                if self._rolling:
+                    self.metrics.rolling_flushes["merged"] += 1
+                await self._dispatch_wave(
+                    immediate + self._take_rolling()
+                )
+            elif self._rolling_sets >= self._flush_target():
+                self.metrics.rolling_flushes["full"] += 1
+                await self._dispatch_wave(self._take_rolling())
+            elif self._rolling:
+                self._arm_rolling_deadline()
+
+    def _take_rolling(self) -> list[_Job]:
+        jobs, self._rolling = self._rolling, []
+        self._rolling_sets = 0
+        self.metrics.rolling_sets = 0
+        if self._rolling_task is not None:
+            self._rolling_task.cancel()
+            self._rolling_task = None
+        return jobs
+
+    def _arm_rolling_deadline(self):
+        """Schedule the deadline flush for the OLDEST rolling job:
+        enqueue time + latency budget (the budget rides on top of the
+        100 ms gossip buffer the job may already have waited in)."""
+        if self._rolling_task is not None:
+            return
+        oldest = min(j.enqueued_at for j in self._rolling)
+        delay = max(
+            0.0, oldest + self._latency_budget - time.monotonic()
+        )
+        self._rolling_task = asyncio.ensure_future(
+            self._rolling_flush_after(delay)
+        )
+
+    async def _rolling_flush_after(self, delay: float):
+        try:
+            await asyncio.sleep(delay)
+        except asyncio.CancelledError:
+            return
+        self._rolling_task = None
+        if self._rolling:
+            self.metrics.rolling_flushes["deadline"] += 1
+            await self._dispatch_wave(self._take_rolling())
+
+    async def _dispatch_wave(self, jobs: list[_Job]):
+        """Prep + dispatch one wave; finalize (readback + retries) in
+        a separate task so the next wave's host prep overlaps device
+        execution."""
+        if not jobs:
+            return
+        self.metrics.waves += 1
+        t0 = time.monotonic()
+        for j in jobs:
+            self.metrics.total_job_wait_s += t0 - j.enqueued_at
+        try:
+            wave = await self._prep_and_dispatch(jobs)
+        except asyncio.CancelledError:
+            self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
+            raise
+        except Exception as e:  # defensive: fail the waiters
+            self._fail_jobs(jobs, e)
+            return
+        task = asyncio.ensure_future(self._finalize_wave(wave, t0))
+        self._finalizers.add(task)
+        task.add_done_callback(self._finalizers.discard)
 
     def _fail_jobs(self, jobs, err):
         for j in jobs:
             if not j.future.done():
                 j.future.set_exception(err)
+
+    def _resolve_job(self, j: _Job, ok: bool):
+        """Resolve a job's future and record submit-to-verdict
+        latency (the continuous-batching SLO: gossip buffer + rolling
+        wait + device time, end to end)."""
+        if not j.future.done():
+            j.future.set_result(ok)
+            if j.created_at:
+                self.metrics.verify_latency.observe(
+                    time.monotonic() - j.created_at
+                )
 
     async def _prep_and_dispatch(self, jobs: list[_Job]):
         """Host prep (thread pool, parallel per job) + bucket packing +
@@ -412,8 +714,7 @@ class TpuBlsVerifier:
             if len(j.sets) == 0:
                 # empty set list: vacuously true, and it would carry no
                 # bucket parts — _finalize_wave would never resolve it
-                if not j.future.done():
-                    j.future.set_result(True)
+                self._resolve_job(j, True)
                 continue
             prep_futs[id(j)] = loop.run_in_executor(
                 self._prep_pool, prep_job, j
@@ -451,14 +752,54 @@ class TpuBlsVerifier:
                 if p is None:
                     # malformed on host -> the job fails without
                     # device work (maybeBatch.ts:17-44 semantics)
-                    if not j.future.done():
-                        j.future.set_result(False)
+                    self._resolve_job(j, False)
+                    continue
+                if j.future.done():
+                    # already failed by another bucket's host
+                    # pre-validation — don't ship dead sets
                     continue
                 j.prepared = p
                 parts.append((j, p[off : off + take]))
             if not parts:
                 return None
             sets = [s for _, part in parts for s in part]
+            b = kernels.bucket_size(len(sets))
+            if not self._use_ingest(b) and len(parts) > 1:
+                # HOST-path bucket spanning several jobs: one
+                # signature that fails host decompression (no sqrt /
+                # not in subgroup) used to scalar-False the WHOLE
+                # bucket, fanning every innocent job out through the
+                # per-job/per-set retry ladder. Pre-validate with the
+                # same cached decompression the host path runs anyway:
+                # fail only the owning jobs, dispatch the rest.
+                def find_bad() -> set[int]:
+                    out: set[int] = set()
+                    for j, part in parts:
+                        if id(j) in out:
+                            continue
+                        if any(
+                            not self._host_sig_valid(s)
+                            for s in part
+                        ):
+                            out.add(id(j))
+                    return out
+
+                # cached C decompression, but still ~0.5 ms/sig cold —
+                # keep it off the event loop
+                bad = await loop.run_in_executor(
+                    self._prep_pool, find_bad
+                )
+                if bad:
+                    self.metrics.host_invalid_jobs += len(bad)
+                    for j, _part in parts:
+                        if id(j) in bad:
+                            self._resolve_job(j, False)
+                    parts = [
+                        (j, p) for j, p in parts if id(j) not in bad
+                    ]
+                    if not parts:
+                        return None
+                    sets = [s for _, part in parts for s in part]
             ok = await loop.run_in_executor(
                 None, self._submit_bucket, sets
             )
@@ -472,6 +813,22 @@ class TpuBlsVerifier:
         oks = [r[1] for r in results if r is not None]
         return buckets, oks
 
+    def _host_sig_valid(self, s: "_PreparedSet") -> bool:
+        """Does this set's signature survive host decompression? Uses
+        the same lru-cached decompression the host dispatch path runs,
+        so pre-validation costs nothing extra."""
+        try:
+            sig = (
+                api.decompress_signature(s.sig_raw)
+                if s.sig_raw
+                else api.decompress_signature_parsed(
+                    s.sig_x, s.sig_sign
+                )
+            )
+        except api.InvalidPointError:
+            return False
+        return sig is not None
+
     async def _finalize_wave(self, wave, t0: float):
         """One readback for the whole wave; failed buckets retry
         per job, then per set (worker.ts:88-103 isolation)."""
@@ -482,21 +839,33 @@ class TpuBlsVerifier:
             # that carried its sets
             job_ok: dict[int, bool] = {}
             job_of: dict[int, _Job] = {}
+            shared: set[int] = set()  # jobs whose bucket carried others
             for b, ok in zip(buckets, verdicts):
                 for j, _part in b:
                     jid = id(j)
                     job_of[jid] = j
                     job_ok[jid] = job_ok.get(jid, True) and ok
+                    if len(b) > 1:
+                        shared.add(jid)
             retry: list[_Job] = []
             for jid, ok in job_ok.items():
                 j = job_of[jid]
-                if ok:
+                if j.future.done():
+                    # pre-failed by host pre-validation — even if its
+                    # other (all-good) buckets came back True, the job
+                    # is failed and its sets must not count as batch
+                    # successes
+                    pass
+                elif ok:
                     self.metrics.batch_sigs_success += len(j.prepared)
-                    if not j.future.done():
-                        j.future.set_result(True)
-                elif len(j.prepared) == 1:
-                    if not j.future.done():
-                        j.future.set_result(False)
+                    self._resolve_job(j, True)
+                elif len(j.prepared) == 1 and jid not in shared:
+                    # alone in its bucket: the aggregate verdict IS the
+                    # job's own. In a SHARED bucket (rolling/merged
+                    # trickle) a failed aggregate says nothing about
+                    # this job — retry it like any multi-set job, or
+                    # one bad pairing fails every innocent 1-set rider.
+                    self._resolve_job(j, False)
                 else:
                     retry.append(j)
             if retry:
@@ -507,11 +876,9 @@ class TpuBlsVerifier:
                 per_set: list[_Job] = []
                 for j, ok in zip(retry, verdicts):
                     if ok:
-                        if not j.future.done():
-                            j.future.set_result(True)
+                        self._resolve_job(j, True)
                     elif len(j.prepared) == 1:
-                        if not j.future.done():
-                            j.future.set_result(False)
+                        self._resolve_job(j, False)
                     else:
                         per_set.append(j)
                 if per_set:
@@ -524,10 +891,9 @@ class TpuBlsVerifier:
                     i = 0
                     for j in per_set:
                         n = len(j.prepared)
-                        if not j.future.done():
-                            j.future.set_result(
-                                all(singles[i : i + n])
-                            )
+                        self._resolve_job(
+                            j, all(singles[i : i + n])
+                        )
                         i += n
         except asyncio.CancelledError:
             self._fail_jobs(
@@ -567,7 +933,9 @@ class TpuBlsVerifier:
         shard = (
             mesh is not None and b % mesh.devices.size == 0
         )
-        if b >= kernels.INGEST_MIN_BUCKET:
+        use_ingest = self._use_ingest(b)
+        self._count_dispatch(b, use_ingest)
+        if use_ingest:
             # device ingest: compressed signatures + field draws in
             sig_x = tower.fq2_from_ints([s.sig_x for s in full])
             sig_sign = jnp.asarray([s.sig_sign for s in full])
@@ -583,9 +951,13 @@ class TpuBlsVerifier:
                 u1 = parallel.shard_batch(mesh, u1)
                 bits = parallel.shard_batch(mesh, bits)
                 mask = parallel.shard_batch(mesh, mask)
-            return kernels.run_verify_batch_ingest_async(
+            out = kernels.run_verify_batch_ingest_async(
                 pk_dev, sig_x, sig_sign, u0, u1, bits, mask
             )
+            # the jit compile (or persistent-cache load) happened on
+            # this dispatch — later buckets of size b are warm
+            kernels.mark_ingest_warm(b)
+            return out
         # small buckets: host decompression/hashing (cached C calls —
         # affordable at this scale, and it avoids compiling the ingest
         # stages for every small bucket size)
@@ -714,7 +1086,9 @@ class TpuBlsVerifier:
             h_dev = C.g2_batch_from_ints([h])  # batch (1,)
             bits = C.scalars_to_bits(rand, kernels.RAND_BITS)
             mask = jnp.asarray([True] * n + [False] * pad)
-            if b >= kernels.INGEST_MIN_BUCKET:
+            use_ingest = self._use_ingest(b, "same_message")
+            self._count_dispatch(b, use_ingest)
+            if use_ingest:
                 sxs = [x for _, x, _ in pairs] + [
                     pad_set.sig_x
                 ] * pad
@@ -723,7 +1097,7 @@ class TpuBlsVerifier:
                 ] * pad
                 sig_x = tower.fq2_from_ints(sxs)
                 sig_sign = jnp.asarray(sgs)
-                return kernels.run_verify_same_message_ingest_async(
+                out = kernels.run_verify_same_message_ingest_async(
                     pk_dev,
                     (h_dev.x, h_dev.y),
                     sig_x,
@@ -731,6 +1105,8 @@ class TpuBlsVerifier:
                     bits,
                     mask,
                 )
+                kernels.mark_ingest_warm(b, "same_message")
+                return out
             # small groups: host decompression (cached C), avoiding a
             # per-bucket-size ingest-stage compile on the gossip path
             sigs = []

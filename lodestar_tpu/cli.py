@@ -101,6 +101,35 @@ def _build_parser() -> argparse.ArgumentParser:
         help="chain config JSON for a FRESH db (required with "
         "--checkpoint-sync-url on first start)",
     )
+    # -- BLS verifier / continuous-batching knobs ---------------------
+    beacon.add_argument(
+        "--bls-verifier", choices=("auto", "tpu", "oracle"),
+        default="auto",
+        help="signature verification backend: 'tpu' runs the batched "
+        "device verifier (bls/verifier.py), 'oracle' the single-"
+        "threaded host reference; 'auto' picks tpu when a TPU is "
+        "attached",
+    )
+    beacon.add_argument(
+        "--bls-ingest-min-bucket", type=int, default=None,
+        help="smallest device-ingest-eligible bucket size (default: "
+        "LODESTAR_TPU_INGEST_MIN_BUCKET env var, else 256) — smaller "
+        "buckets ride the host decompress/hash path",
+    )
+    beacon.add_argument(
+        "--bls-latency-budget-ms", type=int, default=50,
+        help="how long the rolling gossip bucket may hold a batchable "
+        "job past queue admission before a deadline flush (0 disables "
+        "continuous batching)",
+    )
+    beacon.add_argument(
+        "--bls-warmup",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="pre-compile the device-ingest pipeline for every "
+        "eligible bucket size on a background thread at start "
+        "(persistent-cached; --no-bls-warmup to skip)",
+    )
 
     lc = sub.add_parser(
         "lightclient",
@@ -270,6 +299,31 @@ async def _run_beacon(args) -> int:
         for entry in args.bootnodes.split(","):
             host, _, port = entry.strip().rpartition(":")
             bootnodes.append((host, int(port)))
+    # BLS verifier selection: the TPU service when a device is
+    # attached (or forced), else the host oracle
+    verifier = None
+    mode = args.bls_verifier
+    if mode == "auto":
+        import jax
+
+        mode = "tpu" if jax.default_backend() == "tpu" else "oracle"
+    if mode == "tpu":
+        from .bls import TpuBlsVerifier
+        from .bls import kernels as _bls_kernels
+
+        if args.bls_ingest_min_bucket is not None:
+            _bls_kernels.set_ingest_min_bucket(
+                args.bls_ingest_min_bucket
+            )
+        # warmup is started by BeaconNode.init (after the chain
+        # exists) so the node controls its lifecycle; the cold-compile
+        # host fallback is left unset so start_warmup picks the policy
+        # that fits the topology (on for single-device warmup, off for
+        # mesh verifiers where an unsharded warmup can't pre-compile
+        # the sharded programs)
+        verifier = TpuBlsVerifier(
+            latency_budget_ms=args.bls_latency_budget_ms,
+        )
     node = await BeaconNode.init(
         cfg=cfg,
         types=types,
@@ -291,6 +345,8 @@ async def _run_beacon(args) -> int:
             if args.wss_state_root
             else None
         ),
+        verifier=verifier,
+        bls_warmup=args.bls_warmup,
     )
     node.notify_status()
     try:

@@ -125,6 +125,50 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "lodestar_tpu_verifier_batch_retries_total",
         "Failed waves re-verified per job/per set",
     )
+    # continuous batching (rolling gossip bucket, bls/verifier.py):
+    # per-bucket-size and per-path dispatch counters prove trickle
+    # traffic coalesces into device-ingest buckets; the latency
+    # quantiles track the submit-to-verdict SLO the rolling bucket's
+    # deadline flush bounds
+    tv.dispatch_by_bucket_total = reg.gauge(
+        "lodestar_tpu_verifier_dispatch_by_bucket_total",
+        "Device bucket dispatches by padded bucket size",
+        label_names=("bucket",),
+    )
+    tv.dispatch_by_path_total = reg.gauge(
+        "lodestar_tpu_verifier_dispatch_by_path_total",
+        "Bucket dispatches by path (ingest / host / host_cold)",
+        label_names=("path",),
+    )
+    tv.rolling_flush_total = reg.gauge(
+        "lodestar_tpu_verifier_rolling_flush_total",
+        "Rolling-bucket flushes by trigger (full / deadline / merged)",
+        label_names=("reason",),
+    )
+    tv.rolling_bucket_sets = reg.gauge(
+        "lodestar_tpu_verifier_rolling_bucket_sets",
+        "Signature sets currently held by the rolling bucket",
+    )
+    tv.host_invalid_jobs_total = reg.gauge(
+        "lodestar_tpu_verifier_host_invalid_jobs_total",
+        "Jobs failed up front by host-path signature pre-validation",
+    )
+    tv.verify_latency_p50_seconds = reg.gauge(
+        "lodestar_tpu_verifier_verify_latency_p50_seconds",
+        "p50 submit-to-verdict latency of verify_signature_sets jobs",
+    )
+    tv.verify_latency_p99_seconds = reg.gauge(
+        "lodestar_tpu_verifier_verify_latency_p99_seconds",
+        "p99 submit-to-verdict latency of verify_signature_sets jobs",
+    )
+    tv.same_message_latency_p50_seconds = reg.gauge(
+        "lodestar_tpu_verifier_same_message_latency_p50_seconds",
+        "p50 submit-to-verdict latency of same-message groups",
+    )
+    tv.same_message_latency_p99_seconds = reg.gauge(
+        "lodestar_tpu_verifier_same_message_latency_p99_seconds",
+        "p99 submit-to-verdict latency of same-message groups",
+    )
 
     # -- gossip ingest --------------------------------------------------
     g = SimpleNamespace()

@@ -63,6 +63,8 @@ class BeaconNode:
         # -- checkpoint sync (initBeaconState.ts) --
         checkpoint_sync_url: str | None = None,
         wss_state_root: bytes | None = None,
+        # -- bls verifier warmup (bls/kernels.warmup_ingest) --
+        bls_warmup: bool = True,
     ):
         self.cfg = cfg
         self.types = types
@@ -102,6 +104,7 @@ class BeaconNode:
         self.monitored_validators = monitored_validators or []
         self.checkpoint_sync_url = checkpoint_sync_url
         self.wss_state_root = wss_state_root
+        self.bls_warmup = bls_warmup
         self.network = None
         self.builder = None
         self.monitoring = None
@@ -246,6 +249,16 @@ class BeaconNode:
                 verifier=node.verifier,
                 db=node.db,
             )
+        # pre-warm the device-ingest compiles (mid {256,512} + max
+        # buckets) on a background thread through the persistent cache
+        # so steady-state gossip never pays a cold multi-minute XLA
+        # compile; until a size is warm the verifier serves it from
+        # the host path (host_fallback_when_cold)
+        if node.bls_warmup and hasattr(
+            node.chain.verifier, "start_warmup"
+        ):
+            if node.chain.verifier.start_warmup() is not None:
+                log.info("bls ingest warmup started in background")
         gvr = bytes(
             node.chain.head_state.state.genesis_validators_root
         )
@@ -595,6 +608,48 @@ class BeaconNode:
             )
             tv.batch_retries_total.add_collect(
                 lambda g: g.set(vm.batch_retries)
+            )
+            tv.dispatch_by_bucket_total.add_collect(
+                lambda g: [
+                    g.set(c, bucket=str(b))
+                    for b, c in sorted(
+                        vm.snapshot_dispatch()[0].items()
+                    )
+                ]
+            )
+            tv.dispatch_by_path_total.add_collect(
+                lambda g: [
+                    g.set(c, path=p)
+                    for p, c in vm.snapshot_dispatch()[1].items()
+                ]
+            )
+            tv.rolling_flush_total.add_collect(
+                lambda g: [
+                    g.set(c, reason=r)
+                    for r, c in vm.rolling_flushes.items()
+                ]
+            )
+            tv.rolling_bucket_sets.add_collect(
+                lambda g: g.set(vm.rolling_sets)
+            )
+            tv.host_invalid_jobs_total.add_collect(
+                lambda g: g.set(vm.host_invalid_jobs)
+            )
+            tv.verify_latency_p50_seconds.add_collect(
+                lambda g: g.set(vm.verify_latency.quantile(0.5))
+            )
+            tv.verify_latency_p99_seconds.add_collect(
+                lambda g: g.set(vm.verify_latency.quantile(0.99))
+            )
+            tv.same_message_latency_p50_seconds.add_collect(
+                lambda g: g.set(
+                    vm.same_message_latency.quantile(0.5)
+                )
+            )
+            tv.same_message_latency_p99_seconds.add_collect(
+                lambda g: g.set(
+                    vm.same_message_latency.quantile(0.99)
+                )
             )
         # fork choice / eth1 / light-client server sampled gauges
         mm.forkchoice.nodes.add_collect(
