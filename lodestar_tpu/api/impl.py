@@ -1389,6 +1389,27 @@ class BeaconApiImpl:
             for pid, sc in net.gossip.scores.items()
         ]
 
+    def get_block_import_traces(self) -> list:
+        """Recent slow block-import traces from the tracer's ring
+        buffer (metrics/tracing.py): per-stage durations for every
+        pipeline stage of each slow slot, newest last. The debug
+        surface for 'why was slot N slow' — the histogram bridge has
+        the aggregates, this has the exemplars."""
+        tracer = getattr(self.chain, "tracer", None)
+        if tracer is None:
+            return []
+        return [
+            {
+                "slot": str(t["slot"]),
+                "block_root": t["block_root"],
+                "total_ms": t["total_ms"],
+                "stages": t["stages"],
+                "error": t["error"],
+                "timestamp": t["timestamp"],
+            }
+            for t in tracer.buffer.snapshot()
+        ]
+
     def get_sync_chains_debug_state(self) -> list:
         rs = getattr(self.node, "range_sync", None) if self.node else None
         if rs is None:

@@ -458,6 +458,12 @@ ROUTES: list[Route] = [
         "/eth/v1/lodestar/sync_chains_debug_state",
         "get_sync_chains_debug_state",
     ),
+    Route(
+        "getBlockImportTraces",
+        "GET",
+        "/eth/v1/lodestar/block_import_traces",
+        "get_block_import_traces",
+    ),
     # proof namespace (routes/proof.ts)
     Route(
         "getStateProof",

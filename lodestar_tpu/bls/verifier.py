@@ -405,23 +405,31 @@ class TpuBlsVerifier:
         """True iff every set verifies. Malformed points -> False
         (maybeBatch.ts:17-44 semantics). Decompression/hashing is
         deferred to the wave's prep stage (thread pool), keeping the
-        event loop free."""
-        self._ensure_runner()
-        fut = asyncio.get_event_loop().create_future()
-        job = _Job(list(sets), fut, batchable, time.monotonic())
-        self.metrics.sig_sets_started += len(job.sets)
-        if batchable and len(job.sets) < self._max_buffered:
-            self._buffer.append(job)
-            buffered = sum(len(j.sets) for j in self._buffer)
-            if buffered >= self._max_buffered:
-                self._flush_buffer()
-            elif self._buffer_task is None:
-                self._buffer_task = asyncio.ensure_future(
-                    self._flush_after_wait()
-                )
-        else:
-            self._enqueue([job], priority)
-        return await fut
+        event loop free.
+
+        When the caller runs inside a block-import trace (the chain's
+        sig_verify stage, metrics/tracing.py), this job's submit-to-
+        verdict interval lands as a nested span in the trace tree —
+        the contextvar copied at task spawn carries the parent."""
+        from ..metrics.tracing import child_span
+
+        with child_span("bls_verify_job"):
+            self._ensure_runner()
+            fut = asyncio.get_event_loop().create_future()
+            job = _Job(list(sets), fut, batchable, time.monotonic())
+            self.metrics.sig_sets_started += len(job.sets)
+            if batchable and len(job.sets) < self._max_buffered:
+                self._buffer.append(job)
+                buffered = sum(len(j.sets) for j in self._buffer)
+                if buffered >= self._max_buffered:
+                    self._flush_buffer()
+                elif self._buffer_task is None:
+                    self._buffer_task = asyncio.ensure_future(
+                        self._flush_after_wait()
+                    )
+            else:
+                self._enqueue([job], priority)
+            return await fut
 
     async def verify_signature_sets_same_message(
         self, sets: list[api.SameMessageSet], message: bytes
@@ -1141,20 +1149,22 @@ class OracleBlsVerifier:
         self, sets, batchable=False, priority=False
     ) -> bool:
         from ..crypto.bls import pairing as op
+        from ..metrics.tracing import child_span
 
         try:
-            for s in sets:
-                pk = api.decompress_pubkey(s.pubkey)
-                h = api.message_to_g2(s.message)
-                sig = api.decompress_signature(s.signature)
-                if sig is None:
-                    return False
-                ok = op.pairing_product_is_one(
-                    [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
-                )
-                if not ok:
-                    return False
-            return True
+            with child_span("bls_verify_job"):
+                for s in sets:
+                    pk = api.decompress_pubkey(s.pubkey)
+                    h = api.message_to_g2(s.message)
+                    sig = api.decompress_signature(s.signature)
+                    if sig is None:
+                        return False
+                    ok = op.pairing_product_is_one(
+                        [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+                    )
+                    if not ok:
+                        return False
+                return True
         except api.InvalidPointError:
             return False
 

@@ -79,6 +79,10 @@ class BeaconChain:
         self.eth1 = None
         # optional ValidatorMonitor (metrics/validator_monitor.py)
         self.validator_monitor = None
+        # optional span Tracer (metrics/tracing.py): when attached,
+        # every import produces a per-stage trace; slow ones land in
+        # the tracer's ring buffer behind the admin debug route
+        self.tracer = None
         # chain events -> SSE (api events route)
         from .events import ChainEventEmitter
 
@@ -243,7 +247,9 @@ class BeaconChain:
         replay on the executor thread so the event loop keeps serving
         gossip/reqresp/API during deep replays (advisor: chain.py
         get_or_regen_state on-loop replay stall)."""
-        return await self.regen.get_state(block_root)
+        return await self.regen.get_state(
+            block_root, caller="get_state_async"
+        )
 
     def get_block(self, block_root: bytes):
         return self._blocks.get(block_root)
@@ -278,6 +284,7 @@ class BeaconChain:
         signed_block,
         is_timely: bool | None = None,
         blob_sidecars=None,
+        trace=None,
     ) -> bytes:
         """Full import: state transition + TPU signature batch + fork
         choice + head update. Returns the block root.
@@ -286,45 +293,87 @@ class BeaconChain:
         wall clock (seconds-into-slot < SECONDS_PER_SLOT /
         INTERVALS_PER_SLOT, reference importBlock.ts blockDelaySec
         check); the devnode passes True because its simulated clock
-        produces exactly at the slot boundary."""
+        produces exactly at the slot boundary.
+
+        trace: an ImportTrace started upstream (the gossip handler
+        seeds gossip_receive/decode); None starts one here when a
+        tracer is attached."""
+        from ..metrics.tracing import NULL_TRACE
+
+        block = signed_block.message
+        if trace is None:
+            trace = (
+                self.tracer.block_import_trace(int(block.slot))
+                if self.tracer is not None
+                else NULL_TRACE
+            )
+        try:
+            root = await self._import_block(
+                signed_block, is_timely, blob_sidecars, trace
+            )
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish(block_root=root)
+        return root
+
+    async def _import_block(
+        self, signed_block, is_timely, blob_sidecars, trace
+    ) -> bytes:
         types = self.types
         block = signed_block.message
         parent = self.get_state(bytes(block.parent_root))
         if parent is None:
-            # evicted from the state cache: rebuild by replay
+            # evicted from the state cache: rebuild by replay (timed as
+            # its own non-canonical stage: replay storms show up in the
+            # trace, not smeared into state_transition)
             from .regen import RegenError
 
             try:
-                parent = await self.regen.get_state(
-                    bytes(block.parent_root)
-                )
+                with trace.stage("parent_regen"):
+                    parent = await self.regen.get_state(
+                        bytes(block.parent_root),
+                        caller="block_import",
+                    )
             except RegenError as e:
                 raise ChainError(f"unknown parent state: {e}") from e
 
-        work = _clone(parent, types)
-        process_slots(self.cfg, work, block.slot, types)
+        with trace.stage("state_transition"):
+            work = _clone(parent, types)
+            process_slots(self.cfg, work, block.slot, types)
 
-        # signature sets against the advanced pre-state
+        # signature sets against the advanced pre-state; the sig_verify
+        # stage spans dispatch -> verdict and is contextvar-current when
+        # the verifier task is spawned, so the verifier's own spans
+        # (bls/verifier.py) nest under it in the trace tree
+        sv = trace.begin_stage("sig_verify")
         sets = get_block_signature_sets(
             self.cfg, work, signed_block, types
         )
         verify_task = asyncio.ensure_future(
             self.verifier.verify_signature_sets(sets)
         )
+        # the block transition overlaps the in-flight verification
+        # (verifyBlock.ts parallel split) — both stages report wall
+        # time, so their sum can exceed the total
         try:
-            state_transition(
-                self.cfg,
-                work,
-                signed_block,
-                types,
-                verify_state_root=True,
-                verify_proposer=False,
-                verify_signatures=False,
-            )
+            with trace.stage("state_transition"):
+                state_transition(
+                    self.cfg,
+                    work,
+                    signed_block,
+                    types,
+                    verify_state_root=True,
+                    verify_proposer=False,
+                    verify_signatures=False,
+                )
         except BlockProcessError:
             verify_task.cancel()
+            trace.end_stage(sv)
             raise
-        if not await verify_task:
+        ok = await verify_task
+        trace.end_stage(sv)
+        if not ok:
             raise ChainError("block signature verification failed")
 
         block_t = types.by_fork[work.fork].BeaconBlock
@@ -335,19 +384,22 @@ class BeaconChain:
         if work.fork_seq >= ForkSeq.deneb:
             from .blobs import BlobError, validate_blob_sidecars
 
-            n_comms = len(block.body.blob_kzg_commitments)
-            if n_comms and blob_sidecars is None:
-                raise ChainError(
-                    f"block carries {n_comms} blob commitments but no "
-                    "sidecars were provided (data unavailable)"
-                )
-            if blob_sidecars is not None:
-                try:
-                    validate_blob_sidecars(
-                        types, work.fork, block_root, block, blob_sidecars
+            with trace.stage("da"):
+                n_comms = len(block.body.blob_kzg_commitments)
+                if n_comms and blob_sidecars is None:
+                    raise ChainError(
+                        f"block carries {n_comms} blob commitments but no "
+                        "sidecars were provided (data unavailable)"
                     )
-                except BlobError as e:
-                    raise ChainError(f"blob validation failed: {e}") from e
+                if blob_sidecars is not None:
+                    try:
+                        validate_blob_sidecars(
+                            types, work.fork, block_root, block, blob_sidecars
+                        )
+                    except BlobError as e:
+                        raise ChainError(
+                            f"blob validation failed: {e}"
+                        ) from e
 
         # execution verification via the engine when attached
         # (verifyBlocksExecutionPayloads analog); trusted_execution dev
@@ -359,16 +411,18 @@ class BeaconChain:
             self.execution_engine is not None
             and work.fork_seq >= ForkSeq.bellatrix
         ):
-            engine_status = await self._notify_new_payload(
-                work, block, block_root
-            )
+            with trace.stage("engine_notify"):
+                engine_status = await self._notify_new_payload(
+                    work, block, block_root
+                )
 
         self._store_state(block_root, work)
         self._store_block(block_root, signed_block)
         if blob_sidecars and self.db is not None:
-            self.db.blob_sidecars.put(
-                block_root, (work.fork, list(blob_sidecars))
-            )
+            with trace.stage("db_write"):
+                self.db.blob_sidecars.put(
+                    block_root, (work.fork, list(blob_sidecars))
+                )
 
         state = work.state
         epoch = util.compute_epoch_at_slot(block.slot)
@@ -385,6 +439,7 @@ class BeaconChain:
                 state.latest_execution_payload_header.block_hash
             )
         prev_finalized = self.fork_choice.finalized_checkpoint.epoch
+        fc = trace.begin_stage("forkchoice")
         self.fork_choice.on_tick(max(self.fork_choice.current_slot, block.slot))
         self.fork_choice.on_block(
             slot=block.slot,
@@ -419,6 +474,7 @@ class BeaconChain:
         self._refresh_justified_balances()
         prev_head = self.head_root
         self.head_root = self.fork_choice.update_head()
+        trace.end_stage(fc)
         # events (importBlock.ts ChainEvent emissions)
         self.events.emit(
             "block",
@@ -465,11 +521,15 @@ class BeaconChain:
                 },
             )
         if self.db is not None:
-            self._persist_import(block_root, signed_block, work)
-            if self.fork_choice.finalized_checkpoint.epoch > prev_finalized:
-                self.archiver.on_finalized(
-                    self.fork_choice.finalized_checkpoint
-                )
+            with trace.stage("db_write"):
+                self._persist_import(block_root, signed_block, work)
+                if (
+                    self.fork_choice.finalized_checkpoint.epoch
+                    > prev_finalized
+                ):
+                    self.archiver.on_finalized(
+                        self.fork_choice.finalized_checkpoint
+                    )
         if (
             self.light_client_server is not None
             and work.fork_seq >= ForkSeq.altair

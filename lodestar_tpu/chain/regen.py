@@ -47,12 +47,22 @@ class StateRegenerator:
         self.hits = 0
         self.replays = 0
         self.blocks_replayed = 0
+        # lodestar_regen_* / lodestar_state_cache_* catalog family
+        # (metrics/beacon.py m.regen) — wired by the node assembly
+        self.metrics = None
 
-    async def get_state(self, block_root: bytes) -> BeaconStateView:
+    async def get_state(
+        self, block_root: bytes, caller: str = "regen"
+    ) -> BeaconStateView:
         """Post-state of `block_root`, from cache or by replay."""
+        m = self.metrics
+        if m is not None:
+            m.requests_total.inc(caller=caller)
         cached = self.chain.get_state(block_root)
         if cached is not None:
             self.hits += 1
+            if m is not None:
+                m.state_cache_hits_total.inc()
             return cached
         if self._pending >= MAX_REGEN_QUEUE:
             raise RegenError("regen queue full")
@@ -63,7 +73,14 @@ class StateRegenerator:
                 cached = self.chain.get_state(block_root)
                 if cached is not None:
                     self.hits += 1
+                    if m is not None:
+                        m.state_cache_hits_total.inc()
                     return cached
+                # counted here, after the re-check, so hit/miss
+                # partition requests (a request served by a queued
+                # predecessor's replay counts as a hit, not both)
+                if m is not None:
+                    m.state_cache_misses_total.inc()
                 return await asyncio.get_event_loop().run_in_executor(
                     None, self.replay_sync, block_root
                 )
@@ -110,6 +127,8 @@ class StateRegenerator:
                 raise RegenError("replay chain too deep")
 
         self.replays += 1
+        if self.metrics is not None:
+            self.metrics.replays_total.inc()
         work = _clone(chain.get_state(root), chain.types)
         for blk in reversed(path):
             process_slots(
@@ -125,5 +144,7 @@ class StateRegenerator:
                 verify_signatures=False,
             )
             self.blocks_replayed += 1
+            if self.metrics is not None:
+                self.metrics.blocks_replayed_total.inc()
         chain._store_state(block_root, work)
         return work

@@ -130,6 +130,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "eligible bucket size on a background thread at start "
         "(persistent-cached; --no-bls-warmup to skip)",
     )
+    # -- observability knobs ------------------------------------------
+    beacon.add_argument(
+        "--monitored-validators", default=None,
+        help="comma-separated validator indices the validator monitor "
+        "tracks (inclusion distance, head/target correctness, "
+        "sync-committee hit/miss, proposals)",
+    )
+    beacon.add_argument(
+        "--trace-slow-slot-ms", type=float, default=500.0,
+        help="block imports slower than this land in the slow-trace "
+        "ring buffer served by /eth/v1/lodestar/block_import_traces "
+        "(0 records every import)",
+    )
+    beacon.add_argument(
+        "--trace-buffer-size", type=int, default=64,
+        help="how many slow block-import traces the ring buffer keeps",
+    )
 
     lc = sub.add_parser(
         "lightclient",
@@ -347,6 +364,17 @@ async def _run_beacon(args) -> int:
         ),
         verifier=verifier,
         bls_warmup=args.bls_warmup,
+        monitored_validators=(
+            [
+                int(i)
+                for i in args.monitored_validators.split(",")
+                if i.strip()
+            ]
+            if args.monitored_validators
+            else None
+        ),
+        trace_slow_slot_ms=args.trace_slow_slot_ms,
+        trace_buffer_size=args.trace_buffer_size,
     )
     node.notify_status()
     try:

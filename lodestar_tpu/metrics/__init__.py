@@ -17,6 +17,7 @@ from .registry import (
 )
 from .server import MetricsServer
 from .beacon import create_lodestar_metrics
+from .tracing import BLOCK_IMPORT_STAGES, Span, TraceBuffer, Tracer
 
 __all__ = [
     "Counter",
@@ -26,4 +27,8 @@ __all__ = [
     "RegistryMetricCreator",
     "MetricsServer",
     "create_lodestar_metrics",
+    "Tracer",
+    "Span",
+    "TraceBuffer",
+    "BLOCK_IMPORT_STAGES",
 ]

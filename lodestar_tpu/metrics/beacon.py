@@ -238,6 +238,35 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "beacon_current_justified_epoch", "Current justified epoch"
     )
 
+    # -- block-import span tracing (metrics/tracing.py bridge) ----------
+    t = SimpleNamespace()
+    m.tracing = t
+    # total import time reuses the chain histogram (the tracer is its
+    # one observer — per-slot trace root duration)
+    t.import_seconds = c.block_import_time
+    t.stage_seconds = reg.histogram(
+        "lodestar_block_import_stage_seconds",
+        "Per-stage block-import pipeline time"
+        " (tracing.BLOCK_IMPORT_STAGES)",
+        label_names=("stage",),
+        buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2),
+    )
+    t.span_seconds = reg.histogram(
+        "lodestar_tracing_span_seconds",
+        "Nested trace spans by name (Tracer.span / child_span)",
+        label_names=("name",),
+        buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+    )
+    t.slow_traces_total = reg.counter(
+        "lodestar_block_import_slow_traces_total",
+        "Block imports at or above the slow-slot threshold"
+        " (ring-buffered for the admin debug route)",
+    )
+    t.trace_buffer_size = reg.gauge(
+        "lodestar_block_import_trace_buffer_size",
+        "Slow traces currently held in the ring buffer",
+    )
+
     # -- db -------------------------------------------------------------
     d = SimpleNamespace()
     m.db = d
@@ -285,6 +314,28 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "lodestar_gossip_received_messages_total",
         "Gossip messages received",
         label_names=("topic",),
+    )
+    # gossip mesh health (sampled from GossipNode counters)
+    n.gossip_duplicates_total = reg.gauge(
+        "lodestar_gossip_duplicates_received_total",
+        "Gossip frames dropped as already-seen duplicates",
+    )
+    n.gossip_mesh_grafts_total = reg.gauge(
+        "lodestar_gossip_mesh_grafts_total",
+        "Peers grafted into gossip meshes",
+    )
+    n.gossip_mesh_prunes_total = reg.gauge(
+        "lodestar_gossip_mesh_prunes_total",
+        "Peers pruned out of gossip meshes",
+    )
+    n.gossip_forwarded_total = reg.gauge(
+        "lodestar_gossip_forwarded_messages_total",
+        "Validated gossip messages forwarded to the mesh",
+    )
+    n.gossip_peer_score = reg.gauge(
+        "lodestar_gossip_peer_score",
+        "Gossip peer score summary across connected peers",
+        label_names=("stat",),
     )
     n.reqresp_outgoing_requests_total = reg.counter(
         "beacon_reqresp_outgoing_requests_total",
@@ -345,11 +396,35 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
     r.state_cache_hits_total = reg.counter(
         "lodestar_state_cache_hits_total", "Block-state cache hits"
     )
+    r.state_cache_misses_total = reg.counter(
+        "lodestar_state_cache_misses_total",
+        "Block-state cache misses (fell through to replay)",
+    )
     r.state_cache_size = reg.gauge(
         "lodestar_state_cache_size", "Cached block states"
     )
     r.checkpoint_cache_size = reg.gauge(
         "lodestar_cp_state_cache_size", "Cached checkpoint states"
+    )
+    r.queue_length = reg.gauge(
+        "lodestar_regen_queue_length",
+        "State-regen requests currently queued or replaying",
+    )
+    r.cp_cache_hits_total = reg.gauge(
+        "lodestar_cp_state_cache_hits_total",
+        "Checkpoint-state cache hits (memory or reload)",
+    )
+    r.cp_cache_misses_total = reg.gauge(
+        "lodestar_cp_state_cache_misses_total",
+        "Checkpoint-state cache misses",
+    )
+    r.cp_cache_spills_total = reg.gauge(
+        "lodestar_cp_state_cache_spills_total",
+        "Checkpoint states spilled to disk on memory-bound eviction",
+    )
+    r.cp_cache_reloads_total = reg.gauge(
+        "lodestar_cp_state_cache_reloads_total",
+        "Checkpoint states reloaded from the disk spill",
     )
 
     # -- op pools (opPools/) ---------------------------------------------
@@ -506,6 +581,12 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
     rr.rate_limited_total = reg.counter(
         "lodestar_reqresp_rate_limited_total",
         "Inbound requests dropped by the GRCA rate limiter",
+    )
+    rr.request_time = reg.histogram(
+        "lodestar_reqresp_request_time_seconds",
+        "Outgoing reqresp round-trip time per protocol",
+        label_names=("protocol",),
+        buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 10),
     )
 
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------

@@ -52,6 +52,46 @@ def collect_client_stats(chain=None, verifier_metrics=None, process_start=None):
     return general
 
 
+def collect_validator_stats(chain=None):
+    """Validator-process entry (clientStats.ts "validator" schema) fed
+    from the ValidatorMonitor's last epoch rollup: remote monitoring
+    sees sync-committee participation and inclusion-distance, not just
+    node liveness. None when no validators are monitored."""
+    vm = getattr(chain, "validator_monitor", None) if chain else None
+    if vm is None or not vm.count:
+        return None
+    stats = {
+        "version": 1,
+        "timestamp": int(time.time() * 1000),
+        "process": "validator",
+        "client_name": CLIENT_NAME,
+        "client_version": CLIENT_VERSION,
+        "validator_total": vm.count,
+        "validator_active": vm.count,
+    }
+    agg = vm.last_epoch_stats
+    if agg:
+        stats.update(
+            {
+                "epoch": agg["epoch"],
+                "attestation_hits": agg["attestation_hits"],
+                "attestation_misses": agg["attestation_misses"],
+                "attestation_avg_inclusion_delay": agg[
+                    "avg_inclusion_delay"
+                ],
+                "attestation_max_inclusion_delay": agg[
+                    "max_inclusion_delay"
+                ],
+                "sync_committee_members": agg["sync_members"],
+                "sync_committee_hits": agg["sync_hits"],
+                "sync_committee_misses": agg["sync_misses"],
+                "blocks_proposed": agg["blocks_proposed"],
+                "blocks_missed": agg["blocks_missed"],
+            }
+        )
+    return stats
+
+
 class MonitoringService:
     """Push loop (service.ts:37): POST stats every `interval_s`."""
 
@@ -94,10 +134,15 @@ class MonitoringService:
 
     async def push_once(self) -> bool:
         try:
-            stats = self._collect(
-                chain=self.chain, process_start=self._start
-            )
-            body = json.dumps([stats]).encode()
+            batch = [
+                self._collect(
+                    chain=self.chain, process_start=self._start
+                )
+            ]
+            vstats = collect_validator_stats(self.chain)
+            if vstats is not None:
+                batch.append(vstats)
+            body = json.dumps(batch).encode()
         except Exception:
             self.pushes_failed += 1
             return False

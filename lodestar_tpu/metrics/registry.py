@@ -27,11 +27,28 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label_value(v) -> str:
+    # text-format spec: label values escape backslash, double-quote AND
+    # newline (a raw \n would terminate the sample line mid-value and
+    # corrupt the whole scrape)
+    return (
+        str(v)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def _escape_help(text) -> str:
+    # HELP lines escape backslash and newline only (spec: "escaping")
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_labels(names, values) -> str:
     if not names:
         return ""
     parts = [
-        '%s="%s"' % (n, str(v).replace("\\", r"\\").replace('"', r"\""))
+        '%s="%s"' % (n, _escape_label_value(v))
         for n, v in zip(names, values)
     ]
     return "{" + ",".join(parts) + "}"
@@ -72,7 +89,7 @@ class Counter(_MetricBase):
 
     def collect(self) -> str:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} counter",
         ]
         values = self._values or ({(): 0.0} if not self.label_names else {})
@@ -115,7 +132,7 @@ class Gauge(_MetricBase):
         for fn in self._collect_fns:
             fn(self)
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} gauge",
         ]
         values = self._values or ({(): 0.0} if not self.label_names else {})
@@ -180,7 +197,7 @@ class Histogram(_MetricBase):
 
     def collect(self) -> str:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
         ]
         keys = self._counts or ({(): [0] * len(self.buckets)} if not self.label_names else {})

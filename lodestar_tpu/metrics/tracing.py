@@ -1,0 +1,375 @@
+"""Span tracing: nestable sync/async spans over the block-import path.
+
+Reference analog: the reference breaks the import pipeline into timed
+sub-histograms scattered through chain/blocks/* (verifyBlock.ts and
+importBlock.ts each observe their own `lodestar_block_*_seconds`
+series); committee-consensus measurement work (arXiv:2302.00418) shows
+the signature path only becomes tunable once per-stage timing is
+first-class. This module makes the whole pipeline first-class: one
+trace per imported block covering gossip receive -> decode ->
+sig-verify -> DA -> engine notify -> state transition -> forkchoice ->
+db write, every stage bridged to labelled histograms on the registry,
+with a bounded ring buffer of recent slow traces served by the
+`/eth/v1/lodestar/block_import_traces` admin route (api/impl.py).
+
+Spans nest: `Tracer.span()` attaches to the innermost open span via a
+contextvar, so work dispatched with `asyncio.ensure_future` inside a
+stage (the BLS verifier job, bls/verifier.py) lands as a child of that
+stage in the trace tree — contextvars copy at task creation, which is
+exactly the propagation OpenTelemetry's asyncio integration relies on.
+
+The clock is injectable so tests drive deterministic durations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+
+# The canonical per-slot block-import stages, in pipeline order.
+# ImportTrace.finish() guarantees every one is present (0.0 when the
+# stage did not run: pre-deneb DA, no engine attached, no db, direct
+# non-gossip imports).
+BLOCK_IMPORT_STAGES = (
+    "gossip_receive",
+    "decode",
+    "sig_verify",
+    "da",
+    "engine_notify",
+    "state_transition",
+    "forkchoice",
+    "db_write",
+)
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "lodestar_tpu_current_span", default=None
+)
+
+
+def current_span() -> "Span | None":
+    """The innermost open span of the calling task, if any."""
+    return _current_span.get()
+
+
+@contextlib.contextmanager
+def child_span(name: str):
+    """Open a span under the calling task's current span; no-op when
+    no trace is active. The zero-coupling hook for deep subsystems
+    (the BLS verifier) that must not depend on a tracer instance."""
+    parent = _current_span.get()
+    if parent is None:
+        yield None
+        return
+    span = Span(name, clock=parent._clock, tracer=parent._tracer)
+    span.start(parent)
+    try:
+        yield span
+    finally:
+        span.end()
+
+
+class Span:
+    """One timed interval; children nest through the contextvar."""
+
+    __slots__ = (
+        "name",
+        "t0",
+        "t1",
+        "children",
+        "parent",
+        "bridge",
+        "_clock",
+        "_tracer",
+        "_token",
+    )
+
+    def __init__(self, name: str, clock=None, tracer=None, bridge=True):
+        self.name = name
+        self._clock = clock or time.perf_counter
+        self._tracer = tracer
+        self.bridge = bridge
+        self.t0 = None
+        self.t1 = None
+        self.children: list[Span] = []
+        self.parent: Span | None = None
+        self._token = None
+
+    def start(self, parent: "Span | None" = None) -> "Span":
+        self.t0 = self._clock()
+        self.parent = parent
+        if parent is not None:
+            parent.children.append(self)
+        self._token = _current_span.set(self)
+        return self
+
+    def end(self) -> float:
+        """Close the span; returns its duration (idempotent)."""
+        if self.t1 is None:
+            self.t1 = self._clock()
+            if self._token is not None:
+                try:
+                    _current_span.reset(self._token)
+                except ValueError:
+                    # closed from a different context (task finished
+                    # elsewhere): the copied context dies with the task
+                    pass
+                self._token = None
+            if self._tracer is not None:
+                self._tracer._on_span_end(self)
+        return self.duration
+
+    @property
+    def duration(self) -> float:
+        if self.t0 is None:
+            return 0.0
+        end = self.t1 if self.t1 is not None else self._clock()
+        return max(0.0, end - self.t0)
+
+    def __enter__(self) -> "Span":
+        if self.t0 is None:
+            self.start(_current_span.get())
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_ms": round(self.duration * 1000.0, 3),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class TraceBuffer:
+    """Bounded ring of finished trace dicts (oldest evicted first)."""
+
+    def __init__(self, maxlen: int = 64):
+        self.maxlen = max(1, int(maxlen))
+        self._items: list[dict] = []
+        self._lock = threading.Lock()
+        self.added_total = 0
+
+    def add(self, item: dict) -> None:
+        with self._lock:
+            self._items.append(item)
+            self.added_total += 1
+            while len(self._items) > self.maxlen:
+                self._items.pop(0)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class ImportTrace:
+    """Per-block trace: the eight canonical stages plus any nested
+    spans opened while a stage is current.
+
+    Stages accumulate — `add_stage` called twice for one name sums the
+    durations (the state-transition stage covers both the pre-state
+    slot advance and the block transition, separated in code by the
+    signature dispatch)."""
+
+    def __init__(self, tracer: "Tracer", slot: int, t0: float | None = None):
+        self.tracer = tracer
+        self.slot = int(slot)
+        self.root = Span("block_import", clock=tracer.clock)
+        # t0 lets the gossip path backdate the trace to frame receipt
+        # so gossip_receive/decode count into the total
+        self.root.t0 = tracer.clock() if t0 is None else t0
+        self.stages: dict[str, float] = {}
+        self._stage_spans: dict[str, Span] = {}
+        self.error: str | None = None
+        self.block_root: bytes | None = None
+        self._finished = False
+
+    def begin_stage(self, name: str) -> Span:
+        """Open a stage span (contextvar current until `.end()`), so
+        spans opened meanwhile — including in tasks spawned now —
+        nest under it."""
+        # stage durations go to stage_seconds (trace finish), not the
+        # generic span_seconds bridge — bridge=False avoids the double
+        # observation while still letting children bridge
+        span = Span(
+            name, clock=self.tracer.clock, tracer=self.tracer,
+            bridge=False,
+        )
+        span.start(self.root)
+        self._stage_spans[name] = span
+        return span
+
+    def end_stage(self, span: Span) -> None:
+        self.add_stage(span.name, span.end())
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        span = self.begin_stage(name)
+        try:
+            yield span
+        finally:
+            self.end_stage(span)
+
+    def add_stage(self, name: str, duration: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + max(
+            0.0, float(duration)
+        )
+
+    def finish(self, block_root: bytes | None = None, error=None) -> dict:
+        """Close the trace: default missing canonical stages to 0,
+        bridge every stage to the labelled histogram, record the slow
+        ones into the ring buffer. Idempotent."""
+        if self._finished:
+            return {}
+        self._finished = True
+        if block_root is not None:
+            self.block_root = bytes(block_root)
+        if error is not None:
+            self.error = str(error)
+        self.root.end()
+        # close any stage span left open by an aborted import so its
+        # children stop attributing new work here
+        for span in self._stage_spans.values():
+            if span.t1 is None:
+                self.add_stage(span.name, span.end())
+        for name in BLOCK_IMPORT_STAGES:
+            self.stages.setdefault(name, 0.0)
+        return self.tracer._on_trace_finish(self)
+
+    def to_dict(self) -> dict:
+        total = self.root.duration
+        stages = []
+        for name in BLOCK_IMPORT_STAGES:
+            entry = {
+                "stage": name,
+                "duration_ms": round(
+                    self.stages.get(name, 0.0) * 1000.0, 3
+                ),
+            }
+            span = self._stage_spans.get(name)
+            if span is not None and span.children:
+                entry["children"] = [
+                    c.to_dict() for c in span.children
+                ]
+            stages.append(entry)
+        # non-canonical stages (future instrumentation) ride along
+        for name, dur in self.stages.items():
+            if name not in BLOCK_IMPORT_STAGES:
+                stages.append(
+                    {
+                        "stage": name,
+                        "duration_ms": round(dur * 1000.0, 3),
+                    }
+                )
+        return {
+            "slot": self.slot,
+            "block_root": (
+                "0x" + self.block_root.hex()
+                if self.block_root is not None
+                else None
+            ),
+            "total_ms": round(total * 1000.0, 3),
+            "stages": stages,
+            "error": self.error,
+            "timestamp": time.time(),
+        }
+
+
+class Tracer:
+    """Factory + sink: spans, block-import traces, histogram bridge,
+    and the slow-trace ring buffer.
+
+    `metrics` is the `m.tracing` namespace from
+    metrics/beacon.create_lodestar_metrics (stage_seconds /
+    span_seconds / import_seconds / slow_traces_total) or None for an
+    unbridged tracer (unit tests). `clock` is injectable."""
+
+    def __init__(
+        self,
+        metrics=None,
+        clock=None,
+        slow_ms: float = 500.0,
+        buffer_size: int = 64,
+    ):
+        self.metrics = metrics
+        self.clock = clock or time.perf_counter
+        self.slow_ms = float(slow_ms)
+        self.buffer = TraceBuffer(buffer_size)
+
+    def span(self, name: str) -> Span:
+        """Context manager: a span nested under the caller's current
+        span (or a new root)."""
+        return Span(name, clock=self.clock, tracer=self)
+
+    def block_import_trace(
+        self, slot: int, t0: float | None = None
+    ) -> ImportTrace:
+        return ImportTrace(self, slot, t0=t0)
+
+    # -- sinks ----------------------------------------------------------
+
+    def _on_span_end(self, span: Span) -> None:
+        if self.metrics is not None and span.bridge:
+            self.metrics.span_seconds.observe(
+                span.duration, name=span.name
+            )
+
+    def _on_trace_finish(self, trace: ImportTrace) -> dict:
+        total = trace.root.duration
+        if self.metrics is not None:
+            self.metrics.import_seconds.observe(total)
+            for name, dur in trace.stages.items():
+                self.metrics.stage_seconds.observe(dur, stage=name)
+        item = trace.to_dict()
+        if total * 1000.0 >= self.slow_ms or trace.error is not None:
+            self.buffer.add(item)
+            if self.metrics is not None:
+                self.metrics.slow_traces_total.inc()
+        return item
+
+
+class _NullSpan:
+    """Inert span for the untraced path."""
+
+    name = "null"
+    children = ()
+
+    def end(self) -> float:
+        return 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullTrace:
+    """No-op ImportTrace so instrumented code needs no None guards."""
+
+    _span = _NullSpan()
+
+    def begin_stage(self, name):
+        return self._span
+
+    def end_stage(self, span):
+        pass
+
+    @contextlib.contextmanager
+    def stage(self, name):
+        yield self._span
+
+    def add_stage(self, name, duration):
+        pass
+
+    def finish(self, block_root=None, error=None):
+        return {}
+
+
+NULL_TRACE = _NullTrace()

@@ -64,6 +64,14 @@ class ValidatorMonitor:
     def __init__(self, registry=None, logger=None):
         self.validators: dict[int, _MonitoredValidator] = {}
         self.log = logger
+        # last on_epoch_summary rollup, aggregated across the monitored
+        # set — consumed by the client-stats push
+        # (metrics/monitoring.py) and the aggregate log line
+        self.last_epoch_stats: dict | None = None
+        # validator indices whose per-index inclusion-distance series
+        # has been emitted (so outage epochs zero it instead of
+        # leaving the last healthy value on the dashboard)
+        self._incl_indices_emitted: set[int] = set()
         if registry is not None:
             reg = registry
             self._m_att_hit = reg.counter(
@@ -119,6 +127,67 @@ class ValidatorMonitor:
                 " member's signature landed in imported blocks",
                 label_names=("index",),
             )
+            # full-depth rollup series (validatorMonitor.ts
+            # onceEveryEndOfEpoch family): per-epoch miss counters for
+            # head/target votes and sync participation, plus aggregate
+            # rates + the inclusion-distance average that makes an
+            # inclusion-delay regression (the r5 1.74-slot bug class)
+            # alarm-able from one series
+            self._m_head_miss = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_head_attester_miss_total",
+                "Included attestations voting a wrong head",
+            )
+            self._m_target_miss = reg.counter(
+                "validator_monitor_prev_epoch_on_chain_target_attester_miss_total",
+                "Included attestations voting a wrong target",
+            )
+            self._m_sync_hits = reg.counter(
+                "validator_monitor_prev_epoch_sync_committee_hits_total",
+                "Sync signatures of monitored committee members that"
+                " landed in imported blocks",
+            )
+            self._m_sync_misses = reg.counter(
+                "validator_monitor_prev_epoch_sync_committee_misses_total",
+                "Slots a monitored sync-committee member's signature"
+                " missed imported blocks",
+            )
+            self._m_att_hit_rate = reg.gauge(
+                "validator_monitor_prev_epoch_attestation_hit_rate",
+                "Fraction of monitored validators whose attestation was"
+                " included for the previous epoch",
+            )
+            self._m_head_rate = reg.gauge(
+                "validator_monitor_prev_epoch_head_correctness_rate",
+                "Fraction of included monitored attestations voting the"
+                " correct head",
+            )
+            self._m_target_rate = reg.gauge(
+                "validator_monitor_prev_epoch_target_correctness_rate",
+                "Fraction of included monitored attestations voting the"
+                " correct target",
+            )
+            self._m_incl_avg = reg.gauge(
+                "validator_monitor_prev_epoch_inclusion_distance_avg",
+                "Mean best inclusion distance of monitored attestations"
+                " for the previous epoch (healthy chain: ~1.0)",
+            )
+            self._m_incl_by_index = reg.gauge(
+                "validator_monitor_prev_epoch_inclusion_distance",
+                "Best inclusion distance per monitored validator",
+                label_names=("index",),
+            )
+            self._m_proposal_hit_rate = reg.gauge(
+                "validator_monitor_prev_epoch_proposal_hit_rate",
+                "Proposals made / proposals expected for monitored"
+                " validators in the previous epoch",
+            )
+            self._m_count = reg.gauge(
+                "validator_monitor_validators",
+                "Validators registered with the monitor",
+            )
+            self._m_count.add_collect(
+                lambda g: g.set(len(self.validators))
+            )
         else:
             self._m_att_hit = self._m_att_miss = None
             self._m_head_hit = self._m_target_hit = None
@@ -128,6 +197,13 @@ class ValidatorMonitor:
             self._m_sync_seen = self._m_sync_included = None
             self._m_balance = None
             self._m_sync_hit_rate = None
+            self._m_head_miss = self._m_target_miss = None
+            self._m_sync_hits = self._m_sync_misses = None
+            self._m_att_hit_rate = None
+            self._m_head_rate = self._m_target_rate = None
+            self._m_incl_avg = self._m_incl_by_index = None
+            self._m_proposal_hit_rate = None
+            self._m_count = None
 
     # -- registration -----------------------------------------------------
 
@@ -267,32 +343,88 @@ class ValidatorMonitor:
     def on_epoch_summary(self, prev_epoch: int) -> dict:
         """Roll up the previous epoch (validatorMonitor's
         onceEveryEndOfEpoch); returns {index: summary}, bumps the
-        prometheus series, and logs one structured line per validator
-        when a logger is attached."""
+        prometheus series (per-validator + aggregates), records
+        `last_epoch_stats` for the client-stats push, and logs one
+        structured line per validator plus one aggregate line when a
+        logger is attached."""
+        slots = preset().SLOTS_PER_EPOCH
         out = {}
+        agg = {
+            "epoch": prev_epoch,
+            "validators": len(self.validators),
+            "attestation_hits": 0,
+            "attestation_misses": 0,
+            "head_hits": 0,
+            "target_hits": 0,
+            "inclusion_delays": [],
+            "sync_members": 0,
+            "sync_hits": 0,
+            "sync_misses": 0,
+            "blocks_proposed": 0,
+            "blocks_missed": 0,
+        }
         for idx, mv in self.validators.items():
             s = mv.summary(prev_epoch)
             out[idx] = s
+            if s.attestation_included:
+                agg["attestation_hits"] += 1
+                if s.attestation_correct_head:
+                    agg["head_hits"] += 1
+                if s.attestation_correct_target:
+                    agg["target_hits"] += 1
+                if s.attestation_inclusion_delay is not None:
+                    agg["inclusion_delays"].append(
+                        s.attestation_inclusion_delay
+                    )
+            else:
+                agg["attestation_misses"] += 1
+            sync_hits = sync_misses = 0
+            if s.sync_committee_member:
+                agg["sync_members"] += 1
+                sync_hits = s.sync_signatures_included
+                sync_misses = max(0, slots - sync_hits)
+                agg["sync_hits"] += sync_hits
+                agg["sync_misses"] += sync_misses
+            agg["blocks_proposed"] += s.blocks_proposed
+            agg["blocks_missed"] += s.blocks_missed
             if self._m_att_hit is not None:
                 if s.attestation_included:
                     self._m_att_hit.inc()
                     if s.attestation_correct_head:
                         self._m_head_hit.inc()
+                    else:
+                        self._m_head_miss.inc()
                     if s.attestation_correct_target:
                         self._m_target_hit.inc()
+                    else:
+                        self._m_target_miss.inc()
                     if s.attestation_inclusion_delay is not None:
                         self._m_inclusion_delay.observe(
                             s.attestation_inclusion_delay
                         )
+                        self._m_incl_by_index.set(
+                            s.attestation_inclusion_delay,
+                            index=str(idx),
+                        )
+                        self._incl_indices_emitted.add(idx)
                 else:
                     self._m_att_miss.inc()
+                    if idx in self._incl_indices_emitted:
+                        # zero a previously-emitted series so a
+                        # validator going dark doesn't keep showing
+                        # its last healthy distance (per-index analog
+                        # of the aggregate-gauge reset below); never-
+                        # included validators get no series at all
+                        self._m_incl_by_index.set(0, index=str(idx))
+                if s.sync_committee_member:
+                    self._m_sync_hits.inc(sync_hits)
+                    self._m_sync_misses.inc(sync_misses)
             if (
                 self._m_sync_hit_rate is not None
                 and s.sync_committee_member
             ):
                 self._m_sync_hit_rate.set(
-                    s.sync_signatures_included
-                    / preset().SLOTS_PER_EPOCH,
+                    s.sync_signatures_included / slots,
                     index=str(idx),
                 )
             if self.log is not None:
@@ -316,4 +448,32 @@ class ValidatorMonitor:
                         "delta": s.balance_delta,
                     },
                 )
+        delays = agg.pop("inclusion_delays")
+        agg["avg_inclusion_delay"] = (
+            sum(delays) / len(delays) if delays else None
+        )
+        agg["max_inclusion_delay"] = max(delays) if delays else None
+        self.last_epoch_stats = agg
+        hits, misses = agg["attestation_hits"], agg["attestation_misses"]
+        if self._m_att_hit_rate is not None and (hits or misses):
+            # always re-set the aggregate gauges — a zero-hit epoch
+            # (total inclusion outage) must drive them to 0, not leave
+            # the previous healthy values alarming nothing
+            self._m_att_hit_rate.set(hits / (hits + misses))
+            self._m_head_rate.set(
+                agg["head_hits"] / hits if hits else 0.0
+            )
+            self._m_target_rate.set(
+                agg["target_hits"] / hits if hits else 0.0
+            )
+            self._m_incl_avg.set(
+                agg["avg_inclusion_delay"] if delays else 0.0
+            )
+            expected = agg["blocks_proposed"] + agg["blocks_missed"]
+            if expected:
+                self._m_proposal_hit_rate.set(
+                    agg["blocks_proposed"] / expected
+                )
+        if self.log is not None and self.validators:
+            self.log.info("validator monitor epoch rollup", dict(agg))
         return out

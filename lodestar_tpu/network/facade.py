@@ -443,9 +443,10 @@ class Network:
         }[action]
 
     async def _on_block(self, peer_id: str, ssz_bytes: bytes):
-        from ..chain.validation import GossipValidationError
         from ..statetransition.slot import fork_at_epoch
 
+        tracer = getattr(self.chain, "tracer", None)
+        t_recv = tracer.clock() if tracer is not None else None
         try:
             # fork from the BLOCK's slot (the head may still be on the
             # previous fork at a transition): SignedBeaconBlock is
@@ -456,15 +457,40 @@ class Network:
             fork = fork_at_epoch(
                 self.chain.cfg, slot // preset().SLOTS_PER_EPOCH
             )
+            t_dec = tracer.clock() if tracer is not None else None
             block = self.types.by_fork[
                 fork
             ].SignedBeaconBlock.deserialize(ssz_bytes)
+            decode_s = (
+                tracer.clock() - t_dec if tracer is not None else 0.0
+            )
         except Exception:
             return ValidationResult.REJECT
-        return await self._on_main(self._on_block_main(block, fork))
+        return await self._on_main(
+            self._on_block_main(block, fork, t_recv, decode_s)
+        )
 
-    async def _on_block_main(self, block, fork: str):
+    async def _on_block_main(
+        self, block, fork: str, t_recv=None, decode_s: float = 0.0
+    ):
         from ..chain.validation import GossipValidationError
+
+        # start the import trace at frame receipt: gossip_receive is
+        # everything from handler entry to here (snappy + fork resolve
+        # + the network-core -> chain-loop hop), decode is the SSZ
+        # deserialize measured on the network thread
+        trace = None
+        tracer = getattr(self.chain, "tracer", None)
+        if tracer is not None:
+            trace = tracer.block_import_trace(
+                int(block.message.slot), t0=t_recv
+            )
+            if t_recv is not None:
+                trace.add_stage(
+                    "gossip_receive",
+                    tracer.clock() - t_recv - decode_s,
+                )
+            trace.add_stage("decode", decode_s)
 
         if (
             self.processor is not None
@@ -473,40 +499,55 @@ class Network:
             # cheap pre-import checks + proposer signature decide the
             # gossip verdict (validateGossipBlock); the full import
             # runs AFTER forwarding, off the handler (gossipHandlers
-            # onBlock -> processBlock async)
-            try:
-                await self.processor.validate_gossip_block(block, fork)
-            except GossipValidationError as e:
-                if e.reason == "unknown parent":
-                    # catch-up race: the parent's import task may still
-                    # be in flight — wait for pending imports, retry
-                    # once, then escalate to unknown-block sync
-                    if self._import_tasks:
-                        await asyncio.gather(
-                            *list(self._import_tasks),
-                            return_exceptions=True,
-                        )
-                        try:
-                            await self.processor.validate_gossip_block(
-                                block, fork
+            # onBlock -> processBlock async). The gossip_validate stage
+            # accounts this interval (proposer-sig verify + the
+            # unknown-parent retry wait) so a slow-trace total is
+            # always explained by its stages. Traces of IGNOREd /
+            # REJECTed blocks are deliberately dropped unfinished:
+            # rejected traffic is not a block import and must not feed
+            # the import histograms or the slow-trace buffer.
+            from ..metrics.tracing import NULL_TRACE
+
+            vtrace = trace if trace is not None else NULL_TRACE
+            with vtrace.stage("gossip_validate"):
+                try:
+                    await self.processor.validate_gossip_block(
+                        block, fork
+                    )
+                except GossipValidationError as e:
+                    if e.reason == "unknown parent":
+                        # catch-up race: the parent's import task may
+                        # still be in flight — wait for pending
+                        # imports, retry once, then escalate to
+                        # unknown-block sync
+                        if self._import_tasks:
+                            await asyncio.gather(
+                                *list(self._import_tasks),
+                                return_exceptions=True,
                             )
-                        except GossipValidationError as e2:
-                            self._escalate_unknown_parent(block, e2)
-                            return self._to_result(e2.action)
+                            try:
+                                await self.processor.validate_gossip_block(
+                                    block, fork
+                                )
+                            except GossipValidationError as e2:
+                                self._escalate_unknown_parent(block, e2)
+                                return self._to_result(e2.action)
+                        else:
+                            self._escalate_unknown_parent(block, e)
+                            return self._to_result(e.action)
                     else:
-                        self._escalate_unknown_parent(block, e)
                         return self._to_result(e.action)
-                else:
-                    return self._to_result(e.action)
             self.blocks_received += 1
-            task = asyncio.ensure_future(self._import_gossip_block(block))
+            task = asyncio.ensure_future(
+                self._import_gossip_block(block, trace)
+            )
             self._import_tasks.add(task)
             task.add_done_callback(self._import_tasks.discard)
             return ValidationResult.ACCEPT
         # fallback (no validator wired, embedded/test topologies):
         # validation == full import
         try:
-            await self.chain.process_block(block)
+            await self.chain.process_block(block, trace=trace)
             self.blocks_received += 1
             return ValidationResult.ACCEPT
         except Exception as e:
@@ -525,9 +566,9 @@ class Network:
                 self._import_tasks.add(task)
                 task.add_done_callback(self._import_tasks.discard)
 
-    async def _import_gossip_block(self, block) -> None:
+    async def _import_gossip_block(self, block, trace=None) -> None:
         try:
-            await self.chain.process_block(block)
+            await self.chain.process_block(block, trace=trace)
         except Exception as e:
             # import failures after a pre-validated ACCEPT are logged
             # by the chain; unknown-parent can't happen (pre-checked)

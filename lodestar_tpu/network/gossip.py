@@ -120,6 +120,11 @@ class GossipNode:
         self.messages_forwarded = 0
         self.messages_published = 0
         self.frames_sent = 0  # gossip data frames (fan-out accounting)
+        # mesh-health counters (lodestar_gossip_* gauges sample these
+        # at scrape time — node.py add_collect wiring)
+        self.duplicates_received = 0
+        self.grafts_total = 0
+        self.prunes_total = 0
         self._hb_task: asyncio.Task | None = None
         # validation tasks: validation can await the chain's batch
         # verifier (50ms+ windows), so it runs DETACHED from the
@@ -254,6 +259,7 @@ class GossipNode:
         mid = message_id(data)
         first = mid not in self._seen
         if not first:
+            self.duplicates_received += 1
             return
         self._mark_seen(mid)
         handler = self.subscriptions.get(topic)
@@ -426,6 +432,7 @@ class GossipNode:
 
     def _graft(self, topic: str, peer_id: str) -> None:
         self.mesh.setdefault(topic, set()).add(peer_id)
+        self.grafts_total += 1
         self._send_control(peer_id, {"t": "graft", "topic": topic})
 
     # -- heartbeat --------------------------------------------------------
@@ -461,6 +468,7 @@ class GossipNode:
                 if self._score(p) < GRAFT_THRESHOLD
             ]:
                 members.discard(p)
+                self.prunes_total += 1
                 self._send_control(p, {"t": "prune", "topic": topic})
             # fill to D from known good topic peers
             if len(members) < D_LOW:
@@ -480,6 +488,7 @@ class GossipNode:
                 )
                 for p in ranked[D_MESH:]:
                     members.discard(p)
+                    self.prunes_total += 1
                     self._send_control(
                         p, {"t": "prune", "topic": topic}
                     )

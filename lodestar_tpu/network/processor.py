@@ -123,10 +123,12 @@ class NetworkProcessor:
                 f"topic {topic} validates inline, not via queues"
             )
 
-    async def process_block(self, signed_block):
+    async def process_block(self, signed_block, trace=None):
         """Blocks bypass the queues entirely (processor/index.ts:66-80
-        `bypassQueue`)."""
-        return await self.chain.process_block(signed_block)
+        `bypassQueue`). `trace` is the gossip handler's ImportTrace
+        (metrics/tracing.py) carrying receive/decode stage timings into
+        the chain's per-stage import trace."""
+        return await self.chain.process_block(signed_block, trace=trace)
 
     async def validate_gossip_block(self, signed_block, fork: str):
         """Cheap pre-import validation (chain/validation/block.py);

@@ -21,6 +21,7 @@ transport can slot in underneath.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 
 from ..utils import snappy
@@ -174,6 +175,7 @@ class ReqResp:
             self.metrics.outgoing_requests_total.inc(
                 protocol=_short_proto(protocol)
             )
+        t0 = time.monotonic()
         try:
             raw = await asyncio.wait_for(
                 self.transport.request_raw(
@@ -194,6 +196,14 @@ class ReqResp:
                     protocol=_short_proto(protocol)
                 )
             raise
+        finally:
+            # per-protocol round-trip latency, failures included —
+            # a peer timing out IS the latency signal
+            if self.metrics is not None:
+                self.metrics.request_time.observe(
+                    time.monotonic() - t0,
+                    protocol=_short_proto(protocol),
+                )
         stats.consecutive_failures = 0
         return chunks
 

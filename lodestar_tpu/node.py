@@ -65,6 +65,12 @@ class BeaconNode:
         wss_state_root: bytes | None = None,
         # -- bls verifier warmup (bls/kernels.warmup_ingest) --
         bls_warmup: bool = True,
+        # -- block-import span tracing (metrics/tracing.py) --
+        # imports slower than this land in the slow-trace ring buffer
+        # behind /eth/v1/lodestar/block_import_traces; 0 records every
+        # import (debugging / sims)
+        trace_slow_slot_ms: float = 500.0,
+        trace_buffer_size: int = 64,
     ):
         self.cfg = cfg
         self.types = types
@@ -105,6 +111,16 @@ class BeaconNode:
         self.checkpoint_sync_url = checkpoint_sync_url
         self.wss_state_root = wss_state_root
         self.bls_warmup = bls_warmup
+        from .metrics import Tracer
+
+        self.tracer = Tracer(
+            metrics=self.metrics.tracing,
+            slow_ms=trace_slow_slot_ms,
+            buffer_size=trace_buffer_size,
+        )
+        self.metrics.tracing.trace_buffer_size.add_collect(
+            lambda g: g.set(len(self.tracer.buffer))
+        )
         self.network = None
         self.builder = None
         self.monitoring = None
@@ -249,6 +265,11 @@ class BeaconNode:
                 verifier=node.verifier,
                 db=node.db,
             )
+        # block-import span tracing: every import now produces the
+        # per-stage trace; slow slots are ring-buffered for the admin
+        # debug route (api/impl.get_block_import_traces)
+        node.chain.tracer = node.tracer
+        node.chain.regen.metrics = node.metrics.regen
         # pre-warm the device-ingest compiles (mid {256,512} + max
         # buckets) on a background thread through the persistent cache
         # so steady-state gossip never pays a cold multi-minute XLA
@@ -563,13 +584,80 @@ class BeaconNode:
                     for topic, peers in node.network.gossip.mesh.items()
                 ]
             )
+            # gossip mesh health: duplicates / graft-prune churn /
+            # forward volume / peer-score spread, sampled at scrape
+            gos = node.network.gossip
+            mm.network.gossip_duplicates_total.add_collect(
+                lambda g: g.set(gos.duplicates_received)
+            )
+            mm.network.gossip_mesh_grafts_total.add_collect(
+                lambda g: g.set(gos.grafts_total)
+            )
+            mm.network.gossip_mesh_prunes_total.add_collect(
+                lambda g: g.set(gos.prunes_total)
+            )
+            mm.network.gossip_forwarded_total.add_collect(
+                lambda g: g.set(gos.messages_forwarded)
+            )
+
+            def _score_stats(g):
+                # zero when no peers remain — stale last-known scores
+                # would mask a total peer loss on the dashboard
+                vals = [sc.value for sc in gos.scores.values()] or [0.0]
+                g.set(min(vals), stat="min")
+                g.set(max(vals), stat="max")
+                g.set(sum(vals) / len(vals), stat="avg")
+
+            mm.network.gossip_peer_score.add_collect(_score_stats)
         mm.regen.state_cache_size.add_collect(
             lambda g: g.set(len(node.chain._states))
+        )
+        mm.regen.queue_length.add_collect(
+            lambda g: g.set(node.chain.regen._pending)
+        )
+        cps = node.checkpoint_states
+        mm.regen.checkpoint_cache_size.add_collect(
+            lambda g: g.set(len(cps._mem))
+        )
+        mm.regen.cp_cache_hits_total.add_collect(
+            lambda g: g.set(cps.hits)
+        )
+        mm.regen.cp_cache_misses_total.add_collect(
+            lambda g: g.set(cps.misses)
+        )
+        mm.regen.cp_cache_spills_total.add_collect(
+            lambda g: g.set(cps.spills)
+        )
+        mm.regen.cp_cache_reloads_total.add_collect(
+            lambda g: g.set(cps.reloads)
         )
         mm.op_pool.attestation_pool_size.add_collect(
             lambda g: g.set(
                 sum(len(v) for v in node.att_pool._groups.values())
             )
+        )
+        mm.op_pool.unagg_attestation_pool_size.add_collect(
+            lambda g: g.set(
+                sum(len(v) for v in node.unagg_pool._groups.values())
+            )
+        )
+        mm.op_pool.sync_committee_message_pool_size.add_collect(
+            lambda g: g.set(len(node.sync_msg_pool._groups))
+        )
+        mm.op_pool.sync_contribution_pool_size.add_collect(
+            lambda g: g.set(len(node.contrib_pool._best))
+        )
+        mm.op_pool.voluntary_exit_pool_size.add_collect(
+            lambda g: g.set(len(node.op_pool.voluntary_exits))
+        )
+        mm.op_pool.attester_slashing_pool_size.add_collect(
+            lambda g: g.set(len(node.op_pool.attester_slashings))
+        )
+        mm.op_pool.proposer_slashing_pool_size.add_collect(
+            lambda g: g.set(len(node.op_pool.proposer_slashings))
+        )
+        mm.op_pool.bls_to_execution_change_pool_size.add_collect(
+            lambda g: g.set(len(node.op_pool.bls_changes))
         )
         def _wall_slot(g):
             import time as _t

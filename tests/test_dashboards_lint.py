@@ -1,0 +1,54 @@
+"""Dashboard lint runs inside tier 1 (ISSUE 9 satellite): every
+Grafana panel expr must reference only metrics the node registers
+(tools/lint_dashboards.py), so dashboards can never dangle again."""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_dashboards  # noqa: E402
+
+
+class TestDashboardLint:
+    def test_all_repo_dashboards_clean(self):
+        assert lint_dashboards.lint(REPO / "dashboards") == 0
+
+    def test_unknown_metric_fails(self, tmp_path):
+        bad = {
+            "title": "bad",
+            "panels": [
+                {
+                    "title": "dangling",
+                    "targets": [
+                        {
+                            "expr": "rate(lodestar_totally_bogus_metric_total[5m])"
+                        }
+                    ],
+                }
+            ],
+        }
+        (tmp_path / "bad.json").write_text(json.dumps(bad))
+        assert lint_dashboards.lint(tmp_path) == 1
+
+    def test_expr_parser_ignores_promql_syntax(self):
+        names = lint_dashboards.metric_names_in_expr(
+            'histogram_quantile(0.95, sum by (le, stage) '
+            '(rate(lodestar_block_import_stage_seconds_bucket'
+            '{stage="sig_verify"}[5m])))'
+        )
+        assert names == {"lodestar_block_import_stage_seconds_bucket"}
+
+    def test_histogram_suffixes_registered(self):
+        known = lint_dashboards.registered_metric_names()
+        assert "lodestar_block_import_seconds_bucket" in known
+        assert "lodestar_block_import_seconds_sum" in known
+        assert "lodestar_block_import_seconds_count" in known
+        assert (
+            "validator_monitor_prev_epoch_inclusion_distance_avg"
+            in known
+        )

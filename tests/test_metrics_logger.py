@@ -114,3 +114,58 @@ class TestLogger:
         err = capsys.readouterr().err
         assert "hidden" not in err
         assert "shown" in err
+
+
+class TestExpositionEscaping:
+    """Text-format escaping (ISSUE 9 satellite): a newline in a label
+    value or HELP text must never corrupt the scrape."""
+
+    def test_label_value_newline_escaped(self):
+        reg = RegistryMetricCreator()
+        g = reg.gauge("esc_gauge", "h", label_names=("err",))
+        g.set(1, err='line1\nline2 "quoted" back\\slash')
+        out = reg.expose()
+        assert (
+            'esc_gauge{err="line1\\nline2 \\"quoted\\" back\\\\slash"} 1'
+            in out
+        )
+        # no raw newline leaked into any sample line
+        for line in out.splitlines():
+            if line.startswith("esc_gauge{"):
+                assert line.endswith(" 1")
+
+    def test_help_newline_escaped(self):
+        reg = RegistryMetricCreator()
+        reg.counter("esc_total", "first line\nsecond line")
+        out = reg.expose()
+        assert "# HELP esc_total first line\\nsecond line" in out
+        assert "\nsecond line" not in out.replace(
+            "\\nsecond line", ""
+        )
+
+    def test_histogram_labels_escaped(self):
+        reg = RegistryMetricCreator()
+        h = reg.histogram(
+            "esc_hist", "h\\elp", label_names=("k",), buckets=(1,)
+        )
+        h.observe(0.5, k="a\nb")
+        out = reg.expose()
+        assert "# HELP esc_hist h\\\\elp" in out
+        assert 'esc_hist_bucket{k="a\\nb",le="1"} 1' in out
+
+    def test_whole_scrape_parses_line_per_sample(self):
+        """Every non-comment line must be `<series> <value>` — the
+        invariant a newline injection used to break."""
+        reg = RegistryMetricCreator()
+        g = reg.gauge("parse_gauge", "multi\nline help",
+                      label_names=("v",))
+        g.set(3, v="x\ny")
+        h = reg.histogram("parse_hist", "h", buckets=(1, 2))
+        h.observe(1.5)
+        for line in reg.expose().strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith(("# HELP ", "# TYPE "))
+                continue
+            series, _, value = line.rpartition(" ")
+            assert series, line
+            float(value)  # parses as a sample value

@@ -179,3 +179,217 @@ class TestAttestationInBlockFeed:
             and s.attestation_correct_target
             for _, s in included
         )
+
+
+class TestEpochRollupDepth:
+    """Full-depth rollup (ISSUE 9): aggregate rates, head/target miss
+    counters, sync hit/miss, and the client-stats bridge."""
+
+    def test_aggregate_rates_and_miss_counters(self):
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        for i in range(4):
+            vm.register_local_validator(i)
+        # 0: perfect; 1: wrong head, delay 3; 2: wrong target; 3: miss
+        vm.on_attestation_included([0], 1, 1, True, True)
+        vm.on_attestation_included([1], 1, 3, False, True)
+        vm.on_attestation_included([2], 1, 1, True, False)
+        vm.on_epoch_summary(1)
+        text = reg.expose()
+        for needle in (
+            "validator_monitor_prev_epoch_on_chain_attester_hit_total 3",
+            "validator_monitor_prev_epoch_on_chain_attester_miss_total 1",
+            "validator_monitor_prev_epoch_on_chain_head_attester_miss_total 1",
+            "validator_monitor_prev_epoch_on_chain_target_attester_miss_total 1",
+            "validator_monitor_prev_epoch_attestation_hit_rate 0.75",
+            "validator_monitor_prev_epoch_inclusion_distance_avg 1.6666666666666667",
+            'validator_monitor_prev_epoch_inclusion_distance{index="1"} 3',
+            "validator_monitor_validators 4",
+        ):
+            assert needle in text, needle
+        agg = vm.last_epoch_stats
+        assert agg["attestation_hits"] == 3
+        assert agg["attestation_misses"] == 1
+        assert agg["max_inclusion_delay"] == 3
+        assert abs(agg["avg_inclusion_delay"] - 5 / 3) < 1e-9
+
+    def test_sync_committee_hit_miss_counters(self):
+        from lodestar_tpu.params import preset
+
+        slots = preset().SLOTS_PER_EPOCH
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(4)
+        vm.on_sync_committee_membership([4], epoch=2)
+        for s in range(2 * slots, 2 * slots + slots // 2):
+            vm.on_sync_aggregate_included([4], s)
+        vm.on_epoch_summary(2)
+        text = reg.expose()
+        assert (
+            f"validator_monitor_prev_epoch_sync_committee_hits_total {slots // 2}"
+            in text
+        )
+        assert (
+            f"validator_monitor_prev_epoch_sync_committee_misses_total {slots - slots // 2}"
+            in text
+        )
+        agg = vm.last_epoch_stats
+        assert agg["sync_members"] == 1
+        assert agg["sync_hits"] == slots // 2
+
+    def test_proposal_hit_rate(self):
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(2)
+
+        class Blk:
+            proposer_index = 2
+            slot = 9
+
+        vm.on_block_imported(Blk)
+        vm.on_missed_block(2, 10)
+        vm.on_epoch_summary(1)
+        assert (
+            "validator_monitor_prev_epoch_proposal_hit_rate 0.5"
+            in reg.expose()
+        )
+
+    def test_client_stats_validator_section(self):
+        """Satellite: the client-stats push carries sync-committee and
+        inclusion-distance data from the monitor's last rollup."""
+        from lodestar_tpu.metrics.monitoring import (
+            collect_validator_stats,
+        )
+
+        vm = ValidatorMonitor()
+        vm.register_local_validator(0)
+        vm.on_attestation_included([0], 1, 2, True, True)
+        vm.on_sync_committee_membership([0], epoch=1)
+        vm.on_epoch_summary(1)
+
+        class Chain:
+            validator_monitor = vm
+
+        stats = collect_validator_stats(Chain())
+        assert stats["process"] == "validator"
+        assert stats["validator_total"] == 1
+        assert stats["attestation_avg_inclusion_delay"] == 2
+        assert stats["attestation_max_inclusion_delay"] == 2
+        assert stats["sync_committee_members"] == 1
+        assert "sync_committee_hits" in stats
+        assert "sync_committee_misses" in stats
+
+    def test_client_stats_none_without_monitor(self):
+        from lodestar_tpu.metrics.monitoring import (
+            collect_validator_stats,
+        )
+
+        assert collect_validator_stats(None) is None
+
+        class Chain:
+            validator_monitor = None
+
+        assert collect_validator_stats(Chain()) is None
+
+
+class TestInclusionDelayRegression:
+    def test_monitor_catches_two_slot_inclusion_delay(self):
+        """VERDICT task-5 done-criterion: a synthetic 2-slot inclusion
+        delay inside a sim run MUST be visible through the monitor —
+        the instrument that would have caught the r5 bug (avg delay
+        1.74 shipped red because nothing measured it)."""
+        import re
+
+        from lodestar_tpu.chain import DevNode
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.types import ssz_types
+
+        far = 2**64 - 1
+        cfg = ChainConfig(
+            ALTAIR_FORK_EPOCH=far,
+            BELLATRIX_FORK_EPOCH=far,
+            CAPELLA_FORK_EPOCH=far,
+            DENEB_FORK_EPOCH=far,
+            ELECTRA_FORK_EPOCH=far,
+            SHARD_COMMITTEE_PERIOD=0,
+        )
+        types = ssz_types()
+        node = DevNode(cfg, types, 8, verify_attestations=False)
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        for i in range(8):
+            vm.register_local_validator(i)
+        node.chain.validator_monitor = vm
+
+        # synthetic fault: the proposer only packs attestations at
+        # least 2 slots old (the inclusion-delay bug class)
+        orig = node.att_pool.get_attestations_for_block
+
+        def delayed(slot, state=None):
+            return [
+                a
+                for a in orig(slot, state=state)
+                if slot - int(a.data.slot) >= 2
+            ]
+
+        node.att_pool.get_attestations_for_block = delayed
+
+        async def go():
+            await node.run_until(6)
+            await node.close()
+
+        asyncio.run(go())
+
+        out = vm.on_epoch_summary(0)
+        delays = [
+            s.attestation_inclusion_delay
+            for s in out.values()
+            if s.attestation_included
+        ]
+        assert delays, "no inclusions reached the monitor"
+        assert all(d >= 2 for d in delays), delays
+        # the rollup gauge alarms: avg distance over the healthy 1.1
+        # threshold the fork-transition sim enforces
+        assert vm.last_epoch_stats["avg_inclusion_delay"] >= 2
+        m = re.search(
+            r"^validator_monitor_prev_epoch_inclusion_distance_avg"
+            r" (\S+)$",
+            reg.expose(),
+            re.M,
+        )
+        assert m is not None and float(m.group(1)) >= 2
+        # and the histogram saw every delayed inclusion
+        hist = reg.get(
+            "validator_monitor_prev_epoch_attestation_inclusion_delay"
+        )
+        assert hist.get_count() == len(delays)
+        assert hist.get_sum() >= 2 * len(delays)
+
+
+class TestOutageEpochGauges:
+    def test_zero_hit_epoch_resets_aggregate_gauges(self):
+        """A total inclusion outage must drive the alarm gauges to 0 —
+        stale healthy values during the worst case would mask it."""
+        import re
+
+        reg = RegistryMetricCreator()
+        vm = ValidatorMonitor(reg)
+        vm.register_local_validator(0)
+        vm.on_attestation_included([0], 1, 1, True, True)
+        vm.on_epoch_summary(1)
+
+        def val(name):
+            m = re.search(rf"^{name} (\S+)$", reg.expose(), re.M)
+            return float(m.group(1))
+
+        assert val(
+            "validator_monitor_prev_epoch_inclusion_distance_avg"
+        ) == 1.0
+        vm.on_epoch_summary(2)  # nothing included: outage epoch
+        for name in (
+            "validator_monitor_prev_epoch_attestation_hit_rate",
+            "validator_monitor_prev_epoch_inclusion_distance_avg",
+            "validator_monitor_prev_epoch_head_correctness_rate",
+            "validator_monitor_prev_epoch_target_correctness_rate",
+        ):
+            assert val(name) == 0.0, name

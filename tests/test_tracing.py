@@ -1,0 +1,310 @@
+"""Block-import span tracing (metrics/tracing.py).
+
+Covers the ISSUE 9 tentpole contract: nestable sync/async spans with
+an injectable clock, stage accumulation, the bounded slow-trace ring
+buffer, the histogram bridge, and — end to end — a dev-chain run whose
+per-stage trace (all eight stages, non-negative durations) is served
+by the /eth/v1/lodestar/block_import_traces admin route.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from lodestar_tpu.metrics import (
+    RegistryMetricCreator,
+    create_lodestar_metrics,
+)
+from lodestar_tpu.metrics.tracing import (
+    BLOCK_IMPORT_STAGES,
+    NULL_TRACE,
+    TraceBuffer,
+    Tracer,
+    child_span,
+    current_span,
+)
+
+
+class FakeClock:
+    """Injectable deterministic clock."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _bridged_tracer(slow_ms=0.0, buffer_size=64, clock=None):
+    reg = RegistryMetricCreator()
+    m = create_lodestar_metrics(reg)
+    return (
+        Tracer(
+            metrics=m.tracing,
+            slow_ms=slow_ms,
+            buffer_size=buffer_size,
+            clock=clock,
+        ),
+        reg,
+    )
+
+
+class TestSpanNesting:
+    def test_sync_nesting_builds_tree(self):
+        clk = FakeClock()
+        tr = Tracer(clock=clk)
+        with tr.span("outer") as outer:
+            clk.advance(1.0)
+            with tr.span("mid") as mid:
+                clk.advance(0.5)
+                with tr.span("leaf") as leaf:
+                    clk.advance(0.25)
+            clk.advance(1.0)
+        assert outer.children == [mid]
+        assert mid.children == [leaf]
+        assert leaf.duration == 0.25
+        assert mid.duration == 0.75
+        assert outer.duration == 2.75
+        assert current_span() is None  # all tokens reset
+
+    def test_siblings_after_close(self):
+        tr = Tracer()
+        with tr.span("root") as root:
+            with tr.span("a"):
+                pass
+            with tr.span("b"):
+                pass
+        assert [c.name for c in root.children] == ["a", "b"]
+
+    def test_child_span_noop_without_trace(self):
+        assert current_span() is None
+        with child_span("orphan") as span:
+            assert span is None  # no active trace: no-op
+
+    def test_async_spans_nest_across_tasks(self):
+        """The sig_verify pattern: a task spawned while a span is
+        current sees it as parent via the copied contextvars."""
+        tr = Tracer()
+
+        async def go():
+            with tr.span("stage") as stage:
+
+                async def worker():
+                    with child_span("job") as job:
+                        await asyncio.sleep(0)
+                    return job
+
+                fut = asyncio.ensure_future(worker())
+                # the span opened inside the task must not leak into
+                # this task's context
+                with tr.span("inline"):
+                    pass
+                job = await fut
+            return stage, job
+
+        stage, job = asyncio.run(go())
+        assert job in stage.children
+        assert {c.name for c in stage.children} == {"job", "inline"}
+
+    def test_concurrent_tasks_do_not_cross_nest(self):
+        tr = Tracer()
+
+        async def go():
+            with tr.span("root") as root:
+
+                async def worker(name):
+                    with tr.span(name) as s:
+                        await asyncio.sleep(0.001)
+                        with tr.span(name + "_inner") as inner:
+                            await asyncio.sleep(0.001)
+                    return s, inner
+
+                (a, ai), (b, bi) = await asyncio.gather(
+                    worker("a"), worker("b")
+                )
+            return root, a, ai, b, bi
+
+        root, a, ai, b, bi = asyncio.run(go())
+        assert a.children == [ai] and b.children == [bi]
+        assert set(root.children) == {a, b}
+
+
+class TestTraceBuffer:
+    def test_ring_buffer_bounds(self):
+        buf = TraceBuffer(maxlen=3)
+        for i in range(10):
+            buf.add({"slot": i})
+        assert len(buf) == 3
+        assert [t["slot"] for t in buf.snapshot()] == [7, 8, 9]
+        assert buf.added_total == 10
+
+    def test_slow_threshold_filters(self):
+        clk = FakeClock()
+        tr, reg = _bridged_tracer(slow_ms=100.0, clock=clk)
+        # fast import: below threshold, not buffered
+        fast = tr.block_import_trace(1)
+        clk.advance(0.050)
+        fast.finish(block_root=b"\x01" * 32)
+        assert len(tr.buffer) == 0
+        # slow import: buffered + counted
+        slow = tr.block_import_trace(2)
+        clk.advance(0.500)
+        slow.finish(block_root=b"\x02" * 32)
+        assert [t["slot"] for t in tr.buffer.snapshot()] == [2]
+        assert (
+            "lodestar_block_import_slow_traces_total 1" in reg.expose()
+        )
+
+    def test_failed_import_always_buffered(self):
+        clk = FakeClock()
+        tr, _ = _bridged_tracer(slow_ms=1e9, clock=clk)
+        t = tr.block_import_trace(3)
+        clk.advance(0.001)
+        t.finish(error=RuntimeError("bad block"))
+        [item] = tr.buffer.snapshot()
+        assert "bad block" in item["error"]
+
+
+class TestImportTrace:
+    def test_all_canonical_stages_defaulted(self):
+        tr, _ = _bridged_tracer()
+        t = tr.block_import_trace(7)
+        t.finish()
+        item = t.to_dict()
+        got = {s["stage"]: s["duration_ms"] for s in item["stages"]}
+        for name in BLOCK_IMPORT_STAGES:
+            assert got[name] >= 0.0
+        assert set(got) >= set(BLOCK_IMPORT_STAGES)
+
+    def test_stage_accumulation(self):
+        clk = FakeClock()
+        tr = Tracer(clock=clk, slow_ms=0)
+        t = tr.block_import_trace(1)
+        with t.stage("state_transition"):
+            clk.advance(0.25)
+        with t.stage("state_transition"):
+            clk.advance(0.5)
+        t.finish()
+        assert abs(t.stages["state_transition"] - 0.75) < 1e-9
+
+    def test_open_stage_closed_by_finish(self):
+        clk = FakeClock()
+        tr = Tracer(clock=clk, slow_ms=0)
+        t = tr.block_import_trace(1)
+        t.begin_stage("sig_verify")
+        clk.advance(0.1)
+        t.finish(error="aborted")  # never end_stage'd
+        assert abs(t.stages["sig_verify"] - 0.1) < 1e-9
+
+    def test_histogram_bridge_labels_every_stage(self):
+        tr, reg = _bridged_tracer()
+        t = tr.block_import_trace(1)
+        with t.stage("forkchoice"):
+            pass
+        t.finish(block_root=b"\x05" * 32)
+        text = reg.expose()
+        for name in BLOCK_IMPORT_STAGES:
+            assert (
+                f'lodestar_block_import_stage_seconds_bucket{{stage="{name}"'
+                in text
+            )
+        # total bridges into the chain import histogram
+        assert "lodestar_block_import_seconds_count 1" in text
+
+    def test_finish_idempotent(self):
+        tr, _ = _bridged_tracer()
+        t = tr.block_import_trace(1)
+        t.finish()
+        t.finish()
+        assert len(tr.buffer) == 1
+
+    def test_null_trace_is_inert(self):
+        with NULL_TRACE.stage("x"):
+            pass
+        span = NULL_TRACE.begin_stage("y")
+        NULL_TRACE.end_stage(span)
+        NULL_TRACE.add_stage("z", 1.0)
+        assert NULL_TRACE.finish() == {}
+        assert current_span() is None
+
+
+def _dev_cfg():
+    from lodestar_tpu.config.chain_config import ChainConfig
+
+    far = 2**64 - 1
+    return ChainConfig(
+        ALTAIR_FORK_EPOCH=far,
+        BELLATRIX_FORK_EPOCH=far,
+        CAPELLA_FORK_EPOCH=far,
+        DENEB_FORK_EPOCH=far,
+        ELECTRA_FORK_EPOCH=far,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+
+
+class TestSlowTraceAdminRoute:
+    def test_devchain_trace_served_by_admin_route(self):
+        """Acceptance: a sim run produces a complete per-stage
+        block-import trace — all eight stages present with
+        non-negative durations — via the slow-trace admin route."""
+        from lodestar_tpu.api.impl import BeaconApiImpl
+        from lodestar_tpu.api.routes import match_route
+        from lodestar_tpu.chain import DevNode
+        from lodestar_tpu.types import ssz_types
+
+        cfg = _dev_cfg()
+        types = ssz_types()
+        node = DevNode(cfg, types, 16, verify_attestations=False)
+        tracer, reg = _bridged_tracer(slow_ms=0.0)  # record everything
+        node.chain.tracer = tracer
+
+        async def go():
+            await node.run_until(3)
+            await node.close()
+
+        asyncio.run(go())
+
+        impl = BeaconApiImpl(cfg, types, node.chain)
+        matched = match_route(
+            "GET", "/eth/v1/lodestar/block_import_traces"
+        )
+        assert matched is not None, "admin route not registered"
+        route, params = matched
+        traces = getattr(impl, route.impl_name)(**params)
+        assert len(traces) == 3  # one per imported block
+        for t in traces:
+            assert t["error"] is None
+            assert t["total_ms"] > 0
+            got = {
+                s["stage"]: s["duration_ms"] for s in t["stages"]
+            }
+            for name in BLOCK_IMPORT_STAGES:
+                assert name in got and got[name] >= 0.0, (name, got)
+            # real work happened in these stages on every import
+            assert got["sig_verify"] > 0
+            assert got["state_transition"] > 0
+        # stage histograms populated through the bridge
+        text = reg.expose()
+        assert (
+            'lodestar_block_import_stage_seconds_count{stage="sig_verify"} 3'
+            in text
+        )
+        # the verifier's job span nested under sig_verify
+        last = traces[-1]
+        sig = [
+            s for s in last["stages"] if s["stage"] == "sig_verify"
+        ][0]
+        names = [c["name"] for c in sig.get("children", ())]
+        assert "bls_verify_job" in names
+
+    def test_no_tracer_empty_route(self):
+        from lodestar_tpu.api.impl import BeaconApiImpl
+
+        class Chain:
+            tracer = None
+
+        impl = BeaconApiImpl(None, None, Chain())
+        assert impl.get_block_import_traces() == []

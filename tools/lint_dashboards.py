@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Dashboard lint: every metric a Grafana panel references must exist.
+
+Walks every `dashboards/*.json` panel target expr, extracts the metric
+names the PromQL references, and fails (exit 1) when a name is not
+registered by the node's metric catalog — metrics/beacon.py,
+metrics/validator_monitor.py, the resilience family, or the tracing
+bridge. Histogram bases contribute their `_bucket`/`_sum`/`_count`
+series.
+
+Runs inside tier 1 (tools/run_tests.sh + tests/test_dashboards_lint.py)
+so a renamed or deleted metric can never leave a dashboard silently
+flat-lining again.
+
+Usage: python tools/lint_dashboards.py [dashboards_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# PromQL functions / keywords / modifiers that look like identifiers
+_NOT_METRICS = {
+    # aggregations + functions
+    "rate", "irate", "increase", "delta", "idelta", "deriv", "resets",
+    "histogram_quantile", "quantile", "sum", "min", "max", "avg",
+    "count", "count_values", "topk", "bottomk", "stddev", "stdvar",
+    "abs", "ceil", "floor", "round", "clamp", "clamp_min", "clamp_max",
+    "changes", "absent", "scalar", "vector", "time", "timestamp",
+    "label_replace", "label_join", "sort", "sort_desc", "exp", "ln",
+    "log2", "log10", "sqrt", "predict_linear", "avg_over_time",
+    "min_over_time", "max_over_time", "sum_over_time",
+    "count_over_time", "last_over_time", "quantile_over_time",
+    # keywords / modifiers / set ops
+    "by", "without", "on", "ignoring", "group_left", "group_right",
+    "offset", "and", "or", "unless", "bool",
+    # special label
+    "le",
+}
+
+_IDENT = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+
+
+def registered_metric_names() -> set[str]:
+    """Every series name the live /metrics endpoint can expose."""
+    from lodestar_tpu.metrics import (
+        Histogram,
+        RegistryMetricCreator,
+        create_lodestar_metrics,
+    )
+    from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+    from lodestar_tpu.resilience import create_resilience_metrics
+
+    reg = RegistryMetricCreator()
+    create_lodestar_metrics(reg)
+    create_resilience_metrics(reg)
+    ValidatorMonitor(reg)
+    names: set[str] = set()
+    for name, metric in reg._metrics.items():
+        names.add(name)
+        if isinstance(metric, Histogram):
+            names.update(
+                {f"{name}_bucket", f"{name}_sum", f"{name}_count"}
+            )
+    return names
+
+
+def metric_names_in_expr(expr: str) -> set[str]:
+    """Identifiers in a PromQL expr that can only be metric names."""
+    # strip label matchers {...} (their contents are label names and
+    # quoted values) and grouping clauses `by (...)` / `without (...)`
+    expr = re.sub(r"\{[^}]*\}", "", expr)
+    expr = re.sub(
+        r"\b(by|without|on|ignoring|group_left|group_right)\s*"
+        r"\(([^)]*)\)",
+        " ",
+        expr,
+    )
+    expr = re.sub(r"\[[^\]]*\]", "", expr)  # range selectors [5m]
+    expr = re.sub(r'"[^"]*"', "", expr)  # string literals
+    return {
+        tok
+        for tok in _IDENT.findall(expr)
+        if tok not in _NOT_METRICS
+    }
+
+
+def iter_panel_exprs(dashboard: dict):
+    for panel in dashboard.get("panels", []):
+        title = panel.get("title", "<untitled>")
+        for target in panel.get("targets", []):
+            expr = target.get("expr")
+            if expr:
+                yield title, expr
+        # nested row panels
+        for sub in panel.get("panels", []):
+            for target in sub.get("targets", []):
+                expr = target.get("expr")
+                if expr:
+                    yield sub.get("title", title), expr
+
+
+def lint(dash_dir: Path) -> int:
+    known = registered_metric_names()
+    files = sorted(dash_dir.glob("*.json"))
+    if not files:
+        print(f"no dashboards found under {dash_dir}", file=sys.stderr)
+        return 1
+    bad = 0
+    for path in files:
+        dashboard = json.loads(path.read_text())
+        n_exprs = 0
+        unknown: list[tuple[str, str, set]] = []
+        for title, expr in iter_panel_exprs(dashboard):
+            n_exprs += 1
+            missing = metric_names_in_expr(expr) - known
+            if missing:
+                unknown.append((title, expr, missing))
+        if unknown:
+            bad += 1
+            print(f"FAIL {path.name}:")
+            for title, expr, missing in unknown:
+                print(
+                    f"  panel {title!r}: unknown metric(s) "
+                    f"{sorted(missing)}\n    expr: {expr}"
+                )
+        else:
+            print(f"ok   {path.name}: {n_exprs} exprs, 0 unknown")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    target = (
+        Path(sys.argv[1]) if len(sys.argv) > 1 else REPO / "dashboards"
+    )
+    sys.exit(lint(target))

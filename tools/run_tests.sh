@@ -18,7 +18,10 @@ cd "$(dirname "$0")/.."
 tier="${1:-tier1}"
 
 run_tier1() {
-  # byte-identical to ROADMAP.md "Tier-1 verify"
+  # dashboard lint first (also covered by tests/test_dashboards_lint.py
+  # inside the pytest run): a dangling panel metric fails the tier
+  JAX_PLATFORMS=cpu python tools/lint_dashboards.py || exit 1
+  # pytest line byte-identical to ROADMAP.md "Tier-1 verify"
   set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
 }
 
