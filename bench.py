@@ -172,6 +172,8 @@ def main() -> None:
         # not absolute throughput
         global N_JOBS, SETS_PER_JOB, WAVES
         N_JOBS, SETS_PER_JOB, WAVES = 4, 16, 2
+    from lodestar_tpu.utils.provenance import provenance
+
     sets_per_sec = asyncio.run(_run())
     print(
         json.dumps(
@@ -186,6 +188,7 @@ def main() -> None:
                 "vs_baseline": round(
                     sets_per_sec / BASELINE_SETS_PER_SEC, 4
                 ),
+                "provenance": provenance(),
             }
         )
     )

@@ -1349,6 +1349,46 @@ class BeaconApiImpl:
             "top": [str(s) for s in top],
         }
 
+    async def device_trace(self, duration_ms: str = "") -> dict:
+        """Admin-triggered jax.profiler capture (the device-layer
+        sibling of write_profile): runs the profiler for the requested
+        window — bounded by the node's --device-trace-max-ms knob, one
+        capture at a time — and returns the trace directory for
+        offline inspection (TensorBoard / xprof). The sleep runs in an
+        executor so the chain's event loop keeps serving."""
+        import asyncio
+        import functools
+
+        from ..metrics import device as device_telemetry
+
+        max_ms = (
+            getattr(self.node, "device_trace_max_ms", 5000.0)
+            if self.node is not None
+            else 5000.0
+        )
+        try:
+            ms = float(duration_ms) if duration_ms else 100.0
+        except ValueError:
+            raise ApiError(
+                400, f"bad duration_ms {duration_ms!r}"
+            ) from None
+        ms = min(float(max_ms), max(1.0, ms))
+        out_dir = (
+            getattr(self.node, "device_trace_dir", None)
+            if self.node is not None
+            else None
+        )
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None,
+                functools.partial(
+                    device_telemetry.profiler_capture, ms, out_dir
+                ),
+            )
+        except device_telemetry.CaptureBusyError as e:
+            raise ApiError(409, str(e)) from None
+        return result
+
     def get_gossip_queue_items(self) -> list:
         proc = getattr(self.node, "processor", None) if self.node else None
         if proc is None:

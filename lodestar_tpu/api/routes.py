@@ -464,6 +464,13 @@ ROUTES: list[Route] = [
         "/eth/v1/lodestar/block_import_traces",
         "get_block_import_traces",
     ),
+    Route(
+        "writeDeviceTrace",
+        "POST",
+        "/eth/v1/lodestar/device_trace",
+        "device_trace",
+        query_params=("duration_ms",),
+    ),
     # proof namespace (routes/proof.ts)
     Route(
         "getStateProof",

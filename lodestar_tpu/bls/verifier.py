@@ -56,6 +56,7 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from ..crypto.bls import curve as oc
+from ..metrics import device as _device
 from ..ops import curve as C
 from . import api, kernels
 
@@ -125,6 +126,10 @@ class _Job:
     created_at: float = 0.0  # caller submit time (latency histogram)
     enqueued_at: float = 0.0
     prepared: list | None = None
+    # dispatch-to-readback time of the wave that carried this job's
+    # verdict — grafted under the caller's bls_verify_job span as a
+    # backdated device child (metrics/tracing.attach_completed_span)
+    device_s: float = 0.0
 
 
 class LatencyHistogram:
@@ -396,6 +401,12 @@ class TpuBlsVerifier:
             < self._queue_max
         )
 
+    @property
+    def in_flight_waves(self) -> int:
+        """Waves dispatched to the device and not yet finalized — the
+        device dispatch-queue depth (lodestar_jax_dispatch_queue_depth)."""
+        return len(self._finalizers)
+
     async def verify_signature_sets(
         self,
         sets: list[api.SignatureSet],
@@ -411,7 +422,7 @@ class TpuBlsVerifier:
         sig_verify stage, metrics/tracing.py), this job's submit-to-
         verdict interval lands as a nested span in the trace tree —
         the contextvar copied at task spawn carries the parent."""
-        from ..metrics.tracing import child_span
+        from ..metrics.tracing import attach_completed_span, child_span
 
         with child_span("bls_verify_job"):
             self._ensure_runner()
@@ -429,7 +440,11 @@ class TpuBlsVerifier:
                     )
             else:
                 self._enqueue([job], priority)
-            return await fut
+            ok = await fut
+            # device-side child span under this job's span: the wave's
+            # dispatch-to-readback interval, learned at finalize
+            attach_completed_span("device_wave", job.device_s)
+            return ok
 
     async def verify_signature_sets_same_message(
         self, sets: list[api.SameMessageSet], message: bytes
@@ -716,6 +731,11 @@ class TpuBlsVerifier:
                 return None
             return prepared
 
+        # first device dispatch of the wave: the device interval the
+        # jobs' device_wave spans report starts here, not at wave t0
+        # (host prep ahead of it must not masquerade as device time)
+        first_dispatch: list[float | None] = [None]
+
         prep_futs: dict[int, asyncio.Future] = {}
         live: list[_Job] = []
         for j in jobs:
@@ -808,6 +828,8 @@ class TpuBlsVerifier:
                     if not parts:
                         return None
                     sets = [s for _, part in parts for s in part]
+            if first_dispatch[0] is None:
+                first_dispatch[0] = time.monotonic()
             ok = await loop.run_in_executor(
                 None, self._submit_bucket, sets
             )
@@ -819,7 +841,7 @@ class TpuBlsVerifier:
         )
         buckets = [r[0] for r in results if r is not None]
         oks = [r[1] for r in results if r is not None]
-        return buckets, oks
+        return buckets, oks, first_dispatch[0]
 
     def _host_sig_valid(self, s: "_PreparedSet") -> bool:
         """Does this set's signature survive host decompression? Uses
@@ -840,9 +862,19 @@ class TpuBlsVerifier:
     async def _finalize_wave(self, wave, t0: float):
         """One readback for the whole wave; failed buckets retry
         per job, then per set (worker.ts:88-103 isolation)."""
-        buckets, oks = wave
+        buckets, oks, t_dispatch = wave
         try:
             verdicts = await self._readback(oks)
+            # verdicts are on host: the device work for every job in
+            # the wave is done — stamp the first-dispatch-to-readback
+            # interval on each job so its awaiting caller can graft a
+            # device child span (host prep ahead of the first dispatch
+            # is excluded: it must not masquerade as device time)
+            if t_dispatch is not None:
+                dt_dev = time.monotonic() - t_dispatch
+                for b in buckets:
+                    for j, _part in b:
+                        j.device_s = dt_dev
             # a job's direct verdict is the AND over every bucket part
             # that carried its sets
             job_ok: dict[int, bool] = {}
@@ -959,6 +991,9 @@ class TpuBlsVerifier:
                 u1 = parallel.shard_batch(mesh, u1)
                 bits = parallel.shard_batch(mesh, bits)
                 mask = parallel.shard_batch(mesh, mask)
+            _device.record_transfer(
+                "h2d", pk_dev, sig_x, sig_sign, u0, u1, bits, mask
+            )
             out = kernels.run_verify_batch_ingest_async(
                 pk_dev, sig_x, sig_sign, u0, u1, bits, mask
             )
@@ -1007,6 +1042,7 @@ class TpuBlsVerifier:
             sig_dev = parallel.shard_batch(mesh, sig_dev)
             bits = parallel.shard_batch(mesh, bits)
             mask = parallel.shard_batch(mesh, mask)
+        _device.record_transfer("h2d", pk_dev, h, sig_dev, bits, mask)
         return kernels.run_verify_batch_async(
             pk_dev, h, sig_dev, bits, mask
         )
@@ -1020,6 +1056,7 @@ class TpuBlsVerifier:
 
             if not oks:
                 return []
+            _device.record_transfer("d2h", oks)
             if len(oks) == 1:
                 return [bool(oks[0])]
             return [bool(v) for v in np.asarray(jnp.stack(oks))]
@@ -1105,6 +1142,9 @@ class TpuBlsVerifier:
                 ] * pad
                 sig_x = tower.fq2_from_ints(sxs)
                 sig_sign = jnp.asarray(sgs)
+                _device.record_transfer(
+                    "h2d", pk_dev, h_dev, sig_x, sig_sign, bits, mask
+                )
                 out = kernels.run_verify_same_message_ingest_async(
                     pk_dev,
                     (h_dev.x, h_dev.y),
@@ -1129,6 +1169,9 @@ class TpuBlsVerifier:
                 )
             ] * pad
             sig_dev = C.g2_batch_from_ints(sigs)
+            _device.record_transfer(
+                "h2d", pk_dev, h_dev, sig_dev, bits, mask
+            )
             return kernels.run_verify_same_message(
                 pk_dev, (h_dev.x, h_dev.y), sig_dev, bits, mask
             )

@@ -147,6 +147,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "--trace-buffer-size", type=int, default=64,
         help="how many slow block-import traces the ring buffer keeps",
     )
+    beacon.add_argument(
+        "--device-timing", choices=("off", "dispatch", "sync"),
+        default="dispatch",
+        help="device telemetry depth (metrics/device.py): 'dispatch' "
+        "times stage calls and attributes XLA compiles/retraces; "
+        "'sync' adds per-stage dispatch-to-ready deltas via "
+        "block_until_ready (serializes the host against each stage — "
+        "debugging only); 'off' disables the kernel hooks",
+    )
+    beacon.add_argument(
+        "--device-trace-max-ms", type=float, default=5000.0,
+        help="upper bound a POST /eth/v1/lodestar/device_trace capture "
+        "may request (jax.profiler runs for the requested window; one "
+        "capture at a time)",
+    )
+    beacon.add_argument(
+        "--device-trace-dir", default=None,
+        help="directory for on-demand device trace captures (default: "
+        "a fresh temp dir per capture)",
+    )
 
     lc = sub.add_parser(
         "lightclient",
@@ -375,6 +395,9 @@ async def _run_beacon(args) -> int:
         ),
         trace_slow_slot_ms=args.trace_slow_slot_ms,
         trace_buffer_size=args.trace_buffer_size,
+        device_timing=args.device_timing,
+        device_trace_max_ms=args.device_trace_max_ms,
+        device_trace_dir=args.device_trace_dir,
     )
     node.notify_status()
     try:

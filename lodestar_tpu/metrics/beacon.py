@@ -589,6 +589,124 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 10),
     )
 
+    # -- device / XLA compiler telemetry (metrics/device.py) -------------
+    # The execution layer the perf program lives in: stage compiles,
+    # retrace storms, the persistent compilation cache, ingest warmup,
+    # HBM/live-buffer footprint, host<->device transfer volume, and
+    # the on-demand jax.profiler capture. Drives
+    # dashboards/lodestar_tpu_device.json.
+    dv = SimpleNamespace()
+    m.device = dv
+    dv.compiles_total = reg.gauge(
+        "lodestar_jax_compiles_total",
+        "XLA backend compiles by pipeline stage",
+        label_names=("stage",),
+    )
+    dv.compile_seconds_total = reg.gauge(
+        "lodestar_jax_compile_seconds_total",
+        "Cumulative XLA backend-compile seconds by pipeline stage",
+        label_names=("stage",),
+    )
+    dv.retraces_total = reg.gauge(
+        "lodestar_jax_retraces_total",
+        "Stage entry points recompiling an argument signature they"
+        " already served (retrace storm detector)",
+        label_names=("stage",),
+    )
+    dv.persistent_cache_hits_total = reg.gauge(
+        "lodestar_jax_persistent_cache_hits_total",
+        "Compiles served from the persistent XLA compilation cache",
+    )
+    dv.persistent_cache_misses_total = reg.gauge(
+        "lodestar_jax_persistent_cache_misses_total",
+        "Compiles the persistent XLA compilation cache could not serve",
+    )
+    dv.persistent_cache_errors_total = reg.gauge(
+        "lodestar_jax_persistent_cache_errors_total",
+        "Persistent-cache setup/IO failures (cold-cache node detector,"
+        " utils/jaxcache.py)",
+    )
+    dv.cache_retrieval_seconds_total = reg.gauge(
+        "lodestar_jax_persistent_cache_retrieval_seconds_total",
+        "Cumulative time spent loading compiled artifacts from the"
+        " persistent cache",
+    )
+    dv.warmup_progress = reg.gauge(
+        "lodestar_jax_warmup_progress",
+        "Ingest warmup progress per pipeline: warm_buckets /"
+        " eligible_buckets (bls/kernels.warmup_ingest)",
+        label_names=("pipeline",),
+    )
+    dv.warmup_warm_buckets = reg.gauge(
+        "lodestar_jax_warmup_warm_buckets",
+        "Ingest bucket sizes whose compile is warm, per pipeline",
+        label_names=("pipeline",),
+    )
+    dv.warmup_eligible_buckets = reg.gauge(
+        "lodestar_jax_warmup_eligible_buckets",
+        "Ingest-eligible bucket sizes (the warmup target), per pipeline",
+        label_names=("pipeline",),
+    )
+    dv.stage_dispatch_seconds = reg.histogram(
+        "lodestar_jax_stage_dispatch_seconds",
+        "Wall time of each instrumented stage call (trace + lower +"
+        " compile-or-load + enqueue; async dispatch excludes device"
+        " execution)",
+        label_names=("stage",),
+        buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 1, 10, 60, 600),
+    )
+    dv.stage_device_seconds = reg.histogram(
+        "lodestar_jax_stage_device_seconds",
+        "Dispatch-to-ready device time per stage (block_until_ready"
+        " deltas; only populated with --device-timing sync)",
+        label_names=("stage",),
+        buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+    )
+    dv.device_bytes_in_use = reg.gauge(
+        "lodestar_jax_device_bytes_in_use",
+        "Device memory in use (allocator stats on TPU/GPU; live-buffer"
+        " fallback on CPU backends)",
+        label_names=("device",),
+    )
+    dv.device_bytes_limit = reg.gauge(
+        "lodestar_jax_device_bytes_limit",
+        "Device memory capacity where the backend reports one",
+        label_names=("device",),
+    )
+    dv.live_buffers = reg.gauge(
+        "lodestar_jax_live_buffers",
+        "Live jax.Array count in the process",
+    )
+    dv.live_buffer_bytes = reg.gauge(
+        "lodestar_jax_live_buffer_bytes",
+        "Total bytes held by live jax.Arrays",
+    )
+    dv.transfer_bytes_total = reg.gauge(
+        "lodestar_jax_transfer_bytes_total",
+        "Host<->device transfer bytes at the verifier's dispatch and"
+        " readback seams",
+        label_names=("direction",),
+    )
+    dv.dispatch_queue_depth = reg.gauge(
+        "lodestar_jax_dispatch_queue_depth",
+        "Device waves dispatched and not yet finalized"
+        " (TpuBlsVerifier.in_flight_waves)",
+    )
+    dv.backend_switches_total = reg.gauge(
+        "lodestar_jax_backend_switches_total",
+        "Limb-backend switches that dropped every cached jit trace"
+        " (ops/limbs.set_backend)",
+    )
+    dv.trace_captures_total = reg.gauge(
+        "lodestar_jax_device_trace_captures_total",
+        "On-demand jax.profiler captures served by"
+        " POST /eth/v1/lodestar/device_trace",
+    )
+    dv.trace_capture_active = reg.gauge(
+        "lodestar_jax_device_trace_active",
+        "1 while an on-demand profiler capture is running",
+    )
+
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
     k = SimpleNamespace()
     m.clock = k

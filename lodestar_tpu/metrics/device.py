@@ -1,0 +1,613 @@
+"""Device/compiler telemetry: the JAX/XLA execution layer on /metrics.
+
+The perf program lives in the execution layer — multi-minute stage
+compiles, the persistent compilation cache (utils/jaxcache.py), the
+bucket ladder + `warmup_ingest()`, the vpu/mxu backend switch — yet a
+retrace storm, a cold persistent cache, or a warmup that never
+finishes all look identical to "the TPU is slow" from the outside.
+This module makes the layer first-class, the way production batched-
+accelerator systems (Orca/vLLM-style continuous batching, PAPERS.md)
+treat compile-cache and device-utilization telemetry as table stakes:
+
+  * compile & cache tracking — `jax.monitoring` listeners route
+    backend-compile durations and persistent-cache hit/miss events
+    into per-stage counters; instrumented wrappers around the
+    `bls/kernels.py` jit entry points attribute each compile to its
+    pipeline stage and detect RETRACES (the same entry point
+    recompiling for an argument signature it already served — the
+    signature of a `jax.clear_caches()` / limb-backend-switch storm);
+  * device runtime — per-stage dispatch wall time always, optional
+    dispatch-to-ready deltas (`timing="sync"`) fed into histograms
+    and attached as device-side child spans under the block-import
+    trace (metrics/tracing.py); live-buffer/HBM accounting via
+    `Device.memory_stats()` with a `jax.live_arrays()` fallback for
+    backends that expose none (CPU); host<->device transfer byte
+    accounting at the verifier's dispatch/readback seams;
+  * on-demand capture — `profiler_capture()` runs `jax.profiler` for
+    a bounded window (one capture at a time) behind the
+    `POST /eth/v1/lodestar/device_trace` admin route, mirroring the
+    reference's write_profile/write_heapdump ops routes.
+
+The singleton (`install()` / `get_telemetry()`) exists only once a
+node or test asks for it: with no telemetry installed every hook in
+the kernels is a single attribute check, so benches and tools measure
+the uninstrumented pipeline unless they opt in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+# jax.monitoring event names this module consumes (the stable names
+# jax has emitted since 0.4.x; unknown events are ignored, so a jax
+# upgrade degrades to "no data", never to an error).
+EV_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+EV_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+EV_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+EV_CACHE_MISS = "/jax/compilation_cache/cache_misses"
+EV_CACHE_RETRIEVAL = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+TIMING_MODES = ("off", "dispatch", "sync")
+
+# stage label used when a compile fires outside any instrumented
+# stage scope (ad-hoc jit in tools, tests, warmup glue)
+OTHER_STAGE = "other"
+
+_TELEMETRY: "DeviceTelemetry | None" = None
+_LISTENERS_INSTALLED = False
+_INSTALL_LOCK = threading.Lock()
+
+# persistent-cache setup errors recorded before any telemetry exists
+# (utils/jaxcache.enable runs at bls.kernels import time); absorbed by
+# the next install()
+_PENDING_CACHE_ERRORS = 0
+
+_capture_lock = threading.Lock()
+
+
+class CaptureBusyError(RuntimeError):
+    """A profiler capture is already running (one at a time)."""
+
+
+def get_telemetry() -> "DeviceTelemetry | None":
+    return _TELEMETRY
+
+
+def set_telemetry(t: "DeviceTelemetry | None") -> "DeviceTelemetry | None":
+    """Swap the module singleton (tests install a fresh instance so
+    counter assertions never see another test's compiles)."""
+    global _TELEMETRY
+    _TELEMETRY = t
+    return t
+
+
+def install(metrics=None, timing: str | None = None) -> "DeviceTelemetry":
+    """Create (or return) the process singleton, register the
+    jax.monitoring listeners once, and bind the registry namespace.
+    Listeners are global and permanent — they route through the
+    CURRENT singleton, so swapping instances re-targets them."""
+    global _TELEMETRY, _PENDING_CACHE_ERRORS
+    with _INSTALL_LOCK:
+        if _TELEMETRY is None:
+            _TELEMETRY = DeviceTelemetry()
+        if _PENDING_CACHE_ERRORS:
+            _TELEMETRY.cache_errors += _PENDING_CACHE_ERRORS
+            _PENDING_CACHE_ERRORS = 0
+        if timing is not None:
+            _TELEMETRY.set_timing(timing)
+        if metrics is not None:
+            _TELEMETRY.bind(metrics)
+        _install_listeners()
+        return _TELEMETRY
+
+
+def _install_listeners() -> None:
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _LISTENERS_INSTALLED = True
+
+
+def _on_event(name: str, **kwargs) -> None:
+    t = _TELEMETRY
+    if t is None:
+        return
+    if name == EV_CACHE_HIT:
+        t.on_cache_hit()
+    elif name == EV_CACHE_MISS:
+        t.on_cache_miss()
+    elif name.startswith("/jax/compilation_cache/") and "error" in name:
+        t.on_cache_error()
+
+
+def _on_duration(name: str, secs: float, **kwargs) -> None:
+    t = _TELEMETRY
+    if t is None:
+        return
+    if name == EV_BACKEND_COMPILE:
+        t.on_backend_compile(secs)
+    elif name == EV_CACHE_RETRIEVAL:
+        t.on_cache_retrieval(secs)
+
+
+def record_cache_error() -> None:
+    """Persistent-cache setup/IO failure (utils/jaxcache.enable). Works
+    before install(): early errors park in a module counter the next
+    install() absorbs, so a cold-cache node is diagnosable even when
+    the failure happened at import time."""
+    global _PENDING_CACHE_ERRORS
+    t = _TELEMETRY
+    if t is not None:
+        t.on_cache_error()
+    else:
+        _PENDING_CACHE_ERRORS += 1
+
+
+def tree_nbytes(*trees) -> int:
+    """Total array bytes across pytrees (device dispatch payloads)."""
+    import jax
+
+    total = 0
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def record_transfer(direction: str, *trees) -> None:
+    """Host<->device transfer accounting ('h2d' / 'd2h') for the
+    given dispatch payload pytrees. The byte walk only runs when
+    telemetry is installed — an uninstrumented bench pays one None
+    check per dispatch, nothing more."""
+    t = _TELEMETRY
+    if t is not None:
+        t.on_transfer(direction, tree_nbytes(*trees))
+
+
+class DeviceTelemetry:
+    """Counters + per-stage timing for the XLA execution layer.
+
+    Plain-dict counters guarded by one lock (increments come from
+    executor threads, the warmup thread, and monitoring listeners);
+    the registry bridges them at scrape time via add_collect, the
+    same pattern as BlsVerifierMetrics (bls/verifier.py)."""
+
+    def __init__(self, timing: str = "dispatch"):
+        self.set_timing(timing)
+        self._lock = threading.Lock()
+        # compile & cache
+        self.compiles: dict[str, int] = {}
+        self.compile_seconds: dict[str, float] = {}
+        self.retraces: dict[str, int] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_errors = 0
+        self.cache_retrieval_seconds = 0.0
+        # device runtime
+        self.dispatch_count: dict[str, int] = {}
+        self.dispatch_seconds: dict[str, float] = {}
+        self.device_count: dict[str, int] = {}
+        self.device_seconds: dict[str, float] = {}
+        self.transfer_bytes = {"h2d": 0, "d2h": 0}
+        self.backend_switches = 0
+        # on-demand capture
+        self.trace_captures = 0
+        self.trace_capture_active = False
+        self.last_trace_dir: str | None = None
+        # retrace detection state: per stage, the argument signatures
+        # (shapes+dtypes) this entry point has already served
+        self._seen: dict[str, set] = {}
+        self._frames = threading.local()
+        # bound registry histograms (metrics/beacon.py m.device), None
+        # until a node binds them
+        self._hist_dispatch = None
+        self._hist_device = None
+
+    # -- configuration --------------------------------------------------
+
+    def set_timing(self, timing: str) -> None:
+        if timing not in TIMING_MODES:
+            raise ValueError(
+                f"device timing {timing!r} not in {TIMING_MODES}"
+            )
+        self.timing = timing
+
+    @property
+    def enabled(self) -> bool:
+        return self.timing != "off"
+
+    def bind(self, metrics) -> None:
+        """Attach the m.device namespace so stage timings observe into
+        real registry histograms (counters stay internal — node.py
+        bridges them with add_collect like every other service)."""
+        self._hist_dispatch = getattr(
+            metrics, "stage_dispatch_seconds", None
+        )
+        self._hist_device = getattr(metrics, "stage_device_seconds", None)
+
+    # -- monitoring listener sinks --------------------------------------
+
+    def _frame_stack(self) -> list:
+        stack = getattr(self._frames, "stack", None)
+        if stack is None:
+            stack = self._frames.stack = []
+        return stack
+
+    def current_stage(self) -> str | None:
+        stack = self._frame_stack()
+        return stack[-1]["stage"] if stack else None
+
+    def on_backend_compile(self, secs: float) -> None:
+        stack = self._frame_stack()
+        stage = stack[-1]["stage"] if stack else OTHER_STAGE
+        if stack:
+            stack[-1]["compiled"] = True
+        with self._lock:
+            self.compiles[stage] = self.compiles.get(stage, 0) + 1
+            self.compile_seconds[stage] = (
+                self.compile_seconds.get(stage, 0.0) + secs
+            )
+
+    def on_cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
+
+    def on_cache_miss(self) -> None:
+        with self._lock:
+            self.cache_misses += 1
+
+    def on_cache_error(self) -> None:
+        with self._lock:
+            self.cache_errors += 1
+
+    def on_cache_retrieval(self, secs: float) -> None:
+        with self._lock:
+            self.cache_retrieval_seconds += secs
+
+    def on_transfer(self, direction: str, nbytes: int) -> None:
+        with self._lock:
+            self.transfer_bytes[direction] = (
+                self.transfer_bytes.get(direction, 0) + int(nbytes)
+            )
+
+    def note_backend_switch(self) -> None:
+        """A limb-backend switch dropped every cached trace
+        (ops/limbs.set_backend): the next dispatch per (stage, shape)
+        recompiles, which the retrace counters will show — this
+        counter names the cause next to the symptom."""
+        with self._lock:
+            self.backend_switches += 1
+
+    # -- stage instrumentation ------------------------------------------
+
+    @contextlib.contextmanager
+    def stage_scope(self, stage: str):
+        """Attribute backend compiles fired inside the block to
+        `stage` (thread-local — compile runs on the dispatch thread)."""
+        stack = self._frame_stack()
+        frame = {"stage": stage, "compiled": False}
+        stack.append(frame)
+        try:
+            yield frame
+        finally:
+            stack.pop()
+
+    def timed_call(self, stage: str, fn, args, kwargs):
+        t0 = time.perf_counter()
+        with self.stage_scope(stage) as frame:
+            out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        sig = _args_signature(args, kwargs)
+        with self._lock:
+            seen = self._seen.setdefault(stage, set())
+            if frame["compiled"] and sig in seen:
+                self.retraces[stage] = self.retraces.get(stage, 0) + 1
+            seen.add(sig)
+            self.dispatch_count[stage] = (
+                self.dispatch_count.get(stage, 0) + 1
+            )
+            self.dispatch_seconds[stage] = (
+                self.dispatch_seconds.get(stage, 0.0) + dt
+            )
+        if self._hist_dispatch is not None:
+            self._hist_dispatch.observe(dt, stage=stage)
+        if self.timing == "sync":
+            self._block_and_time(stage, out)
+        return out
+
+    def _block_and_time(self, stage: str, out) -> None:
+        """Dispatch-to-ready delta: wait for the stage's outputs and
+        record the device-side time, attaching it as a child span when
+        a block-import trace is active on this task/thread."""
+        import jax
+
+        from .tracing import child_span
+
+        with child_span(f"device:{stage}"):
+            t0 = time.perf_counter()
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                return
+            dt = time.perf_counter() - t0
+        with self._lock:
+            self.device_count[stage] = self.device_count.get(stage, 0) + 1
+            self.device_seconds[stage] = (
+                self.device_seconds.get(stage, 0.0) + dt
+            )
+        if self._hist_device is not None:
+            self._hist_device.observe(dt, stage=stage)
+
+    # -- scrape-time snapshots ------------------------------------------
+
+    def snapshot_compiles(self):
+        with self._lock:
+            return (
+                dict(self.compiles),
+                dict(self.compile_seconds),
+                dict(self.retraces),
+            )
+
+    def snapshot_transfers(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.transfer_bytes)
+
+
+def _args_signature(args, kwargs) -> tuple:
+    """Cheap structural signature of a call: shapes + dtypes of array
+    leaves, values of hashable scalars. Two calls with equal
+    signatures hit the same jit executable — so a backend compile on
+    an already-seen signature is a RETRACE."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", ""))))
+        else:
+            try:
+                hash(leaf)
+                sig.append(leaf)
+            except TypeError:
+                sig.append(type(leaf).__name__)
+    return tuple(sig)
+
+
+def instrument_stage(stage: str, fn):
+    """Wrap a jit entry point: attribute its compiles to `stage`,
+    detect retraces, time dispatches (and, in 'sync' mode, device
+    readiness). A single attribute check when no telemetry is
+    installed or timing is off."""
+
+    def wrapper(*args, **kwargs):
+        t = _TELEMETRY
+        if t is None or not t.enabled:
+            return fn(*args, **kwargs)
+        return t.timed_call(stage, fn, args, kwargs)
+
+    wrapper.__name__ = getattr(fn, "__name__", stage)
+    wrapper.__qualname__ = wrapper.__name__
+    wrapper.__wrapped__ = fn
+    wrapper.stage = stage
+    return wrapper
+
+
+def bind_collectors(metrics, telemetry: "DeviceTelemetry", verifier=None):
+    """Wire the m.device registry namespace (metrics/beacon.py) to
+    sample this telemetry instance at scrape time — the addCollect
+    pattern every other service uses (node.py). `verifier` supplies
+    the dispatch-queue depth when it exposes `in_flight_waves`."""
+    dtel = telemetry
+
+    # One collect fn populates each related gauge GROUP: the registry
+    # renders metrics in registration order (metrics/beacon.py keeps
+    # each group contiguous), so a fn hung on the group's first gauge
+    # may set the later ones — one snapshot per scrape, not one per
+    # gauge.
+    def _compiles(g):
+        comp, secs, retr = dtel.snapshot_compiles()
+        for s, c in comp.items():
+            g.set(c, stage=s)
+        for s, v in secs.items():
+            metrics.compile_seconds_total.set(v, stage=s)
+        for s, v in retr.items():
+            metrics.retraces_total.set(v, stage=s)
+
+    metrics.compiles_total.add_collect(_compiles)
+    metrics.persistent_cache_hits_total.add_collect(
+        lambda g: g.set(dtel.cache_hits)
+    )
+    metrics.persistent_cache_misses_total.add_collect(
+        lambda g: g.set(dtel.cache_misses)
+    )
+    metrics.persistent_cache_errors_total.add_collect(
+        lambda g: g.set(dtel.cache_errors)
+    )
+    metrics.cache_retrieval_seconds_total.add_collect(
+        lambda g: g.set(dtel.cache_retrieval_seconds)
+    )
+    metrics.transfer_bytes_total.add_collect(
+        lambda g: [
+            g.set(v, direction=d)
+            for d, v in dtel.snapshot_transfers().items()
+        ]
+    )
+    metrics.backend_switches_total.add_collect(
+        lambda g: g.set(dtel.backend_switches)
+    )
+    metrics.trace_captures_total.add_collect(
+        lambda g: g.set(dtel.trace_captures)
+    )
+    metrics.trace_capture_active.add_collect(
+        lambda g: g.set(1 if dtel.trace_capture_active else 0)
+    )
+
+    def _warmup(g):
+        # warmup progress derives from the kernels' warm registry;
+        # imported lazily so nodes without the device verifier never
+        # pull the kernel stack just to serve a scrape. The eligible
+        # set honors the VERIFIER's ingest gate when it carries an
+        # override (ingest_min_bucket=512 must not leave the gauge
+        # stuck at 2/3 waiting on a 256 bucket it will never warm).
+        from ..bls import kernels as _bk
+
+        gate = None
+        gate_fn = getattr(verifier, "_ingest_gate", None)
+        if gate_fn is not None:
+            gate = gate_fn()
+        for kind, (warm, elig) in _bk.warmup_progress(gate).items():
+            g.set((warm / elig) if elig else 1.0, pipeline=kind)
+            metrics.warmup_warm_buckets.set(warm, pipeline=kind)
+            metrics.warmup_eligible_buckets.set(elig, pipeline=kind)
+
+    metrics.warmup_progress.add_collect(_warmup)
+
+    def _memory(g):
+        for row in device_memory_snapshot():
+            g.set(row["bytes_in_use"] or 0, device=str(row["id"]))
+            if row["bytes_limit"] is not None:
+                metrics.device_bytes_limit.set(
+                    row["bytes_limit"], device=str(row["id"])
+                )
+
+    metrics.device_bytes_in_use.add_collect(_memory)
+
+    def _live(g):
+        n, total = live_buffer_stats()
+        g.set(n)
+        metrics.live_buffer_bytes.set(total)
+
+    metrics.live_buffers.add_collect(_live)
+    if verifier is not None and hasattr(verifier, "in_flight_waves"):
+        metrics.dispatch_queue_depth.add_collect(
+            lambda g: g.set(verifier.in_flight_waves)
+        )
+
+
+# -- device memory ----------------------------------------------------------
+
+
+def device_memory_snapshot() -> list[dict]:
+    """Per-device memory stats. TPU/GPU backends report allocator
+    stats through `Device.memory_stats()`; backends that return None
+    (CPU) fall back to summing the live jax.Arrays committed to the
+    device — the readback-free analog the dashboards need."""
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception:
+        return []
+    live_by_device: dict | None = None
+    rows = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        row = {
+            "id": int(d.id),
+            "platform": str(d.platform),
+            "kind": str(getattr(d, "device_kind", "")),
+            "bytes_in_use": None,
+            "bytes_limit": None,
+            "source": "memory_stats",
+        }
+        if stats:
+            row["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            limit = stats.get("bytes_limit")
+            row["bytes_limit"] = int(limit) if limit is not None else None
+        else:
+            if live_by_device is None:
+                live_by_device = _live_bytes_by_device()
+            row["bytes_in_use"] = live_by_device.get(d.id, 0)
+            row["source"] = "live_arrays"
+        rows.append(row)
+    return rows
+
+
+def _live_bytes_by_device() -> dict[int, int]:
+    """Live jax.Array bytes per device id (sharded arrays split their
+    footprint evenly across the devices holding them)."""
+    import jax
+
+    out: dict[int, int] = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return out
+    for a in arrays:
+        try:
+            nbytes = int(getattr(a, "nbytes", 0) or 0)
+            devs = list(a.devices())
+        except Exception:
+            continue
+        share = nbytes // max(1, len(devs))
+        for dev in devs:
+            out[dev.id] = out.get(dev.id, 0) + share
+    return out
+
+
+def live_buffer_stats() -> tuple[int, int]:
+    """(count, total bytes) of live jax.Arrays in the process."""
+    import jax
+
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return 0, 0
+    n, total = 0, 0
+    for a in arrays:
+        n += 1
+        total += int(getattr(a, "nbytes", 0) or 0)
+    return n, total
+
+
+# -- on-demand profiler capture ---------------------------------------------
+
+
+def profiler_capture(
+    duration_ms: float, out_dir: str | None = None
+) -> dict:
+    """Run jax.profiler for `duration_ms` and return the trace
+    directory. BLOCKING (callers run it in an executor); one capture
+    at a time — a second concurrent request raises CaptureBusyError
+    instead of corrupting the global profiler session."""
+    import os
+    import tempfile
+
+    import jax
+
+    t = _TELEMETRY
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusyError("a device trace capture is already running")
+    try:
+        if t is not None:
+            t.trace_capture_active = True
+        if out_dir is None:
+            out_dir = tempfile.mkdtemp(prefix="lodestar_device_trace_")
+        else:
+            os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(max(0.0, duration_ms) / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        if t is not None:
+            with t._lock:
+                t.trace_captures += 1
+            t.last_trace_dir = out_dir
+        return {"trace_dir": out_dir, "duration_ms": float(duration_ms)}
+    finally:
+        if t is not None:
+            t.trace_capture_active = False
+        _capture_lock.release()

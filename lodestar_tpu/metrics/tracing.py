@@ -70,6 +70,29 @@ def child_span(name: str):
         span.end()
 
 
+def attach_completed_span(name: str, duration: float) -> "Span | None":
+    """Attach an already-finished interval of known duration under the
+    calling task's current span; no-op when no trace is active.
+
+    The hook for work measured elsewhere — the BLS verifier learns its
+    wave's device time only when the wave finalizes, after the jobs'
+    `bls_verify_job` spans are already current, so the device interval
+    is backdated ([now - duration, now]) and grafted in. Bridges to
+    the span_seconds histogram like any other span."""
+    parent = _current_span.get()
+    if parent is None or duration <= 0.0:
+        return None
+    span = Span(name, clock=parent._clock, tracer=parent._tracer)
+    span.parent = parent
+    parent.children.append(span)
+    now = parent._clock()
+    span.t0 = now - float(duration)
+    span.t1 = now
+    if span._tracer is not None:
+        span._tracer._on_span_end(span)
+    return span
+
+
 class Span:
     """One timed interval; children nest through the contextvar."""
 

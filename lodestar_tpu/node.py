@@ -71,6 +71,15 @@ class BeaconNode:
         # import (debugging / sims)
         trace_slow_slot_ms: float = 500.0,
         trace_buffer_size: int = 64,
+        # -- device telemetry (metrics/device.py) --
+        # "dispatch" times stage calls + attributes compiles/retraces;
+        # "sync" adds block_until_ready deltas (serializes the host
+        # against each stage — debugging, not steady-state); "off"
+        # reduces every kernel hook to one attribute check
+        device_timing: str = "dispatch",
+        # POST /eth/v1/lodestar/device_trace capture-length ceiling
+        device_trace_max_ms: float = 5000.0,
+        device_trace_dir: str | None = None,
     ):
         self.cfg = cfg
         self.types = types
@@ -111,6 +120,16 @@ class BeaconNode:
         self.checkpoint_sync_url = checkpoint_sync_url
         self.wss_state_root = wss_state_root
         self.bls_warmup = bls_warmup
+        self.device_trace_max_ms = device_trace_max_ms
+        self.device_trace_dir = device_trace_dir
+        # device/compiler telemetry: singleton installed here so the
+        # jax.monitoring listeners and the kernels' instrumented stage
+        # wrappers route into THIS node's registry
+        from .metrics import device as _device_telemetry
+
+        self.device_telemetry = _device_telemetry.install(
+            metrics=self.metrics.device, timing=device_timing
+        )
         from .metrics import Tracer
 
         self.tracer = Tracer(
@@ -739,6 +758,16 @@ class BeaconNode:
                     vm.same_message_latency.quantile(0.99)
                 )
             )
+        # device / XLA compiler telemetry: compile + cache counters,
+        # warmup progress, memory, transfers — sampled at scrape from
+        # the telemetry singleton (dashboards/lodestar_tpu_device.json)
+        from .metrics import device as _dm
+
+        _dm.bind_collectors(
+            mm.device,
+            node.device_telemetry,
+            verifier=node.chain.verifier,
+        )
         # fork choice / eth1 / light-client server sampled gauges
         mm.forkchoice.nodes.add_collect(
             lambda g: g.set(len(node.chain.fork_choice.proto.nodes))

@@ -110,6 +110,15 @@ def set_backend(name: str, *, clear: bool = True) -> None:
         _backend = name
         if clear:
             jax.clear_caches()
+            # every live jit trace just died: each (stage, shape) the
+            # pipeline re-dispatches will recompile, which the device
+            # telemetry counts as retraces — name the cause next to
+            # the symptom on /metrics
+            from ..metrics import device as _telemetry
+
+            t = _telemetry.get_telemetry()
+            if t is not None:
+                t.note_backend_switch()
 
 
 @contextlib.contextmanager

@@ -29,6 +29,28 @@ def enable(cache_dir: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass  # cache is an optimization only
+        # jax latches "no persistent cache" at the process's FIRST
+        # compile; a host that jitted anything before enable() would
+        # silently never cache without this re-init
+        try:
+            from jax._src.compilation_cache import reset_cache
+
+            reset_cache()
+        except Exception:
+            pass
+    except Exception as e:
+        # The cache is an optimization only — but a node running
+        # without it pays every multi-minute stage compile on EVERY
+        # restart, which from the outside looks identical to a slow
+        # TPU. Say so, and count it where the dashboards look
+        # (lodestar_jax_persistent_cache_errors_total).
+        from ..logger import get_logger
+        from ..metrics import device as _telemetry
+
+        _telemetry.record_cache_error()
+        get_logger("jaxcache").warn(
+            "persistent XLA compilation cache DISABLED — every stage "
+            "compile will be paid again on each restart",
+            {"dir": d, "err": repr(e)},
+        )
     _enabled = True

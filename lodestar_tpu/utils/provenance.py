@@ -1,0 +1,67 @@
+"""Bench-artifact provenance: which environment produced this number.
+
+Every bench JSON (bench.py -> BENCH_*.json, tools/bench_trickle.py,
+tools/bench_mesh_sweep.py -> MULTICHIP_*.json) embeds this stamp so
+the trajectory stays interpretable across environments — a 1-core CPU
+emulation run and a real-chip run differ by orders of magnitude, and
+without jax/device/knob provenance the JSON files cannot say which
+one they are.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+
+def provenance() -> dict:
+    """Environment fingerprint for bench artifacts. Every field is
+    best-effort: a bench must never fail because git or a device
+    query is unavailable."""
+    stamp: dict = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        stamp["jax"] = jax.__version__
+        stamp["jaxlib"] = jaxlib.__version__
+        devs = jax.devices()
+        stamp["platform"] = jax.default_backend()
+        stamp["device_count"] = len(devs)
+        stamp["device_kind"] = (
+            str(getattr(devs[0], "device_kind", "")) if devs else ""
+        )
+    except Exception:
+        pass
+    try:
+        from ..ops import limbs
+
+        stamp["limb_backend"] = limbs.get_backend()
+    except Exception:
+        pass
+    try:
+        from ..bls import kernels
+
+        stamp["ingest_min_bucket"] = kernels.ingest_min_bucket()
+    except Exception:
+        pass
+    stamp["git_rev"] = _git_rev()
+    return stamp
+
+
+def _git_rev() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        rev = out.stdout.strip()
+        return rev or None
+    except Exception:
+        return None

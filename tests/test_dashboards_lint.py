@@ -52,3 +52,50 @@ class TestDashboardLint:
             "validator_monitor_prev_epoch_inclusion_distance_avg"
             in known
         )
+
+
+class TestInverseLint:
+    """Registered metrics referenced by NO dashboard fail the lint
+    unless explicitly allowlisted (ISSUE 10 satellite) — new families
+    like the lodestar_jax_* device series can't silently rot."""
+
+    def test_unreferenced_metric_fails(self, tmp_path):
+        # one valid expr, so the forward lint is clean; everything
+        # else registered is an orphan -> inverse lint must fail
+        dash = {
+            "title": "lonely",
+            "panels": [
+                {
+                    "title": "one",
+                    "targets": [{"expr": "beacon_head_slot"}],
+                }
+            ],
+        }
+        (tmp_path / "lonely.json").write_text(json.dumps(dash))
+        assert lint_dashboards.lint(tmp_path) == 1
+        # with the orphan check off the same dir is clean
+        assert lint_dashboards.lint(tmp_path, check_orphans=False) == 0
+
+    def test_allowlist_entries_are_registered(self):
+        """A renamed/deleted metric must not linger in the allowlist."""
+        families = lint_dashboards.registered_metric_families()
+        stale = lint_dashboards.ORPHAN_ALLOWLIST - set(families)
+        assert not stale, f"stale allowlist entries: {sorted(stale)}"
+
+    def test_device_series_on_device_dashboard(self):
+        """Acceptance: every new lodestar_jax_* metric appears in the
+        device dashboard (or the allowlist)."""
+        dash = json.loads(
+            (REPO / "dashboards" / "lodestar_tpu_device.json").read_text()
+        )
+        referenced = set()
+        for _title, expr in lint_dashboards.iter_panel_exprs(dash):
+            referenced |= lint_dashboards.metric_names_in_expr(expr)
+        families = lint_dashboards.registered_metric_families()
+        for base, fam in families.items():
+            if not base.startswith("lodestar_jax_"):
+                continue
+            assert (
+                fam & referenced
+                or base in lint_dashboards.ORPHAN_ALLOWLIST
+            ), f"device metric {base} missing from the device dashboard"

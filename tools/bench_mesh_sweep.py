@@ -207,8 +207,11 @@ def main() -> None:
             if base > 0
             else None
         )
+    from lodestar_tpu.utils.provenance import provenance
+
     out = {
         "workload": f"{args.sets} sets x {args.reps} reps, fixed batch",
+        "provenance": provenance(),
         "platform": rows[0]["platform"],
         "limb_backend": rows[0]["limb_backend"],
         "rows": rows,

@@ -197,8 +197,11 @@ async def _bench(args) -> dict:
         }
     import jax
 
+    from lodestar_tpu.utils.provenance import provenance
+
     return {
         "metric": "bls_trickle_gossip_shaped",
+        "provenance": provenance(),
         "platform": jax.default_backend(),
         "devices": len(jax.devices()),
         "rolling_enabled": not args.no_rolling,

@@ -1,16 +1,20 @@
 #!/usr/bin/env python
-"""Dashboard lint: every metric a Grafana panel references must exist.
+"""Dashboard lint: panels and the metric catalog must agree BOTH ways.
 
-Walks every `dashboards/*.json` panel target expr, extracts the metric
-names the PromQL references, and fails (exit 1) when a name is not
-registered by the node's metric catalog — metrics/beacon.py,
+Forward: walks every `dashboards/*.json` panel target expr, extracts
+the metric names the PromQL references, and fails (exit 1) when a name
+is not registered by the node's metric catalog — metrics/beacon.py,
 metrics/validator_monitor.py, the resilience family, or the tracing
 bridge. Histogram bases contribute their `_bucket`/`_sum`/`_count`
 series.
 
-Runs inside tier 1 (tools/run_tests.sh + tests/test_dashboards_lint.py)
-so a renamed or deleted metric can never leave a dashboard silently
-flat-lining again.
+Inverse: fails when a REGISTERED metric is referenced by no dashboard
+at all (and is not in the explicit ORPHAN_ALLOWLIST below) — a new
+metric family that never gets a panel silently rots exactly the way a
+deleted metric used to leave a panel flat-lining. Adding a metric
+means adding a panel or an allowlist entry, on purpose.
+
+Runs inside tier 1 (tools/run_tests.sh + tests/test_dashboards_lint.py).
 
 Usage: python tools/lint_dashboards.py [dashboards_dir]
 """
@@ -46,11 +50,70 @@ _NOT_METRICS = {
 
 _IDENT = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
 
+# Registered metrics no dashboard panels yet — each entry is a
+# DELIBERATE exception to the inverse lint (log-first or API-first
+# series, reference-dashboard name compatibility, raw operands of
+# panels that chart a derived form). Everything registered after the
+# inverse lint landed (ISSUE 10) must either appear in a dashboard or
+# be added here with intent.
+ORPHAN_ALLOWLIST = {
+    # reference lodestar_bls_thread_pool_* names kept 1:1 for the
+    # reference Grafana board (metrics/beacon.py header); the TPU
+    # dashboard charts the lodestar_tpu_verifier_* twins instead
+    "lodestar_bls_thread_pool_batch_retries_total",
+    "lodestar_bls_thread_pool_batch_sigs_success_total",
+    "lodestar_bls_thread_pool_batchable_sig_sets_total",
+    "lodestar_bls_thread_pool_error_jobs_signature_sets_count",
+    "lodestar_bls_thread_pool_job_groups_started_total",
+    "lodestar_bls_thread_pool_jobs_started_total",
+    "lodestar_bls_thread_pool_prioritized_sig_sets_total",
+    "lodestar_bls_thread_pool_sig_sets_total",
+    "lodestar_bls_thread_pool_success_jobs_signature_sets_count",
+    "lodestar_bls_thread_pool_time_seconds_sum",
+    # reqresp: the lodestar_reqresp_* family is the charted one; the
+    # beacon_reqresp_* twins keep reference name compatibility
+    "beacon_reqresp_incoming_requests_total",
+    "beacon_reqresp_outgoing_errors_total",
+    "beacon_reqresp_outgoing_requests_total",
+    # REST api / event loop self-observation (log + admin routes)
+    "lodestar_api_rest_errors_total",
+    "lodestar_api_rest_requests_total",
+    "lodestar_api_rest_response_time_seconds",
+    "lodestar_event_loop_lag_seconds",
+    # resilience family: alert-rule operands (breaker/engine state
+    # machines), no dedicated board yet
+    "lodestar_builder_faults_total",
+    "lodestar_execution_engine_http_errors_total",
+    "lodestar_execution_engine_http_requests_total",
+    "lodestar_execution_engine_state",
+    "lodestar_execution_engine_state_transitions_total",
+    "lodestar_resilience_breaker_state",
+    "lodestar_resilience_breaker_transitions_total",
+    "lodestar_resilience_retries_total",
+    "lodestar_resilience_retry_giveups_total",
+    # eth1 / light-client / sync / forkchoice detail gauges surfaced
+    # through the status log line and REST namespaces
+    "lodestar_eth1_deposit_count",
+    "lodestar_eth1_deposit_tree_size",
+    "lodestar_eth1_followed_blocks_count",
+    "lodestar_eth1_latest_followed_block_number",
+    "lodestar_eth1_update_errors_total",
+    "lodestar_lightclient_server_best_updates_count",
+    "lodestar_lightclient_server_finality_update_slot",
+    "lodestar_lightclient_server_optimistic_update_slot",
+    "lodestar_sync_status",
+    "lodestar_sync_unknown_block_requests_total",
+    "lodestar_forkchoice_indices_count",
+    # raw operands of charted ratios / rollups
+    "lodestar_gossip_validation_queue_job_time_seconds",
+    "lodestar_oppool_sync_contribution_and_proof_pool_size",
+    "validator_monitor_prev_epoch_on_chain_head_attester_hit_total",
+    "validator_monitor_prev_epoch_on_chain_target_attester_hit_total",
+}
 
-def registered_metric_names() -> set[str]:
-    """Every series name the live /metrics endpoint can expose."""
+
+def _build_registry():
     from lodestar_tpu.metrics import (
-        Histogram,
         RegistryMetricCreator,
         create_lodestar_metrics,
     )
@@ -61,13 +124,29 @@ def registered_metric_names() -> set[str]:
     create_lodestar_metrics(reg)
     create_resilience_metrics(reg)
     ValidatorMonitor(reg)
-    names: set[str] = set()
+    return reg
+
+
+def registered_metric_families() -> dict[str, set[str]]:
+    """base name -> every series name it can expose (histograms add
+    their _bucket/_sum/_count children)."""
+    from lodestar_tpu.metrics import Histogram
+
+    reg = _build_registry()
+    families: dict[str, set[str]] = {}
     for name, metric in reg._metrics.items():
-        names.add(name)
+        fam = {name}
         if isinstance(metric, Histogram):
-            names.update(
-                {f"{name}_bucket", f"{name}_sum", f"{name}_count"}
-            )
+            fam |= {f"{name}_bucket", f"{name}_sum", f"{name}_count"}
+        families[name] = fam
+    return families
+
+
+def registered_metric_names() -> set[str]:
+    """Every series name the live /metrics endpoint can expose."""
+    names: set[str] = set()
+    for fam in registered_metric_families().values():
+        names |= fam
     return names
 
 
@@ -106,20 +185,26 @@ def iter_panel_exprs(dashboard: dict):
                     yield sub.get("title", title), expr
 
 
-def lint(dash_dir: Path) -> int:
-    known = registered_metric_names()
+def lint(dash_dir: Path, check_orphans: bool = True) -> int:
+    families = registered_metric_families()
+    known: set[str] = set()
+    for fam in families.values():
+        known |= fam
     files = sorted(dash_dir.glob("*.json"))
     if not files:
         print(f"no dashboards found under {dash_dir}", file=sys.stderr)
         return 1
     bad = 0
+    referenced: set[str] = set()
     for path in files:
         dashboard = json.loads(path.read_text())
         n_exprs = 0
         unknown: list[tuple[str, str, set]] = []
         for title, expr in iter_panel_exprs(dashboard):
             n_exprs += 1
-            missing = metric_names_in_expr(expr) - known
+            names = metric_names_in_expr(expr)
+            referenced |= names
+            missing = names - known
             if missing:
                 unknown.append((title, expr, missing))
         if unknown:
@@ -132,6 +217,31 @@ def lint(dash_dir: Path) -> int:
                 )
         else:
             print(f"ok   {path.name}: {n_exprs} exprs, 0 unknown")
+    if check_orphans:
+        orphans = sorted(
+            base
+            for base, fam in families.items()
+            if not (fam & referenced) and base not in ORPHAN_ALLOWLIST
+        )
+        if orphans:
+            bad += 1
+            print(
+                "FAIL inverse lint: registered metric(s) referenced by "
+                "NO dashboard (add a panel or an ORPHAN_ALLOWLIST "
+                "entry):"
+            )
+            for name in orphans:
+                print(f"  {name}")
+        else:
+            n_allow = sum(
+                1
+                for base, fam in families.items()
+                if not (fam & referenced)
+            )
+            print(
+                f"ok   inverse lint: 0 orphans "
+                f"({n_allow} allowlisted, {len(families)} registered)"
+            )
     return 1 if bad else 0
 
 
