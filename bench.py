@@ -67,11 +67,22 @@ def _build_all_waves():
     ]
 
 
+_AUTOTUNE_DECISION = None  # loaded by --autotune-from (main)
+
+
 async def _run() -> float:
     from lodestar_tpu.bls import TpuBlsVerifier
 
     waves = _build_all_waves()
     v = TpuBlsVerifier()
+    if _AUTOTUNE_DECISION is not None:
+        # the kernel-side knobs were replayed in main() (where an
+        # explicit --limb-backend then wins); here apply only the
+        # verifier-side knob — re-running the FULL decision would
+        # silently switch the backend back and defeat the A/B flag
+        v.set_latency_budget_ms(
+            float(_AUTOTUNE_DECISION["config"]["latency_budget_ms"])
+        )
 
     async def run_wave(jobs) -> bool:
         results = await asyncio.gather(
@@ -125,6 +136,32 @@ def main() -> None:
         from lodestar_tpu.ops import limbs as _L
 
         _L.set_backend(limb_backend)
+
+    # --autotune-from AUTOTUNE.json: replay a recorded autotune
+    # decision (device/autotune.py) — the bench then measures the
+    # exact configuration the tuner picked on this host, and the
+    # provenance stamp records the replay. Applied before anything
+    # traces; exported via the env var so mesh-mode re-exec children
+    # inherit the backend. An EXPLICIT --limb-backend wins over the
+    # replayed backend (A/B runs against the tuned config), matching
+    # the precedence the sibling benches document.
+    global _AUTOTUNE_DECISION
+    if "--autotune-from" in sys.argv:
+        from lodestar_tpu.device import autotune as _at
+
+        path = sys.argv[sys.argv.index("--autotune-from") + 1]
+        _AUTOTUNE_DECISION = _at.load_decision(path)
+        cfg = _at.apply_decision(_AUTOTUNE_DECISION)
+        os.environ["LODESTAR_TPU_INGEST_MIN_BUCKET"] = str(
+            cfg.ingest_min_bucket
+        )
+        if limb_backend is not None:
+            from lodestar_tpu.ops import limbs as _L
+
+            if _L.get_backend() != limb_backend:
+                _L.set_backend(limb_backend)
+        else:
+            os.environ["LODESTAR_TPU_LIMB_BACKEND"] = cfg.limb_backend
 
     mesh_n = 0
     if "--mesh" in sys.argv:

@@ -141,9 +141,35 @@ def ingest_min_bucket() -> int:
     return INGEST_MIN_BUCKET
 
 
-def set_ingest_min_bucket(n: int) -> None:
+def set_ingest_min_bucket(n: int, rewarm: bool = True) -> None:
+    """Move the device-ingest gate at runtime.
+
+    LOWERING the gate makes rungs eligible whose ingest pipelines were
+    never compiled: warmup_progress() recomputes eligibility from the
+    live gate at every scrape (so the `lodestar_jax_warmup_*` gauges
+    drop honestly instead of reporting the old, fully-warm set), and —
+    when a warmup ran in this process — the newly eligible COLD rungs
+    are re-warmed on the background thread, otherwise a cold-fallback
+    verifier would route them host_cold forever (nothing else marks a
+    size warm without a live ingest dispatch, which the fallback
+    prevents). rewarm=False skips the kick (tests, tools that manage
+    warmup themselves)."""
     global INGEST_MIN_BUCKET
+    old = INGEST_MIN_BUCKET
     INGEST_MIN_BUCKET = int(n)
+    if not rewarm or INGEST_MIN_BUCKET >= old:
+        return
+    if not _WARMUP_STARTED:
+        # no warmup policy in this process (bench/test/mesh node):
+        # kicking multi-minute compiles behind a setter would be rude
+        return
+    newly = tuple(
+        b
+        for b in default_warmup_sizes(INGEST_MIN_BUCKET)
+        if b < old and not ingest_is_warm(b)
+    )
+    if newly:
+        warmup_ingest(newly)
 
 
 @jax.jit
@@ -446,23 +472,66 @@ def _cat_fq2(a, b):
 
 # The one bucket ladder: retry-chunk rungs (<=128, reference job
 # granularity), the rolling-accumulator ingest rungs {256, 512}, and
-# the bulk-wave max. bucket_size, default_warmup_sizes, and the
-# verifier's warmup all derive from THIS tuple — add a rung here and
-# warmup covers it automatically.
-BUCKET_LADDER = (4, 8, 16, 32, 64, 128, 256, 512, 2048)
+# the bulk-wave TOP rung. bucket_size, default_warmup_sizes, and the
+# verifier's warmup all derive from the LIVE tuple — add a rung and
+# warmup covers it automatically. The top rung is a KNOB
+# (set_ladder_top): the device autotuner (device/autotune.py) may
+# trade the 2048 bulk bucket for 1024 on hosts where the bigger
+# compile/dispatch does not pay for its padding.
+_MID_RUNGS = (4, 8, 16, 32, 64, 128, 256, 512)
+LADDER_TOPS = (1024, 2048)  # autotune-selectable top rungs
+BUCKET_LADDER = _MID_RUNGS + (2048,)
 
 
-def bucket_size(n: int, buckets=BUCKET_LADDER) -> int:
+def ladder_top() -> int:
+    """The live top (bulk-wave) bucket rung."""
+    return BUCKET_LADDER[-1]
+
+
+def set_ladder_top(n: int, rewarm: bool = True) -> None:
+    """Swap the bulk-wave top rung of the ladder. Sizes that fall out
+    of the ladder are dropped from the warm registry — they can no
+    longer be dispatched, and counting them warm would overstate the
+    `lodestar_jax_warmup_*` gauges. An INCOMING top rung was never
+    compiled: when a warmup policy exists in this process, kick the
+    background warmup for every cold ingest-eligible rung, or a
+    cold-fallback verifier would route the bulk bucket host_cold
+    forever (nothing else warms a size the fallback never
+    dispatches). rewarm=False defers that to a caller that re-warms
+    once for a whole batch of knob changes (autotune.apply_config)."""
+    global BUCKET_LADDER
+    n = int(n)
+    if n < _MID_RUNGS[-1]:
+        raise ValueError(
+            f"ladder top {n} below the largest mid rung {_MID_RUNGS[-1]}"
+        )
+    BUCKET_LADDER = tuple(b for b in _MID_RUNGS if b < n) + (n,)
+    live = set(BUCKET_LADDER)
+    stale = {k for k in _INGEST_WARM if k[1] not in live}
+    _INGEST_WARM.difference_update(stale)
+    if rewarm and _WARMUP_STARTED:
+        newly = tuple(
+            b for b in default_warmup_sizes() if not ingest_is_warm(b)
+        )
+        if newly:
+            warmup_ingest(newly)
+
+
+def bucket_size(n: int, buckets=None) -> int:
     """Smallest bucket >= n. Small sizes mirror the reference's <=128
     sets/job chunks (chain/bls/multithread/index.ts:48-56). The mid
     sizes {256, 512} are the device-ingest-eligible rungs the
     verifier's rolling gossip accumulator flushes into — without them
     the ladder jumped 128 -> 2048 and steady-state trickle traffic
     either rode the slow host decompress/hash path or paid 16x
-    padding. Above 512 whole waves pack into one 2048-set device
+    padding. Above 512 whole waves pack into one top-rung device
     bucket (per-op device cost is batch-flat to ~2048, so padding
     there is nearly free; each extra bucket size is an extra
-    multi-minute XLA compile, pre-warmed by warmup_ingest)."""
+    multi-minute XLA compile, pre-warmed by warmup_ingest). `buckets`
+    defaults to the LIVE ladder so a set_ladder_top() retune is seen
+    by every later call."""
+    if buckets is None:
+        buckets = BUCKET_LADDER
     for b in buckets:
         if n <= b:
             return b
@@ -489,6 +558,14 @@ def bucket_size(n: int, buckets=BUCKET_LADDER) -> int:
 _INGEST_WARM: set[tuple[str, int]] = set()
 _WARMUP_LOCK = threading.Lock()
 _WARMUP_THREAD: threading.Thread | None = None
+# has warmup_ingest ever run in this process? Gates the automatic
+# re-warm on live retunes (set_ingest_min_bucket / backend switches):
+# processes that never opted into warmup never get background compiles
+# sprung on them by a knob change.
+_WARMUP_STARTED = False
+# sizes requested while a warmup thread was already running; the
+# thread drains this set before exiting (guarded by _WARMUP_LOCK)
+_WARMUP_WANT: set[int] = set()
 
 
 def ingest_is_warm(b: int, kind: str = "batch") -> bool:
@@ -497,6 +574,31 @@ def ingest_is_warm(b: int, kind: str = "batch") -> bool:
 
 def mark_ingest_warm(b: int, kind: str = "batch") -> None:
     _INGEST_WARM.add((kind, b))
+
+
+# generation counter for the warm registry: invalidation bumps it so
+# a warmup dispatch that STARTED under the previous generation (its
+# executable died with the cache clear) cannot land a stale mark when
+# it completes. The check-and-mark and the bump-and-clear each run
+# under the lock, or a mark could slip in between them.
+_WARM_GEN = 0
+_WARM_GEN_LOCK = threading.Lock()
+
+
+def invalidate_ingest_warm(rewarm: bool = True) -> None:
+    """Drop every warm mark. Called when a limb-backend switch clears
+    the jit caches (ops/limbs.set_backend): the compiled executables
+    the marks described are gone, and a cold-fallback verifier
+    trusting a stale mark would dispatch a live bucket straight into
+    the recompile the mark claimed was paid. When a warmup ran in this
+    process, re-warm the eligible rungs in the background (persistent
+    cache makes a switch back near-free)."""
+    global _WARM_GEN
+    with _WARM_GEN_LOCK:
+        _WARM_GEN += 1
+        _INGEST_WARM.clear()
+    if rewarm and _WARMUP_STARTED:
+        warmup_ingest()
 
 
 WARMUP_PIPELINES = ("batch", "same_message")
@@ -573,45 +675,76 @@ def warmup_ingest(
     compilation cache (utils/jaxcache.py) makes this a disk load on
     every process after the first. Idempotent; block=True runs
     synchronously (tests, tools)."""
-    global _WARMUP_THREAD
+    global _WARMUP_THREAD, _WARMUP_STARTED
     jaxcache.enable()
+    _WARMUP_STARTED = True
     want = tuple(sizes) if sizes is not None else default_warmup_sizes()
 
+    def warm_one_marked(b, kind, log, msg):
+        """One warmup dispatch + mark, generation-guarded: if the
+        registry was invalidated while the dispatch ran (a backend
+        switch killed the executable this compile produced), the
+        stale mark must NOT land — the size re-warms on the next
+        kick under the new generation instead."""
+        gen = _WARM_GEN
+        try:
+            _warm_one(b, same_message=(kind == "same_message"))
+            with _WARM_GEN_LOCK:
+                if _WARM_GEN == gen:
+                    mark_ingest_warm(b, kind)
+        except Exception as e:
+            # warmup is an optimization: the size stays cold and the
+            # verifier keeps its host fallback — but say so, or the
+            # node silently runs degraded forever
+            log.warn(msg, {"bucket": b, "err": repr(e)})
+
+    def warm_sizes(seq, log):
+        for b in sorted(set(seq)):
+            if not ingest_is_warm(b, "batch"):
+                # only the batch pipeline becomes warm here — the
+                # same-message program is a different compile
+                warm_one_marked(
+                    b,
+                    "batch",
+                    log,
+                    "ingest warmup failed; bucket stays on host path",
+                )
+            if same_message and not ingest_is_warm(b, "same_message"):
+                warm_one_marked(
+                    b,
+                    "same_message",
+                    log,
+                    "same-message ingest warmup failed",
+                )
+
     def run():
+        global _WARMUP_THREAD
         from ..logger import get_logger
 
         log = get_logger("bls-warmup")
-        for b in sorted(set(want)):
-            if not ingest_is_warm(b, "batch"):
-                try:
-                    _warm_one(b, same_message=False)
-                    # only the batch pipeline is warm — the
-                    # same-message program is a different compile
-                    mark_ingest_warm(b, "batch")
-                except Exception as e:
-                    # warmup is an optimization: the size stays cold
-                    # and the verifier keeps its host fallback — but
-                    # say so, or the node silently runs degraded
-                    # forever
-                    log.warn(
-                        "ingest warmup failed; bucket stays on host path",
-                        {"bucket": b, "err": repr(e)},
-                    )
-            if same_message and not ingest_is_warm(b, "same_message"):
-                try:
-                    _warm_one(b, same_message=True)
-                    mark_ingest_warm(b, "same_message")
-                except Exception as e:
-                    log.warn(
-                        "same-message ingest warmup failed",
-                        {"bucket": b, "err": repr(e)},
-                    )
+        warm_sizes(want, log)
+        # drain sizes enqueued while this thread ran (a live retune —
+        # gate lowered or backend switched — kicks warmup again; the
+        # request must not be lost just because a thread was active).
+        # The emptiness check and the thread deregistration happen
+        # under ONE lock hold: an enqueue serialized before it is
+        # drained here; one after it sees no live thread and spawns.
+        while True:
+            with _WARMUP_LOCK:
+                extra = sorted(_WARMUP_WANT)
+                _WARMUP_WANT.clear()
+                if not extra:
+                    if _WARMUP_THREAD is threading.current_thread():
+                        _WARMUP_THREAD = None
+                    return
+            warm_sizes(extra, log)
 
     if block:
         run()
         return None
     with _WARMUP_LOCK:
         if _WARMUP_THREAD is not None and _WARMUP_THREAD.is_alive():
+            _WARMUP_WANT.update(want)
             return _WARMUP_THREAD
         _WARMUP_THREAD = threading.Thread(
             target=run, name="bls-ingest-warmup", daemon=True

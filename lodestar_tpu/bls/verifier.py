@@ -46,6 +46,7 @@ submit-to-verdict latency feeds p50/p99 histograms on /metrics.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import secrets
 import threading
@@ -284,6 +285,8 @@ class TpuBlsVerifier:
         self._rolling: list[_Job] = []
         self._rolling_sets = 0
         self._rolling_task: asyncio.Task | None = None
+        self._dispatching = 0  # waves between job pop and finalizer
+        self._intake_held = 0  # hold_intake() nesting depth
         self._buffer: list[_Job] = []
         self._buffer_task: asyncio.Task | None = None
         # priority queue: (priority_class, seq) keeps FIFO within class;
@@ -325,6 +328,40 @@ class TpuBlsVerifier:
             self._ingest_min
             if self._ingest_min is not None
             else kernels.ingest_min_bucket()
+        )
+
+    def _bucket_cap(self) -> int:
+        """Sets per device dispatch: the ladder's live top rung,
+        bounded by the hard DEVICE_BUCKET_MAX. Reads the ladder every
+        wave so a set_ladder_top() retune (device/autotune.py) applies
+        to the next packing without a restart."""
+        return min(DEVICE_BUCKET_MAX, kernels.ladder_top())
+
+    def set_latency_budget_ms(self, ms: float) -> None:
+        """Live retune of the rolling-bucket latency budget (the
+        autotuner's fourth knob). Applies to the NEXT deadline arming;
+        an already-armed deadline keeps its schedule — the budget is
+        an upper bound on added wait, and rescheduling mid-flight
+        could extend a promise already made to a queued job."""
+        self._latency_budget = max(0.0, float(ms)) / 1000.0
+
+    def latency_budget_ms(self) -> float:
+        return self._latency_budget * 1000.0
+
+    def is_quiescent(self) -> bool:
+        """No queued, buffered, rolling, or in-flight work — the gate
+        the drift monitor (device/autotune.py) requires before a
+        re-tune may touch live knobs (a backend switch mid-wave would
+        drop the very traces the wave is executing). `_dispatching`
+        covers the prep-and-dispatch window: jobs are already popped
+        from the queue but the finalizer task is not yet registered,
+        so none of the other indicators would show the wave."""
+        return (
+            self._dispatching == 0
+            and self._queue.empty()
+            and not self._buffer
+            and not self._rolling
+            and not self._finalizers
         )
 
     def _flush_target(self) -> int:
@@ -395,11 +432,27 @@ class TpuBlsVerifier:
     def can_accept_work(self) -> bool:
         return (
             not self._closed
+            and not self._intake_held
             and self._queue.qsize()
             + len(self._buffer)
             + len(self._rolling)
             < self._queue_max
         )
+
+    @contextlib.contextmanager
+    def hold_intake(self):
+        """Backpressure gossip intake (can_accept_work -> False) for
+        the duration of the block. The drift monitor wraps a re-tune
+        in this so the quiescence it checked once keeps holding for
+        the processor-fed path; callers that bypass can_accept_work
+        (block import) can still submit — a mid-tune wave then pays
+        recompile latency, never wrong verdicts (the cleared caches
+        re-trace deterministically)."""
+        self._intake_held += 1
+        try:
+            yield
+        finally:
+            self._intake_held -= 1
 
     @property
     def in_flight_waves(self) -> int:
@@ -612,33 +665,41 @@ class TpuBlsVerifier:
         (index.ts:357-534)."""
         while not self._closed:
             _, _, jobs = await self._queue.get()
-            jobs = list(jobs)
-            while True:
-                try:
-                    _, _, more = self._queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
-                jobs.extend(more)
-            self.metrics.queue_length = self._queue.qsize()
-            immediate: list[_Job] = []
-            for j in jobs:
-                if j.batchable and self._latency_budget > 0:
-                    self._rolling.append(j)
-                    self._rolling_sets += len(j.sets)
-                else:
-                    immediate.append(j)
-            self.metrics.rolling_sets = self._rolling_sets
-            if immediate:
-                if self._rolling:
-                    self.metrics.rolling_flushes["merged"] += 1
-                await self._dispatch_wave(
-                    immediate + self._take_rolling()
-                )
-            elif self._rolling_sets >= self._flush_target():
-                self.metrics.rolling_flushes["full"] += 1
-                await self._dispatch_wave(self._take_rolling())
-            elif self._rolling:
-                self._arm_rolling_deadline()
+            # from here until the jobs land in _rolling or in
+            # _dispatch_wave they live only in this local — count the
+            # window as dispatching so a cross-thread is_quiescent()
+            # (drift monitor) can never see a falsely idle verifier
+            self._dispatching += 1
+            try:
+                jobs = list(jobs)
+                while True:
+                    try:
+                        _, _, more = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    jobs.extend(more)
+                self.metrics.queue_length = self._queue.qsize()
+                immediate: list[_Job] = []
+                for j in jobs:
+                    if j.batchable and self._latency_budget > 0:
+                        self._rolling.append(j)
+                        self._rolling_sets += len(j.sets)
+                    else:
+                        immediate.append(j)
+                self.metrics.rolling_sets = self._rolling_sets
+                if immediate:
+                    if self._rolling:
+                        self.metrics.rolling_flushes["merged"] += 1
+                    await self._dispatch_wave(
+                        immediate + self._take_rolling()
+                    )
+                elif self._rolling_sets >= self._flush_target():
+                    self.metrics.rolling_flushes["full"] += 1
+                    await self._dispatch_wave(self._take_rolling())
+                elif self._rolling:
+                    self._arm_rolling_deadline()
+            finally:
+                self._dispatching -= 1
 
     def _take_rolling(self) -> list[_Job]:
         jobs, self._rolling = self._rolling, []
@@ -683,17 +744,25 @@ class TpuBlsVerifier:
         t0 = time.monotonic()
         for j in jobs:
             self.metrics.total_job_wait_s += t0 - j.enqueued_at
+        self._dispatching += 1
         try:
-            wave = await self._prep_and_dispatch(jobs)
-        except asyncio.CancelledError:
-            self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
-            raise
-        except Exception as e:  # defensive: fail the waiters
-            self._fail_jobs(jobs, e)
-            return
-        task = asyncio.ensure_future(self._finalize_wave(wave, t0))
-        self._finalizers.add(task)
-        task.add_done_callback(self._finalizers.discard)
+            try:
+                wave = await self._prep_and_dispatch(jobs)
+            except asyncio.CancelledError:
+                self._fail_jobs(
+                    jobs, RuntimeError("BLS verifier closed")
+                )
+                raise
+            except Exception as e:  # defensive: fail the waiters
+                self._fail_jobs(jobs, e)
+                return
+            task = asyncio.ensure_future(
+                self._finalize_wave(wave, t0)
+            )
+            self._finalizers.add(task)
+            task.add_done_callback(self._finalizers.discard)
+        finally:
+            self._dispatching -= 1
 
     def _fail_jobs(self, jobs, err):
         for j in jobs:
@@ -755,10 +824,11 @@ class TpuBlsVerifier:
         packing: list[list[tuple[_Job, int, int]]] = []  # (job, off, n)
         cur: list[tuple[_Job, int, int]] = []
         cur_n = 0
+        cap = self._bucket_cap()
         for j in live:
             total, off = len(j.sets), 0
             while off < total:
-                take = min(total - off, DEVICE_BUCKET_MAX - cur_n)
+                take = min(total - off, cap - cur_n)
                 if take == 0:
                     packing.append(cur)
                     cur, cur_n = [], 0
@@ -766,7 +836,7 @@ class TpuBlsVerifier:
                 cur.append((j, off, take))
                 cur_n += take
                 off += take
-                if cur_n >= DEVICE_BUCKET_MAX:
+                if cur_n >= cap:
                     packing.append(cur)
                     cur, cur_n = [], 0
         if cur:
@@ -1074,10 +1144,10 @@ class TpuBlsVerifier:
         # ~8,000 sets, index.ts:51) into AND-ed device buckets
         plan: list[tuple[int, int]] = []  # (group idx, n buckets)
         buckets: list[list[_PreparedSet]] = []
+        cap = self._bucket_cap()
         for gi, g in enumerate(groups):
             parts = [
-                g[i : i + DEVICE_BUCKET_MAX]
-                for i in range(0, len(g), DEVICE_BUCKET_MAX)
+                g[i : i + cap] for i in range(0, len(g), cap)
             ] or [[]]
             plan.append((gi, len(parts)))
             buckets.extend(parts)
@@ -1107,7 +1177,7 @@ class TpuBlsVerifier:
         cap and ANDs (random weights keep each part sound). pairs:
         (pk_ints, (xc0, xc1), sign) triples — signature decompression
         happens on device."""
-        cap = DEVICE_BUCKET_MAX
+        cap = self._bucket_cap()
         if len(pairs) > cap:
             parts = [
                 pairs[i : i + cap] for i in range(0, len(pairs), cap)

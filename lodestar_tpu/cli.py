@@ -130,6 +130,34 @@ def _build_parser() -> argparse.ArgumentParser:
         "eligible bucket size on a background thread at start "
         "(persistent-cached; --no-bls-warmup to skip)",
     )
+    # -- device auto-tuning (device/autotune.py) ----------------------
+    beacon.add_argument(
+        "--autotune", choices=("off", "startup", "adaptive"),
+        default="off",
+        help="device self-tuning: 'startup' micro-benches the limb-"
+        "backend x ingest-gate x ladder-top x latency-budget grid "
+        "once at init (riding the persistent compile cache) and "
+        "applies the winner through the live setters; 'adaptive' "
+        "adds the drift monitor that re-tunes (bounded, quiescence-"
+        "gated) when a stage departs its COVERAGE.md budget share; "
+        "'off' keeps the env/CLI knobs as given",
+    )
+    beacon.add_argument(
+        "--autotune-budget-ms", type=float, default=30_000.0,
+        help="wall-clock ceiling for one tune; candidates that do "
+        "not fit are skipped (decision source becomes 'partial')",
+    )
+    beacon.add_argument(
+        "--autotune-grid", default=None,
+        help="restrict the candidate grid, e.g. "
+        "'backend=vpu,mxu;gate=256,512;top=2048;budget=50' "
+        "(omitted axes keep their defaults)",
+    )
+    beacon.add_argument(
+        "--autotune-artifact", default="AUTOTUNE.json",
+        help="where the tuner records its decision JSON (replayable "
+        "by bench.py/tools/bench_* --autotune-from; empty to skip)",
+    )
     # -- observability knobs ------------------------------------------
     beacon.add_argument(
         "--monitored-validators", default=None,
@@ -398,6 +426,10 @@ async def _run_beacon(args) -> int:
         device_timing=args.device_timing,
         device_trace_max_ms=args.device_trace_max_ms,
         device_trace_dir=args.device_trace_dir,
+        autotune=args.autotune,
+        autotune_budget_ms=args.autotune_budget_ms,
+        autotune_grid=args.autotune_grid,
+        autotune_artifact=args.autotune_artifact or None,
     )
     node.notify_status()
     try:

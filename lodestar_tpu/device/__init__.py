@@ -1,0 +1,22 @@
+"""Device self-management: the feedback loop from telemetry to knobs.
+
+`metrics/device.py` made the JAX/XLA execution layer observable;
+this package closes the loop — `autotune.py` turns the observed
+numbers back into the live configuration knobs (limb backend, ingest
+gate, bucket-ladder top, verifier latency budget) so one binary
+converges to its host's optimum without operator tuning.
+"""
+
+from .autotune import (  # noqa: F401
+    DeviceAutotuner,
+    DriftMonitor,
+    TunedConfig,
+    apply_decision,
+    applied_decision,
+    budget_shares,
+    current_config,
+    load_decision,
+    parse_grid,
+    provenance_fields,
+    select_config,
+)

@@ -707,6 +707,70 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "1 while an on-demand profiler capture is running",
     )
 
+    # -- device auto-tuner (device/autotune.py) --------------------------
+    # The feedback loop from the device telemetry above back into the
+    # live knobs (limb backend, ingest gate, ladder top, latency
+    # budget). Drives the "Auto-tuner" row of
+    # dashboards/lodestar_tpu_device.json.
+    at = SimpleNamespace()
+    m.autotune = at
+    at.runs_total = reg.gauge(
+        "lodestar_autotune_runs_total",
+        "Autotune runs applied (startup + drift re-tunes)",
+    )
+    at.retunes_total = reg.gauge(
+        "lodestar_autotune_retunes_total",
+        "Drift-triggered re-tunes applied by the drift monitor",
+    )
+    at.retunes_blocked_total = reg.gauge(
+        "lodestar_autotune_retunes_blocked_total",
+        "Drift re-tunes deferred because the verifier was not"
+        " quiescent (never mid-wave)",
+    )
+    at.candidates_measured_total = reg.gauge(
+        "lodestar_autotune_candidates_measured_total",
+        "Candidate grid points micro-benchmarked",
+    )
+    at.last_duration_seconds = reg.gauge(
+        "lodestar_autotune_last_duration_seconds",
+        "Wall time of the most recent tune (persistent cache makes"
+        " repeat starts near-free)",
+    )
+    at.best_sets_per_sec = reg.gauge(
+        "lodestar_autotune_best_sets_per_sec",
+        "Best probe throughput measured by the most recent tune",
+    )
+    at.selected = reg.gauge(
+        "lodestar_autotune_selected",
+        "Numeric knob values the tuner applied"
+        " (ingest_min_bucket / ladder_top / latency_budget_ms)",
+        label_names=("knob",),
+    )
+    at.config_info = reg.gauge(
+        "lodestar_autotune_config_info",
+        "Active tuned configuration as an info series (value 1;"
+        " backend + mode + decision source in labels)",
+        label_names=("backend", "mode", "source"),
+    )
+    at.stage_share = reg.gauge(
+        "lodestar_autotune_stage_share",
+        "Observed per-stage share of device time in the last drift"
+        " window (compare against lodestar_autotune_stage_budget_share)",
+        label_names=("stage",),
+    )
+    at.stage_budget_share = reg.gauge(
+        "lodestar_autotune_stage_budget_share",
+        "Budgeted per-stage share from the COVERAGE.md device stage"
+        " budget table",
+        label_names=("stage",),
+    )
+    at.drift_windows = reg.gauge(
+        "lodestar_autotune_drift_windows",
+        "Consecutive windows each stage has been outside its budget"
+        " share (re-tune fires at the configured streak)",
+        label_names=("stage",),
+    )
+
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
     k = SimpleNamespace()
     m.clock = k

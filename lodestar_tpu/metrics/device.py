@@ -358,6 +358,14 @@ class DeviceTelemetry:
         with self._lock:
             return dict(self.transfer_bytes)
 
+    def snapshot_stage_seconds(self):
+        """(dispatch_seconds, device_seconds) cumulative per-stage
+        copies taken under the lock — the drift monitor
+        (device/autotune.py) diffs consecutive snapshots into
+        per-window stage shares against the COVERAGE.md budget."""
+        with self._lock:
+            return dict(self.dispatch_seconds), dict(self.device_seconds)
+
 
 def _args_signature(args, kwargs) -> tuple:
     """Cheap structural signature of a call: shapes + dtypes of array

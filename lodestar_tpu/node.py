@@ -80,6 +80,15 @@ class BeaconNode:
         # POST /eth/v1/lodestar/device_trace capture-length ceiling
         device_trace_max_ms: float = 5000.0,
         device_trace_dir: str | None = None,
+        # -- device auto-tuning (device/autotune.py) --
+        # "startup": micro-bench the candidate grid once at init and
+        # apply the winner through the live setters; "adaptive" adds
+        # the drift monitor (budget-share watch + bounded re-tunes);
+        # "off" leaves every knob wherever env/CLI put it
+        autotune: str = "off",
+        autotune_budget_ms: float = 30_000.0,
+        autotune_grid: str | None = None,
+        autotune_artifact: str | None = "AUTOTUNE.json",
     ):
         self.cfg = cfg
         self.types = types
@@ -122,6 +131,18 @@ class BeaconNode:
         self.bls_warmup = bls_warmup
         self.device_trace_max_ms = device_trace_max_ms
         self.device_trace_dir = device_trace_dir
+        if autotune not in ("off", "startup", "adaptive"):
+            raise ValueError(
+                f"autotune mode {autotune!r} not in"
+                " ('off', 'startup', 'adaptive')"
+            )
+        self.autotune_mode = autotune
+        self.autotune_budget_ms = autotune_budget_ms
+        self.autotune_grid = autotune_grid
+        self.autotune_artifact = autotune_artifact
+        self.autotuner = None
+        self.drift_monitor = None
+        self._drift_task: asyncio.Task | None = None
         # device/compiler telemetry: singleton installed here so the
         # jax.monitoring listeners and the kernels' instrumented stage
         # wrappers route into THIS node's registry
@@ -289,11 +310,49 @@ class BeaconNode:
         # debug route (api/impl.get_block_import_traces)
         node.chain.tracer = node.tracer
         node.chain.regen.metrics = node.metrics.regen
-        # pre-warm the device-ingest compiles (mid {256,512} + max
-        # buckets) on a background thread through the persistent cache
-        # so steady-state gossip never pays a cold multi-minute XLA
-        # compile; until a size is warm the verifier serves it from
-        # the host path (host_fallback_when_cold)
+        # device auto-tuning: close the telemetry->knobs loop. The
+        # startup tune micro-benches the candidate grid through the
+        # persistent compilation cache and applies the winner via the
+        # real setters BEFORE traffic arrives; adaptive mode adds the
+        # drift monitor (budget-share watch, quiescence-gated bounded
+        # re-tunes). Runs in an executor: the probes block on device
+        # work and must not stall the event loop during assembly.
+        # Ordered BEFORE warmup so the background warmup compiles the
+        # TUNED gate/ladder eligibility, not rungs about to change.
+        if node.autotune_mode != "off":
+            from .device import autotune as _autotune
+
+            node.autotuner = _autotune.DeviceAutotuner(
+                verifier=node.chain.verifier,
+                budget_ms=node.autotune_budget_ms,
+                grid=_autotune.parse_grid(node.autotune_grid),
+                artifact_path=node.autotune_artifact,
+                mode=node.autotune_mode,
+                logger=get_logger("autotune"),
+            )
+            await asyncio.get_running_loop().run_in_executor(
+                None, node.autotuner.tune
+            )
+            if node.autotune_mode == "adaptive":
+                node.drift_monitor = _autotune.DriftMonitor(
+                    node.autotuner,
+                    node.device_telemetry,
+                    verifier=node.chain.verifier,
+                )
+                node._drift_task = asyncio.ensure_future(
+                    node.drift_monitor.run()
+                )
+            _autotune.bind_autotune_collectors(
+                node.metrics.autotune,
+                node.autotuner,
+                monitor=node.drift_monitor,
+            )
+        # pre-warm the device-ingest compiles (every eligible ladder
+        # rung at the — possibly just tuned — gate) on a background
+        # thread through the persistent cache so steady-state gossip
+        # never pays a cold multi-minute XLA compile; until a size is
+        # warm the verifier serves it from the host path
+        # (host_fallback_when_cold)
         if node.bls_warmup and hasattr(
             node.chain.verifier, "start_warmup"
         ):
@@ -879,6 +938,9 @@ class BeaconNode:
 
     async def close(self) -> None:
         """Reverse-order shutdown (graceful SIGINT path)."""
+        if self._drift_task is not None:
+            self._drift_task.cancel()
+            self._drift_task = None
         if self.clock is not None:
             self.clock.stop()
         if self.monitoring is not None:

@@ -95,14 +95,18 @@ def get_backend() -> str:
     return _backend
 
 
-def set_backend(name: str, *, clear: bool = True) -> None:
+def set_backend(name: str, *, clear: bool = True, rewarm: bool = True) -> None:
     """Select the limb backend. The choice is read at TRACE time, so a
     switch drops every cached jit trace by default (XLA stages and
     Pallas kernel builders re-trace lazily and re-read the backend);
     the persistent compile cache keys on the emitted HLO, so both
     backends' compiled artifacts coexist on disk. clear=False skips
     the (process-wide, expensive to repopulate) cache drop — only
-    sound for EAGER op use, which reads the backend per call."""
+    sound for EAGER op use, which reads the backend per call.
+    rewarm=False keeps the ingest warm-registry invalidation but
+    suppresses its background warmup re-kick — for transient switches
+    (the autotuner's probes) that would otherwise launch a compile
+    storm for a candidate backend that may lose."""
     global _backend
     if name not in LIMB_BACKENDS:
         raise ValueError(f"unknown limb backend {name!r}; want {LIMB_BACKENDS}")
@@ -119,6 +123,18 @@ def set_backend(name: str, *, clear: bool = True) -> None:
             t = _telemetry.get_telemetry()
             if t is not None:
                 t.note_backend_switch()
+            # the ingest warm registry described the executables that
+            # just died: a cold-fallback verifier trusting a stale
+            # mark would dispatch a live bucket straight into the
+            # recompile. Only when the kernels module is already
+            # loaded — switching backends before any kernel import
+            # has no marks to invalidate and must not pull the whole
+            # kernel stack in here.
+            import sys
+
+            k = sys.modules.get("lodestar_tpu.bls.kernels")
+            if k is not None:
+                k.invalidate_ingest_warm(rewarm=rewarm)
 
 
 @contextlib.contextmanager

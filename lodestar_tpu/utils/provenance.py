@@ -46,6 +46,20 @@ def provenance() -> dict:
         from ..bls import kernels
 
         stamp["ingest_min_bucket"] = kernels.ingest_min_bucket()
+        stamp["ladder_top"] = kernels.ladder_top()
+    except Exception:
+        pass
+    try:
+        # active tuned configuration: which autotune mode/decision (if
+        # any) produced the knob values above — without it two BENCH_*
+        # artifacts with different numbers cannot say whether a tuner
+        # or an operator set them apart
+        from ..device import autotune
+
+        stamp.update(autotune.provenance_fields())
+        d = autotune.applied_decision()
+        if d is not None:
+            stamp["autotune_config"] = dict(d.get("config", {}))
     except Exception:
         pass
     stamp["git_rev"] = _git_rev()

@@ -114,6 +114,12 @@ def _child(args) -> None:
 
     from lodestar_tpu.ops import limbs as L
 
+    if args.autotune_from:
+        # replay the recorded decision in EVERY child: the sweep then
+        # measures the tuner's backend/ladder at each mesh size
+        from lodestar_tpu.device import autotune as AT
+
+        AT.apply_decision(AT.load_decision(args.autotune_from))
     if args.limb_backend:
         L.set_backend(args.limb_backend)
     rate, ok = run_workload(args.devices, args.sets, args.reps)
@@ -160,6 +166,8 @@ def _spawn(d: int, args) -> dict:
     ]
     if args.limb_backend:
         cmd += ["--limb-backend", args.limb_backend]
+    if args.autotune_from:
+        cmd += ["--autotune-from", os.path.abspath(args.autotune_from)]
     res = subprocess.run(
         cmd, env=env, capture_output=True, text=True, timeout=3600
     )
@@ -189,6 +197,11 @@ def main() -> None:
     )
     ap.add_argument(
         "--limb-backend", choices=("vpu", "mxu"), default=None
+    )
+    ap.add_argument(
+        "--autotune-from", default=None,
+        help="replay a recorded autotune decision JSON in every "
+        "sweep child before measuring",
     )
     ap.add_argument(
         "--json-out", default=None, help="write the sweep table here"

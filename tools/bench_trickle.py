@@ -136,6 +136,19 @@ async def _bench(args) -> dict:
     from lodestar_tpu.bls import TpuBlsVerifier
     from lodestar_tpu.bls import kernels as K
 
+    if args.autotune_from:
+        # replay a recorded autotune decision (device/autotune.py):
+        # the trickle then measures the tuner's configuration. Like
+        # --ingest-min-bucket below, an EXPLICIT --latency-budget-ms
+        # wins over the replayed value (A/B runs against the tuned
+        # config must be possible).
+        from lodestar_tpu.device import autotune as _at
+
+        cfg = _at.apply_decision(_at.load_decision(args.autotune_from))
+        if args.latency_budget_ms is None:
+            args.latency_budget_ms = cfg.latency_budget_ms
+    if args.latency_budget_ms is None:
+        args.latency_budget_ms = 50
     if args.ingest_min_bucket is not None:
         K.set_ingest_min_bucket(args.ingest_min_bucket)
 
@@ -247,12 +260,17 @@ def main() -> None:
                    help="repetitions of each group size")
     p.add_argument("--gap-ms", type=float, default=20.0,
                    help="arrival gap between trickle items")
-    p.add_argument("--latency-budget-ms", type=int, default=50)
+    p.add_argument("--latency-budget-ms", type=int, default=None,
+                   help="rolling-bucket latency budget (default 50; "
+                   "an explicit value wins over --autotune-from)")
     p.add_argument("--ingest-min-bucket", type=int, default=None)
     p.add_argument("--no-rolling", action="store_true",
                    help="disable continuous batching (A/B reference)")
     p.add_argument("--warmup", action="store_true",
                    help="block on full ingest warmup before measuring")
+    p.add_argument("--autotune-from", default=None,
+                   help="replay a recorded autotune decision JSON "
+                   "(AUTOTUNE.json) before measuring")
     p.add_argument("--json-out", default=None)
     args = p.parse_args()
     if args.real:

@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""The first TPU-attached measurement round, in one command.
+
+Every perf lever since round 6 — the MXU int8 limb backend, the mid
+bucket-ladder rungs, continuous batching, mesh sharding, and now the
+device auto-tuner — was built and CI-guarded on a CPU-only container;
+COVERAGE.md states plainly which numbers measure the 1-core emulation
+instead of the chip. This tool is the payoff script for the first
+round that runs WITH hardware: it executes the whole campaign in
+dependency order and leaves one artifact per step, so the post-MXU
+stage budget and the chip-scaling curve land in a single run.
+
+Steps (see REAL_CAMPAIGN.md for the runbook):
+
+  1. preflight      — platform/device/persistent-cache check
+  2. autotune       — DeviceAutotuner startup tune on the real chip
+                      (full grid, generous budget) -> AUTOTUNE_real.json
+  3. bench          — bench.py --autotune-from (headline sets/s under
+                      the tuned config) -> BENCH_real.json
+  4. stage_budget   — tools/profile_prefix.py per backend: the
+                      post-MXU per-stage budget that updates
+                      COVERAGE.md's table -> STAGE_BUDGET_real.json
+  5. trickle        — tools/bench_trickle.py --real --autotune-from
+                      (gossip-shaped steady state) -> BENCH_trickle_real.json
+  6. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
+                      (the chip-scaling curve) -> MULTICHIP_real.json
+
+`--dry-run` emits the full campaign plan (commands, artifacts,
+prerequisites) as JSON without executing anything — reviewable on
+this CPU container, runnable on the TPU host. `--steps` selects a
+subset; a failed step aborts the remainder (later steps consume
+earlier artifacts).
+
+Usage:
+  python tools/run_real_campaign.py --dry-run
+  python tools/run_real_campaign.py                 # on the TPU host
+  python tools/run_real_campaign.py --steps autotune,bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PY = sys.executable
+
+AUTOTUNE_ARTIFACT = "AUTOTUNE_real.json"
+
+
+def build_plan(args) -> list[dict]:
+    """The campaign as data: each step is {name, why, cmd | fn,
+    artifact, needs}. Commands are plain argv lists so the dry-run
+    plan is copy-pasteable."""
+    at = args.autotune_artifact
+    return [
+        {
+            "name": "preflight",
+            "why": "fail fast off-TPU; confirm the persistent "
+            "compile cache is writable (a cold cache turns every "
+            "later step into a multi-minute compile festival)",
+            "fn": "preflight",
+            "artifact": None,
+            "needs": [],
+        },
+        {
+            "name": "autotune",
+            "why": "derive THIS host's config: limb backend x ingest "
+            "gate x ladder top x latency budget, measured on the "
+            "real chip at real ladder rungs (batch-flat cost makes "
+            "the probes exact there)",
+            "fn": "autotune",
+            "artifact": at,
+            "needs": ["preflight"],
+        },
+        {
+            "name": "bench",
+            "why": "the headline production-path sets/s under the "
+            "tuned config (the number COVERAGE.md's 'Measured "
+            "performance' table tracks; 10x north star ~22,200)",
+            "cmd": [PY, "bench.py", "--autotune-from", at],
+            "stdout": "BENCH_real.json",
+            "artifact": "BENCH_real.json",
+            "needs": ["autotune"],
+        },
+        {
+            "name": "stage_budget",
+            "why": "the post-MXU per-stage device budget: the offline "
+            "counterpart of the live lodestar_jax_stage_device_"
+            "seconds histograms and the drift monitor's shares — "
+            "updates COVERAGE.md's stage-budget table",
+            "cmd": [
+                PY,
+                "tools/profile_prefix.py",
+                "--limb-backend",
+                "mxu",
+                "--n",
+                "2048",
+            ],
+            "stdout": "STAGE_BUDGET_real.txt",
+            "artifact": "STAGE_BUDGET_real.txt",
+            "needs": ["autotune"],
+        },
+        {
+            "name": "trickle",
+            "why": "gossip-shaped steady state on the chip: proves "
+            "the 50ms-budget rolling bucket coalesces real arrival "
+            "gaps onto the device-ingest path (BENCH_trickle's CPU "
+            "caveat finally retired)",
+            "cmd": [
+                PY,
+                "tools/bench_trickle.py",
+                "--real",
+                "--autotune-from",
+                at,
+                "--json-out",
+                "BENCH_trickle_real.json",
+            ],
+            "artifact": "BENCH_trickle_real.json",
+            "needs": ["autotune"],
+        },
+        {
+            "name": "mesh",
+            "why": "the chip-scaling curve (strong scaling over the "
+            "attached chips) — the multi-chip arm of the 10x path, "
+            "never yet measured on hardware (MULTICHIP_SWEEP.json "
+            "is virtual devices)",
+            "cmd": [
+                PY,
+                "tools/bench_mesh_sweep.py",
+                "--real",
+                "--autotune-from",
+                at,
+                "--sets",
+                "2048",
+                "--reps",
+                "3",
+                "--json-out",
+                "MULTICHIP_real.json",
+            ],
+            "artifact": "MULTICHIP_real.json",
+            "needs": ["autotune"],
+        },
+    ]
+
+
+def step_preflight(args) -> dict:
+    import jax
+
+    from lodestar_tpu.utils import jaxcache
+    from lodestar_tpu.utils.provenance import provenance
+
+    jaxcache.enable()
+    platform = jax.default_backend()
+    devs = jax.devices()
+    info = {
+        "platform": platform,
+        "devices": len(devs),
+        "device_kind": str(getattr(devs[0], "device_kind", "")),
+        "provenance": provenance(),
+    }
+    if platform != "tpu" and not args.allow_cpu:
+        raise SystemExit(
+            f"preflight: platform is {platform!r}, not 'tpu'. This "
+            "campaign measures hardware; run it on the TPU host "
+            "(--allow-cpu to force a smoke run whose numbers are "
+            "emulation, not measurement)."
+        )
+    return info
+
+
+def step_autotune(args) -> dict:
+    from lodestar_tpu.device.autotune import DeviceAutotuner
+
+    tuner = DeviceAutotuner(
+        budget_ms=args.autotune_budget_ms,
+        # anchored to the repo: the later subprocess steps resolve
+        # the artifact against REPO (cwd=REPO), and so does the
+        # resume check — a cwd-relative write from $HOME would strand
+        # the expensive tune's output where nothing reads it
+        artifact_path=os.path.join(REPO, args.autotune_artifact),
+        mode="startup",
+    )
+    return tuner.tune(trigger="campaign")
+
+
+def run(args) -> int:
+    plan = build_plan(args)
+    want = (
+        [s.strip() for s in args.steps.split(",") if s.strip()]
+        if args.steps
+        else [st["name"] for st in plan]
+    )
+    unknown = set(want) - {st["name"] for st in plan}
+    if unknown:
+        print(f"unknown steps: {sorted(unknown)}", file=sys.stderr)
+        return 2
+    if args.dry_run:
+        out = {
+            "campaign": "first TPU-attached measurement round",
+            "runbook": "REAL_CAMPAIGN.md",
+            "cwd": REPO,
+            "steps": [
+                {
+                    "name": st["name"],
+                    "selected": st["name"] in want,
+                    "why": st["why"],
+                    "command": (
+                        " ".join(st["cmd"])
+                        + (
+                            f" > {st['stdout']}"
+                            if st.get("stdout")
+                            else ""
+                        )
+                        if "cmd" in st
+                        else f"<in-process: {st['fn']}>"
+                    ),
+                    "artifact": st["artifact"],
+                    "needs": st["needs"],
+                }
+                for st in plan
+            ],
+        }
+        print(json.dumps(out, indent=2))
+        return 0
+    done: set[str] = set()
+    results: dict = {}
+    fns = {"preflight": step_preflight, "autotune": step_autotune}
+    for st in plan:
+        if st["name"] not in want:
+            continue
+        missing = [n for n in st["needs"] if n not in done]
+        if missing:
+            # a skipped prerequisite is fine when its artifact
+            # already exists on disk (resuming a campaign)
+            for n in missing:
+                art = next(
+                    p["artifact"] for p in plan if p["name"] == n
+                )
+                if art is not None and not os.path.exists(
+                    os.path.join(REPO, art)
+                ):
+                    print(
+                        f"step {st['name']}: prerequisite {n} not "
+                        f"run and artifact {art} absent",
+                        file=sys.stderr,
+                    )
+                    return 1
+        print(f"==> {st['name']}", file=sys.stderr)
+        try:
+            if "fn" in st:
+                results[st["name"]] = fns[st["fn"]](args)
+            elif st.get("stdout"):
+                with open(os.path.join(REPO, st["stdout"]), "w") as f:
+                    subprocess.run(
+                        st["cmd"], cwd=REPO, check=True, stdout=f
+                    )
+            else:
+                subprocess.run(st["cmd"], cwd=REPO, check=True)
+        except Exception as e:
+            print(
+                f"step {st['name']} FAILED: {e!r} — aborting the "
+                "remainder (later steps consume earlier artifacts)",
+                file=sys.stderr,
+            )
+            return 1
+        done.add(st["name"])
+    print(
+        json.dumps(
+            {
+                "completed": sorted(done),
+                "artifacts": [
+                    st["artifact"]
+                    for st in plan
+                    if st["name"] in done and st["artifact"]
+                ],
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="emit the campaign plan as JSON without executing",
+    )
+    p.add_argument(
+        "--steps",
+        default=None,
+        help="comma-separated subset of steps to run",
+    )
+    p.add_argument(
+        "--autotune-budget-ms",
+        type=float,
+        default=1_200_000.0,
+        help="tune budget on the real chip (default 20 min: first "
+        "run pays real compiles; repeats ride the persistent cache)",
+    )
+    p.add_argument(
+        "--autotune-artifact", default=AUTOTUNE_ARTIFACT
+    )
+    p.add_argument(
+        "--allow-cpu",
+        action="store_true",
+        help="let preflight pass off-TPU (smoke only; numbers "
+        "measure the CPU emulation)",
+    )
+    return run(p.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,12 @@ run_tier1() {
   # dashboard lint first (also covered by tests/test_dashboards_lint.py
   # inside the pytest run): a dangling panel metric fails the tier
   JAX_PLATFORMS=cpu python tools/lint_dashboards.py || exit 1
+  # autotuner offline unit suite, standalone and first (also part of
+  # the full pytest run below): the drift-monitor/tuner logic runs
+  # with STUBBED kernels, so this gate stays seconds-fast — no real
+  # multi-minute ingest compile may ever enter tier-1 through it
+  JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q \
+    -m 'not slow' -p no:cacheprovider || exit 1
   # pytest line matches ROADMAP.md "Tier-1 verify" plus --durations=25:
   # the per-test timing artifact tracks suite-runtime creep per PR
   # (slowest offenders land in /tmp/lodestar_tier1_durations.txt and
