@@ -576,6 +576,8 @@ def mark_ingest_warm(b: int, kind: str = "batch") -> None:
     _INGEST_WARM.add((kind, b))
 
 
+
+
 # generation counter for the warm registry: invalidation bumps it so
 # a warmup dispatch that STARTED under the previous generation (its
 # executable died with the cache clear) cannot land a stale mark when
@@ -592,13 +594,23 @@ def invalidate_ingest_warm(rewarm: bool = True) -> None:
     trusting a stale mark would dispatch a live bucket straight into
     the recompile the mark claimed was paid. When a warmup ran in this
     process, re-warm the eligible rungs in the background (persistent
-    cache makes a switch back near-free)."""
+    cache makes a switch back near-free). The registry also carries
+    the KZG MSM rung marks (kind "msm", ops/msm.py) whose executables
+    the same cache clear killed — their rewarm is kicked through the
+    msm module's own warmup policy, or the DA workload would ride the
+    host fallback for the rest of the process."""
     global _WARM_GEN
     with _WARM_GEN_LOCK:
         _WARM_GEN += 1
         _INGEST_WARM.clear()
     if rewarm and _WARMUP_STARTED:
         warmup_ingest()
+    if rewarm:
+        import sys
+
+        m = sys.modules.get("lodestar_tpu.ops.msm")
+        if m is not None:
+            m.rewarm_async()
 
 
 WARMUP_PIPELINES = ("batch", "same_message")

@@ -5,7 +5,10 @@ Reference analog: chain/validation/blobSidecar.ts
 commitment inclusion proof, batched KZG proof verification) and
 produceBlock blob bundle assembly
 (produceBlock/validateBlobsAndKzgCommitments.ts). KZG math:
-crypto/kzg.py (c-kzg analog).
+crypto/kzg.py (c-kzg analog) — a full max-blobs block's batched
+proof check is ONE random-lincomb verification whose three MSMs ride
+a single device dispatch on the TPU Pippenger backend (ops/msm.py),
+with host-C and pure-Python fallback tiers.
 """
 
 from __future__ import annotations

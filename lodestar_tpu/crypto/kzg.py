@@ -6,10 +6,24 @@ and used by blob validation (chain/validation/blobSidecar.ts) and block
 production (produceBlock/validateBlobsAndKzgCommitments.ts). Fresh
 implementation of consensus-specs deneb/polynomial-commitments.md.
 
-Group arithmetic runs on the native C backend (csrc/bls381.c — incl. a
-Pippenger MSM for the 4096-point lagrange lincombs) with the
-pure-Python oracle as fallback. Scalar-field (Fr) arithmetic is plain
-Python ints with Montgomery batch inversion.
+The multi-scalar multiplications — the pairing-heavy core of both the
+4096-point Lagrange lincombs and the batch-verify random lincombs —
+run on a THREE-TIER backend (`set_msm_backend` /
+LODESTAR_TPU_KZG_MSM_BACKEND; per-path dispatch counters mirror the
+BLS verifier's):
+
+  1. **device** — the TPU bucketed Pippenger (`ops/msm.py`): batched
+     limb tensors, one dispatch for a whole blob batch's lincombs. The
+     default "auto" mode routes here on a TPU host once the rung's
+     compile is warm (the kernels warm registry, kind "msm");
+  2. **native** — the host C Pippenger (csrc/bls381.c `blsn_g1_msm`),
+     the cold-rung / off-TPU fallback and the differential oracle;
+  3. **oracle** — the pure-Python double-and-add lincomb, always
+     available, the last-resort tier and the slow reference.
+
+Other group arithmetic stays on native-with-oracle-fallback.
+Scalar-field (Fr) arithmetic is plain Python ints with Montgomery
+batch inversion.
 
 Trusted setup: `load_trusted_setup(path)` reads the standard JSON
 format ({"g1_lagrange": [...48B hex...], "g2_monomial": [...]}), so the
@@ -125,15 +139,132 @@ _g2_add = oc.g2_add
 _g2_mul = oc.g2_mul
 
 
+# --- three-tier MSM backend (device / native / oracle) ---------------------
+
+MSM_BACKENDS = ("auto", "device", "native", "oracle")
+
+_msm_backend = os.environ.get("LODESTAR_TPU_KZG_MSM_BACKEND", "auto")
+if _msm_backend not in MSM_BACKENDS:
+    raise ValueError(
+        f"LODESTAR_TPU_KZG_MSM_BACKEND={_msm_backend!r} not in "
+        f"{MSM_BACKENDS}"
+    )
+
+# per-path dispatch counters (the BLS verifier's dispatch_by_path
+# discipline): one entry per _g1_lincomb_many call, by the tier that
+# served it; device_fallbacks counts auto-mode dispatches that WANTED
+# the device but found the rung cold (or the dispatch erroring) and
+# fell back to a host tier. Sampled at scrape by
+# bind_kzg_collectors (lodestar_kzg_* series).
+_MSM_DISPATCH: dict[str, int] = {"device": 0, "native": 0, "oracle": 0}
+_MSM_DEVICE_FALLBACKS = 0
+_BATCH_HIST = None  # bound lodestar_kzg_batch_verify_blobs histogram
+
+
+def msm_backend() -> str:
+    """The live MSM backend mode."""
+    return _msm_backend
+
+
+def set_msm_backend(name: str) -> None:
+    global _msm_backend
+    if name not in MSM_BACKENDS:
+        raise ValueError(
+            f"unknown kzg msm backend {name!r}; want {MSM_BACKENDS}"
+        )
+    _msm_backend = name
+
+
+def msm_path_counts() -> dict:
+    """Snapshot of the per-path dispatch counters (tests, /metrics)."""
+    return dict(_MSM_DISPATCH, device_fallbacks=_MSM_DEVICE_FALLBACKS)
+
+
+def bind_kzg_collectors(metrics) -> None:
+    """Wire the m.kzg registry namespace (metrics/beacon.py) to sample
+    the module counters at scrape — the addCollect pattern every other
+    service uses (node.py)."""
+    global _BATCH_HIST
+    _BATCH_HIST = getattr(metrics, "batch_verify_blobs", None)
+    metrics.msm_dispatch_total.add_collect(
+        lambda g: [
+            g.set(v, path=p) for p, v in _MSM_DISPATCH.items()
+        ]
+    )
+    metrics.msm_device_fallback_total.add_collect(
+        lambda g: g.set(_MSM_DEVICE_FALLBACKS)
+    )
+
+
+def _device_msm_ready(n: int) -> bool:
+    """Should auto mode route an n-point lincomb to the device? Only
+    on a TPU host, and only once the rung's compile is warm — a cold
+    rung rides the host C path (counted as a fallback) the way the
+    BLS verifier's host_fallback_when_cold keeps cold buckets off
+    multi-minute compiles."""
+    global _MSM_DEVICE_FALLBACKS
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    from ..ops import msm as _msm
+
+    if _msm.msm_is_warm(_msm.msm_rung(n)):
+        return True
+    _MSM_DEVICE_FALLBACKS += 1
+    return False
+
+
+def _resolve_msm_path(n: int) -> str:
+    mode = _msm_backend
+    if mode == "device":
+        return "device"
+    if mode == "oracle":
+        return "oracle"
+    if mode == "native":
+        return "native" if native.available() else "oracle"
+    if _device_msm_ready(n):
+        return "device"
+    return "native" if native.available() else "oracle"
+
+
+def _g1_lincomb_many(tasks):
+    """Batched lincombs: [(points, scalars), ...] -> [point | None].
+    On the device tier every task rides ONE dispatch (batch axis over
+    lincombs — ops/msm.g1_msm_many); host tiers loop. A device error
+    falls back to the host tiers (counted), never fails the caller."""
+    global _MSM_DEVICE_FALLBACKS
+    if not tasks:
+        return []
+    for pts, ks in tasks:
+        assert len(pts) == len(ks)
+    path = _resolve_msm_path(max(len(p) for p, _ in tasks))
+    if path == "device":
+        from ..ops import msm as _msm
+
+        try:
+            out = _msm.g1_msm_many(tasks)
+            _MSM_DISPATCH["device"] += 1
+            return out
+        except Exception:
+            _MSM_DEVICE_FALLBACKS += 1
+            path = "native" if native.available() else "oracle"
+    if path == "native":
+        _MSM_DISPATCH["native"] += 1
+        return [native.g1_msm(pts, ks) for pts, ks in tasks]
+    _MSM_DISPATCH["oracle"] += 1
+    out = []
+    for pts, ks in tasks:
+        acc = None
+        for p, s in zip(pts, ks):
+            acc = oc.g1_add(acc, oc.g1_mul(p, s % BLS_MODULUS))
+        out.append(acc)
+    return out
+
+
 def _g1_lincomb(points, scalars):
-    """sum_i scalars[i] * points[i] (Pippenger when native)."""
-    assert len(points) == len(scalars)
-    if native.available():
-        return native.g1_msm(points, scalars)
-    acc = None
-    for p, s in zip(points, scalars):
-        acc = oc.g1_add(acc, oc.g1_mul(p, s % BLS_MODULUS))
-    return acc
+    """sum_i scalars[i] * points[i] through the three-tier backend."""
+    return _g1_lincomb_many([(points, scalars)])[0]
 
 
 def _pairings_one(pairs) -> bool:
@@ -419,12 +550,23 @@ def verify_blob_kzg_proof_batch(
     proof_bytes_list: list[bytes],
 ) -> bool:
     """Random-linear-combination batch verification (spec
-    verify_kzg_proof_batch): one 2-pairing check for n blobs."""
+    verify_kzg_proof_batch): one 2-pairing check for n blobs, the
+    three verification lincombs batched into ONE device dispatch on
+    the device MSM tier. The length check comes first — a
+    proofs/commitments mismatch must raise, not be zip-truncated into
+    a verdict about a batch nobody submitted — and the empty batch
+    short-circuits True without touching the trusted setup."""
     n = len(blobs)
     if not (n == len(commitment_bytes_list) == len(proof_bytes_list)):
-        raise KzgError("batch length mismatch")
+        raise KzgError(
+            f"batch length mismatch: {n} blobs, "
+            f"{len(commitment_bytes_list)} commitments, "
+            f"{len(proof_bytes_list)} proofs"
+        )
     if n == 0:
         return True
+    if _BATCH_HIST is not None:
+        _BATCH_HIST.observe(n)
     commitments = [_validate_g1(c) for c in commitment_bytes_list]
     proofs = [_validate_g1(p) for p in proof_bytes_list]
     zs, ys = [], []
@@ -446,15 +588,20 @@ def verify_blob_kzg_proof_batch(
     r = hash_to_bls_field(data)
     r_powers = [pow(r, i, BLS_MODULUS) for i in range(n)]
 
-    proof_lincomb = _g1_lincomb(proofs, r_powers)
-    proof_z_lincomb = _g1_lincomb(
-        proofs, [rp * z % BLS_MODULUS for rp, z in zip(r_powers, zs)]
-    )
     c_minus_y = [
         _g1_add(c, _g1_mul(oc.G1_GEN, (-y) % BLS_MODULUS))
         for c, y in zip(commitments, ys)
     ]
-    c_minus_y_lincomb = _g1_lincomb(c_minus_y, r_powers)
+    proof_lincomb, proof_z_lincomb, c_minus_y_lincomb = _g1_lincomb_many(
+        [
+            (proofs, r_powers),
+            (
+                proofs,
+                [rp * z % BLS_MODULUS for rp, z in zip(r_powers, zs)],
+            ),
+            (c_minus_y, r_powers),
+        ]
+    )
     lhs = _g1_add(c_minus_y_lincomb, proof_z_lincomb)
     return _pairings_one(
         [

@@ -108,6 +108,7 @@ DEFAULT_GRID = {
     "gate": (128, 256, 512),
     "top": (1024, 2048),
     "budget_ms": (25, 50, 100),
+    "msm_window": (8, 12, 16),
 }
 
 # bulk (block-import / sync) buckets must clear well inside a slot;
@@ -127,7 +128,11 @@ def parse_grid(spec: str | None) -> dict:
     grid = {k: tuple(v) for k, v in DEFAULT_GRID.items()}
     if not spec:
         return grid
-    alias = {"budget": "budget_ms", "latency": "budget_ms"}
+    alias = {
+        "budget": "budget_ms",
+        "latency": "budget_ms",
+        "window": "msm_window",
+    }
     for part in spec.split(";"):
         part = part.strip()
         if not part:
@@ -180,16 +185,27 @@ def _validate_grid_values(grid: dict) -> None:
             raise ValueError(
                 f"autotune grid latency budget {b} must be positive"
             )
+    from ..ops import msm as _msm
+
+    for w in grid["msm_window"]:
+        if w not in _msm.SUPPORTED_WINDOWS:
+            raise ValueError(
+                f"autotune grid msm_window {w} not in "
+                f"{_msm.SUPPORTED_WINDOWS}"
+            )
 
 
 @dataclass(frozen=True)
 class TunedConfig:
-    """One point of the knob space — everything apply() touches."""
+    """One point of the knob space — everything apply() touches.
+    msm_window == 0 means "leave the live window alone" (the default
+    keeps pre-MSM decision artifacts replayable)."""
 
     limb_backend: str
     ingest_min_bucket: int
     ladder_top: int
     latency_budget_ms: float
+    msm_window: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -200,6 +216,8 @@ def current_config(verifier=None) -> TunedConfig:
     from ..bls import kernels
     from ..ops import limbs
 
+    from ..ops import msm
+
     budget_ms = 50.0
     fn = getattr(verifier, "latency_budget_ms", None)
     if fn is not None:
@@ -209,6 +227,7 @@ def current_config(verifier=None) -> TunedConfig:
         ingest_min_bucket=kernels.ingest_min_bucket(),
         ladder_top=kernels.ladder_top(),
         latency_budget_ms=budget_ms,
+        msm_window=msm.msm_window(),
     )
 
 
@@ -327,13 +346,60 @@ def select_config(
         "needed_ms": round(need_ms, 3),
         "model": "2x estimated gate-bucket dispatch time",
     }
+    msm_window, msm_rationale = select_msm_window(
+        grid.get("msm_window", DEFAULT_GRID["msm_window"]), platform
+    )
+    rationale["msm_window"] = msm_rationale
     cfg = TunedConfig(
         limb_backend=best.backend,
         ingest_min_bucket=gate,
         ladder_top=top,
         latency_budget_ms=float(budget),
+        msm_window=msm_window,
     )
     return cfg, rationale
+
+
+def select_msm_window(
+    candidates, platform: str, rung: int | None = None
+) -> tuple[int, dict]:
+    """Pick the Pippenger window for the KZG MSM workload (ops/msm.py)
+    from an explicit cost model of the device program at the dominant
+    rung (the blob-width Lagrange lincomb).
+
+    TPU: per-step device cost is batch-flat (COVERAGE.md), so the
+    objective is SEQUENTIAL DEPTH — scatter steps (rung/PAR) + bucket
+    reduction (2^(w-1)) + window combination (~255 doubles + nwin
+    adds); small windows win until the bucket scan is negligible.
+    CPU XLA: one core executes every lane, so the objective is TOTAL
+    point adds — rung*nwin (scatter) + 2^w*nwin (reduction); the
+    optimum sits near w = log2(rung). Both models and every
+    candidate's estimate land in the rationale."""
+    from ..ops import msm as _msm
+
+    rung = rung or _msm.MSM_RUNGS[-1]
+    cands = sorted(set(int(w) for w in candidates))
+
+    def seq_steps(w):
+        nwin = _msm.num_windows(w)
+        return rung // _msm.PAR + (1 << (w - 1)) + 255 + nwin
+
+    def total_adds(w):
+        nwin = _msm.num_windows(w)
+        return rung * nwin + (1 << w) * nwin
+
+    model = seq_steps if platform == "tpu" else total_adds
+    chosen = min(cands, key=model)
+    return chosen, {
+        "chosen": chosen,
+        "rung": rung,
+        "model": (
+            "sequential device steps (batch-flat per-step cost)"
+            if platform == "tpu"
+            else "total point adds (CPU linear per-lane cost)"
+        ),
+        "estimates": {w: model(w) for w in cands},
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -383,22 +449,34 @@ def apply_config(config: TunedConfig, verifier=None) -> None:
     host_cold forever."""
     from ..bls import kernels
     from ..ops import limbs
+    from ..ops import msm as _msm
 
     switching = limbs.get_backend() != config.limb_backend
     kernels.set_ladder_top(config.ladder_top, rewarm=False)
     kernels.set_ingest_min_bucket(
         config.ingest_min_bucket, rewarm=False
     )
+    if config.msm_window:
+        # rewarm deferred like the bucket knobs: a kick here would
+        # compile MSM programs against a limb backend the switch
+        # below is about to clear-caches away
+        _msm.set_msm_window(config.msm_window, rewarm=False)
     if switching:
+        # the switch's registry invalidation re-kicks BOTH workloads'
+        # warmups (BLS ingest + MSM rungs) at the final knob state
         limbs.set_backend(config.limb_backend)
-    elif kernels._WARMUP_STARTED:
-        newly = tuple(
-            b
-            for b in kernels.default_warmup_sizes()
-            if not kernels.ingest_is_warm(b)
-        )
-        if newly:
-            kernels.warmup_ingest(newly)
+    else:
+        if kernels._WARMUP_STARTED:
+            newly = tuple(
+                b
+                for b in kernels.default_warmup_sizes()
+                if not kernels.ingest_is_warm(b)
+            )
+            if newly:
+                kernels.warmup_ingest(newly)
+        # cold MSM rungs (a re-tuned window) re-warm when the process
+        # opted in; warm rungs make this a no-op
+        _msm.rewarm_async()
     fn = getattr(verifier, "set_latency_budget_ms", None)
     if fn is not None:
         fn(config.latency_budget_ms)
@@ -425,6 +503,8 @@ def apply_decision(
         ingest_min_bucket=int(c["ingest_min_bucket"]),
         ladder_top=int(c["ladder_top"]),
         latency_budget_ms=float(c["latency_budget_ms"]),
+        # pre-MSM artifacts carry no window; 0 leaves the live one
+        msm_window=int(c.get("msm_window", 0)),
     )
     apply_config(cfg, verifier=verifier)
     _record_applied(
@@ -962,6 +1042,8 @@ def bind_autotune_collectors(
         g.set(cfg["ingest_min_bucket"], knob="ingest_min_bucket")
         g.set(cfg["ladder_top"], knob="ladder_top")
         g.set(cfg["latency_budget_ms"], knob="latency_budget_ms")
+        # 0 = decision predates the knob / left the live window alone
+        g.set(cfg.get("msm_window") or 0, knob="msm_window")
 
     metrics.selected.add_collect(_selected)
 

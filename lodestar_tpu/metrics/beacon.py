@@ -771,6 +771,31 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         label_names=("stage",),
     )
 
+    # -- kzg / data availability (crypto/kzg.py three-tier MSM) ----------
+    # The second device workload: blob-batch KZG verification routes
+    # its lincombs through the device Pippenger MSM (ops/msm.py) with
+    # host-C and pure-Python fallback tiers. Drives the "KZG / DA"
+    # panels of dashboards/lodestar_tpu_device.json.
+    kz = SimpleNamespace()
+    m.kzg = kz
+    kz.msm_dispatch_total = reg.gauge(
+        "lodestar_kzg_msm_dispatch_total",
+        "KZG MSM lincomb dispatches by backend tier"
+        " (device / native / oracle)",
+        label_names=("path",),
+    )
+    kz.msm_device_fallback_total = reg.gauge(
+        "lodestar_kzg_msm_device_fallback_total",
+        "KZG MSM dispatches that wanted the device tier but fell back"
+        " to a host tier (cold rung or device error)",
+    )
+    kz.batch_verify_blobs = reg.histogram(
+        "lodestar_kzg_batch_verify_blobs",
+        "Blobs per verify_blob_kzg_proof_batch call (peak-DA blocks"
+        " land at max blobs per block)",
+        buckets=(1, 2, 4, 6, 9, 16, 32),
+    )
+
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
     k = SimpleNamespace()
     m.clock = k

@@ -475,6 +475,14 @@ def bind_collectors(metrics, telemetry: "DeviceTelemetry", verifier=None):
             g.set((warm / elig) if elig else 1.0, pipeline=kind)
             metrics.warmup_warm_buckets.set(warm, pipeline=kind)
             metrics.warmup_eligible_buckets.set(elig, pipeline=kind)
+        # the KZG MSM workload (ops/msm.py) rides the same warm
+        # registry under its own pipeline label and rung set
+        from ..ops import msm as _msm
+
+        mw, me = _msm.warmup_progress()
+        g.set((mw / me) if me else 1.0, pipeline="msm")
+        metrics.warmup_warm_buckets.set(mw, pipeline="msm")
+        metrics.warmup_eligible_buckets.set(me, pipeline="msm")
 
     metrics.warmup_progress.add_collect(_warmup)
 

@@ -388,6 +388,28 @@ class BeaconNode:
                 "Provide the Ethereum KZG ceremony file for production.",
                 {"config": node.cfg.CONFIG_NAME},
             )
+        # pre-warm the device MSM rungs the DA path dispatches (blob
+        # batch-verify + blob-width lincombs) on a background thread —
+        # only where the auto backend will actually route them (TPU);
+        # until a rung is warm, lincombs ride the host C Pippenger
+        # (counted as lodestar_kzg_msm_device_fallback_total)
+        import jax as _jax
+
+        if (
+            node.bls_warmup
+            and _kzg.msm_backend() in ("auto", "device")
+            and _jax.default_backend() == "tpu"
+        ):
+            import threading
+
+            from .ops import msm as _msm
+
+            threading.Thread(
+                target=_msm.warmup_msm,
+                name="kzg-msm-warmup",
+                daemon=True,
+            ).start()
+            log.info("kzg msm warmup started in background")
         # execution engine (engine API over JSON-RPC + JWT), wrapped in
         # the resilience layer: classified retries in the RPC client,
         # engine-state tracking + fail-fast breaker around every call
@@ -827,6 +849,10 @@ class BeaconNode:
             node.device_telemetry,
             verifier=node.chain.verifier,
         )
+        # kzg / DA MSM backend counters (crypto/kzg.py three tiers)
+        from .crypto import kzg as _kzg_metrics
+
+        _kzg_metrics.bind_kzg_collectors(mm.kzg)
         # fork choice / eth1 / light-client server sampled gauges
         mm.forkchoice.nodes.add_collect(
             lambda g: g.set(len(node.chain.fork_choice.proto.nodes))

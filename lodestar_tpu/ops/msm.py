@@ -1,0 +1,515 @@
+"""Bucketed Pippenger multi-scalar multiplication on TPU limb values.
+
+Reference analog: blst's Pippenger MSM behind c-kzg's lincombs
+(SURVEY.md §2.1), mirrored host-side by `csrc/bls381.c blsn_g1_msm`.
+That C path is serial: one core walks every (point, scalar) pair, which
+leaves peak-DA blocks (EIP-4844 blob verification, `crypto/kzg.py`) on
+the one pairing-heavy hot path the accelerator cannot help. This module
+ports the bucket method to the device the way the scalar ladders went
+(static trace-time schedules, batched limb tensors, interval-proved
+accumulators — the `pallas_ladder`/`pallas_chain` design points), with
+a batch axis over independent MSMs so one dispatch serves all the
+lincombs of a blob batch.
+
+Shape of the device program (one jit, one dispatch):
+
+  1. **Signed-digit decomposition** (host, numpy): each scalar k < r
+     becomes ceil(255/w)+1 signed base-2^w digits d_j in [-2^(w-1),
+     2^(w-1)); signed digits halve the bucket table vs the textbook
+     method because -d*P = d*(-P) and negating a G1 point is one field
+     negation of y. Exact: k == sum_j d_j * 2^(w*j) by construction.
+  2. **Bucket accumulation** (device): a `lax.scan` over point chunks.
+     Buckets live as a JacPoint batch of shape (B, par, nwin, 2^(w-1)+1)
+     — B independent MSMs, `par` parallel accumulator copies (the
+     jac_sum_scan trick: n/par sequential steps instead of n), one
+     bucket table per window lane. Each step gathers the target bucket
+     per (B, par, nwin) lane, adds the (sign-selected) point with the
+     COMPLETE Jacobian add, and scatters it back. The complete add is
+     load-bearing, not caution: duplicate input points are legal (two
+     identical blobs yield identical proofs), and when their digits
+     coincide at some window the bucket add degenerates to a doubling —
+     the incomplete add's "negligible collision" argument does not
+     apply when the adversary controls the points.
+  3. **Bucket reduction** (device): the running-sum identity
+     sum_b b*bucket_b = sum of suffix sums, one scan of 2^(w-1)-1 steps
+     with two adds per step, batched over (B, nwin).
+  4. **Window combination** (device): MSB-first scan over windows, w
+     doublings + one add per step — the unchanged double-and-add tail.
+
+  Sequential depth is n/par + 2^(w-1) + ~255/w steps with (par*nwin)-
+  wide vector parallelism per step: on a TPU (batch-flat per-step cost)
+  small windows minimize latency; on CPU XLA (per-lane linear cost) the
+  total-adds optimum sits near w = log2(n). The window is therefore a
+  KNOB (`set_msm_window` / LODESTAR_TPU_MSM_WINDOW) on the autotune
+  grid (device/autotune.py `msm_window`).
+
+Entry layer mirrors `bls/kernels.py`: MSM size rungs pad inputs to a
+small set of static shapes so every rung is ONE compile served by the
+persistent cache; live dispatches mark their rung warm in the kernels
+warm registry (kind "msm") so `crypto/kzg.py`'s auto backend can route
+cold rungs to the host C path instead of stalling gossip on a compile;
+the jit entry is wrapped in `instrument_stage("msm")` so compiles,
+retraces and dispatch/device timings land on /metrics next to the BLS
+stages. Grounding: the bucketed-MSM cost model of 2G2T MSM outsourcing
+(PAPERS.md, arXiv 2602.23464); the batch-verify engine shape of the
+FPGA ECDSA verifier (arXiv 2112.02229).
+
+Correctness oracles: `crypto/bls/native.py g1_msm` (blst-shaped C
+Pippenger) and the pure-Python `crypto/bls/curve.py` ops — differential
+tests in tests/test_ops_msm.py, bit-exact including infinity and
+zero-scalar edge cases.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import jaxcache
+from . import curve as C
+from . import fq
+from . import limbs as L
+
+R_ORDER = (
+    0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+)
+
+# The supported fixed-window widths. 4 exists for cheap CPU-backed
+# tests and tiny inputs; 8/12/16 are the autotune grid. Larger windows
+# shrink the window count (less vector work) but grow the bucket
+# table and its reduction scan as 2^(w-1).
+SUPPORTED_WINDOWS = (4, 8, 12, 16)
+
+# MSM size rungs: every dispatch pads to the smallest rung >= n, so
+# the whole DA workload compiles a handful of static shapes (the
+# bucket-ladder discipline of bls/kernels.py). 64 carries the
+# max-blobs batch-verify lincombs; 4096 carries the blob-width
+# Lagrange lincombs of blob_to_kzg_commitment/compute_kzg_proof.
+MSM_RUNGS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+# Parallel accumulator copies in the bucket-accumulation scan (the
+# jac_sum_scan two-level trick): n/PAR sequential steps, merged by a
+# log2(PAR) tree. Every rung is a multiple of PAR.
+PAR = 8
+
+_WINDOW = int(os.environ.get("LODESTAR_TPU_MSM_WINDOW", "8"))
+if _WINDOW not in SUPPORTED_WINDOWS:
+    raise ValueError(
+        f"LODESTAR_TPU_MSM_WINDOW={_WINDOW} not in {SUPPORTED_WINDOWS}"
+    )
+
+
+def msm_window() -> int:
+    """The live fixed-window width (module knob; autotune-settable)."""
+    return _WINDOW
+
+
+def set_msm_window(w: int, rewarm: bool = True) -> None:
+    """Select the Pippenger window width. Compiled programs are keyed
+    on the window (static jit arg), so no cache clearing is needed —
+    but the kernels warm registry's "msm" marks described programs at
+    the OLD window, and trusting them would route a live lincomb
+    straight into a cold compile, so they drop. The window assignment
+    and the mark invalidation happen under the registry lock as ONE
+    step, so a completing dispatch's check-and-mark (_mark_warm)
+    observes either the old world (mark later cleared here) or the new
+    one (window mismatch, no mark) — never a half-switched state.
+    When a warmup policy exists in this process (node start ran
+    warmup_msm), the rungs re-warm on a background thread — otherwise
+    an autotune window retune would strand the DA workload on the host
+    fallback for the rest of the process (nothing else warms a rung
+    the auto backend's cold fallback never dispatches). rewarm=False
+    suppresses the kick (tests, tools that manage warmup themselves,
+    apply_config's deferred single kick)."""
+    global _WINDOW
+    w = int(w)
+    if w not in SUPPORTED_WINDOWS:
+        raise ValueError(
+            f"unknown msm window {w}; want {SUPPORTED_WINDOWS}"
+        )
+    if w == _WINDOW:
+        return
+    k = sys.modules.get("lodestar_tpu.bls.kernels")
+    if k is None:
+        _WINDOW = w
+    else:
+        with k._WARM_GEN_LOCK:
+            _WINDOW = w
+            k._INGEST_WARM.difference_update(
+                {x for x in k._INGEST_WARM if x[0] == "msm"}
+            )
+    if rewarm:
+        rewarm_async()
+
+
+def rewarm_async() -> None:
+    """Kick a background MSM rewarm — a no-op unless this process
+    opted into warmup (warmup_msm ran). Called by the window setter
+    and by the kernels registry invalidation: a limb-backend switch
+    clears the jit caches, which kills the MSM executables exactly
+    like the BLS ingest ones, and only a re-kick keeps the DA
+    workload off a permanent host fallback."""
+    if not _WARMUP_STARTED:
+        return
+    import threading
+
+    threading.Thread(
+        target=warmup_msm, name="kzg-msm-rewarm", daemon=True
+    ).start()
+
+
+def num_windows(window: int) -> int:
+    """Signed digit count for scalars < r < 2^255: ceil(255/w) data
+    windows plus one carry window (the signed rounding can push a +1
+    past the top data window)."""
+    return 255 // window + 2
+
+
+def msm_rung(n: int) -> int:
+    """Smallest rung >= n (n must not exceed the top rung — callers
+    chunk above it, see g1_msm_many)."""
+    for b in MSM_RUNGS:
+        if n <= b:
+            return b
+    raise ValueError(f"MSM size {n} above the top rung {MSM_RUNGS[-1]}")
+
+
+def default_warmup_rungs() -> tuple[int, ...]:
+    """The rungs the DA hot paths actually dispatch: the batch-verify
+    lincombs (n = blobs-per-block, rung 64) and the blob-width
+    Lagrange lincombs (rung 4096). Warming all seven rungs would pay
+    five compiles nothing dispatches."""
+    return (MSM_RUNGS[0], MSM_RUNGS[-1])
+
+
+# ---------------------------------------------------------------------------
+# Host-side signed-digit decomposition
+# ---------------------------------------------------------------------------
+
+
+def signed_digits(scalars, window: int) -> np.ndarray:
+    """(len(scalars), num_windows) int32 signed base-2^w digits, LSW
+    first; exact (sum_j d_j 2^(wj) == k mod r) by construction. Scalars
+    are reduced mod r first — the spec's scalar domain (native.g1_msm
+    reduces the same way)."""
+    nwin = num_windows(window)
+    half = 1 << (window - 1)
+    full = 1 << window
+    out = np.zeros((len(scalars), nwin), np.int32)
+    for i, k in enumerate(scalars):
+        k = int(k) % R_ORDER
+        j = 0
+        while k:
+            d = k & (full - 1)
+            if d >= half:
+                d -= full
+            out[i, j] = d
+            k = (k - d) >> window
+            j += 1
+        assert j <= nwin, "signed-digit carry overran the window count"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device program
+# ---------------------------------------------------------------------------
+
+
+def _gather_lv(x: L.Lv, idx: jax.Array) -> L.Lv:
+    """x.v (..., nbuckets, nlimb) gathered at idx (...) -> (..., nlimb)."""
+    g = jnp.take_along_axis(x.v, idx[..., None, None], axis=-2)
+    return L.Lv(g[..., 0, :], x.lo, x.hi)
+
+
+def _scatter_lv(x: L.Lv, idx: jax.Array, val: L.Lv) -> L.Lv:
+    """Write val (..., nlimb) into x at bucket idx (...). Caller
+    guarantees val shares x's (canonical) interval profile."""
+    assert (val.lo, val.hi) == (x.lo, x.hi)
+    ix = jnp.indices(idx.shape, sparse=True)
+    return L.Lv(x.v.at[tuple(ix) + (idx,)].set(val.v), x.lo, x.hi)
+
+
+def _gather_jac(b: C.JacPoint, idx: jax.Array) -> C.JacPoint:
+    ix = jnp.indices(idx.shape, sparse=True)
+    return C.JacPoint(
+        _gather_lv(b.x, idx),
+        _gather_lv(b.y, idx),
+        _gather_lv(b.z, idx),
+        b.inf[tuple(ix) + (idx,)],
+    )
+
+
+def _scatter_jac(
+    b: C.JacPoint, idx: jax.Array, val: C.JacPoint
+) -> C.JacPoint:
+    ix = jnp.indices(idx.shape, sparse=True)
+    return C.JacPoint(
+        _scatter_lv(b.x, idx, val.x),
+        _scatter_lv(b.y, idx, val.y),
+        _scatter_lv(b.z, idx, val.z),
+        b.inf.at[tuple(ix) + (idx,)].set(val.inf),
+    )
+
+
+def _bcast_lv(x: L.Lv, shape: tuple) -> L.Lv:
+    return L.Lv(
+        jnp.broadcast_to(x.v[..., None, :], shape + (x.v.shape[-1],)),
+        x.lo,
+        x.hi,
+    )
+
+
+def _norm_add(p: C.JacPoint, q: C.JacPoint) -> C.JacPoint:
+    """Complete add + canonical profile (stable scan carry type)."""
+    return C.jac_normalize(C.FQ_OPS, C.jac_add(C.FQ_OPS, p, q))
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def _msm_program(
+    px: L.Lv, py: L.Lv, inf: jax.Array, digits: jax.Array, *, window: int
+) -> C.JacPoint:
+    """Batched Pippenger: px/py (B, n) canonical affine limb batches,
+    inf (B, n) bool, digits (B, n, nwin) int32 signed. Returns the B
+    MSM results as a JacPoint batch (B,). n must be a multiple of PAR
+    (entry pads to a rung)."""
+    B, n = inf.shape
+    nwin = digits.shape[-1]
+    half = 1 << (window - 1)
+    nbuckets = half + 1  # slot 0 is the zero-digit trash bucket
+    chunks = n // PAR
+
+    def chunked(t):
+        return jnp.moveaxis(
+            t.reshape((B, chunks, PAR) + t.shape[2:]), 1, 0
+        )
+
+    px_c = L.Lv(chunked(px.v), px.lo, px.hi)
+    py_c = L.Lv(chunked(py.v), py.lo, py.hi)
+    inf_c = chunked(inf)
+    dig_c = chunked(digits)
+
+    buckets = C.jac_infinity(C.FQ_OPS, (B, PAR, nwin, nbuckets))
+
+    def accumulate(bkts, xs):
+        qx_v, qy_v, q_inf, digs = xs
+        qx = L.Lv(qx_v, px.lo, px.hi)
+        qy = L.Lv(qy_v, py.lo, py.hi)
+        idx = jnp.abs(digs)  # (B, PAR, nwin); 0 -> trash slot
+        lane = idx.shape
+        bx = _bcast_lv(qx, lane)
+        by = _bcast_lv(qy, lane)
+        by = fq.select(digs < 0, L.neg(by), by)
+        q = C.jac_from_affine(
+            C.FQ_OPS,
+            bx,
+            by,
+            jnp.broadcast_to(q_inf[..., None], lane),
+        )
+        cur = _gather_jac(bkts, idx)
+        new = _norm_add(cur, q)
+        return _scatter_jac(bkts, idx, new), None
+
+    buckets, _ = jax.lax.scan(
+        accumulate, buckets, (px_c.v, py_c.v, inf_c, dig_c)
+    )
+
+    # merge the PAR accumulator copies: log2(PAR) complete adds
+    m = PAR
+    while m > 1:
+        h = m // 2
+        bot = jax.tree.map(lambda t: t[:, :h], buckets)
+        top = jax.tree.map(lambda t: t[:, h:m], buckets)
+        buckets = _norm_add(bot, top)
+        m = h
+    buckets = jax.tree.map(lambda t: t[:, 0], buckets)  # (B, nwin, nbuckets)
+
+    # bucket reduction: sum_b b*bucket_b via running suffix sums,
+    # scanned from the top bucket down (slot 0 never enters; leaves
+    # differ in trailing dims — coords carry a limb axis, inf does
+    # not — so the bucket axis is sliced positionally)
+    def bucket_stack(t):
+        sl = [slice(None)] * t.ndim
+        sl[2] = slice(1, None)
+        return jnp.flip(jnp.moveaxis(t[tuple(sl)], 2, 0), 0)
+
+    stack = jax.tree.map(bucket_stack, buckets)
+    zero = C.jac_infinity(C.FQ_OPS, (B, nwin))
+
+    def reduce_body(carry, bkt):
+        acc, tot = carry
+        acc = _norm_add(acc, bkt)
+        tot = _norm_add(tot, acc)
+        return (acc, tot), None
+
+    (_, windows), _ = jax.lax.scan(reduce_body, (zero, zero), stack)
+
+    # window combination, MSB first: tot = 2^w * tot + S_j
+    win_stack = jax.tree.map(
+        lambda t: jnp.flip(jnp.moveaxis(t, 1, 0), 0), windows
+    )
+    total = C.jac_infinity(C.FQ_OPS, (B,))
+
+    def combine_body(tot, s_j):
+        for _ in range(window):
+            tot = C.jac_double(C.FQ_OPS, tot)
+        return _norm_add(tot, s_j), None
+
+    total, _ = jax.lax.scan(combine_body, total, win_stack)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Telemetry + warm-registry seam
+# ---------------------------------------------------------------------------
+
+from ..metrics import device as _telemetry  # noqa: E402
+
+_stage_msm = _telemetry.instrument_stage("msm", _msm_program)
+
+
+def msm_is_warm(rung: int) -> bool:
+    """Has this rung's program (at the live window) been compiled in
+    this process / marked warm? Rides the kernels warm registry under
+    kind "msm" so one registry answers every cold-fallback question."""
+    from ..bls import kernels
+
+    return kernels.ingest_is_warm(rung, "msm")
+
+
+def _mark_warm(rung: int, window: int, gen: int) -> None:
+    """Mark a rung warm — only when the dispatch that just completed
+    (a) ran at the LIVE window (the registry is keyed on rung alone,
+    so an explicit-window dispatch — tests, tools — or one that raced
+    a set_msm_window retune must not land a mark describing a program
+    the live window will never dispatch), and (b) started under the
+    CURRENT registry generation (a limb-backend switch mid-dispatch
+    bumped _WARM_GEN and killed the executable this dispatch compiled
+    — the BLS warmup's warm_one_marked guard, applied here too). The
+    check-and-mark runs under the same lock the setter's invalidation
+    and the generation bump take, so neither can interleave."""
+    from ..bls import kernels
+
+    with kernels._WARM_GEN_LOCK:
+        if window == _WINDOW and gen == kernels._WARM_GEN:
+            kernels._INGEST_WARM.add(("msm", rung))
+
+
+def warmup_progress() -> tuple[int, int]:
+    """(warm, eligible) over default_warmup_rungs() — feeds the
+    pipeline="msm" warmup gauges (metrics/device.py)."""
+    rungs = default_warmup_rungs()
+    return (sum(1 for b in rungs if msm_is_warm(b)), len(rungs))
+
+
+# has warmup_msm ever run in this process? Gates the automatic rewarm
+# on a live msm_window retune: processes that never opted into warmup
+# (tests, benches) must not get background compiles sprung on them by
+# a knob change (the kernels._WARMUP_STARTED discipline).
+_WARMUP_STARTED = False
+
+
+def warmup_msm(rungs: tuple[int, ...] | None = None) -> None:
+    """Pre-compile (or cache-load) the MSM program for the given rungs
+    by running one tiny dispatch to completion per rung, at the batch
+    width the live path uses there: the batch-verify rung dispatches
+    B=3 (the three verification lincombs of verify_blob_kzg_proof_
+    batch), larger rungs B=1 (the blob-width Lagrange lincombs).
+    Blocking — callers own the threading (node start wraps it in a
+    thread)."""
+    global _WARMUP_STARTED
+    from ..crypto.bls import curve as oc
+
+    _WARMUP_STARTED = True
+    for rung in rungs or default_warmup_rungs():
+        if msm_is_warm(rung):
+            continue
+        b = 3 if rung == MSM_RUNGS[0] else 1
+        outs = g1_msm_many(
+            [([oc.G1_GEN], [i + 1]) for i in range(b)], _pad_to=rung
+        )
+        if outs[0] != oc.G1_GEN:
+            raise RuntimeError(f"msm warmup self-check failed at {rung}")
+
+
+# ---------------------------------------------------------------------------
+# Host entry points
+# ---------------------------------------------------------------------------
+
+
+def g1_msm(points, scalars, window: int | None = None, _pad_to=None):
+    """sum_i scalars[i] * points[i] on the device. Points are oracle
+    affine int tuples (None = infinity); scalars python ints (reduced
+    mod r). Returns an affine tuple or None — the native.g1_msm
+    contract, bit-exact."""
+    return g1_msm_many(
+        [(points, scalars)], window=window, _pad_to=_pad_to
+    )[0]
+
+
+def g1_msm_many(tasks, window: int | None = None, _pad_to=None):
+    """Batched MSMs in ONE device dispatch: tasks is a list of
+    (points, scalars) pairs, each padded to the shared rung (infinity
+    points, zero scalars — both exact no-ops in the bucket method).
+    This is how a blob batch's three verification lincombs ride one
+    dispatch (crypto/kzg.py verify_blob_kzg_proof_batch)."""
+    if not tasks:
+        return []
+    window = int(window) if window is not None else msm_window()
+    if window not in SUPPORTED_WINDOWS:
+        raise ValueError(
+            f"unknown msm window {window}; want {SUPPORTED_WINDOWS}"
+        )
+    for pts, ks in tasks:
+        if len(pts) != len(ks):
+            raise ValueError("MSM points/scalars length mismatch")
+    n_max = max(len(pts) for pts, _ in tasks)
+    if n_max == 0:
+        return [None] * len(tasks)
+    if n_max > MSM_RUNGS[-1]:
+        return _chunked_msm_many(tasks, window)
+    rung = msm_rung(max(n_max, _pad_to or 0))
+    nwin = num_windows(window)
+    B = len(tasks)
+    flat_pts: list = []
+    digits = np.zeros((B, rung, nwin), np.int32)
+    for b, (pts, ks) in enumerate(tasks):
+        flat_pts.extend(pts)
+        flat_pts.extend([None] * (rung - len(pts)))
+        if ks:
+            digits[b, : len(ks)] = signed_digits(ks, window)
+    jaxcache.enable()
+    from ..bls import kernels as _k
+
+    gen = _k._WARM_GEN  # registry generation this dispatch compiles under
+    jac = C.g1_batch_from_ints(flat_pts)  # (B*rung,)
+    jac = jax.tree.map(
+        lambda t: t.reshape((B, rung) + t.shape[1:]), jac
+    )
+    out = _stage_msm(
+        jac.x, jac.y, jac.inf, jnp.asarray(digits), window=window
+    )
+    res = C.jac_to_affine_ints(C.FQ_OPS, out)
+    _mark_warm(rung, window, gen)
+    return res
+
+
+def _chunked_msm_many(tasks, window: int):
+    """Inputs beyond the top rung split into top-rung chunks whose
+    partial results combine on host — the top rung covers the blob
+    width, so this is a guard rail, not a hot path."""
+    from ..crypto.bls import curve as oc
+
+    top = MSM_RUNGS[-1]
+    out = []
+    for pts, ks in tasks:
+        acc = None
+        for i in range(0, len(pts), top):
+            part = g1_msm(pts[i : i + top], ks[i : i + top], window)
+            acc = oc.g1_add(acc, part)
+        out.append(acc)
+    return out

@@ -39,12 +39,15 @@ def _restore_knobs():
     """Every test here may move the live knobs through the real
     setters; restore the module state so no other test file sees a
     tuned process."""
+    from lodestar_tpu.ops import msm as M
+
     gate = K.INGEST_MIN_BUCKET
     ladder = K.BUCKET_LADDER
     warm = set(K._INGEST_WARM)
     started = K._WARMUP_STARTED
     backend = L.get_backend()
     applied = AT._APPLIED
+    window = M.msm_window()
     yield
     K.INGEST_MIN_BUCKET = gate
     K.BUCKET_LADDER = ladder
@@ -54,6 +57,7 @@ def _restore_knobs():
     if L.get_backend() != backend:
         L.set_backend(backend)
     AT._APPLIED = applied
+    M.set_msm_window(window)
 
 
 def _quiet_log():
@@ -453,6 +457,122 @@ class TestSelectConfig:
     def test_empty_measurements_rejected(self):
         with pytest.raises(ValueError):
             AT.select_config(self.GRID, [], 5e-4, "cpu")
+
+
+class TestMsmWindowKnob:
+    """The DA workload's knob on the grid (ops/msm.py Pippenger
+    window): parse/validate, the platform cost models, live apply,
+    and replay compatibility with pre-MSM decision artifacts."""
+
+    def test_parse_grid_axis_and_alias(self):
+        assert AT.parse_grid("msm_window=8,12")["msm_window"] == (8, 12)
+        assert AT.parse_grid("window=16")["msm_window"] == (16,)
+
+    def test_parse_grid_rejects_unsupported_window(self):
+        with pytest.raises(ValueError):
+            AT.parse_grid("msm_window=5")
+
+    def test_tpu_model_minimizes_sequential_depth(self):
+        # batch-flat per-step cost: the bucket-reduction scan
+        # (2^(w-1) steps) dominates large windows -> smallest wins
+        w, rat = AT.select_msm_window((8, 12, 16), "tpu")
+        assert w == 8
+        assert rat["estimates"][8] < rat["estimates"][16]
+        assert "sequential" in rat["model"]
+
+    def test_cpu_model_minimizes_total_adds(self):
+        w, rat = AT.select_msm_window((8, 12, 16), "cpu")
+        assert w == min(
+            rat["estimates"], key=rat["estimates"].get
+        )
+        assert "total point adds" in rat["model"]
+
+    def test_select_config_carries_window_and_rationale(self):
+        ms = [_measurement("vpu", 400.0, bucket=4, dispatch=0.010)]
+        grid = dict(TestSelectConfig.GRID, msm_window=(8, 16))
+        cfg, rationale = AT.select_config(grid, ms, 5e-4, "tpu")
+        assert cfg.msm_window == 8
+        assert rationale["msm_window"]["chosen"] == 8
+        assert set(rationale["msm_window"]["estimates"]) == {8, 16}
+
+    def test_apply_config_moves_live_window(self, monkeypatch):
+        from lodestar_tpu.ops import msm as M
+
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        target = 12 if M.msm_window() != 12 else 8
+        AT.apply_config(
+            AT.TunedConfig("vpu", 256, 2048, 50.0, msm_window=target)
+        )
+        assert M.msm_window() == target
+
+    def test_apply_config_zero_leaves_window_alone(self, monkeypatch):
+        from lodestar_tpu.ops import msm as M
+
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        before = M.msm_window()
+        AT.apply_config(AT.TunedConfig("vpu", 256, 2048, 50.0))
+        assert M.msm_window() == before
+
+    def test_replay_of_pre_msm_artifact_keeps_live_window(
+        self, monkeypatch
+    ):
+        from lodestar_tpu.ops import msm as M
+
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        before = M.msm_window()
+        decision = {
+            "mode": "startup",
+            "config": {
+                "limb_backend": "vpu",
+                "ingest_min_bucket": 256,
+                "ladder_top": 2048,
+                "latency_budget_ms": 50.0,
+                # no msm_window key: a pre-MSM AUTOTUNE.json
+            },
+        }
+        cfg = AT.apply_decision(decision)
+        assert cfg.msm_window == 0
+        assert M.msm_window() == before
+
+    def test_window_switch_invalidates_msm_warm_marks(
+        self, monkeypatch
+    ):
+        from lodestar_tpu.ops import msm as M
+
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        K.mark_ingest_warm(64, "msm")
+        target = 16 if M.msm_window() != 16 else 8
+        AT.apply_config(
+            AT.TunedConfig("vpu", 256, 2048, 50.0, msm_window=target)
+        )
+        assert not K.ingest_is_warm(64, "msm")
+
+    def test_current_config_reports_live_window(self):
+        from lodestar_tpu.ops import msm as M
+
+        assert AT.current_config().msm_window == M.msm_window()
+
+    def test_tune_records_window_rationale_in_artifact(
+        self, tmp_path, monkeypatch
+    ):
+        """The AUTOTUNE.json satellite: a (stubbed) tune's decision
+        artifact carries the chosen msm_window AND the cost-model
+        rationale that picked it."""
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        tuner = _mk_tuner(
+            tmp_path, lambda b, n: _measurement(b, 1000.0), "backend=vpu"
+        )
+        tuner.tune()
+        d = json.loads((tmp_path / "AUTOTUNE.json").read_text())
+        assert d["config"]["msm_window"] in (8, 12, 16)
+        assert d["rationale"]["msm_window"]["chosen"] == (
+            d["config"]["msm_window"]
+        )
+        assert "model" in d["rationale"]["msm_window"]
+        from lodestar_tpu.ops import msm as M
+
+        # the decision was APPLIED: the live window moved with it
+        assert M.msm_window() == d["config"]["msm_window"]
 
 
 # ---------------------------------------------------------------------------

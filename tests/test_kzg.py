@@ -162,6 +162,137 @@ class TestValidation:
             kzg.verify_blob_kzg_proof(blob, b"\x01" * 48, b"\x02" * 48)
 
 
+class TestBatchValidation:
+    """Regression: the batch entry must validate its SHAPE before any
+    crypto — a proofs/commitments length mismatch raises KzgError
+    (zip truncation would silently verify a batch nobody submitted),
+    and the empty batch short-circuits True without even touching the
+    trusted setup."""
+
+    def test_length_mismatch_raises(self):
+        blob = mk_blob(30)
+        c = kzg.blob_to_kzg_commitment(blob)
+        p = kzg.compute_blob_kzg_proof(blob, c)
+        with pytest.raises(kzg.KzgError, match="length mismatch"):
+            kzg.verify_blob_kzg_proof_batch([blob], [c, c], [p])
+        with pytest.raises(kzg.KzgError, match="length mismatch"):
+            kzg.verify_blob_kzg_proof_batch([blob], [c], [p, p])
+        with pytest.raises(kzg.KzgError, match="length mismatch"):
+            kzg.verify_blob_kzg_proof_batch([blob, blob], [c], [p])
+
+    def test_empty_batch_short_circuits(self, monkeypatch):
+        def boom():
+            raise AssertionError(
+                "empty batch must not touch the trusted setup"
+            )
+
+        monkeypatch.setattr(kzg, "_setup", boom)
+        assert kzg.verify_blob_kzg_proof_batch([], [], [])
+
+
+class TestDeviceBackend:
+    """The tentpole acceptance path: a full max-blobs block's batch
+    verification routed through the device Pippenger MSM (ops/msm.py),
+    bit-compatible with the host tiers and fail-closed on tampering.
+    Uses the shared (B=3, rung 64, window 4) program shape."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_backend(self):
+        """Fixture prep (commitments/proofs over the 4096-point
+        lincombs) stays on the native tier; each test flips to the
+        device tier only around the verify under test."""
+        from lodestar_tpu.ops import msm as M
+
+        prev_mode = kzg.msm_backend()
+        prev_win = M.msm_window()
+        kzg.set_msm_backend("native")
+        M.set_msm_window(4)
+        yield
+        kzg.set_msm_backend(prev_mode)
+        M.set_msm_window(prev_win)
+
+    @staticmethod
+    def _fixtures(seeds):
+        blobs = [mk_blob(s) for s in seeds]
+        comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+        proofs = [
+            kzg.compute_blob_kzg_proof(b, c)
+            for b, c in zip(blobs, comms)
+        ]
+        return blobs, comms, proofs
+
+    def test_max_blobs_block_verifies_on_device(self):
+        from lodestar_tpu.params import preset
+
+        n = preset().MAX_BLOBS_PER_BLOCK
+        seeds = [40 + s for s in range(n)]
+        # duplicate blobs are legal and common (identical padding
+        # blobs) — make two identical so the bucket adds hit their
+        # doubling fallback on the device
+        seeds[1] = seeds[0]
+        blobs, comms, proofs = self._fixtures(seeds)
+        kzg.set_msm_backend("device")
+        before = kzg.msm_path_counts()["device"]
+        assert kzg.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        after = kzg.msm_path_counts()["device"]
+        # the three verification lincombs ride ONE device dispatch
+        assert after == before + 1
+
+    def test_tampered_proof_rejected_on_device(self):
+        blobs, comms, proofs = self._fixtures([50, 51])
+        kzg.set_msm_backend("device")
+        assert not kzg.verify_blob_kzg_proof_batch(
+            blobs, comms, [proofs[1], proofs[0]]
+        )
+
+    def test_forced_device_matches_native_verdict(self):
+        blobs, comms, proofs = self._fixtures([52])
+        kzg.set_msm_backend("device")
+        assert kzg.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        kzg.set_msm_backend("native")
+        assert kzg.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+
+    @pytest.mark.slow
+    def test_commitment_lincomb_on_device(self):
+        """The producer-side 4096-point Lagrange lincomb through the
+        device tier — its own multi-minute CPU compile, hence slow."""
+        blob = mk_blob(53)
+        want = kzg.blob_to_kzg_commitment(blob)  # native tier
+        kzg.set_msm_backend("device")
+        assert want == kzg.blob_to_kzg_commitment(blob)
+
+
+class TestBackendSelection:
+    def test_oracle_tier_matches_native(self):
+        blob = mk_blob(60)
+        prev = kzg.msm_backend()
+        try:
+            # the oracle tier walks python scalar muls — compare at
+            # the lincomb seam with a small slice, not a whole blob
+            pts = kzg._setup().g1_lagrange_brp[:8]
+            ks = kzg.blob_to_polynomial(blob)[:8]
+            kzg.set_msm_backend("oracle")
+            assert kzg._g1_lincomb(pts, ks) == kzg.native.g1_msm(
+                pts, ks
+            )
+        finally:
+            kzg.set_msm_backend(prev)
+
+    def test_auto_stays_on_host_off_tpu(self):
+        # this container has no TPU: auto must route native, never
+        # attempt a device compile behind a verify call
+        prev = kzg.msm_backend()
+        try:
+            kzg.set_msm_backend("auto")
+            assert kzg._resolve_msm_path(6) == "native"
+        finally:
+            kzg.set_msm_backend(prev)
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError):
+            kzg.set_msm_backend("gpu")
+
+
 class TestMsm:
     def test_native_msm_matches_naive(self):
         pts = [oc.g1_mul(oc.G1_GEN, 3 + i) for i in range(20)]

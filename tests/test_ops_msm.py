@@ -1,0 +1,287 @@
+"""Differential tests: device Pippenger MSM (ops/msm.py) vs the host C
+Pippenger (crypto/bls/native.py g1_msm) vs the pure-Python oracle.
+
+Bit-exact across randomized inputs and the edge cases the bucket
+method must survive: zero scalars, scalars >= the group order, points
+at infinity inside the input set, single-point MSMs, duplicate points
+(the case that forces the COMPLETE bucket add — identical blobs yield
+identical proofs in production).
+
+Compile budget: every device dispatch here shares the (batch, rung 64,
+window 4) program shapes — one trace each for B=1 and B=3, served by
+the persistent cache across processes. The window/size sweep beyond
+that is slow-marked.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as oc
+from lodestar_tpu.crypto.bls import native
+from lodestar_tpu.ops import msm as M
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native BLS backend unavailable"
+)
+
+random.seed(0xDA)
+
+W = 4  # shared tier-1 window (small bucket table, cheap reduction)
+
+
+def _rand_pts(n):
+    return [
+        oc.g1_mul(oc.G1_GEN, random.getrandbits(200) + 1)
+        for _ in range(n)
+    ]
+
+
+def _oracle_msm(pts, ks):
+    acc = None
+    for p, k in zip(pts, ks):
+        acc = oc.g1_add(acc, oc.g1_mul(p, k % M.R_ORDER))
+    return acc
+
+
+class TestSignedDigits:
+    def test_digits_reconstruct_scalar(self):
+        for w in M.SUPPORTED_WINDOWS:
+            ks = [0, 1, M.R_ORDER - 1, random.getrandbits(255)]
+            digs = M.signed_digits(ks, w)
+            half = 1 << (w - 1)
+            for k, row in zip(ks, digs):
+                assert all(-half <= int(d) <= half - 1 for d in row)
+                got = sum(int(d) << (w * j) for j, d in enumerate(row))
+                assert got == k % M.R_ORDER
+
+    def test_digit_magnitude_within_bucket_table(self):
+        # |d| <= 2^(w-1) exactly matches the nbuckets = half+1 table
+        for w in (4, 8):
+            digs = M.signed_digits(
+                [random.getrandbits(255) for _ in range(16)], w
+            )
+            half = 1 << (w - 1)
+            assert int(abs(digs).max()) <= half
+
+
+class TestRungs:
+    def test_rung_rounds_up(self):
+        assert M.msm_rung(1) == 64
+        assert M.msm_rung(64) == 64
+        assert M.msm_rung(65) == 128
+        assert M.msm_rung(4096) == 4096
+
+    def test_above_top_rejected(self):
+        with pytest.raises(ValueError):
+            M.msm_rung(4097)
+
+    def test_window_knob_validates(self):
+        with pytest.raises(ValueError):
+            M.set_msm_window(5)
+        assert M.msm_window() in M.SUPPORTED_WINDOWS
+
+
+class TestDifferential:
+    def test_randomized_matches_native_and_oracle(self):
+        pts = _rand_pts(12)
+        ks = [random.getrandbits(255) for _ in range(12)]
+        dev = M.g1_msm(pts, ks, window=W)
+        assert dev == native.g1_msm(pts, ks)
+        assert dev == _oracle_msm(pts, ks)
+
+    def test_zero_scalars(self):
+        pts = _rand_pts(3)
+        assert M.g1_msm(pts, [0, 0, 0], window=W) is None
+
+    def test_scalar_at_and_above_group_order(self):
+        pts = _rand_pts(2)
+        ks = [M.R_ORDER, M.R_ORDER + 7]
+        dev = M.g1_msm(pts, ks, window=W)
+        assert dev == native.g1_msm(pts, ks)
+        assert dev == oc.g1_mul(pts[1], 7)
+
+    def test_infinity_in_input_set(self):
+        pts = [oc.G1_GEN, None, oc.g1_mul(oc.G1_GEN, 9), None]
+        ks = [5, 7, 11, 0]
+        dev = M.g1_msm(pts, ks, window=W)
+        assert dev == native.g1_msm(pts, ks)
+        assert dev == oc.g1_mul(oc.G1_GEN, 5 + 9 * 11)
+
+    def test_single_point(self):
+        p = _rand_pts(1)[0]
+        k = random.getrandbits(255)
+        dev = M.g1_msm([p], [k], window=W)
+        assert dev == native.g1_msm([p], [k])
+
+    def test_duplicate_points_hit_bucket_doubling(self):
+        # the same point appearing twice can land in one bucket at a
+        # window where both digits coincide — the complete add's
+        # doubling fallback; and with opposite-sign digits of equal
+        # magnitude the p == -q infinity fallback. Exercise both by
+        # sweeping scalar pairs.
+        p = _rand_pts(1)[0]
+        cases = [
+            (3, 3),  # equal scalars: every window collides
+            (3, M.R_ORDER - 3),  # opposite: bucket + (-bucket)
+            (0x33, 0x35),
+            (1, 1 << 128),
+        ]
+        for a, b in cases:
+            pts, ks = [p, p], [a, b]
+            dev = M.g1_msm(pts, ks, window=W)
+            assert dev == native.g1_msm(pts, ks), (a, b)
+
+    def test_batched_tasks_one_dispatch(self):
+        # the verify_blob_kzg_proof_batch shape: three lincombs in one
+        # device dispatch (batch axis B=3 over tasks)
+        pts = _rand_pts(6)
+        tasks = [
+            (pts, [random.getrandbits(255) for _ in pts]),
+            (pts[:4], [random.getrandbits(64) for _ in range(4)]),
+            ([None] + pts[:2], [9, 0, M.R_ORDER + 2]),
+        ]
+        got = M.g1_msm_many(tasks, window=W)
+        for (p_l, k_l), out in zip(tasks, got):
+            assert out == native.g1_msm(p_l, k_l)
+
+    def test_empty_inputs(self):
+        assert M.g1_msm([], [], window=W) is None
+        assert M.g1_msm_many([], window=W) == []
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            M.g1_msm(_rand_pts(2), [1], window=W)
+
+
+class TestWarmRegistry:
+    def test_live_window_dispatch_marks_rung_warm(self):
+        from lodestar_tpu.bls import kernels as K
+
+        prev = M.msm_window()
+        K._INGEST_WARM.discard(("msm", 64))
+        try:
+            M.set_msm_window(W)
+            assert not M.msm_is_warm(64)
+            M.g1_msm([oc.G1_GEN], [1], window=W)
+            assert M.msm_is_warm(64)
+        finally:
+            M.set_msm_window(prev)
+            K._INGEST_WARM.discard(("msm", 64))
+
+    def test_explicit_window_dispatch_does_not_mark_other_window(self):
+        """A dispatch at a NON-live window (tests, tools) must not
+        mark the rung warm — the mark would claim the live window's
+        program is compiled when it is not, routing a live lincomb
+        straight into a cold compile."""
+        from lodestar_tpu.bls import kernels as K
+
+        assert M.msm_window() != W  # live default is 8; W is 4
+        K._INGEST_WARM.discard(("msm", 64))
+        M.g1_msm([oc.G1_GEN], [1], window=W)
+        assert not M.msm_is_warm(64)
+
+    def test_window_switch_rewarms_when_policy_exists(self, monkeypatch):
+        """A live msm_window retune must re-kick the MSM warmup when
+        node start opted in — otherwise the auto backend's cold
+        fallback strands the DA workload on the host tier forever."""
+        kicks = []
+        monkeypatch.setattr(M, "_WARMUP_STARTED", True)
+        monkeypatch.setattr(M, "warmup_msm", lambda *a, **kw: kicks.append(1))
+        prev = M.msm_window()
+        target = 12 if prev != 12 else 8
+        try:
+            M.set_msm_window(target)
+            import time
+
+            for _ in range(50):  # daemon thread runs the stub
+                if kicks:
+                    break
+                time.sleep(0.02)
+            assert kicks
+        finally:
+            M.set_msm_window(prev, rewarm=False)
+
+    def test_no_warmup_policy_means_no_rewarm_kick(self, monkeypatch):
+        kicks = []
+        monkeypatch.setattr(M, "_WARMUP_STARTED", False)
+        monkeypatch.setattr(M, "warmup_msm", lambda *a, **kw: kicks.append(1))
+        prev = M.msm_window()
+        try:
+            M.set_msm_window(12 if prev != 12 else 8)
+        finally:
+            M.set_msm_window(prev, rewarm=False)
+        assert kicks == []
+
+    def test_stale_generation_mark_dropped(self):
+        """A dispatch that started before a limb-backend switch (which
+        bumps the registry generation and kills its executable) must
+        not land a warm mark when it completes — the BLS warmup's
+        generation guard, applied to the msm marks."""
+        from lodestar_tpu.bls import kernels as K
+
+        K._INGEST_WARM.discard(("msm", 64))
+        stale = K._WARM_GEN
+        K.invalidate_ingest_warm(rewarm=False)  # bumps the generation
+        M._mark_warm(64, M.msm_window(), stale)
+        assert not M.msm_is_warm(64)
+        M._mark_warm(64, M.msm_window(), K._WARM_GEN)
+        assert M.msm_is_warm(64)
+        K._INGEST_WARM.discard(("msm", 64))
+
+    def test_backend_invalidation_kicks_msm_rewarm(self, monkeypatch):
+        """A limb-backend switch clears the jit caches, killing the
+        MSM executables like the BLS ones — the registry invalidation
+        must re-kick the MSM warmup or the DA workload rides the host
+        fallback forever."""
+        from lodestar_tpu.bls import kernels as K
+
+        kicks = []
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)  # no BLS kick
+        monkeypatch.setattr(M, "_WARMUP_STARTED", True)
+        monkeypatch.setattr(
+            M, "warmup_msm", lambda *a, **kw: kicks.append(1)
+        )
+        K.invalidate_ingest_warm(rewarm=True)
+        import time
+
+        for _ in range(50):
+            if kicks:
+                break
+            time.sleep(0.02)
+        assert kicks
+
+    def test_window_switch_drops_msm_marks_only(self):
+        from lodestar_tpu.bls import kernels as K
+
+        prev = M.msm_window()
+        K.mark_ingest_warm(64, "msm")
+        K.mark_ingest_warm(256, "batch")
+        try:
+            M.set_msm_window(12 if prev != 12 else 8)
+            assert not M.msm_is_warm(64)
+            assert K.ingest_is_warm(256, "batch")
+        finally:
+            M.set_msm_window(prev)
+            K._INGEST_WARM.discard(("batch", 256))
+
+
+@pytest.mark.slow
+class TestWindowSizeSweep:
+    """The sizes/windows matrix beyond the shared tier-1 shapes —
+    each combination is its own multi-minute CPU compile."""
+
+    @pytest.mark.parametrize("window", (8, 12))
+    def test_windows_match_native(self, window):
+        pts = _rand_pts(10)
+        ks = [random.getrandbits(255) for _ in range(10)]
+        assert M.g1_msm(pts, ks, window=window) == native.g1_msm(
+            pts, ks
+        )
+
+    def test_rung_128(self):
+        pts = _rand_pts(100)
+        ks = [random.getrandbits(255) for _ in range(100)]
+        assert M.g1_msm(pts, ks, window=W) == native.g1_msm(pts, ks)

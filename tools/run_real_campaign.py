@@ -22,7 +22,10 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
                       COVERAGE.md's table -> STAGE_BUDGET_real.json
   5. trickle        — tools/bench_trickle.py --real --autotune-from
                       (gossip-shaped steady state) -> BENCH_trickle_real.json
-  6. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
+  6. blobs          — tools/bench_blobs.py --real --autotune-from
+                      (peak-DA KZG batch verify through the device
+                      Pippenger MSM) -> BENCH_blobs_real.json
+  7. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
                       (the chip-scaling curve) -> MULTICHIP_real.json
 
 `--dry-run` emits the full campaign plan (commands, artifacts,
@@ -122,6 +125,27 @@ def build_plan(args) -> list[dict]:
                 "BENCH_trickle_real.json",
             ],
             "artifact": "BENCH_trickle_real.json",
+            "needs": ["autotune"],
+        },
+        {
+            "name": "blobs",
+            "why": "the DA chip curve next to the BLS one: peak "
+            "max-blobs-per-block KZG batch verification through the "
+            "device Pippenger MSM (ops/msm.py) under the tuned "
+            "msm_window — the second workload sharing the chip, "
+            "never yet measured on hardware",
+            "cmd": [
+                PY,
+                "tools/bench_blobs.py",
+                "--real",
+                "--backend",
+                "auto",
+                "--autotune-from",
+                at,
+                "--json-out",
+                "BENCH_blobs_real.json",
+            ],
+            "artifact": "BENCH_blobs_real.json",
             "needs": ["autotune"],
         },
         {
