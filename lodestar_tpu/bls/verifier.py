@@ -41,6 +41,19 @@ latency budget (default 50 ms past queue admission, on top of the
 device-ingest-sized buckets without unbounded latency. Dispatches are
 counted per bucket size and per path (ingest / host / host_cold), and
 submit-to-verdict latency feeds p50/p99 histograms on /metrics.
+
+OVERLAPPED WAVE PIPELINE (ISSUE 16): waves are DOUBLE-BUFFERED — each
+wave's host prep + dispatch runs as its own task, so while wave N
+executes on the device, wave N+1's prep (decompression, padding, limb
+packing) runs on the thread pool and its dispatch queues behind N via
+JAX async dispatch (donated input buffers on TPU let XLA reuse wave
+N's freed device memory). The pipeline depth is a knob
+(`pipeline_depth` / LODESTAR_TPU_PIPELINE_DEPTH, default 2; 1 = the
+pre-pipeline synchronous behavior), `is_quiescent`/`close()` extend
+over the prefetch window so autotune re-tunes and shutdown cannot
+race an in-flight prep, and occupancy (fraction of wall time with ≥1
+wave in flight) plus prep-overlap-hidden seconds are exported on
+/metrics.
 """
 
 from __future__ import annotations
@@ -71,6 +84,11 @@ QUEUE_MAX_LENGTH = 512  # canAcceptWork threshold, index.ts:149-155
 # device-ingest gate or when the oldest job has waited this long past
 # its queue admission — ~50 ms on top of the 100 ms gossip buffer.
 LATENCY_BUDGET_MS = 50
+# Overlapped wave pipeline: how many waves may be in the prep+dispatch
+# window at once. Depth d admits wave N+1's host prep while wave N
+# still executes on device; 1 restores the synchronous pre-pipeline
+# behavior (prep of N+1 starts only after N is dispatched).
+PIPELINE_DEPTH = int(os.environ.get("LODESTAR_TPU_PIPELINE_DEPTH", "2"))
 
 
 def _rand_scalars(n: int):
@@ -207,6 +225,10 @@ class BlsVerifierMetrics:
         self.last_wave_sets = 0
         self.last_wave_duration_s = 0.0
         self.wave_sets_total = 0
+        # overlapped pipeline: host prep seconds that ran while
+        # another wave was already in flight — work the pipeline hid
+        # behind device execution instead of serializing ahead of it
+        self.prep_overlap_hidden_s = 0.0
         # continuous batching: per-bucket-size device dispatches, path
         # split (device ingest vs host decompress/hash vs cold-compile
         # host fallback), rolling-bucket flush triggers, and the
@@ -249,6 +271,7 @@ class TpuBlsVerifier:
         latency_budget_ms: int = LATENCY_BUDGET_MS,
         warmup: bool = False,
         host_fallback_when_cold: bool | None = None,
+        pipeline_depth: int | None = None,
     ):
         """Continuous-batching knobs:
 
@@ -258,6 +281,10 @@ class TpuBlsVerifier:
           a batchable job past queue admission before a deadline flush
           (0 disables the rolling bucket — every wave dispatches
           immediately, the pre-round-6 behavior).
+        pipeline_depth: overlapped-wave pipeline depth (None = the
+          LODESTAR_TPU_PIPELINE_DEPTH env default, 2). Depth d lets
+          up to d-1 waves prep/dispatch ahead of the wave executing
+          on device; 1 = synchronous pre-pipeline behavior.
         warmup: pre-compile the ingest pipeline for every eligible
           bucket size on a background thread (node start).
         host_fallback_when_cold: route ingest-eligible buckets to the
@@ -282,10 +309,24 @@ class TpuBlsVerifier:
             if host_fallback_when_cold is None
             else host_fallback_when_cold
         )
+        self._pipeline_depth = max(
+            1,
+            int(
+                pipeline_depth
+                if pipeline_depth is not None
+                else PIPELINE_DEPTH
+            ),
+        )
         self._rolling: list[_Job] = []
         self._rolling_sets = 0
         self._rolling_task: asyncio.Task | None = None
         self._dispatching = 0  # waves between job pop and finalizer
+        # overlapped pipeline: in-flight prep+dispatch tasks, and the
+        # occupancy clock (cumulative seconds with >=1 wave in flight)
+        self._wave_tasks: set[asyncio.Task] = set()
+        self._born = time.monotonic()
+        self._busy_since: float | None = None
+        self._busy_total = 0.0
         self._intake_held = 0  # hold_intake() nesting depth
         self._buffer: list[_Job] = []
         self._buffer_task: asyncio.Task | None = None
@@ -348,6 +389,47 @@ class TpuBlsVerifier:
     def latency_budget_ms(self) -> float:
         return self._latency_budget * 1000.0
 
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Live retune of the overlapped-pipeline depth (autotune's
+        fifth knob). Applies to the NEXT wave admission; waves already
+        in the prefetch window keep their slot."""
+        self._pipeline_depth = max(1, int(depth))
+
+    def pipeline_depth(self) -> int:
+        return self._pipeline_depth
+
+    # -- overlapped-pipeline bookkeeping -------------------------------
+
+    def _inflight(self) -> int:
+        """Waves anywhere in the pipeline: prepping/dispatching
+        (_wave_tasks) or on device awaiting readback (_finalizers)."""
+        return len(self._wave_tasks) + len(self._finalizers)
+
+    def _occupancy_mark(self) -> None:
+        """Record a possible busy/idle transition of the pipeline.
+        Called whenever _wave_tasks/_finalizers membership changes;
+        the event loop is single-threaded, so no lock is needed."""
+        now = time.monotonic()
+        if self._inflight() > 0:
+            if self._busy_since is None:
+                self._busy_since = now
+        elif self._busy_since is not None:
+            self._busy_total += now - self._busy_since
+            self._busy_since = None
+
+    def pipeline_occupancy(self) -> float:
+        """Fraction of this verifier's wall time with >=1 wave in
+        flight (lodestar_jax_pipeline_occupancy). High occupancy with
+        depth >= 2 means the overlap is keeping the device fed."""
+        now = time.monotonic()
+        total = now - self._born
+        if total <= 0.0:
+            return 0.0
+        busy = self._busy_total
+        if self._busy_since is not None:
+            busy += now - self._busy_since
+        return min(1.0, busy / total)
+
     def is_quiescent(self) -> bool:
         """No queued, buffered, rolling, or in-flight work — the gate
         the drift monitor (device/autotune.py) requires before a
@@ -355,12 +437,19 @@ class TpuBlsVerifier:
         drop the very traces the wave is executing). `_dispatching`
         covers the prep-and-dispatch window: jobs are already popped
         from the queue but the finalizer task is not yet registered,
-        so none of the other indicators would show the wave."""
+        so none of the other indicators would show the wave.
+        `_wave_tasks` covers the overlapped pipeline's PREFETCH window
+        (ISSUE 16 quiescence bugfix): a wave whose prep is running as
+        a pipeline task is invisible to `_dispatching` once
+        _dispatch_wave has returned, and a re-tune that cleared jit
+        caches mid-prefetch would recompile — or worse, retune knobs
+        — under a wave that already sampled them."""
         return (
             self._dispatching == 0
             and self._queue.empty()
             and not self._buffer
             and not self._rolling
+            and not self._wave_tasks
             and not self._finalizers
         )
 
@@ -599,6 +688,11 @@ class TpuBlsVerifier:
         if self._runner:
             self._runner.cancel()
             self._runner = None
+        # cancel the prefetch window first: a wave task cancelled here
+        # fails its jobs (see _run_wave / _wave_done), never leaves a
+        # caller awaiting a future its wave will no longer resolve
+        for t in list(self._wave_tasks):
+            t.cancel()
         for t in list(self._finalizers):
             t.cancel()
         self._prep_pool.shutdown(wait=False)
@@ -735,9 +829,14 @@ class TpuBlsVerifier:
             await self._dispatch_wave(self._take_rolling())
 
     async def _dispatch_wave(self, jobs: list[_Job]):
-        """Prep + dispatch one wave; finalize (readback + retries) in
-        a separate task so the next wave's host prep overlaps device
-        execution."""
+        """Admit one wave into the overlapped pipeline. The wave's
+        prep + dispatch runs as its own task (_run_wave) so the run
+        loop returns to draining the queue immediately — wave N+1's
+        host prep overlaps wave N's device execution. Admission is
+        bounded by the pipeline-depth knob: depth d allows d-1 waves
+        in the prefetch window ahead of the finalizing wave; depth 1
+        awaits the wave inline (the pre-pipeline synchronous
+        behavior)."""
         if not jobs:
             return
         self.metrics.waves += 1
@@ -746,23 +845,71 @@ class TpuBlsVerifier:
             self.metrics.total_job_wait_s += t0 - j.enqueued_at
         self._dispatching += 1
         try:
+            depth = self._pipeline_depth
             try:
-                wave = await self._prep_and_dispatch(jobs)
+                while depth > 1 and len(self._wave_tasks) >= depth - 1:
+                    await asyncio.wait(
+                        set(self._wave_tasks),
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
             except asyncio.CancelledError:
                 self._fail_jobs(
                     jobs, RuntimeError("BLS verifier closed")
                 )
                 raise
-            except Exception as e:  # defensive: fail the waiters
-                self._fail_jobs(jobs, e)
-                return
-            task = asyncio.ensure_future(
-                self._finalize_wave(wave, t0)
-            )
-            self._finalizers.add(task)
-            task.add_done_callback(self._finalizers.discard)
+            task = asyncio.ensure_future(self._run_wave(jobs, t0))
+            self._wave_tasks.add(task)
+            task.add_done_callback(self._wave_done(jobs))
+            self._occupancy_mark()
+            if depth <= 1:
+                await task
         finally:
             self._dispatching -= 1
+
+    def _wave_done(self, jobs: list[_Job]):
+        """Done-callback for a pipeline wave task: drop it from the
+        prefetch window, and fail its jobs if the task was cancelled
+        before its own CancelledError handler could run (close() can
+        cancel a task that never started executing)."""
+
+        def cb(task: asyncio.Task):
+            self._wave_tasks.discard(task)
+            if task.cancelled():
+                self._fail_jobs(
+                    jobs, RuntimeError("BLS verifier closed")
+                )
+            self._occupancy_mark()
+
+        return cb
+
+    def _finalizer_done(self, task: asyncio.Task):
+        self._finalizers.discard(task)
+        self._occupancy_mark()
+
+    async def _run_wave(self, jobs: list[_Job], t0: float):
+        """Prep + dispatch one wave; finalize (readback + retries) in
+        a separate task so readback of wave N overlaps compute of
+        N+1. Prep seconds spent while another wave was already in
+        flight are credited to prep_overlap_hidden_s — host time the
+        pipeline hid behind device execution instead of serializing
+        ahead of it."""
+        overlapped = self._inflight() > 1  # this task counts as one
+        tp = time.monotonic()
+        try:
+            wave = await self._prep_and_dispatch(jobs)
+        except asyncio.CancelledError:
+            self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
+            raise
+        except Exception as e:  # defensive: fail the waiters
+            self._fail_jobs(jobs, e)
+            return
+        if overlapped:
+            self.metrics.prep_overlap_hidden_s += (
+                time.monotonic() - tp
+            )
+        task = asyncio.ensure_future(self._finalize_wave(wave, t0))
+        self._finalizers.add(task)
+        task.add_done_callback(self._finalizer_done)
 
     def _fail_jobs(self, jobs, err):
         for j in jobs:
@@ -1052,6 +1199,12 @@ class TpuBlsVerifier:
             u0 = tower.fq2_from_ints([s.draws[0] for s in full])
             u1 = tower.fq2_from_ints([s.draws[1] for s in full])
             if shard:
+                # WHOLE-BUCKET mesh path (ISSUE 16): each chip runs
+                # the complete collective-free verify on the
+                # sub-bucket it owns; the only collective is the one
+                # verdict psum inside the shard_map program. Mesh
+                # programs bypass the warm registry (distinct
+                # executables from the single-host ones).
                 from .. import parallel
 
                 pk_dev = parallel.shard_batch(mesh, pk_dev)
@@ -1061,6 +1214,12 @@ class TpuBlsVerifier:
                 u1 = parallel.shard_batch(mesh, u1)
                 bits = parallel.shard_batch(mesh, bits)
                 mask = parallel.shard_batch(mesh, mask)
+                _device.record_transfer(
+                    "h2d", pk_dev, sig_x, sig_sign, u0, u1, bits, mask
+                )
+                return kernels.run_verify_batch_ingest_mesh(
+                    mesh, pk_dev, sig_x, sig_sign, u0, u1, bits, mask
+                )
             _device.record_transfer(
                 "h2d", pk_dev, sig_x, sig_sign, u0, u1, bits, mask
             )
@@ -1105,6 +1264,7 @@ class TpuBlsVerifier:
         sig_dev = C.g2_batch_from_ints(sigs)
         h = (h_dev.x, h_dev.y)
         if shard:
+            # whole-bucket mesh verify (one collective: verdict psum)
             from .. import parallel
 
             pk_dev = parallel.shard_batch(mesh, pk_dev)
@@ -1112,6 +1272,12 @@ class TpuBlsVerifier:
             sig_dev = parallel.shard_batch(mesh, sig_dev)
             bits = parallel.shard_batch(mesh, bits)
             mask = parallel.shard_batch(mesh, mask)
+            _device.record_transfer(
+                "h2d", pk_dev, h, sig_dev, bits, mask
+            )
+            return kernels.run_verify_batch_mesh(
+                mesh, pk_dev, h, sig_dev, bits, mask
+            )
         _device.record_transfer("h2d", pk_dev, h, sig_dev, bits, mask)
         return kernels.run_verify_batch_async(
             pk_dev, h, sig_dev, bits, mask
@@ -1212,6 +1378,32 @@ class TpuBlsVerifier:
                 ] * pad
                 sig_x = tower.fq2_from_ints(sxs)
                 sig_sign = jnp.asarray(sgs)
+                mesh = self._mesh
+                if (
+                    mesh is not None
+                    and b % mesh.devices.size == 0
+                ):
+                    # whole-bucket mesh: the (1,)-batch hash point is
+                    # replicated (every shard pairs its aggregate
+                    # against the same H); one verdict psum
+                    from .. import parallel
+
+                    pk_s = parallel.shard_batch(mesh, pk_dev)
+                    sig_x_s = parallel.shard_batch(mesh, sig_x)
+                    sign_s = parallel.shard_batch(mesh, sig_sign)
+                    bits_s = parallel.shard_batch(mesh, bits)
+                    mask_s = parallel.shard_batch(mesh, mask)
+                    h_r = parallel.replicate(
+                        mesh, (h_dev.x, h_dev.y)
+                    )
+                    _device.record_transfer(
+                        "h2d", pk_s, h_r, sig_x_s, sign_s,
+                        bits_s, mask_s,
+                    )
+                    return kernels.run_verify_same_message_mesh(
+                        mesh, pk_s, h_r, sig_x_s, sign_s,
+                        bits_s, mask_s,
+                    )
                 _device.record_transfer(
                     "h2d", pk_dev, h_dev, sig_x, sig_sign, bits, mask
                 )

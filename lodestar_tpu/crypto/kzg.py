@@ -23,7 +23,14 @@ BLS verifier's):
 
 Other group arithmetic stays on native-with-oracle-fallback.
 Scalar-field (Fr) arithmetic is plain Python ints with Montgomery
-batch inversion.
+batch inversion — except the batch-verify barycentric evaluations,
+which ride a TWO-TIER backend (`set_fr_backend` /
+LODESTAR_TPU_KZG_FR_BACKEND): **device** dispatches every blob's
+4096-point evaluation + Montgomery batch inversion as ONE limb-kernel
+program (`ops/fr.py`, bit-exact vs the ints; z-equals-root blobs are
+special-cased on host exactly like the Python path), **python** is
+the oracle below; "auto" routes to the device on a TPU host and
+falls back (counted) on any device error.
 
 Trusted setup: `load_trusted_setup(path)` reads the standard JSON
 format ({"g1_lagrange": [...48B hex...], "g2_monomial": [...]}), so the
@@ -194,6 +201,123 @@ def bind_kzg_collectors(metrics) -> None:
     metrics.msm_device_fallback_total.add_collect(
         lambda g: g.set(_MSM_DEVICE_FALLBACKS)
     )
+    metrics.fr_dispatch_total.add_collect(
+        lambda g: [
+            g.set(v, path=p) for p, v in _FR_DISPATCH.items()
+        ]
+    )
+    metrics.fr_device_fallback_total.add_collect(
+        lambda g: g.set(_FR_DEVICE_FALLBACKS)
+    )
+
+
+# --- two-tier Fr backend (device / python) ---------------------------------
+
+FR_BACKENDS = ("auto", "device", "python")
+
+_fr_backend = os.environ.get("LODESTAR_TPU_KZG_FR_BACKEND", "auto")
+if _fr_backend not in FR_BACKENDS:
+    raise ValueError(
+        f"LODESTAR_TPU_KZG_FR_BACKEND={_fr_backend!r} not in "
+        f"{FR_BACKENDS}"
+    )
+
+# per-path counters for the batch-verify barycentric evaluations,
+# mirroring the MSM tier's discipline: one entry per
+# _evaluate_polynomials_batch call by the tier that served it;
+# fr_device_fallbacks counts dispatches that wanted the device but
+# errored and fell back to the Python ints.
+_FR_DISPATCH: dict[str, int] = {"device": 0, "python": 0}
+_FR_DEVICE_FALLBACKS = 0
+_FR_ROOTS_DEV = None  # cached device limb array of the brp'd domain
+
+
+def fr_backend() -> str:
+    """The live Fr-evaluation backend mode."""
+    return _fr_backend
+
+
+def set_fr_backend(name: str) -> None:
+    global _fr_backend
+    if name not in FR_BACKENDS:
+        raise ValueError(
+            f"unknown kzg fr backend {name!r}; want {FR_BACKENDS}"
+        )
+    _fr_backend = name
+
+
+def fr_path_counts() -> dict:
+    """Snapshot of the Fr-evaluation dispatch counters."""
+    return dict(_FR_DISPATCH, device_fallbacks=_FR_DEVICE_FALLBACKS)
+
+
+def _fr_roots_dev():
+    global _FR_ROOTS_DEV
+    if _FR_ROOTS_DEV is None:
+        import jax.numpy as jnp
+
+        from ..ops import fr as _fr
+
+        _FR_ROOTS_DEV = jnp.asarray(_fr.fr_from_ints(_roots_brp()))
+    return _FR_ROOTS_DEV
+
+
+def _evaluate_polynomials_batch(
+    polys: list[list[int]], zs: list[int]
+) -> list[int]:
+    """ys for m (poly, z) pairs — the batch-verify evaluation seam.
+    The device tier packs every z-outside-the-domain evaluation into
+    ONE ops/fr barycentric dispatch (the Montgomery batch inversion
+    runs on device too); z-equals-root blobs read the coefficient on
+    host exactly like the Python oracle. Any device error falls back
+    to the Python ints (counted), never fails the caller."""
+    global _FR_DEVICE_FALLBACKS
+    mode = _fr_backend
+    use_device = mode == "device"
+    if mode == "auto":
+        import jax
+
+        use_device = jax.default_backend() == "tpu"
+    if use_device:
+        roots = _roots_brp()
+        ys: list[int | None] = [None] * len(zs)
+        live = []
+        for i, (p, z) in enumerate(zip(polys, zs)):
+            if z in roots:
+                ys[i] = p[roots.index(z)]
+            else:
+                live.append(i)
+        try:
+            if live:
+                import jax.numpy as jnp
+                import numpy as np
+
+                from ..ops import fr as _fr
+
+                pd = jnp.asarray(
+                    np.stack(
+                        [_fr.fr_from_ints(polys[i]) for i in live]
+                    )
+                )
+                zd = jnp.asarray(
+                    _fr.fr_from_ints([zs[i] for i in live])
+                )
+                out = _fr.fr_to_ints(
+                    _fr.eval_barycentric_batch(
+                        pd, _fr_roots_dev(), zd
+                    )
+                )
+                for i, y in zip(live, out):
+                    ys[i] = y
+            _FR_DISPATCH["device"] += 1
+            return ys
+        except Exception:
+            _FR_DEVICE_FALLBACKS += 1
+    _FR_DISPATCH["python"] += 1
+    return [
+        evaluate_polynomial_in_evaluation_form(p, z)
+        for p, z in zip(polys, zs)
+    ]
 
 
 def _device_msm_ready(n: int) -> bool:
@@ -569,15 +693,13 @@ def verify_blob_kzg_proof_batch(
         _BATCH_HIST.observe(n)
     commitments = [_validate_g1(c) for c in commitment_bytes_list]
     proofs = [_validate_g1(p) for p in proof_bytes_list]
-    zs, ys = [], []
+    zs, polys = [], []
     for blob, cb in zip(blobs, commitment_bytes_list):
-        z = compute_challenge(blob, cb)
-        zs.append(z)
-        ys.append(
-            evaluate_polynomial_in_evaluation_form(
-                blob_to_polynomial(blob), z
-            )
-        )
+        zs.append(compute_challenge(blob, cb))
+        polys.append(blob_to_polynomial(blob))
+    # the whole batch's barycentric math in one device dispatch on
+    # the Fr device tier (python tier loops the oracle)
+    ys = _evaluate_polynomials_batch(polys, zs)
     # Fiat-Shamir the whole statement into one scalar; use its powers
     data = RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
     data += FIELD_ELEMENTS_PER_BLOB.to_bytes(8, "big")

@@ -75,20 +75,22 @@ from dataclasses import asdict, dataclass
 
 # Per-stage device budget in ms for the 2048-set production bucket —
 # COVERAGE.md "Device stage budget" (the post window/static-ladder
-# column, measured round 5 by tools/profile_prefix.py on one v5e).
-# The drift monitor compares each stage's SHARE of windowed device
-# time against these shares: absolute times shift with host and
-# backend, but a stage whose share balloons past its budgeted
-# fraction has regressed relative to its pipeline — the live analog
-# of re-running the offline prefix budget.
+# column, measured round 5 by tools/profile_prefix.py on one v5e,
+# re-cut for the FUSED dispatch default of ISSUE 16: the eight
+# per-stage rows collapse into the three fused programs — prepare =
+# g2_sqrt + g2_subgroup + sswu_iso + cofactor + prepare_batch,
+# pairing = miller + product, final unchanged; named sub-scopes
+# keep the finer attribution inside the profiler). The drift monitor
+# compares each stage's SHARE of windowed device time against these
+# shares: absolute times shift with host and backend, but a stage
+# whose share balloons past its budgeted fraction has regressed
+# relative to its pipeline — the live analog of re-running the
+# offline prefix budget. On hosts running the per-stage rollback
+# composition the fused names accrue no time and the monitor sees
+# no signal — it never false-fires there.
 STAGE_BUDGET_MS = {
-    "g2_sqrt": 98.7,
-    "g2_subgroup": 24.6,
-    "sswu_iso": 87.0,
-    "cofactor": 54.2,
-    "prepare_batch": 23.5,
-    "miller": 49.4,
-    "product": 29.0,
+    "prepare": 288.0,  # 98.7 + 24.6 + 87.0 + 54.2 + 23.5
+    "pairing": 78.4,  # 49.4 + 29.0
     "final": 16.2,
 }
 
@@ -109,6 +111,7 @@ DEFAULT_GRID = {
     "top": (1024, 2048),
     "budget_ms": (25, 50, 100),
     "msm_window": (8, 12, 16),
+    "pipeline_depth": (1, 2, 4),
 }
 
 # bulk (block-import / sync) buckets must clear well inside a slot;
@@ -132,6 +135,7 @@ def parse_grid(spec: str | None) -> dict:
         "budget": "budget_ms",
         "latency": "budget_ms",
         "window": "msm_window",
+        "depth": "pipeline_depth",
     }
     for part in spec.split(";"):
         part = part.strip()
@@ -193,19 +197,27 @@ def _validate_grid_values(grid: dict) -> None:
                 f"autotune grid msm_window {w} not in "
                 f"{_msm.SUPPORTED_WINDOWS}"
             )
+    for d in grid["pipeline_depth"]:
+        if d < 1:
+            raise ValueError(
+                f"autotune grid pipeline_depth {d} must be >= 1 "
+                "(1 = synchronous dispatch)"
+            )
 
 
 @dataclass(frozen=True)
 class TunedConfig:
     """One point of the knob space — everything apply() touches.
     msm_window == 0 means "leave the live window alone" (the default
-    keeps pre-MSM decision artifacts replayable)."""
+    keeps pre-MSM decision artifacts replayable); pipeline_depth == 0
+    the same for the verifier's wave-overlap depth."""
 
     limb_backend: str
     ingest_min_bucket: int
     ladder_top: int
     latency_budget_ms: float
     msm_window: int = 0
+    pipeline_depth: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -222,12 +234,17 @@ def current_config(verifier=None) -> TunedConfig:
     fn = getattr(verifier, "latency_budget_ms", None)
     if fn is not None:
         budget_ms = float(fn())
+    depth = 0
+    dfn = getattr(verifier, "pipeline_depth", None)
+    if dfn is not None:
+        depth = int(dfn())
     return TunedConfig(
         limb_backend=limbs.get_backend(),
         ingest_min_bucket=kernels.ingest_min_bucket(),
         ladder_top=kernels.ladder_top(),
         latency_budget_ms=budget_ms,
         msm_window=msm.msm_window(),
+        pipeline_depth=depth,
     )
 
 
@@ -350,14 +367,52 @@ def select_config(
         grid.get("msm_window", DEFAULT_GRID["msm_window"]), platform
     )
     rationale["msm_window"] = msm_rationale
+    depth, depth_rationale = select_pipeline_depth(
+        grid.get("pipeline_depth", DEFAULT_GRID["pipeline_depth"]),
+        platform,
+    )
+    rationale["pipeline_depth"] = depth_rationale
     cfg = TunedConfig(
         limb_backend=best.backend,
         ingest_min_bucket=gate,
         ladder_top=top,
         latency_budget_ms=float(budget),
         msm_window=msm_window,
+        pipeline_depth=depth,
     )
     return cfg, rationale
+
+
+def select_pipeline_depth(
+    candidates, platform: str
+) -> tuple[int, dict]:
+    """Pick the verifier wave-overlap depth (bls/verifier.py).
+
+    TPU: host prep (pubkey packing, limb conversion) and device
+    execution run on different hardware, so any depth >= 2 hides the
+    prep behind the in-flight wave; deeper queues only add latency
+    and buffer footprint, so take the SMALLEST candidate >= 2.
+    CPU emulation: the single core both preps and executes, there is
+    no overlap to win — depth > 1 just reorders work and widens the
+    flush window, so take the minimum candidate."""
+    cands = sorted(set(int(d) for d in candidates))
+    if platform == "tpu":
+        chosen = next((d for d in cands if d >= 2), cands[-1])
+        model = (
+            "smallest depth >= 2: one prefetched wave hides host "
+            "prep; deeper queues only add latency"
+        )
+    else:
+        chosen = cands[0]
+        model = (
+            "min depth: one core preps AND executes, overlap "
+            "hides nothing"
+        )
+    return chosen, {
+        "chosen": chosen,
+        "candidates": cands,
+        "model": model,
+    }
 
 
 def select_msm_window(
@@ -480,6 +535,12 @@ def apply_config(config: TunedConfig, verifier=None) -> None:
     fn = getattr(verifier, "set_latency_budget_ms", None)
     if fn is not None:
         fn(config.latency_budget_ms)
+    if config.pipeline_depth:
+        # 0 = leave the live overlap depth alone (pre-pipeline
+        # decision artifacts stay replayable)
+        dfn = getattr(verifier, "set_pipeline_depth", None)
+        if dfn is not None:
+            dfn(config.pipeline_depth)
 
 
 def load_decision(path: str) -> dict:
@@ -505,6 +566,7 @@ def apply_decision(
         latency_budget_ms=float(c["latency_budget_ms"]),
         # pre-MSM artifacts carry no window; 0 leaves the live one
         msm_window=int(c.get("msm_window", 0)),
+        pipeline_depth=int(c.get("pipeline_depth", 0)),
     )
     apply_config(cfg, verifier=verifier)
     _record_applied(
@@ -1044,6 +1106,7 @@ def bind_autotune_collectors(
         g.set(cfg["latency_budget_ms"], knob="latency_budget_ms")
         # 0 = decision predates the knob / left the live window alone
         g.set(cfg.get("msm_window") or 0, knob="msm_window")
+        g.set(cfg.get("pipeline_depth") or 0, knob="pipeline_depth")
 
     metrics.selected.add_collect(_selected)
 

@@ -692,6 +692,22 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "Device waves dispatched and not yet finalized"
         " (TpuBlsVerifier.in_flight_waves)",
     )
+    dv.pipeline_occupancy = reg.gauge(
+        "lodestar_jax_pipeline_occupancy",
+        "Fraction of wall time with >=1 device wave in flight"
+        " (TpuBlsVerifier overlapped pipeline; 1.0 = device never"
+        " idles between buckets)",
+    )
+    dv.prep_overlap_hidden_seconds_total = reg.gauge(
+        "lodestar_jax_prep_overlap_hidden_seconds_total",
+        "Host wave-prep seconds spent while another wave was in"
+        " flight — the latency the depth>1 pipeline hid",
+    )
+    dv.donated_buffer_reuse_total = reg.gauge(
+        "lodestar_jax_donated_buffer_reuse_total",
+        "Input buffers donated to fused stage dispatches"
+        " (donate_argnums; armed on TPU only, honest 0 elsewhere)",
+    )
     dv.backend_switches_total = reg.gauge(
         "lodestar_jax_backend_switches_total",
         "Limb-backend switches that dropped every cached jit trace"
@@ -742,8 +758,8 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
     )
     at.selected = reg.gauge(
         "lodestar_autotune_selected",
-        "Numeric knob values the tuner applied"
-        " (ingest_min_bucket / ladder_top / latency_budget_ms)",
+        "Numeric knob values the tuner applied (ingest_min_bucket /"
+        " ladder_top / latency_budget_ms / msm_window / pipeline_depth)",
         label_names=("knob",),
     )
     at.config_info = reg.gauge(
@@ -788,6 +804,17 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "lodestar_kzg_msm_device_fallback_total",
         "KZG MSM dispatches that wanted the device tier but fell back"
         " to a host tier (cold rung or device error)",
+    )
+    kz.fr_dispatch_total = reg.gauge(
+        "lodestar_kzg_fr_dispatch_total",
+        "KZG batch-verify barycentric evaluations by Fr backend tier"
+        " (device limb kernels / python ints)",
+        label_names=("path",),
+    )
+    kz.fr_device_fallback_total = reg.gauge(
+        "lodestar_kzg_fr_device_fallback_total",
+        "KZG Fr evaluations that wanted the device tier but fell"
+        " back to the Python ints (device error)",
     )
     kz.batch_verify_blobs = reg.histogram(
         "lodestar_kzg_batch_verify_blobs",

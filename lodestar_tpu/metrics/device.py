@@ -196,6 +196,10 @@ class DeviceTelemetry:
         self.device_seconds: dict[str, float] = {}
         self.transfer_bytes = {"h2d": 0, "d2h": 0}
         self.backend_switches = 0
+        # input buffers donated to the fused stage programs
+        # (bls/kernels donate_argnums; stays 0 off-TPU where donation
+        # is disarmed — the gauge must not claim reuse XLA ignored)
+        self.donated_buffer_reuses = 0
         # on-demand capture
         self.trace_captures = 0
         self.trace_capture_active = False
@@ -275,6 +279,13 @@ class DeviceTelemetry:
             self.transfer_bytes[direction] = (
                 self.transfer_bytes.get(direction, 0) + int(nbytes)
             )
+
+    def note_donation(self, n: int) -> None:
+        """n input buffers handed to a fused dispatch with
+        donate_argnums armed (their device memory is reusable for the
+        program's outputs — the double-buffered pipeline's HBM bound)."""
+        with self._lock:
+            self.donated_buffer_reuses += int(n)
 
     def note_backend_switch(self) -> None:
         """A limb-backend switch dropped every cached trace
@@ -506,6 +517,19 @@ def bind_collectors(metrics, telemetry: "DeviceTelemetry", verifier=None):
         metrics.dispatch_queue_depth.add_collect(
             lambda g: g.set(verifier.in_flight_waves)
         )
+    # overlapped-pipeline observability (ISSUE 16): occupancy and the
+    # host-prep seconds the overlap hid come from the verifier's wave
+    # accounting; donated-buffer reuse from the kernels' dispatches
+    if verifier is not None and hasattr(verifier, "pipeline_occupancy"):
+        metrics.pipeline_occupancy.add_collect(
+            lambda g: g.set(verifier.pipeline_occupancy())
+        )
+        metrics.prep_overlap_hidden_seconds_total.add_collect(
+            lambda g: g.set(verifier.metrics.prep_overlap_hidden_s)
+        )
+    metrics.donated_buffer_reuse_total.add_collect(
+        lambda g: g.set(dtel.donated_buffer_reuses)
+    )
 
 
 # -- device memory ----------------------------------------------------------

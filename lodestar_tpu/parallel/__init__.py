@@ -50,3 +50,52 @@ def shard_batch(mesh: Mesh, tree):
 def replicate(mesh: Mesh, tree):
     r = replicated(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, r), tree)
+
+
+def whole_bucket_verify(mesh: Mesh, local_fn, n_args: int,
+                        replicated_args: tuple = ()):
+    """Whole-bucket SPMD verify wrapper (ISSUE 16).
+
+    The auto-spmd mesh path shards the batch axis INSIDE one bucket's
+    program, so XLA inserts ICI all-reduces into the aggregate and
+    product reduction trees — several collectives per wave, each a
+    latency wall. But the random-linear-combination batch-verify
+    equation is SEPARABLE across disjoint subsets of sets: each chip
+    can run the complete verify on the sub-bucket it owns and the
+    batch verdict is just the AND of the per-chip verdicts. shard_map
+    makes that explicit: `local_fn` (batch-shaped args -> () bool) is
+    traced per shard with collective-free local shapes, and the ONLY
+    collective in the whole program is one scalar `psum` of the
+    per-chip bad counts at the final verdict.
+
+    in_specs are pytree PREFIXES: P(batch) splits every array leaf's
+    leading axis across the mesh; indices in `replicated_args` get P()
+    (e.g. the shared same-message hash point). The caller places
+    inputs with shard_batch/replicate to match.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    import jax.numpy as jnp
+
+    in_specs = tuple(
+        P() if i in replicated_args else P(BATCH_AXIS)
+        for i in range(n_args)
+    )
+
+    def spmd(*args):
+        ok = local_fn(*args)
+        bad = jax.lax.psum(jnp.where(ok, 0, 1), BATCH_AXIS)
+        return bad == 0
+
+    # check_rep=False: the replication-type checker mis-infers the
+    # carry replication of lax.scan bodies (the ladders and masked
+    # products are scan-based) and rejects the program; the body is
+    # collective-free by construction and the one explicit psum above
+    # is the whole cross-shard story, so the check adds nothing here.
+    return shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_rep=False,
+    )

@@ -575,6 +575,96 @@ class TestMsmWindowKnob:
         assert M.msm_window() == d["config"]["msm_window"]
 
 
+class TestPipelineDepthKnob:
+    """The overlapped-pipeline knob on the grid (bls/verifier.py wave
+    double buffering, ISSUE 16): parse/validate, the platform cost
+    models, live apply, and replay compatibility with pre-pipeline
+    decision artifacts. Mirrors TestMsmWindowKnob."""
+
+    def test_parse_grid_axis_and_alias(self):
+        g = AT.parse_grid("pipeline_depth=1,2")
+        assert g["pipeline_depth"] == (1, 2)
+        assert AT.parse_grid("depth=4")["pipeline_depth"] == (4,)
+
+    def test_parse_grid_rejects_depth_below_one(self):
+        with pytest.raises(ValueError):
+            AT.parse_grid("depth=0")
+
+    def test_tpu_model_takes_smallest_overlapping_depth(self):
+        # one prefetched wave hides host prep; deeper queues only
+        # add latency -> smallest candidate >= 2
+        d, rat = AT.select_pipeline_depth((1, 2, 4), "tpu")
+        assert d == 2
+        assert rat["candidates"] == [1, 2, 4]
+        assert "hides host prep" in rat["model"]
+
+    def test_cpu_model_takes_min_depth(self):
+        # one core preps AND executes: overlap hides nothing
+        d, rat = AT.select_pipeline_depth((1, 2, 4), "cpu")
+        assert d == 1
+        assert "overlap" in rat["model"]
+
+    def test_select_config_carries_depth_and_rationale(self):
+        ms = [_measurement("vpu", 400.0, bucket=4, dispatch=0.010)]
+        grid = dict(TestSelectConfig.GRID, pipeline_depth=(1, 2, 4))
+        cfg, rationale = AT.select_config(grid, ms, 5e-4, "tpu")
+        assert cfg.pipeline_depth == 2
+        assert rationale["pipeline_depth"]["chosen"] == 2
+
+    def test_apply_config_moves_verifier_depth(self, monkeypatch):
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        v = _FakeVerifier()
+        AT.apply_config(
+            AT.TunedConfig("vpu", 256, 2048, 50.0, pipeline_depth=4),
+            verifier=v,
+        )
+        assert v.depth == 4
+
+    def test_apply_config_zero_leaves_depth_alone(self, monkeypatch):
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        v = _FakeVerifier()
+        v.depth = 2
+        AT.apply_config(
+            AT.TunedConfig("vpu", 256, 2048, 50.0), verifier=v
+        )
+        assert v.depth == 2
+
+    def test_replay_of_pre_pipeline_artifact_keeps_depth(
+        self, monkeypatch
+    ):
+        monkeypatch.setattr(K, "_WARMUP_STARTED", False)
+        v = _FakeVerifier()
+        v.depth = 2
+        decision = {
+            "mode": "startup",
+            "config": {
+                "limb_backend": "vpu",
+                "ingest_min_bucket": 256,
+                "ladder_top": 2048,
+                "latency_budget_ms": 50.0,
+                # no pipeline_depth key: a pre-pipeline AUTOTUNE.json
+            },
+        }
+        cfg = AT.apply_decision(decision, verifier=v)
+        assert cfg.pipeline_depth == 0
+        assert v.depth == 2
+
+    def test_current_config_reports_live_depth(self):
+        v = _FakeVerifier()
+        v.depth = 4
+        assert AT.current_config(v).pipeline_depth == 4
+        # verifiers without the knob (oracle) report 0 = unknown
+        assert AT.current_config(None).pipeline_depth == 0
+
+    def test_real_verifier_depth_setter_roundtrip(self):
+        v = TpuBlsVerifier(mesh=False, pipeline_depth=4)
+        assert v.pipeline_depth() == 4
+        v.set_pipeline_depth(1)
+        assert v.pipeline_depth() == 1
+        v.set_pipeline_depth(0)  # clamped: depth is at least 1
+        assert v.pipeline_depth() == 1
+
+
 # ---------------------------------------------------------------------------
 # the tuner, offline (stubbed bench — no compile in tier-1)
 # ---------------------------------------------------------------------------
@@ -604,6 +694,7 @@ class _FakeVerifier:
         self.budget_ms = 50.0
         self.quiet = True
         self.accepting = True
+        self.depth = 0
 
     def set_latency_budget_ms(self, ms):
         self.budget_ms = ms
@@ -616,6 +707,12 @@ class _FakeVerifier:
 
     def is_quiescent(self):
         return self.quiet
+
+    def pipeline_depth(self):
+        return self.depth
+
+    def set_pipeline_depth(self, depth):
+        self.depth = depth
 
 
 class TestDeviceAutotuner:
@@ -772,14 +869,21 @@ def _budget_window():
     return dict(AT.budget_shares())
 
 
-def _drifted_window(stage="miller", share=0.6):
-    """One stage ballooned to `share`; the others keep their budget
-    PROPORTIONS (scaled into the remainder), so only the drifted
-    stage departs its budget share beyond the 0.15 threshold."""
-    base = AT.budget_shares()
-    scale = (1.0 - share) / (1.0 - base[stage])
-    shares = {s: v * scale for s, v in base.items()}
-    shares[stage] = share
+def _drifted_window(stage="pairing", delta=0.16):
+    """The target stage departs its budget share by +delta (past the
+    0.15 threshold); the loss is spread over the OTHER stages capped
+    at 0.13 each, so ONLY the drifted stage trips the monitor. (The
+    fused 3-row budget is prepare-dominant — a proportional rescale
+    of the remainder would drag `prepare` past the threshold too.)"""
+    shares = dict(AT.budget_shares())
+    shares[stage] += delta
+    remaining = delta
+    rest = [s for s in shares if s != stage]
+    for s in sorted(rest, key=lambda s: -shares[s]):
+        give = min(0.13, shares[s], remaining)
+        shares[s] -= give
+        remaining -= give
+    assert remaining < 1e-9, "drift helper could not balance shares"
     return shares
 
 
@@ -828,19 +932,19 @@ class TestDriftMonitor:
         tel.add_window(_budget_window())
         mon.sample()  # baseline
         for i in range(3):
-            tel.add_window(_drifted_window("miller"))
+            tel.add_window(_drifted_window("pairing"))
             mon.sample()
-            assert mon.streaks["miller"] == i + 1
-        assert mon.pending_stage == "miller"
+            assert mon.streaks["pairing"] == i + 1
+        assert mon.pending_stage == "pairing"
         assert mon.maybe_retune() is True
         assert mon.retunes == 1
         assert tuner.runs == 1
         assert tuner.drift_retunes == 1
-        assert tuner.last_decision["trigger"] == "drift:miller"
+        assert tuner.last_decision["trigger"] == "drift:pairing"
         # knobs moved through the real setters
         cfg = tuner.last_decision["config"]
         assert K.ingest_min_bucket() == cfg["ingest_min_bucket"]
-        assert mon.streaks["miller"] == 0  # streaks reset post-tune
+        assert mon.streaks["pairing"] == 0  # streaks reset post-tune
 
     def test_retune_blocked_until_verifier_quiescent(self):
         tel = _FakeTelemetry()
@@ -856,15 +960,15 @@ class TestDriftMonitor:
         tel.add_window(_budget_window())
         mon.sample()
         for _ in range(3):
-            tel.add_window(_drifted_window("g2_sqrt"))
+            tel.add_window(_drifted_window("prepare"))
             mon.sample()
-        assert mon.pending_stage == "g2_sqrt"
+        assert mon.pending_stage == "prepare"
         assert mon.maybe_retune() is False  # NEVER mid-wave
         assert mon.retunes_blocked == 1
         assert tunes == []
         v.quiet = True
         assert mon.maybe_retune() is True
-        assert tunes == ["drift:g2_sqrt"]
+        assert tunes == ["drift:prepare"]
 
     def test_retune_holds_verifier_intake_for_its_duration(self):
         """The quiescence checked before a re-tune must keep holding
@@ -884,12 +988,60 @@ class TestDriftMonitor:
         mon = self._monitor(tuner, tel, verifier=v, windows=1)
         tel.add_window(_budget_window())
         mon.sample()
-        tel.add_window(_drifted_window("miller"))
+        tel.add_window(_drifted_window("pairing"))
         mon.sample()
         assert v.can_accept_work()  # held only DURING the tune
         assert mon.maybe_retune() is True
         assert during["accepting"] is False
         assert v.can_accept_work()  # released after
+
+    def test_retune_blocked_mid_prefetch_defers(self):
+        """ISSUE 16 regression: with the overlapped pipeline a wave
+        can be IN FLIGHT (prefetched, not yet finalized) while the
+        rolling buckets and finalizer set are empty. is_quiescent now
+        accounts for those wave tasks, so a drift re-tune arriving
+        mid-prefetch DEFERS (retunes_blocked counts it) instead of
+        switching knobs under a dispatched wave; the pending trigger
+        fires once the wave drains."""
+        tel = _FakeTelemetry()
+        v = TpuBlsVerifier(mesh=False, pipeline_depth=2)
+        tunes = []
+        tuner = SimpleNamespace(
+            tune=lambda trigger: tunes.append(trigger),
+            verifier=v,
+            log=_quiet_log(),
+        )
+        mon = self._monitor(tuner, tel, verifier=v, windows=1)
+        tel.add_window(_budget_window())
+        mon.sample()
+        tel.add_window(_drifted_window("pairing"))
+        mon.sample()
+        assert mon.pending_stage == "pairing"
+
+        async def scenario():
+            gate = asyncio.Event()
+
+            async def wave():
+                await gate.wait()
+
+            t = asyncio.ensure_future(wave())
+            v._wave_tasks.add(t)
+            try:
+                assert not v.is_quiescent()
+                assert mon.maybe_retune() is False
+                assert mon.retunes_blocked == 1
+                assert mon.pending_stage == "pairing"  # still pending
+                assert tunes == []
+            finally:
+                gate.set()
+                await t
+                v._wave_tasks.discard(t)
+            assert v.is_quiescent()
+            assert mon.maybe_retune() is True
+
+        asyncio.run(scenario())
+        assert tunes == ["drift:pairing"]
+        assert mon.retunes_blocked == 1
 
     def test_cooldown_and_cap_bound_retunes(self):
         tel = _FakeTelemetry()
@@ -935,14 +1087,14 @@ class TestDriftMonitor:
         mon = self._monitor(tuner, tel)
         tel.add_window(_budget_window())
         mon.sample()
-        tel.add_window(_drifted_window("miller"))
+        tel.add_window(_drifted_window("pairing"))
         mon.sample()
-        assert mon.streaks["miller"] == 1
+        assert mon.streaks["pairing"] == 1
         # an idle node (window total below min_window_s) must neither
         # extend nor produce drift streaks off noise
-        tel.add_window(_drifted_window("miller"), total_s=0.001)
+        tel.add_window(_drifted_window("pairing"), total_s=0.001)
         assert mon.sample() == {}
-        assert mon.streaks["miller"] == 1
+        assert mon.streaks["pairing"] == 1
 
 
 # ---------------------------------------------------------------------------

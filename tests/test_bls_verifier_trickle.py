@@ -64,8 +64,11 @@ def _run(coro):
 
 
 def _stub_ingest(monkeypatch, calls):
-    """Replace both device-ingest entry points with shape-recording
-    stubs that return a device True verdict."""
+    """Replace the device-ingest entry points with shape-recording
+    stubs that return a device True verdict — both the single-host
+    entries and the whole-bucket MESH entries (conftest forces 8
+    virtual devices, so a bucket divisible by 8 routes to the mesh
+    programs)."""
     import jax.numpy as jnp
 
     monkeypatch.setattr(K, "_INGEST_WARM", set())
@@ -78,9 +81,23 @@ def _stub_ingest(monkeypatch, calls):
         calls.append(("same_message", int(mask.shape[0])))
         return jnp.asarray(True)
 
+    def fake_batch_mesh(mesh, pk, sig_x, sig_sign, u0, u1, bits, mask):
+        calls.append(("batch", int(mask.shape[0])))
+        return jnp.asarray(True)
+
+    def fake_same_message_mesh(mesh, pk, h, sig_x, sig_sign, bits, mask):
+        calls.append(("same_message", int(mask.shape[0])))
+        return jnp.asarray(True)
+
     monkeypatch.setattr(K, "run_verify_batch_ingest_async", fake_batch)
     monkeypatch.setattr(
         K, "run_verify_same_message_ingest_async", fake_same_message
+    )
+    monkeypatch.setattr(
+        K, "run_verify_batch_ingest_mesh", fake_batch_mesh
+    )
+    monkeypatch.setattr(
+        K, "run_verify_same_message_mesh", fake_same_message_mesh
     )
 
 

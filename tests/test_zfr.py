@@ -1,0 +1,236 @@
+"""Device Fr evaluation vs the Python oracle.
+
+ops/fr.py carries the 4096-point barycentric evaluation (and its
+Montgomery batch inversion) as limb kernels; crypto/kzg.py routes
+`verify_blob_kzg_proof_batch` evaluations through it when the device
+tier is on.  These tests pin the kernels bit-exact against plain
+python ints mod r — small widths for the primitives, the real
+4096-wide program for the kzg wiring (one ~3 s CPU compile, cached
+for the process; test_z* files run last so tier-1 pays it warm).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lodestar_tpu.crypto import kzg  # noqa: E402
+from lodestar_tpu.ops import fr as F  # noqa: E402
+
+R = F.R
+
+
+@pytest.fixture(autouse=True)
+def _restore_fr_backend():
+    before = kzg.fr_backend()
+    yield
+    kzg.set_fr_backend(before)
+
+
+def _rand(n, seed):
+    rng = random.Random(seed)
+    return [rng.randrange(R) for _ in range(n)]
+
+
+def _bary_oracle(poly, roots, z):
+    """Plain-ints barycentric oracle for an arbitrary power-of-two
+    domain (evaluate_polynomial_in_evaluation_form is pinned to the
+    4096-wide production domain)."""
+    width = len(roots)
+    if z in roots:
+        return poly[roots.index(z)]
+    inv = kzg._fr_batch_inv([(z - w) % R for w in roots])
+    acc = 0
+    for p, w, iv in zip(poly, roots, inv):
+        acc = (acc + p * w % R * iv) % R
+    zn = (pow(z, width, R) - 1) % R
+    return acc * zn % R * pow(width, R - 2, R) % R
+
+
+def _mini_roots(width):
+    return kzg._bit_reversal_permutation(
+        kzg.compute_roots_of_unity(width)
+    )
+
+
+class TestFrPrimitives:
+    def test_int_roundtrip(self):
+        vals = [0, 1, R - 1, R - 2] + _rand(12, seed=1)
+        assert F.fr_to_ints(F.fr_from_ints(vals)) == vals
+
+    def test_limbs_are_canonical_width(self):
+        limbs = F.fr_from_ints(_rand(5, seed=2))
+        assert limbs.shape == (5, F.NC)
+        assert limbs.dtype == np.int32
+        assert int(limbs.min()) >= 0
+        assert int(limbs.max()) < (1 << F.BITS)
+
+    def test_mul_add_sub_match_python(self):
+        a = [0, 1, R - 1] + _rand(9, seed=3)
+        b = [R - 1, 0, R - 1] + _rand(9, seed=4)
+        ad = jnp.asarray(F.fr_from_ints(a))
+        bd = jnp.asarray(F.fr_from_ints(b))
+        assert F.fr_to_ints(F.fr_mul(ad, bd)) == [
+            x * y % R for x, y in zip(a, b)
+        ]
+        assert F.fr_to_ints(F.fr_add(ad, bd)) == [
+            (x + y) % R for x, y in zip(a, b)
+        ]
+        assert F.fr_to_ints(F.fr_sub(ad, bd)) == [
+            (x - y) % R for x, y in zip(a, b)
+        ]
+
+    @pytest.mark.parametrize("exp", [1, 7, 4096, R - 2])
+    def test_pow_matches_python(self, exp):
+        a = [1, R - 1] + _rand(4, seed=5)
+        ad = jnp.asarray(F.fr_from_ints(a))
+        assert F.fr_to_ints(F.fr_pow(ad, exp)) == [
+            pow(x, exp, R) for x in a
+        ]
+
+    def test_batch_inv_matches_fermat(self):
+        xs = [1, R - 1] + _rand(14, seed=6)
+        xd = jnp.asarray(F.fr_from_ints(xs))
+        assert F.fr_to_ints(F.fr_batch_inv(xd)) == [
+            pow(x, R - 2, R) for x in xs
+        ]
+
+
+class TestBarycentricMiniDomain:
+    """Differential tests at width 8 — same program shape as the
+    4096-wide production dispatch, compile measured in seconds."""
+
+    WIDTH = 8
+
+    def _run(self, polys, zs):
+        roots = _mini_roots(self.WIDTH)
+        pd = jnp.asarray(np.stack([F.fr_from_ints(p) for p in polys]))
+        rd = jnp.asarray(F.fr_from_ints(roots))
+        zd = jnp.asarray(F.fr_from_ints(zs))
+        got = F.fr_to_ints(F.eval_barycentric_batch(pd, rd, zd))
+        want = [
+            _bary_oracle(p, roots, z) for p, z in zip(polys, zs)
+        ]
+        return got, want
+
+    def test_random_batch_matches_oracle(self):
+        polys = [_rand(self.WIDTH, seed=10 + i) for i in range(3)]
+        zs = _rand(3, seed=20)
+        got, want = self._run(polys, zs)
+        assert got == want
+
+    def test_zero_polynomial_evaluates_to_zero(self):
+        polys = [[0] * self.WIDTH, _rand(self.WIDTH, seed=30)]
+        zs = _rand(2, seed=31)
+        got, want = self._run(polys, zs)
+        assert got == want
+        assert got[0] == 0
+
+    def test_sparse_zero_coefficients(self):
+        poly = _rand(self.WIDTH, seed=40)
+        poly[0] = poly[3] = poly[7] = 0
+        got, want = self._run([poly], _rand(1, seed=41))
+        assert got == want
+
+
+class TestKzgWiring:
+    """The production seam: _evaluate_polynomials_batch on the real
+    4096-wide domain, device tier forced on."""
+
+    def _polys(self, m, seed):
+        return [
+            _rand(kzg.FIELD_ELEMENTS_PER_BLOB, seed=seed + i)
+            for i in range(m)
+        ]
+
+    def test_device_tier_bit_exact_with_root_shortcut(self):
+        kzg.set_fr_backend("device")
+        before = kzg.fr_path_counts()
+        roots = kzg._roots_brp()
+        polys = self._polys(3, seed=50)
+        # one z ON the domain (host coefficient shortcut), two off it
+        zs = [roots[5]] + _rand(2, seed=60)
+        got = kzg._evaluate_polynomials_batch(polys, zs)
+        want = [
+            kzg.evaluate_polynomial_in_evaluation_form(p, z)
+            for p, z in zip(polys, zs)
+        ]
+        assert got == want
+        assert got[0] == polys[0][5]
+        after = kzg.fr_path_counts()
+        assert after["device"] == before["device"] + 1
+        assert after["python"] == before["python"]
+        assert (
+            after["device_fallbacks"] == before["device_fallbacks"]
+        )
+
+    def test_all_roots_batch_never_dispatches(self):
+        kzg.set_fr_backend("device")
+        before = kzg.fr_path_counts()
+        roots = kzg._roots_brp()
+        polys = self._polys(2, seed=70)
+        zs = [roots[0], roots[4095]]
+        got = kzg._evaluate_polynomials_batch(polys, zs)
+        assert got == [polys[0][0], polys[1][4095]]
+        assert (
+            kzg.fr_path_counts()["device"] == before["device"] + 1
+        )
+
+    def test_python_tier_counts(self):
+        kzg.set_fr_backend("python")
+        before = kzg.fr_path_counts()
+        polys = self._polys(1, seed=80)
+        zs = _rand(1, seed=81)
+        got = kzg._evaluate_polynomials_batch(polys, zs)
+        assert got == [
+            kzg.evaluate_polynomial_in_evaluation_form(
+                polys[0], zs[0]
+            )
+        ]
+        after = kzg.fr_path_counts()
+        assert after["python"] == before["python"] + 1
+        assert after["device"] == before["device"]
+
+    def test_auto_on_cpu_routes_python(self):
+        kzg.set_fr_backend("auto")
+        before = kzg.fr_path_counts()
+        kzg._evaluate_polynomials_batch(
+            self._polys(1, seed=90), _rand(1, seed=91)
+        )
+        assert (
+            kzg.fr_path_counts()["python"] == before["python"] + 1
+        )
+
+    def test_device_error_falls_back_counted(self, monkeypatch):
+        kzg.set_fr_backend("device")
+        before = kzg.fr_path_counts()
+
+        def _boom(*a, **k):
+            raise RuntimeError("device lost")
+
+        monkeypatch.setattr(F, "eval_barycentric_batch", _boom)
+        polys = self._polys(1, seed=95)
+        zs = _rand(1, seed=96)
+        got = kzg._evaluate_polynomials_batch(polys, zs)
+        assert got == [
+            kzg.evaluate_polynomial_in_evaluation_form(
+                polys[0], zs[0]
+            )
+        ]
+        after = kzg.fr_path_counts()
+        assert (
+            after["device_fallbacks"]
+            == before["device_fallbacks"] + 1
+        )
+        assert after["python"] == before["python"] + 1
+
+    def test_bad_backend_rejected(self):
+        live = kzg.fr_backend()
+        with pytest.raises(ValueError):
+            kzg.set_fr_backend("gpu")
+        assert kzg.fr_backend() == live

@@ -1,0 +1,149 @@
+"""Whole-bucket mesh sharding: one collective, verdict semantics.
+
+The auto-spmd mesh path let XLA scatter ICI all-reduces through the
+aggregate/product reduction trees; `parallel.whole_bucket_verify`
+gives each chip complete sub-buckets so the ONLY collective in the
+lowered program is the single verdict psum. These tests pin that
+structurally (StableHLO of the real batch program) and semantically
+(AND-of-shards through the real shard_map wrapper on the virtual
+8-device mesh). The production execution smoke lives in
+test_bls_mesh.py, which drives the verifier end to end on this mesh.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lodestar_tpu import parallel  # noqa: E402
+from lodestar_tpu.bls import kernels as K  # noqa: E402
+from lodestar_tpu.bls import api  # noqa: E402
+from lodestar_tpu.crypto.bls.signature import sign, sk_to_pk  # noqa: E402
+from lodestar_tpu.ops import curve as C  # noqa: E402
+from lodestar_tpu.ops import tower  # noqa: E402
+
+# every StableHLO collective spelling that could appear if sharding
+# leaked into the reduction trees (underscore forms; stablehlo uses
+# e.g. "stablehlo.all_reduce")
+OTHER_COLLECTIVES = (
+    "all_gather",
+    "all_to_all",
+    "collective_permute",
+    "reduce_scatter",
+    "collective_broadcast",
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return parallel.make_mesh(8)
+
+
+def _batch_args(n):
+    """Real-shaped host-hashed batch args (values irrelevant for
+    lowering; shapes mirror kernels._warm_one)."""
+    msg = b"\x5a" * 32
+    pk = api.decompress_pubkey(sk_to_pk(7))
+    h = api.message_to_g2(msg)
+    pk_dev = C.g1_batch_from_ints([pk] * n)
+    h_dev = C.g2_batch_from_ints([h] * n)
+    sig_dev = C.g2_batch_from_ints([h] * n)
+    bits = C.scalars_to_bits([3] * n, K.RAND_BITS)
+    mask = jnp.asarray([True] * n)
+    return pk_dev, h_dev.x, h_dev.y, sig_dev, bits, mask
+
+
+class TestSingleCollective:
+    def test_batch_program_has_exactly_one_all_reduce(self, mesh):
+        """The ISSUE-16 acceptance assertion: the whole-bucket batch
+        program lowers to exactly ONE all_reduce (the verdict psum)
+        and no other collective anywhere."""
+        args = _batch_args(8)
+        txt = K._mesh_program("batch", mesh).lower(*args).as_text()
+        assert txt.count("all_reduce") == 1
+        for name in OTHER_COLLECTIVES:
+            assert txt.count(name) == 0, name
+
+    @pytest.mark.slow
+    def test_ingest_program_has_exactly_one_all_reduce(self, mesh):
+        """Device-ingest mesh kind: decompress + hash-to-curve add
+        big scan ladders, still zero extra collectives. slow: ~130 s
+        of pure trace/lower on the 1-core container, and the batch
+        test above already pins the acceptance property."""
+        n = 8
+        msg = b"\x5a" * 32
+        s = sign(7, msg)
+        xc0, xc1, s_sign, ok = api.parse_signature(s)
+        assert ok
+        pk = api.decompress_pubkey(sk_to_pk(7))
+        draws = api.message_draws(msg)
+        pk_dev = C.g1_batch_from_ints([pk] * n)
+        sig_x = tower.fq2_from_ints([(xc0, xc1)] * n)
+        sig_sign = jnp.asarray([s_sign] * n)
+        u0 = tower.fq2_from_ints([draws[0]] * n)
+        u1 = tower.fq2_from_ints([draws[1]] * n)
+        bits = C.scalars_to_bits([3] * n, K.RAND_BITS)
+        mask = jnp.asarray([True] * n)
+        txt = (
+            K._mesh_program("ingest_batch", mesh)
+            .lower(pk_dev, sig_x, sig_sign, u0, u1, bits, mask)
+            .as_text()
+        )
+        assert txt.count("all_reduce") == 1
+        for name in OTHER_COLLECTIVES:
+            assert txt.count(name) == 0, name
+
+    def test_mesh_program_is_cached_per_kind(self, mesh):
+        assert K._mesh_program("batch", mesh) is K._mesh_program(
+            "batch", mesh
+        )
+        assert K._mesh_program("batch", mesh) is not K._mesh_program(
+            "ingest_batch", mesh
+        )
+
+
+class TestVerdictSemantics:
+    """whole_bucket_verify with a trivial local body: the AND-of-
+    per-chip-verdicts reduction, executed on the real 8-device mesh
+    (compiles in milliseconds — the production bodies are covered by
+    the structural tests above plus test_bls_mesh)."""
+
+    def _verify(self, mesh, flags):
+        fn = parallel.whole_bucket_verify(
+            mesh, lambda x: jnp.all(x), n_args=1
+        )
+        arr = parallel.shard_batch(
+            mesh, jnp.asarray(flags, dtype=bool)
+        )
+        return bool(jax.jit(fn)(arr))
+
+    def test_all_shards_good(self, mesh):
+        assert self._verify(mesh, [True] * 16) is True
+
+    def test_one_bad_shard_fails_whole_bucket(self, mesh):
+        flags = [True] * 16
+        flags[9] = False  # lives on chip 4 of 8; psum must carry it
+        assert self._verify(mesh, flags) is False
+
+    def test_replicated_args_stay_whole(self, mesh):
+        """An arg listed in replicated_args keeps its full shape on
+        every shard (the same-message hash point)."""
+        seen = []
+
+        def local(x, shared):
+            seen.append((x.shape, shared.shape))
+            return jnp.logical_and(jnp.all(x), jnp.all(shared))
+
+        fn = parallel.whole_bucket_verify(
+            mesh, local, n_args=2, replicated_args=(1,)
+        )
+        x = parallel.shard_batch(mesh, jnp.ones((8, 3), dtype=bool))
+        shared = parallel.replicate(
+            mesh, jnp.ones((1, 5), dtype=bool)
+        )
+        assert bool(jax.jit(fn)(x, shared)) is True
+        # traced once per shard group: local shapes, whole replicated
+        assert seen[0] == ((1, 3), (1, 5))

@@ -167,6 +167,7 @@ async def _bench(args) -> dict:
 
     v = TpuBlsVerifier(
         latency_budget_ms=0 if args.no_rolling else args.latency_budget_ms,
+        pipeline_depth=args.pipeline_depth,
     )
     if args.warmup:
         v.start_warmup(block=True)
@@ -195,9 +196,25 @@ async def _bench(args) -> dict:
     lat, wall = await _run_trickle(
         v, singles, groups, args.gap_ms / 1000.0
     )
+    depth = v.pipeline_depth()
     await v.close()
 
     total_sigs = n_singles + reps * sum(group_sizes)
+    # overlapped-vs-sync A/B: when the measured run overlapped waves
+    # (depth > 1), repeat the SAME schedule synchronously (depth 1,
+    # every program already warm) so the report carries both columns
+    sync_wall = None
+    if depth > 1:
+        v_sync = TpuBlsVerifier(
+            latency_budget_ms=(
+                0 if args.no_rolling else args.latency_budget_ms
+            ),
+            pipeline_depth=1,
+        )
+        _, sync_wall = await _run_trickle(
+            v_sync, singles, groups, args.gap_ms / 1000.0
+        )
+        await v_sync.close()
     per_size = {}
     for size in sorted(lat):
         xs = lat[size]
@@ -212,12 +229,19 @@ async def _bench(args) -> dict:
 
     from lodestar_tpu.utils.provenance import provenance
 
+    pipeline: dict = {"depth": depth}
+    if sync_wall:
+        pipeline["sync_sigs_per_sec"] = round(
+            total_sigs / sync_wall, 2
+        )
+        pipeline["overlap_speedup"] = round(sync_wall / wall, 4)
     return {
         "metric": "bls_trickle_gossip_shaped",
         "provenance": provenance(),
         "platform": jax.default_backend(),
         "devices": len(jax.devices()),
         "rolling_enabled": not args.no_rolling,
+        "pipeline": pipeline,
         "latency_budget_ms": args.latency_budget_ms,
         "ingest_min_bucket": K.ingest_min_bucket(),
         "gap_ms": args.gap_ms,
@@ -264,6 +288,10 @@ def main() -> None:
                    help="rolling-bucket latency budget (default 50; "
                    "an explicit value wins over --autotune-from)")
     p.add_argument("--ingest-min-bucket", type=int, default=None)
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="verifier wave-overlap depth (1 = synchronous "
+                   "dispatch; default: verifier default). A depth > 1 "
+                   "adds a second sync run for an A/B pair")
     p.add_argument("--no-rolling", action="store_true",
                    help="disable continuous batching (A/B reference)")
     p.add_argument("--warmup", action="store_true",

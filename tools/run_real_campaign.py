@@ -17,15 +17,18 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
                       (full grid, generous budget) -> AUTOTUNE_real.json
   3. bench          — bench.py --autotune-from (headline sets/s under
                       the tuned config) -> BENCH_real.json
-  4. stage_budget   — tools/profile_prefix.py per backend: the
+  4. pipeline       — bench.py --pipeline-depth 1,2,4 (overlapped
+                      wave pipeline depth sweep: sync vs double/
+                      quad buffering) -> BENCH_pipeline_real.json
+  5. stage_budget   — tools/profile_prefix.py per backend: the
                       post-MXU per-stage budget that updates
                       COVERAGE.md's table -> STAGE_BUDGET_real.json
-  5. trickle        — tools/bench_trickle.py --real --autotune-from
+  6. trickle        — tools/bench_trickle.py --real --autotune-from
                       (gossip-shaped steady state) -> BENCH_trickle_real.json
-  6. blobs          — tools/bench_blobs.py --real --autotune-from
+  7. blobs          — tools/bench_blobs.py --real --autotune-from
                       (peak-DA KZG batch verify through the device
                       Pippenger MSM) -> BENCH_blobs_real.json
-  7. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
+  8. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
                       (the chip-scaling curve) -> MULTICHIP_real.json
 
 `--dry-run` emits the full campaign plan (commands, artifacts,
@@ -89,6 +92,25 @@ def build_plan(args) -> list[dict]:
             "cmd": [PY, "bench.py", "--autotune-from", at],
             "stdout": "BENCH_real.json",
             "artifact": "BENCH_real.json",
+            "needs": ["autotune"],
+        },
+        {
+            "name": "pipeline",
+            "why": "overlapped-pipeline depth sweep on the chip: how "
+            "much host prep the double-buffered dispatch (depth 2) "
+            "actually hides behind device waves vs synchronous "
+            "depth 1, and whether depth 4 buys anything beyond it — "
+            "the seam BENCH_pipeline.json could only emulate on CPU",
+            "cmd": [
+                PY,
+                "bench.py",
+                "--autotune-from",
+                at,
+                "--pipeline-depth",
+                "1,2,4",
+            ],
+            "stdout": "BENCH_pipeline_real.json",
+            "artifact": "BENCH_pipeline_real.json",
             "needs": ["autotune"],
         },
         {
