@@ -866,6 +866,33 @@ _WARMUP_STARTED = False
 # thread drains this set before exiting (guarded by _WARMUP_LOCK)
 _WARMUP_WANT: set[int] = set()
 
+# maintenance gate (device/executor.py): the node wires this to the
+# executor's maintenance_checkpoint so the warmup thread YIELDS the
+# device between compiles whenever a deadline client (live gossip)
+# has work pending — node-start warmup no longer races live traffic.
+# None = no executor: warmup runs back-to-back, the pre-executor
+# behavior (tests, tools, standalone verifiers).
+_MAINT_GATE = None
+
+
+def set_maintenance_gate(gate) -> None:
+    """Install (or clear, with None) the between-compiles yield hook
+    called by warmup_ingest's warm loop."""
+    global _MAINT_GATE
+    _MAINT_GATE = gate
+
+
+def _maintenance_checkpoint() -> None:
+    """Invoke the installed maintenance gate, tolerating any failure:
+    yielding is an optimization — a broken gate must never kill the
+    warmup thread (a size left cold rides the host fallback forever)."""
+    gate = _MAINT_GATE
+    if gate is not None:
+        try:
+            gate()
+        except Exception:
+            pass
+
 
 def ingest_is_warm(b: int, kind: str = "batch") -> bool:
     return (kind, b) in _INGEST_WARM
@@ -1012,6 +1039,11 @@ def warmup_ingest(
     def warm_sizes(seq, log):
         for b in sorted(set(seq)):
             if not ingest_is_warm(b, "batch"):
+                # yield the device to pending deadline work before
+                # each compile (maintenance-class discipline,
+                # device/executor.py) — a multi-second compile must
+                # not start in front of a queued gossip wave
+                _maintenance_checkpoint()
                 # only the batch pipeline becomes warm here — the
                 # same-message program is a different compile
                 warm_one_marked(
@@ -1021,6 +1053,7 @@ def warmup_ingest(
                     "ingest warmup failed; bucket stays on host path",
                 )
             if same_message and not ingest_is_warm(b, "same_message"):
+                _maintenance_checkpoint()
                 warm_one_marked(
                     b,
                     "same_message",

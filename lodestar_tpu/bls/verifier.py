@@ -70,6 +70,13 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from ..crypto.bls import curve as oc
+
+# The latency histogram became an executor-level primitive when the
+# node-wide QoS scheduler landed (device/executor.py tracks per-class
+# submit-to-completion latency with the same class); re-exported here
+# because the verifier's own histograms predate it and tests/tools
+# reach it as verifier.LatencyHistogram.
+from ..device.executor import LatencyHistogram  # noqa: F401
 from ..metrics import device as _device
 from ..ops import curve as C
 from . import api, kernels
@@ -149,60 +156,6 @@ class _Job:
     # verdict — grafted under the caller's bls_verify_job span as a
     # backdated device child (metrics/tracing.attach_completed_span)
     device_s: float = 0.0
-
-
-class LatencyHistogram:
-    """Fixed-bound latency histogram with host-side quantile
-    estimation (linear interpolation inside a bucket). Cheap enough to
-    observe per job; the metrics server samples p50/p99 at scrape."""
-
-    BOUNDS = (
-        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-        0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-    )
-
-    def __init__(self):
-        self.counts = [0] * (len(self.BOUNDS) + 1)
-        self.count = 0
-        self.sum = 0.0
-
-    def observe(self, seconds: float) -> None:
-        i = 0
-        for i, b in enumerate(self.BOUNDS):
-            if seconds <= b:
-                break
-        else:
-            i = len(self.BOUNDS)
-        self.counts[i] += 1
-        self.count += 1
-        self.sum += seconds
-
-    def quantile(self, q: float) -> float:
-        """Estimated q-quantile in seconds (0.0 when empty)."""
-        if self.count == 0:
-            return 0.0
-        rank = q * self.count
-        seen = 0
-        for i, c in enumerate(self.counts):
-            if seen + c >= rank and c > 0:
-                lo = 0.0 if i == 0 else self.BOUNDS[i - 1]
-                hi = (
-                    self.BOUNDS[i]
-                    if i < len(self.BOUNDS)
-                    else self.BOUNDS[-1] * 2
-                )
-                frac = (rank - seen) / c
-                return lo + (hi - lo) * min(1.0, max(0.0, frac))
-            seen += c
-        return self.BOUNDS[-1] * 2
-
-    def snapshot(self) -> dict:
-        return {
-            "count": self.count,
-            "mean_s": (self.sum / self.count) if self.count else 0.0,
-            "p50_s": self.quantile(0.5),
-            "p99_s": self.quantile(0.99),
-        }
 
 
 class BlsVerifierMetrics:
@@ -338,6 +291,7 @@ class TpuBlsVerifier:
         self._runner: asyncio.Task | None = None
         self._finalizers: set[asyncio.Task] = set()
         self._closed = False
+        self._executor = None  # node DeviceExecutor (attach_executor)
         if mesh is None:
             import jax
 
@@ -453,6 +407,39 @@ class TpuBlsVerifier:
             and not self._finalizers
         )
 
+    def has_pending_deadline_work(self) -> bool:
+        """Deadline work WAITING for the device: queued/buffered/
+        rolling jobs, or a wave inside the prep-and-dispatch window.
+        This is the executor's deadline probe — while True, the
+        executor defers bulk/maintenance picks so the next wave
+        boundary belongs to gossip verdicts. Deliberately narrower
+        than `not is_quiescent()`: a wave already EXECUTING on device
+        (`_finalizers`) does not defer bulk — the chip is busy either
+        way, and deferring on in-flight waves would starve blob
+        batches under any sustained gossip."""
+        return bool(
+            self._dispatching
+            or not self._queue.empty()
+            or self._buffer
+            or self._rolling
+            or self._wave_tasks
+        )
+
+    def attach_executor(self, executor) -> None:
+        """Join the node-wide DeviceExecutor (device/executor.py) as
+        its deadline-class client: register the pending-work and
+        quiescence probes, and gate this verifier's intake on the
+        executor's (a drain closes can_accept_work here with no
+        hold_intake call). The wave pipeline itself stays in this
+        class — verdicts are bit-identical and depth semantics are
+        unchanged; the executor schedules AROUND it."""
+        self._executor = executor
+        if executor is not None:
+            executor.register_deadline_probe(
+                self.has_pending_deadline_work
+            )
+            executor.register_quiescence_probe(self.is_quiescent)
+
     def _flush_target(self) -> int:
         """Rolling-bucket full threshold: the smallest device-ingest-
         eligible bucket size."""
@@ -519,6 +506,13 @@ class TpuBlsVerifier:
     # -- IBlsVerifier surface ------------------------------------------
 
     def can_accept_work(self) -> bool:
+        # with a node executor attached, its intake state is part of
+        # this verifier's: an executor drain (the re-tune window)
+        # closes the processor-fed path exactly like hold_intake did
+        if self._executor is not None and not self._executor.can_accept_work(
+            "deadline"
+        ):
+            return False
         return (
             not self._closed
             and not self._intake_held

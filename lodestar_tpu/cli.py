@@ -158,6 +158,34 @@ def _build_parser() -> argparse.ArgumentParser:
         help="where the tuner records its decision JSON (replayable "
         "by bench.py/tools/bench_* --autotune-from; empty to skip)",
     )
+    # -- node-wide device executor (device/executor.py) ---------------
+    beacon.add_argument(
+        "--device-executor",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="QoS-classed scheduling for every accelerator client: "
+        "deadline (gossip verdicts) dispatches ahead of bulk (blob "
+        "batches) at every wave boundary, maintenance (warmup / "
+        "autotune probes) yields to deadline and ages past bulk, "
+        "bounded per-class queues shed bulk/maintenance under "
+        "overload (lodestar_device_sheds_total); "
+        "--no-device-executor restores ad-hoc contention",
+    )
+    beacon.add_argument(
+        "--executor-bulk-queue", type=int, default=64,
+        help="bulk-class admission bound: queued KZG/blob device "
+        "jobs beyond this are shed to their host fallback tier",
+    )
+    beacon.add_argument(
+        "--executor-maintenance-queue", type=int, default=32,
+        help="maintenance-class admission bound (warmup compiles, "
+        "autotune probes)",
+    )
+    beacon.add_argument(
+        "--executor-aging-ms", type=float, default=2000.0,
+        help="a queued maintenance job runs no later than this even "
+        "under continuous bulk pressure (anti-starvation)",
+    )
     # -- observability knobs ------------------------------------------
     beacon.add_argument(
         "--monitored-validators", default=None,
@@ -430,6 +458,10 @@ async def _run_beacon(args) -> int:
         autotune_budget_ms=args.autotune_budget_ms,
         autotune_grid=args.autotune_grid,
         autotune_artifact=args.autotune_artifact or None,
+        device_executor=args.device_executor,
+        executor_bulk_queue=args.executor_bulk_queue,
+        executor_maintenance_queue=args.executor_maintenance_queue,
+        executor_aging_ms=args.executor_aging_ms,
     )
     node.notify_status()
     try:

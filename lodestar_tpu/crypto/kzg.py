@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+from concurrent import futures
 from hashlib import sha256
 from pathlib import Path
 
@@ -167,6 +168,40 @@ _MSM_DISPATCH: dict[str, int] = {"device": 0, "native": 0, "oracle": 0}
 _MSM_DEVICE_FALLBACKS = 0
 _BATCH_HIST = None  # bound lodestar_kzg_batch_verify_blobs histogram
 
+# node-wide device executor (device/executor.py): when wired, device
+# MSM/Fr dispatches ride its BULK lane — they queue behind pending
+# deadline (gossip verdict) work at every wave boundary, and under
+# overload the executor sheds them (bounded bulk queue) so this module
+# falls back to its host tier instead of piling onto the chip.
+_EXECUTOR = None
+
+
+def set_executor(executor) -> None:
+    """Install (or clear, with None) the node DeviceExecutor this
+    module's device dispatches route through as bulk-class jobs."""
+    global _EXECUTOR
+    _EXECUTOR = executor
+
+
+def _submit_bulk(fn):
+    """Run a device dispatch, through the executor's bulk lane when
+    one is wired. Returns (served, result): served=False means the
+    executor SHED the job — the caller rides its host fallback tier
+    (counted as a device fallback, like any other device miss).
+    Dispatch exceptions propagate to the caller's existing handler."""
+    ex = _EXECUTOR
+    if ex is None:
+        return True, fn()
+    fut = ex.submit("bulk", fn)
+    if fut is None:
+        return False, None
+    try:
+        return True, fut.result()
+    except futures.CancelledError:
+        # executor closed under us (node shutdown): treat like a
+        # shed — the host tier still answers the caller
+        return False, None
+
 
 def msm_backend() -> str:
     """The live MSM backend mode."""
@@ -288,29 +323,39 @@ def _evaluate_polynomials_batch(
             else:
                 live.append(i)
         try:
+            served = True
             if live:
-                import jax.numpy as jnp
-                import numpy as np
 
-                from ..ops import fr as _fr
+                def _dispatch():
+                    import jax.numpy as jnp
+                    import numpy as np
 
-                pd = jnp.asarray(
-                    np.stack(
-                        [_fr.fr_from_ints(polys[i]) for i in live]
+                    from ..ops import fr as _fr
+
+                    pd = jnp.asarray(
+                        np.stack(
+                            [_fr.fr_from_ints(polys[i]) for i in live]
+                        )
                     )
-                )
-                zd = jnp.asarray(
-                    _fr.fr_from_ints([zs[i] for i in live])
-                )
-                out = _fr.fr_to_ints(
-                    _fr.eval_barycentric_batch(
-                        pd, _fr_roots_dev(), zd
+                    zd = jnp.asarray(
+                        _fr.fr_from_ints([zs[i] for i in live])
                     )
-                )
-                for i, y in zip(live, out):
-                    ys[i] = y
-            _FR_DISPATCH["device"] += 1
-            return ys
+                    return _fr.fr_to_ints(
+                        _fr.eval_barycentric_batch(
+                            pd, _fr_roots_dev(), zd
+                        )
+                    )
+
+                # bulk-class dispatch: behind pending gossip verdicts
+                # at the wave boundary; a shed rides the Python tier
+                served, out = _submit_bulk(_dispatch)
+                if served:
+                    for i, y in zip(live, out):
+                        ys[i] = y
+            if served:
+                _FR_DISPATCH["device"] += 1
+                return ys
+            _FR_DEVICE_FALLBACKS += 1
         except Exception:
             _FR_DEVICE_FALLBACKS += 1
     _FR_DISPATCH["python"] += 1
@@ -367,9 +412,17 @@ def _g1_lincomb_many(tasks):
         from ..ops import msm as _msm
 
         try:
-            out = _msm.g1_msm_many(tasks)
-            _MSM_DISPATCH["device"] += 1
-            return out
+            # bulk-class dispatch (device/executor.py): queues behind
+            # pending gossip verdicts; an admission-control shed
+            # falls back to the host tiers like any device miss
+            served, out = _submit_bulk(
+                lambda: _msm.g1_msm_many(tasks)
+            )
+            if served:
+                _MSM_DISPATCH["device"] += 1
+                return out
+            _MSM_DEVICE_FALLBACKS += 1
+            path = "native" if native.available() else "oracle"
         except Exception:
             _MSM_DEVICE_FALLBACKS += 1
             path = "native" if native.available() else "oracle"

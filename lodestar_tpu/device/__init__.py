@@ -1,10 +1,15 @@
-"""Device self-management: the feedback loop from telemetry to knobs.
+"""Device self-management: the feedback loop from telemetry to knobs,
+and the scheduler that owns the accelerator.
 
 `metrics/device.py` made the JAX/XLA execution layer observable;
 this package closes the loop — `autotune.py` turns the observed
 numbers back into the live configuration knobs (limb backend, ingest
 gate, bucket-ladder top, verifier latency budget) so one binary
-converges to its host's optimum without operator tuning.
+converges to its host's optimum without operator tuning — and
+`executor.py` arbitrates the device itself: every accelerator client
+(gossip verdicts, KZG blob batches, warmup/auto-tune compiles) goes
+through one QoS-classed executor with admission control, load
+shedding, and drain-for-retune.
 """
 
 from .autotune import (  # noqa: F401
@@ -19,4 +24,12 @@ from .autotune import (  # noqa: F401
     parse_grid,
     provenance_fields,
     select_config,
+)
+from .executor import (  # noqa: F401
+    QOS_BULK,
+    QOS_CLASSES,
+    QOS_DEADLINE,
+    QOS_MAINTENANCE,
+    DeviceExecutor,
+    bind_executor_collectors,
 )

@@ -619,8 +619,13 @@ class DeviceAutotuner:
         mode: str = "startup",
         clock=time.monotonic,
         logger=None,
+        executor=None,
     ):
         self.verifier = verifier
+        # node DeviceExecutor (device/executor.py): probes are
+        # maintenance-class work — between candidates the tuner
+        # yields the device to pending deadline traffic
+        self.executor = executor
         self.budget_ms = float(budget_ms)
         self.grid = grid or parse_grid(None)
         self._bench = bench or self._measure_real
@@ -748,6 +753,19 @@ class DeviceAutotuner:
 
     # -- the tune -------------------------------------------------------
 
+    def _maintenance_checkpoint(self) -> None:
+        """Between candidate probes, yield the device to pending
+        deadline work through the executor's maintenance gate (no
+        executor wired = no-op, the pre-executor behavior). A startup
+        tune inside the drift monitor's drain window sees no pending
+        deadline work by construction and does not stall."""
+        ex = self.executor
+        if ex is not None:
+            try:
+                ex.maintenance_checkpoint()
+            except Exception:
+                pass
+
     def tune(self, trigger: str = "startup") -> dict:
         """Measure, select, APPLY, export, record. Returns the
         decision dict (also written to `artifact_path`)."""
@@ -805,6 +823,7 @@ class DeviceAutotuner:
                     {"backend": b, "spent_ms": round(spent_ms(), 1)},
                 )
                 continue
+            self._maintenance_checkpoint()
             t_c = self._clock()
             try:
                 m = self._bench(b, probe)
@@ -936,11 +955,23 @@ class DriftMonitor:
         max_retunes: int = 8,
         min_window_s: float = 0.05,
         clock=time.monotonic,
+        executor=None,
     ):
         self.tuner = tuner
         self.telemetry = telemetry
         self.verifier = (
             verifier if verifier is not None else tuner.verifier
+        )
+        # node DeviceExecutor (device/executor.py): when wired, a
+        # re-tune runs inside executor.drained() — intake closes for
+        # EVERY device client and quiescence is awaited centrally,
+        # with zero calls to the verifier's hold_intake. Without one
+        # the legacy hold_intake/is_quiescent dance below still works
+        # (standalone verifiers, tests).
+        self.executor = (
+            executor
+            if executor is not None
+            else getattr(tuner, "executor", None)
         )
         self.shares = shares or budget_shares()
         self.threshold = threshold
@@ -1017,10 +1048,14 @@ class DriftMonitor:
         return accept is None or bool(accept())
 
     def maybe_retune(self) -> bool:
-        """Fire the pending re-tune if the verifier is quiescent.
+        """Fire the pending re-tune if the device is quiescent.
         Returns True when a re-tune ran. BLOCKING (the tune probes
-        the device) — the async loop runs it in an executor. The
-        quiescence checked here is then HELD for the tune's duration
+        the device) — the async loop runs it in an executor thread.
+        With a node DeviceExecutor wired the whole window is one
+        `executor.drained()`: intake closes for every device client,
+        quiescence (including the verifier's probe) is awaited
+        centrally, and `hold_intake` is never called. Without one,
+        the quiescence checked here is HELD for the tune's duration
         via the verifier's intake hold (can_accept_work -> False), so
         the processor-fed gossip path cannot start waves under the
         knob switches; direct callers (block import) can still land a
@@ -1029,17 +1064,31 @@ class DriftMonitor:
         stage = self.pending_stage
         if stage is None:
             return False
-        hold = getattr(self.verifier, "hold_intake", None)
-        ctx = hold() if hold is not None else contextlib.nullcontext()
-        with ctx:
-            # quiescence is checked INSIDE the hold: a wave admitted
-            # between an outside check and the hold engaging would
-            # run under the tune's knob switches
-            if not self._verifier_quiet():
-                self.retunes_blocked += 1
-                return False
-            self.pending_stage = None
-            self.tuner.tune(trigger=f"drift:{stage}")
+        if self.executor is not None:
+            # executor path: one drain closes intake for EVERY device
+            # client (verifier, kzg bulk, warmup) and awaits their
+            # quiescence probes — the hold_intake/is_quiescent dance
+            # is the executor's job now
+            with self.executor.drained() as quiet:
+                if not quiet:
+                    self.retunes_blocked += 1
+                    return False
+                self.pending_stage = None
+                self.tuner.tune(trigger=f"drift:{stage}")
+        else:
+            hold = getattr(self.verifier, "hold_intake", None)
+            ctx = (
+                hold() if hold is not None else contextlib.nullcontext()
+            )
+            with ctx:
+                # quiescence is checked INSIDE the hold: a wave
+                # admitted between an outside check and the hold
+                # engaging would run under the tune's knob switches
+                if not self._verifier_quiet():
+                    self.retunes_blocked += 1
+                    return False
+                self.pending_stage = None
+                self.tuner.tune(trigger=f"drift:{stage}")
         self.retunes += 1
         self._last_retune_t = self._clock()
         self.streaks = {s: 0 for s in self.shares}

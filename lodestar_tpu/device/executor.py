@@ -1,0 +1,547 @@
+"""Node-wide device executor: QoS-classed scheduling, admission
+control, and load shedding for every accelerator client.
+
+The chip serves four workloads — BLS verify waves (gossip verdicts),
+KZG MSM + device-Fr blob batches, ingest warmup compiles, and
+autotune probes — and until this module they contended ad hoc: the
+drift monitor had to `hold_intake` the verifier and poll for
+quiescence, warmup raced live gossip at node start, and a blob batch
+could sit in front of a deadline-critical attestation wave. The
+`DeviceExecutor` generalizes the reference's `BlsMultiThreadWorkerPool`
+job-queue/priority design (SURVEY §2.3) beyond BLS into three QoS
+classes:
+
+  deadline    — gossip attestation/block verdicts. The verifier keeps
+                its own depth-N overlapped wave pipeline (verdicts
+                stay bit-identical, depth semantics preserved); it
+                participates through a PROBE lane — it registers a
+                pending-work probe and a quiescence probe, and the
+                executor refuses to start bulk/maintenance jobs while
+                any deadline probe reports waiting work. Deadline-
+                class jobs may also be queued directly (unbounded —
+                admission control never sheds deadline).
+  bulk        — blob-batch MSM/Fr dispatches, backfill re-verification,
+                bench waves. Bounded queue; under overload the
+                executor sheds (submit returns None) and the caller
+                rides its host fallback tier.
+  maintenance — warmup compiles, autotune probes, drift re-tunes.
+                Bounded queue, lowest priority, but AGED: bulk can
+                never starve maintenance forever (`aging_ms`, or
+                `max_bulk_between_maintenance` consecutive bulk jobs,
+                whichever trips first).
+
+Scheduling happens at wave boundaries: one worker thread runs one job
+at a time, and every pick re-consults the deadline probes — a
+deadline job submitted while a bulk batch occupies the pipeline is
+dispatched at the next boundary ahead of any further bulk.
+
+The drain primitive replaces the `hold_intake`/`is_quiescent` dance:
+`drained()` closes intake for every class (clients' `can_accept_work`
+consults the executor, so the processor-fed paths stop feeding),
+waits until the executor's own queues are empty AND every registered
+quiescence probe reports quiet, then yields. A drift re-tune runs
+inside that window with zero calls to `hold_intake`.
+
+`maintenance_checkpoint()` is the yield point for long maintenance
+work running OUTSIDE the worker (the warmup thread between compiles,
+the tuner between candidate probes): it blocks — bounded — while
+deadline work is pending, so node-start warmup no longer competes
+with live gossip for the device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+QOS_DEADLINE = "deadline"
+QOS_BULK = "bulk"
+QOS_MAINTENANCE = "maintenance"
+QOS_CLASSES = (QOS_DEADLINE, QOS_BULK, QOS_MAINTENANCE)
+
+# Admission bounds per class. Deadline is None — unbounded — by
+# design: admission control sheds bulk/maintenance under overload,
+# never deadline (the verifier's own queue_max bounds that stream at
+# ITS intake, where the processor can still count the drop).
+DEFAULT_QUEUE_BOUNDS = {
+    QOS_DEADLINE: None,
+    QOS_BULK: 64,
+    QOS_MAINTENANCE: 32,
+}
+
+# A maintenance job at the queue head runs no later than this, bulk
+# pressure notwithstanding.
+DEFAULT_AGING_MS = 2000.0
+# ... or after this many consecutive bulk jobs, whichever trips first.
+DEFAULT_MAX_BULK_BETWEEN_MAINTENANCE = 16
+
+# How long drained() waits for quiescence before reporting blocked.
+DEFAULT_DRAIN_TIMEOUT_S = 10.0
+
+
+class LatencyHistogram:
+    """Fixed-bound latency histogram with host-side quantile
+    estimation (linear interpolation inside a bucket). Cheap enough to
+    observe per job; the metrics server samples p50/p99 at scrape.
+    (Extracted from bls/verifier.py — the verifier re-exports it.)"""
+
+    BOUNDS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BOUNDS) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, seconds: float) -> None:
+        i = 0
+        for i, b in enumerate(self.BOUNDS):
+            if seconds <= b:
+                break
+        else:
+            i = len(self.BOUNDS)
+        self.counts[i] += 1
+        self.count += 1
+        self.sum += seconds
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile in seconds (0.0 when empty)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= rank and c > 0:
+                lo = 0.0 if i == 0 else self.BOUNDS[i - 1]
+                hi = (
+                    self.BOUNDS[i]
+                    if i < len(self.BOUNDS)
+                    else self.BOUNDS[-1] * 2
+                )
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.BOUNDS[-1] * 2
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": (self.sum / self.count) if self.count else 0.0,
+            "p50_s": self.quantile(0.5),
+            "p99_s": self.quantile(0.99),
+        }
+
+
+class _QueuedJob:
+    __slots__ = ("fn", "future", "submitted_at")
+
+    def __init__(self, fn, future, submitted_at):
+        self.fn = fn
+        self.future = future
+        self.submitted_at = submitted_at
+
+
+class DeviceExecutor:
+    """One worker, three bounded intakes, probe-gated priority.
+
+    Thread model: `submit` / `note_shed` / `can_accept_work` /
+    `maintenance_checkpoint` are safe from any thread (the warmup
+    thread, asyncio executor threads, the event loop). The worker
+    thread is the only consumer. Probes run on whichever thread
+    consults them and must be cheap and exception-tolerant."""
+
+    def __init__(
+        self,
+        queue_bounds: dict | None = None,
+        aging_ms: float = DEFAULT_AGING_MS,
+        max_bulk_between_maintenance: int = (
+            DEFAULT_MAX_BULK_BETWEEN_MAINTENANCE
+        ),
+        drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+        clock=time.monotonic,
+    ):
+        self._clock = clock
+        self._bounds = dict(DEFAULT_QUEUE_BOUNDS)
+        if queue_bounds:
+            for cls, bound in queue_bounds.items():
+                if cls not in self._bounds:
+                    raise ValueError(
+                        f"unknown QoS class {cls!r}; want {QOS_CLASSES}"
+                    )
+                self._bounds[cls] = bound
+        self._aging_s = max(0.0, float(aging_ms)) / 1000.0
+        self._max_bulk_between_maintenance = max(
+            1, int(max_bulk_between_maintenance)
+        )
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict[str, deque] = {
+            cls: deque() for cls in QOS_CLASSES
+        }
+        self._running_cls: str | None = None
+        self._intake_closed = 0  # drained() nesting depth
+        self._closed = False
+        self._deferring = False  # current defer streak (count once)
+        self._bulk_since_maintenance = 0
+        self._deadline_probes: list = []
+        self._quiescence_probes: list = []
+        # -- telemetry (bind_executor_collectors samples at scrape) --
+        self.sheds: dict[tuple[str, str], int] = {}
+        self.completed = {cls: 0 for cls in QOS_CLASSES}
+        self.latency = {cls: LatencyHistogram() for cls in QOS_CLASSES}
+        self.deadline_deferrals = 0
+        self.maintenance_aged = 0
+        self.maintenance_yields = 0
+        self.drains = 0
+        self.drains_blocked = 0
+        self._worker = threading.Thread(
+            target=self._run, name="device-executor", daemon=True
+        )
+        self._worker.start()
+
+    # -- client registration -------------------------------------------
+
+    def register_deadline_probe(self, probe) -> None:
+        """probe() -> True while the client has deadline work WAITING
+        for the device (queued/buffered/rolling, or a wave being
+        prepped). While any probe is True the worker defers
+        bulk/maintenance picks — the deadline lane owns the next wave
+        boundary."""
+        with self._lock:
+            self._deadline_probes.append(probe)
+
+    def register_quiescence_probe(self, probe) -> None:
+        """probe() -> True when the client has NOTHING in flight
+        (the verifier's is_quiescent). drained() waits on all of
+        these in addition to its own queues."""
+        with self._lock:
+            self._quiescence_probes.append(probe)
+
+    # -- admission ------------------------------------------------------
+
+    def can_accept_work(self, cls: str = QOS_DEADLINE) -> bool:
+        """Would a submit of class `cls` be admitted right now?
+        Clients gate their intake on this (the verifier ANDs it into
+        its own can_accept_work), so a drain closes the processor-fed
+        paths without any hold_intake call."""
+        self._check_cls(cls)
+        with self._lock:
+            return self._can_accept_locked(cls)
+
+    def _can_accept_locked(self, cls: str) -> bool:
+        if self._closed or self._intake_closed:
+            return False
+        bound = self._bounds[cls]
+        return bound is None or len(self._queues[cls]) < bound
+
+    def submit(self, cls: str, fn) -> Future | None:
+        """Queue fn() for the worker; returns a concurrent Future, or
+        None when admission control sheds the job (bounded queue full,
+        intake drained, or executor closed — counted per class+reason).
+        Shed callers fall back to their host tier; they never block."""
+        self._check_cls(cls)
+        with self._cond:
+            if self._closed:
+                self._shed_locked(cls, "closed")
+                return None
+            if self._intake_closed:
+                self._shed_locked(cls, "drain")
+                return None
+            bound = self._bounds[cls]
+            if bound is not None and len(self._queues[cls]) >= bound:
+                self._shed_locked(cls, "queue_full")
+                return None
+            fut: Future = Future()
+            self._queues[cls].append(
+                _QueuedJob(fn, fut, self._clock())
+            )
+            self._cond.notify_all()
+            return fut
+
+    def note_shed(self, cls: str, reason: str) -> None:
+        """External shed accounting: a client refused work at ITS
+        intake because the device path was saturated (the processor's
+        can_accept_work rejection sites). Keeps every drop visible on
+        one series (lodestar_device_sheds_total) whether the executor
+        or the client's own bound did the refusing."""
+        self._check_cls(cls)
+        with self._lock:
+            self._shed_locked(cls, reason)
+
+    def _shed_locked(self, cls: str, reason: str) -> None:
+        key = (cls, reason)
+        self.sheds[key] = self.sheds.get(key, 0) + 1
+
+    def _check_cls(self, cls: str) -> None:
+        if cls not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown QoS class {cls!r}; want {QOS_CLASSES}"
+            )
+
+    # -- introspection --------------------------------------------------
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {c: len(q) for c, q in self._queues.items()}
+
+    def shed_counts(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            return dict(self.sheds)
+
+    def intake_open(self) -> bool:
+        with self._lock:
+            return not self._closed and not self._intake_closed
+
+    # -- deadline lane --------------------------------------------------
+
+    def _deadline_pending_locked(self) -> bool:
+        if self._queues[QOS_DEADLINE]:
+            return True
+        for probe in self._deadline_probes:
+            try:
+                if probe():
+                    return True
+            except Exception:
+                # a broken probe must not stall bulk forever
+                continue
+        return False
+
+    def maintenance_checkpoint(self, timeout_s: float = 2.0) -> bool:
+        """Yield point for long maintenance work running OUTSIDE the
+        worker (the warmup thread between compiles, the tuner between
+        candidate probes). Blocks — bounded — while deadline work is
+        pending, so a compile storm never sits in front of a live
+        gossip wave. Returns True when it actually yielded."""
+        deadline = self._clock() + max(0.0, timeout_s)
+        yielded = False
+        with self._cond:
+            while (
+                not self._closed
+                and self._deadline_pending_locked()
+                and self._clock() < deadline
+            ):
+                if not yielded:
+                    yielded = True
+                    self.maintenance_yields += 1
+                self._cond.wait(timeout=0.005)
+        return yielded
+
+    # -- drain (the hold_intake replacement) ----------------------------
+
+    @contextlib.contextmanager
+    def drained(self, timeout_s: float | None = None):
+        """Close intake for EVERY class, wait for device quiet, yield
+        whether quiet was reached. The drift monitor wraps a re-tune:
+
+            with executor.drained() as quiet:
+                if not quiet:        # still busy at timeout: defer,
+                    ...              # count retunes_blocked, retry
+                tuner.tune(...)      # device is quiet AND stays fed
+                                     # by nothing for the duration
+
+        Intake reopens on exit either way. While closed, every
+        client's can_accept_work reports False through the executor
+        consult — semantically the old hold_intake, for all classes
+        at once, with sheds counted instead of silent."""
+        if timeout_s is None:
+            timeout_s = self.drain_timeout_s
+        with self._cond:
+            self._intake_closed += 1
+        try:
+            quiet = self._await_quiet(timeout_s)
+            with self._lock:
+                if quiet:
+                    self.drains += 1
+                else:
+                    self.drains_blocked += 1
+            yield quiet
+        finally:
+            with self._cond:
+                self._intake_closed -= 1
+                self._cond.notify_all()
+
+    def _await_quiet(self, timeout_s: float) -> bool:
+        deadline = self._clock() + max(0.0, timeout_s)
+        with self._cond:
+            while self._clock() <= deadline:
+                if self._quiet_locked():
+                    return True
+                self._cond.wait(timeout=0.01)
+            return self._quiet_locked()
+
+    def _quiet_locked(self) -> bool:
+        if self._running_cls is not None:
+            return False
+        if any(self._queues[c] for c in QOS_CLASSES):
+            return False
+        if self._deadline_pending_locked():
+            return False
+        for probe in self._quiescence_probes:
+            try:
+                if not probe():
+                    return False
+            except Exception:
+                # a broken probe must not wedge every future drain
+                continue
+        return True
+
+    # -- worker ---------------------------------------------------------
+
+    def _next_job_locked(self):
+        """One wave-boundary scheduling decision. Returns
+        (cls, job) or None (nothing runnable right now)."""
+        dq = self._queues[QOS_DEADLINE]
+        if dq:
+            self._deferring = False
+            return QOS_DEADLINE, dq.popleft()
+        bq = self._queues[QOS_BULK]
+        mq = self._queues[QOS_MAINTENANCE]
+        if not bq and not mq:
+            return None
+        if self._deadline_pending_locked():
+            # a deadline client owns the next wave boundary; count
+            # the defer streak once, not per 5ms poll
+            if not self._deferring:
+                self._deferring = True
+                self.deadline_deferrals += 1
+            return None
+        self._deferring = False
+        if mq:
+            waited = self._clock() - mq[0].submitted_at
+            if (
+                not bq
+                or waited >= self._aging_s
+                or self._bulk_since_maintenance
+                >= self._max_bulk_between_maintenance
+            ):
+                if bq:
+                    self.maintenance_aged += 1
+                self._bulk_since_maintenance = 0
+                return QOS_MAINTENANCE, mq.popleft()
+        if bq:
+            self._bulk_since_maintenance += 1
+            return QOS_BULK, bq.popleft()
+        return None
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                picked = None
+                while picked is None:
+                    if self._closed:
+                        self._reject_queued_locked()
+                        return
+                    picked = self._next_job_locked()
+                    if picked is None:
+                        # empty queues can sleep long (submit
+                        # notifies); a probe-deferred pick re-polls
+                        # fast — the probes have no notify hook
+                        idle = not any(
+                            self._queues[c] for c in QOS_CLASSES
+                        )
+                        self._cond.wait(
+                            timeout=0.25 if idle else 0.005
+                        )
+                cls, job = picked
+                self._running_cls = cls
+            try:
+                if job.future.set_running_or_notify_cancel():
+                    try:
+                        job.future.set_result(job.fn())
+                    except BaseException as e:
+                        job.future.set_exception(e)
+            finally:
+                with self._cond:
+                    self._running_cls = None
+                    self.completed[cls] += 1
+                    self.latency[cls].observe(
+                        self._clock() - job.submitted_at
+                    )
+                    self._cond.notify_all()
+
+    def _reject_queued_locked(self) -> None:
+        for cls in QOS_CLASSES:
+            q = self._queues[cls]
+            while q:
+                job = q.popleft()
+                self._shed_locked(cls, "closed")
+                job.future.cancel()
+        self._cond.notify_all()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop admitting, let the running job finish, cancel queued
+        futures (counted as sheds, reason='closed'), stop the worker.
+        Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout_s)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+# ---------------------------------------------------------------------------
+# /metrics bridging (the addCollect pattern every service uses)
+# ---------------------------------------------------------------------------
+
+
+def bind_executor_collectors(metrics, executor: DeviceExecutor) -> None:
+    """Wire the m.device_executor registry namespace
+    (metrics/beacon.py) to sample the executor at scrape time."""
+
+    def _sheds(g):
+        for (cls, reason), n in executor.shed_counts().items():
+            g.set(n, cls=cls, reason=reason)
+
+    metrics.sheds_total.add_collect(_sheds)
+    metrics.queue_depth.add_collect(
+        lambda g: [
+            g.set(n, cls=c)
+            for c, n in executor.queue_depths().items()
+        ]
+    )
+    metrics.completed_total.add_collect(
+        lambda g: [
+            g.set(n, cls=c) for c, n in executor.completed.items()
+        ]
+    )
+    metrics.latency_p50.add_collect(
+        lambda g: [
+            g.set(h.quantile(0.5), cls=c)
+            for c, h in executor.latency.items()
+        ]
+    )
+    metrics.latency_p99.add_collect(
+        lambda g: [
+            g.set(h.quantile(0.99), cls=c)
+            for c, h in executor.latency.items()
+        ]
+    )
+    metrics.deadline_deferrals_total.add_collect(
+        lambda g: g.set(executor.deadline_deferrals)
+    )
+    metrics.maintenance_aged_total.add_collect(
+        lambda g: g.set(executor.maintenance_aged)
+    )
+    metrics.maintenance_yields_total.add_collect(
+        lambda g: g.set(executor.maintenance_yields)
+    )
+    metrics.drains_total.add_collect(lambda g: g.set(executor.drains))
+    metrics.drains_blocked_total.add_collect(
+        lambda g: g.set(executor.drains_blocked)
+    )
+    metrics.intake_open.add_collect(
+        lambda g: g.set(1.0 if executor.intake_open() else 0.0)
+    )

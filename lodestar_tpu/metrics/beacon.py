@@ -787,6 +787,75 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         label_names=("stage",),
     )
 
+    # -- device executor (device/executor.py) ----------------------------
+    # The node-wide QoS scheduler in front of the chip: per-class
+    # queue depth / completion / latency, admission-control sheds,
+    # deadline-lane deferrals, maintenance aging, and the drain
+    # primitive that replaced hold_intake. Drives the "Device
+    # executor" row of dashboards/lodestar_tpu_device.json.
+    dx = SimpleNamespace()
+    m.device_executor = dx
+    dx.sheds_total = reg.gauge(
+        "lodestar_device_sheds_total",
+        "Device work shed by class and reason: executor admission"
+        " control (queue_full / drain / closed) plus client-intake"
+        " refusals the processor routes through note_shed (overload"
+        " is visible here, never a silent drop)",
+        label_names=("cls", "reason"),
+    )
+    dx.queue_depth = reg.gauge(
+        "lodestar_device_executor_queue_depth",
+        "Jobs queued in the executor per QoS class"
+        " (deadline / bulk / maintenance)",
+        label_names=("cls",),
+    )
+    dx.completed_total = reg.gauge(
+        "lodestar_device_executor_completed_total",
+        "Executor jobs completed per QoS class",
+        label_names=("cls",),
+    )
+    dx.latency_p50 = reg.gauge(
+        "lodestar_device_executor_latency_p50_seconds",
+        "Median submit-to-completion latency per QoS class",
+        label_names=("cls",),
+    )
+    dx.latency_p99 = reg.gauge(
+        "lodestar_device_executor_latency_p99_seconds",
+        "p99 submit-to-completion latency per QoS class",
+        label_names=("cls",),
+    )
+    dx.deadline_deferrals_total = reg.gauge(
+        "lodestar_device_executor_deadline_deferrals_total",
+        "Wave boundaries where queued bulk/maintenance work was"
+        " deferred because a deadline client had work pending",
+    )
+    dx.maintenance_aged_total = reg.gauge(
+        "lodestar_device_executor_maintenance_aged_total",
+        "Maintenance jobs promoted over queued bulk by the aging"
+        " policy (bulk never starves maintenance forever)",
+    )
+    dx.maintenance_yields_total = reg.gauge(
+        "lodestar_device_executor_maintenance_yields_total",
+        "maintenance_checkpoint() calls that actually yielded the"
+        " device to pending deadline work (warmup between compiles,"
+        " autotune between candidate probes)",
+    )
+    dx.drains_total = reg.gauge(
+        "lodestar_device_executor_drains_total",
+        "Executor drains that reached device-quiet (the re-tune"
+        " window that replaced hold_intake)",
+    )
+    dx.drains_blocked_total = reg.gauge(
+        "lodestar_device_executor_drains_blocked_total",
+        "Executor drains that timed out before device-quiet (the"
+        " re-tune stays pending; never fires mid-wave)",
+    )
+    dx.intake_open = reg.gauge(
+        "lodestar_device_executor_intake_open",
+        "1 while the executor admits work; 0 during a drain or"
+        " after close",
+    )
+
     # -- kzg / data availability (crypto/kzg.py three-tier MSM) ----------
     # The second device workload: blob-batch KZG verification routes
     # its lincombs through the device Pippenger MSM (ops/msm.py) with

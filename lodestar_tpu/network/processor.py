@@ -59,10 +59,16 @@ class NetworkProcessor:
         unagg_pool=None,
         sync_msg_pool=None,
         contrib_pool=None,
+        executor=None,
     ):
         self.chain = chain
         self.validator = attestation_validator
         self.verifier = verifier
+        # node DeviceExecutor (device/executor.py): every
+        # can_accept_work rejection below is reported through its
+        # per-class shed accounting (lodestar_device_sheds_total) —
+        # overload shows up on /metrics instead of silently dropping
+        self.executor = executor
         self.att_pool = att_pool
         self.metrics = metrics
         self.aggregate_validator = aggregate_validator
@@ -93,6 +99,7 @@ class NetworkProcessor:
     def _on_queue_drop(self, item) -> None:
         """Overflow eviction: release the evicted item's waiter."""
         self.dropped += 1
+        self._shed("att_queue_overflow")
         fut = item[1]
         if fut is not None and not fut.done():
             fut.set_result(GossipAction.IGNORE)
@@ -156,6 +163,7 @@ class NetworkProcessor:
         if not self.verifier.can_accept_work():
             # inline validators share the verifier's queue budget; an
             # overloaded verifier means IGNORE, not an unbounded queue
+            self._shed("gossip_aggregate")
             self._count(
                 GossipAction.IGNORE,
                 GossipTopic.beacon_aggregate_and_proof,
@@ -178,6 +186,7 @@ class NetworkProcessor:
         if self.sync_validator is None:
             return GossipAction.IGNORE
         if not self.verifier.can_accept_work():
+            self._shed("gossip_sync_message")
             self._count(GossipAction.IGNORE, GossipTopic.sync_committee)
             return GossipAction.IGNORE
         try:
@@ -210,6 +219,7 @@ class NetworkProcessor:
         if self.sync_validator is None:
             return GossipAction.IGNORE
         if not self.verifier.can_accept_work():
+            self._shed("gossip_sync_contribution")
             self._count(
                 GossipAction.IGNORE,
                 GossipTopic.sync_committee_contribution_and_proof,
@@ -249,6 +259,15 @@ class NetworkProcessor:
         return (
             preset().SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
         )
+
+    def _shed(self, reason: str) -> None:
+        """Report one deadline-class intake refusal to the executor's
+        shed accounting. Gossip verdicts are deadline work; these are
+        client-intake refusals (the verifier's bounded queue said no),
+        distinguished from executor admission-control sheds by the
+        reason label."""
+        if self.executor is not None:
+            self.executor.note_shed("deadline", reason)
 
     def _count(self, action: GossipAction, topic: str) -> None:
         if action == GossipAction.ACCEPT:
@@ -302,6 +321,11 @@ class NetworkProcessor:
         # backpressure: don't pull work the verifier can't take
         # (processor executeWork gating on canAcceptWork)
         if not self.verifier.can_accept_work():
+            # deferral, not a drop — the attestations stay queued —
+            # but only report it while real work is actually waiting,
+            # or an idle poll would inflate the shed series
+            if len(self.att_queue):
+                self._shed("work_queue_backpressure")
             await asyncio.sleep(0.005)
             return False
         chunk = self.att_queue.next()

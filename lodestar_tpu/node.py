@@ -89,6 +89,16 @@ class BeaconNode:
         autotune_budget_ms: float = 30_000.0,
         autotune_grid: str | None = None,
         autotune_artifact: str | None = "AUTOTUNE.json",
+        # -- node-wide device executor (device/executor.py) --
+        # QoS-classed scheduling for every accelerator client:
+        # deadline (gossip verdicts) ahead of bulk (blob batches)
+        # at every wave boundary, maintenance (warmup / autotune)
+        # aged so bulk can't starve it, bounded per-class queues
+        # shedding bulk/maintenance under overload
+        device_executor: bool = True,
+        executor_bulk_queue: int = 64,
+        executor_maintenance_queue: int = 32,
+        executor_aging_ms: float = 2000.0,
     ):
         self.cfg = cfg
         self.types = types
@@ -143,6 +153,11 @@ class BeaconNode:
         self.autotuner = None
         self.drift_monitor = None
         self._drift_task: asyncio.Task | None = None
+        self.device_executor_enabled = device_executor
+        self.executor_bulk_queue = executor_bulk_queue
+        self.executor_maintenance_queue = executor_maintenance_queue
+        self.executor_aging_ms = executor_aging_ms
+        self.executor = None
         # device/compiler telemetry: singleton installed here so the
         # jax.monitoring listeners and the kernels' instrumented stage
         # wrappers route into THIS node's registry
@@ -310,6 +325,45 @@ class BeaconNode:
         # debug route (api/impl.get_block_import_traces)
         node.chain.tracer = node.tracer
         node.chain.regen.metrics = node.metrics.regen
+        # node-wide device executor: the QoS scheduler every
+        # accelerator client joins. Constructed BEFORE autotune and
+        # warmup so both run as maintenance-class clients from their
+        # very first dispatch: the verifier registers its deadline
+        # probes, kzg's MSM/Fr device tiers ride the bulk lane, the
+        # warmup thread yields between compiles, and the drift
+        # monitor's re-tune becomes an executor drain (zero
+        # hold_intake calls).
+        if node.device_executor_enabled:
+            from .bls import kernels as _kernels
+            from .crypto import kzg as _kzg_exec
+            from .device import executor as _dexec
+
+            node.executor = _dexec.DeviceExecutor(
+                queue_bounds={
+                    "bulk": node.executor_bulk_queue,
+                    "maintenance": node.executor_maintenance_queue,
+                },
+                aging_ms=node.executor_aging_ms,
+            )
+            if hasattr(node.chain.verifier, "attach_executor"):
+                node.chain.verifier.attach_executor(node.executor)
+            _kernels.set_maintenance_gate(
+                node.executor.maintenance_checkpoint
+            )
+            _kzg_exec.set_executor(node.executor)
+            _dexec.bind_executor_collectors(
+                node.metrics.device_executor, node.executor
+            )
+            log.info(
+                "device executor up",
+                {
+                    "bulk_queue": node.executor_bulk_queue,
+                    "maintenance_queue": (
+                        node.executor_maintenance_queue
+                    ),
+                    "aging_ms": node.executor_aging_ms,
+                },
+            )
         # device auto-tuning: close the telemetry->knobs loop. The
         # startup tune micro-benches the candidate grid through the
         # persistent compilation cache and applies the winner via the
@@ -329,6 +383,7 @@ class BeaconNode:
                 artifact_path=node.autotune_artifact,
                 mode=node.autotune_mode,
                 logger=get_logger("autotune"),
+                executor=node.executor,
             )
             await asyncio.get_running_loop().run_in_executor(
                 None, node.autotuner.tune
@@ -338,6 +393,7 @@ class BeaconNode:
                     node.autotuner,
                     node.device_telemetry,
                     verifier=node.chain.verifier,
+                    executor=node.executor,
                 )
                 node._drift_task = asyncio.ensure_future(
                     node.drift_monitor.run()
@@ -523,6 +579,7 @@ class BeaconNode:
             unagg_pool=node.unagg_pool,
             sync_msg_pool=node.sync_msg_pool,
             contrib_pool=node.contrib_pool,
+            executor=node.executor,
         )
         node.processor.start()
         # wall-clock slot driver: the gossip validators' slot-window
@@ -967,6 +1024,18 @@ class BeaconNode:
         if self._drift_task is not None:
             self._drift_task.cancel()
             self._drift_task = None
+        if self.executor is not None:
+            # detach the module-level hooks FIRST (other nodes or
+            # tests in this process must not route through a closed
+            # executor), then stop the worker — queued bulk futures
+            # cancel and their callers ride the host tiers
+            from .bls import kernels as _kernels
+            from .crypto import kzg as _kzg_exec
+
+            _kernels.set_maintenance_gate(None)
+            _kzg_exec.set_executor(None)
+            self.executor.close()
+            self.executor = None
         if self.clock is not None:
             self.clock.stop()
         if self.monitoring is not None:

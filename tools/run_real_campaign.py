@@ -30,6 +30,12 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
                       Pippenger MSM) -> BENCH_blobs_real.json
   8. mesh           — tools/bench_mesh_sweep.py --real --autotune-from
                       (the chip-scaling curve) -> MULTICHIP_real.json
+  9. executor_contention
+                    — gossip trickle (deadline class) through the
+                      node DeviceExecutor while a KZG blob firehose
+                      saturates the bulk lane: deadline p50/p99 with
+                      vs without contention, bulk sheds, deferral
+                      counts -> EXECUTOR_CONTENTION_real.json
 
 `--dry-run` emits the full campaign plan (commands, artifacts,
 prerequisites) as JSON without executing anything — reviewable on
@@ -192,6 +198,18 @@ def build_plan(args) -> list[dict]:
             "artifact": "MULTICHIP_real.json",
             "needs": ["autotune"],
         },
+        {
+            "name": "executor_contention",
+            "why": "the QoS guarantee under real contention: gossip "
+            "verdict latency (deadline class) while a blob KZG "
+            "firehose saturates the executor's bulk lane — deadline "
+            "p99 should hold near its quiet baseline (~one wave), "
+            "with the pressure showing up as bulk sheds and "
+            "deferrals instead (device/executor.py)",
+            "fn": "executor_contention",
+            "artifact": "EXECUTOR_CONTENTION_real.json",
+            "needs": ["autotune"],
+        },
     ]
 
 
@@ -235,6 +253,108 @@ def step_autotune(args) -> dict:
     return tuner.tune(trigger="campaign")
 
 
+def step_executor_contention(args) -> dict:
+    """Deadline QoS under bulk pressure, measured in-process: the
+    same gossip trickle runs twice through a node DeviceExecutor —
+    once quiet, once with a KZG blob-batch firehose hammering the
+    bulk lane from a second thread — and the artifact records the
+    caller-observed verdict p50/p99 of both phases next to the
+    executor's own accounting (bulk throughput, sheds, deadline
+    deferrals). The acceptance shape: contended deadline p99 holds
+    near the quiet baseline, and the pressure is visible as
+    bulk-class sheds/deferrals instead of verdict latency."""
+    import asyncio
+    import threading
+
+    from lodestar_tpu.bls import TpuBlsVerifier
+    from lodestar_tpu.crypto import kzg
+    from lodestar_tpu.device import autotune as at
+    from lodestar_tpu.device.executor import DeviceExecutor
+    from lodestar_tpu.utils import jaxcache
+    from lodestar_tpu.utils.provenance import provenance
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_blobs as BB
+    import bench_trickle as BT
+
+    jaxcache.enable()
+    dec_path = os.path.join(REPO, args.autotune_artifact)
+    if os.path.exists(dec_path):
+        with open(dec_path) as f:
+            at.apply_decision(json.load(f))
+
+    kzg.activate_trusted_setup(kzg.dev_trusted_setup())
+    blobs, comms, proofs = BB.build_batch(args.contention_blobs)
+    gap_s = args.contention_gap_ms / 1000.0
+
+    async def phase(firehose: bool) -> dict:
+        ex = DeviceExecutor()
+        kzg.set_executor(ex)
+        v = TpuBlsVerifier()
+        v.attach_executor(ex)
+        singles = BT._build_single_sets(args.contention_sets)
+        stop = threading.Event()
+        fired = {"batches": 0}
+
+        def pump():
+            # the bulk client: blob batches back to back; each MSM
+            # rides the executor's bulk lane (or sheds to the host
+            # tiers when admission control says no — also the point)
+            while not stop.is_set():
+                kzg.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+                fired["batches"] += 1
+
+        th = None
+        if firehose:
+            th = threading.Thread(
+                target=pump, name="blob-firehose", daemon=True
+            )
+            th.start()
+        try:
+            lat, wall = await BT._run_trickle(v, singles, [], gap_s)
+        finally:
+            stop.set()
+            if th is not None:
+                th.join(timeout=30.0)
+            await v.close()
+            kzg.set_executor(None)
+            ex.close()
+        xs = lat.get(1, [])
+        return {
+            "firehose": firehose,
+            "gossip_jobs": len(xs),
+            "deadline_p50_ms": BT._quantile(xs, 0.50) * 1000.0,
+            "deadline_p99_ms": BT._quantile(xs, 0.99) * 1000.0,
+            "wall_s": wall,
+            "bulk_batches": fired["batches"],
+            "bulk_blobs_per_batch": args.contention_blobs,
+            "deadline_deferrals": ex.deadline_deferrals,
+            "executor_sheds": {
+                f"{cls}/{reason}": n
+                for (cls, reason), n in sorted(
+                    ex.shed_counts().items()
+                )
+            },
+            "msm_paths": kzg.msm_path_counts(),
+        }
+
+    quiet = asyncio.run(phase(firehose=False))
+    contended = asyncio.run(phase(firehose=True))
+    out = {
+        "workload": "gossip trickle (deadline) vs blob KZG firehose "
+        "(bulk) through one DeviceExecutor",
+        "quiet": quiet,
+        "contended": contended,
+        "provenance": provenance(),
+    }
+    with open(
+        os.path.join(REPO, "EXECUTOR_CONTENTION_real.json"), "w"
+    ) as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
 def run(args) -> int:
     plan = build_plan(args)
     want = (
@@ -276,7 +396,11 @@ def run(args) -> int:
         return 0
     done: set[str] = set()
     results: dict = {}
-    fns = {"preflight": step_preflight, "autotune": step_autotune}
+    fns = {
+        "preflight": step_preflight,
+        "autotune": step_autotune,
+        "executor_contention": step_executor_contention,
+    }
     for st in plan:
         if st["name"] not in want:
             continue
@@ -353,6 +477,25 @@ def main() -> int:
     )
     p.add_argument(
         "--autotune-artifact", default=AUTOTUNE_ARTIFACT
+    )
+    p.add_argument(
+        "--contention-sets",
+        type=int,
+        default=128,
+        help="gossip jobs per executor-contention phase",
+    )
+    p.add_argument(
+        "--contention-blobs",
+        type=int,
+        default=6,
+        help="blobs per firehose batch in the executor-contention "
+        "step (6 = max blobs per block)",
+    )
+    p.add_argument(
+        "--contention-gap-ms",
+        type=float,
+        default=20.0,
+        help="gossip arrival gap in the executor-contention step",
     )
     p.add_argument(
         "--allow-cpu",

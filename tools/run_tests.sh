@@ -27,6 +27,12 @@ run_tier1() {
   # multi-minute ingest compile may ever enter tier-1 through it
   JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q \
     -m 'not slow' -p no:cacheprovider || exit 1
+  # device-executor offline suite, standalone and ahead of the main
+  # line for the same reason: QoS ordering / admission control /
+  # drain-for-retune run against stubbed kernels only, so a scheduling
+  # regression surfaces in seconds instead of minutes into the run
+  JAX_PLATFORMS=cpu python -m pytest tests/test_device_executor.py -q \
+    -m 'not slow' -p no:cacheprovider || exit 1
   # pytest line matches ROADMAP.md "Tier-1 verify" plus --durations=25:
   # the per-test timing artifact tracks suite-runtime creep per PR
   # (slowest offenders land in /tmp/lodestar_tier1_durations.txt and
