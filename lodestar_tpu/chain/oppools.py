@@ -70,6 +70,9 @@ class AttestationPool:
             k: v for k, v in self._groups.items() if k[0] > cutoff
         }
 
+    def __len__(self) -> int:
+        return len(self._groups)
+
 
 class AggregatedAttestationPool:
     """Aggregated attestations for block packing, grouped by data."""
@@ -79,6 +82,12 @@ class AggregatedAttestationPool:
         # (slot, data_root) -> list of {"bits": [...], "sig": bytes,
         #                               "data": AttestationData}
         self._groups: dict[tuple, list] = defaultdict(list)
+
+    def __len__(self) -> int:
+        # total pooled aggregates, not key count — the memory-bound SLO
+        # (sim/assertions.op_pool_sizes) watches the entries that grow
+        # without pruning, and one key can hold many aggregates
+        return sum(len(v) for v in self._groups.values())
 
     def add(self, attestation) -> None:
         data = attestation.data

@@ -892,6 +892,22 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         buckets=(1, 2, 4, 6, 9, 16, 32),
     )
 
+    # -- simulation fault injection (sim/faults.py) ----------------------
+    # Delivered-fault accounting for the scenario fleet: every fault an
+    # injector actually fired, by kind. Scenario SLOs assert on these
+    # so a run whose scheduled fault never fired fails instead of
+    # passing vacuously (sim/scenarios.py).
+    sf = SimpleNamespace()
+    m.sim = sf
+    sf.injected_faults_total = reg.gauge(
+        "lodestar_sim_injected_faults_total",
+        "Faults actually delivered by the sim injectors, by kind"
+        " (gossip_drop/delay/duplicate, engine_error, relay_outage,"
+        " late_block, equivocating_block, node_kill/restart, ...) —"
+        " sampled from the scenario's FaultRegistry at scrape",
+        label_names=("kind",),
+    )
+
     # -- clock / event loop (nodeJsMetrics.ts analog) --------------------
     k = SimpleNamespace()
     m.clock = k

@@ -5,17 +5,32 @@ Reference analog: the "crucible" sim framework
 N nodes as one process-local network, drive an epoch clock, and assert
 whole-network behavior: finality advancing, head consistency across
 nodes, attestation participation.
+
+On top of the raw harness sits the scenario fleet (sim/scenarios.py):
+named, deterministic adversity regimes with machine-evaluated SLO
+contracts, driven by the fault injectors in sim/faults.py and
+evaluated through sim/assertions.py. `tools/run_scenarios.py` is the
+operator CLI; SCENARIOS.md tabulates the fleet.
+
+NOTE: scenario-fleet symbols (run_scenario, SCENARIOS, ...) import
+lazily from .scenarios to keep `import lodestar_tpu.sim` cheap for
+the plain sim tests.
 """
 
 from .simulation import Simulation, SimNode
 from .faults import (
+    FaultRegistry,
     FaultSchedule,
     FlakyEngine,
     FlakyRelay,
     GossipFaultInjector,
+    LateBlockReplayer,
     SimBuilder,
+    bind_sim_fault_collectors,
     catch_up,
     kill_node,
+    propose_equivocation,
+    republish_head_block,
     restart_node,
 )
 from .assertions import (
@@ -25,18 +40,29 @@ from .assertions import (
     assert_no_missed_blocks,
     assert_participation,
     assert_sync_committee_participation,
+    finalized_epochs,
+    heads_consistent,
+    max_import_ms,
+    missed_slots,
+    op_pool_sizes,
+    state_cache_sizes,
 )
 
 __all__ = [
+    "FaultRegistry",
     "FaultSchedule",
     "FlakyEngine",
     "FlakyRelay",
     "GossipFaultInjector",
+    "LateBlockReplayer",
     "SimBuilder",
     "Simulation",
     "SimNode",
+    "bind_sim_fault_collectors",
     "catch_up",
     "kill_node",
+    "propose_equivocation",
+    "republish_head_block",
     "restart_node",
     "assert_finalized",
     "assert_heads_consistent",
@@ -44,4 +70,10 @@ __all__ = [
     "assert_no_missed_blocks",
     "assert_participation",
     "assert_sync_committee_participation",
+    "finalized_epochs",
+    "heads_consistent",
+    "max_import_ms",
+    "missed_slots",
+    "op_pool_sizes",
+    "state_cache_sizes",
 ]

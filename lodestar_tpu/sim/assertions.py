@@ -1,8 +1,14 @@
-"""Default sim assertions.
+"""Default sim assertions + scenario SLO evaluators.
 
 Reference analog: crucible's default assertions
 (cli/test/utils/crucible/assertions/defaults/): finalized checkpoint,
 head consistency across nodes, attestation participation.
+
+The non-asserting evaluators at the bottom (`heads_consistent`,
+`missed_slots`, `finalized_epochs`, `op_pool_sizes`,
+`state_cache_sizes`, `max_import_ms`) read the same telemetry surfaces
+and return observations — sim/scenarios.py turns them into
+machine-evaluated pass/fail SLO records instead of bare asserts.
 """
 
 from __future__ import annotations
@@ -91,22 +97,32 @@ def assert_inclusion_delay(sim, max_avg: float = 1.1) -> None:
         )
 
 
-def assert_no_missed_blocks(sim, start_slot: int = 1, end_slot=None) -> None:
-    """Every slot in [start_slot, end_slot] has a canonical block
-    (crucible missedBlocksAssertion with 0 tolerated misses)."""
+def missed_slots(sim, start_slot: int = 1, end_slot=None) -> dict:
+    """Per-node list of slots in [start_slot, end_slot] without a
+    canonical block. `end_slot=None` defaults to the sim's CURRENT
+    slot — never to the newest canonical block, which would let a run
+    whose trailing slots all missed look clean."""
+    out = {}
     for node in sim.nodes:
         blocks = _canonical_blocks(node)
         have = {
             int(getattr(s, "message", s).slot) for _, s in blocks
         }
-        end = end_slot
-        if end is None:
-            end = max(have) if have else 0
-        missing = [
+        end = end_slot if end_slot is not None else sim.slot
+        out[node.name] = [
             s for s in range(start_slot, end + 1) if s not in have
         ]
+    return out
+
+
+def assert_no_missed_blocks(sim, start_slot: int = 1, end_slot=None) -> None:
+    """Every slot in [start_slot, end_slot] has a canonical block
+    (crucible missedBlocksAssertion with 0 tolerated misses).
+    `end_slot=None` means "up to the sim's current slot" — trailing
+    missed slots fail instead of passing vacuously."""
+    for name, missing in missed_slots(sim, start_slot, end_slot).items():
         assert not missing, (
-            f"{node.name} missed proposals at slots {missing}"
+            f"{name} missed proposals at slots {missing}"
         )
 
 
@@ -132,3 +148,57 @@ def assert_sync_committee_participation(
         assert avg >= min_ratio, (
             f"{node.name} sync participation {avg:.2f} < {min_ratio}"
         )
+
+
+# ---------------------------------------------------------------------------
+# non-asserting SLO evaluators (sim/scenarios.py consumes these)
+# ---------------------------------------------------------------------------
+
+
+def heads_consistent(sim) -> bool:
+    """True when every ALIVE node reports the same head root."""
+    heads = {
+        node.chain.head_root for node in sim.nodes if node.alive
+    }
+    return len(heads) <= 1
+
+
+def finalized_epochs(sim) -> dict:
+    """Per-node finalized checkpoint epoch."""
+    return {
+        node.name: int(node.chain.finalized_checkpoint.epoch)
+        for node in sim.nodes
+    }
+
+
+def op_pool_sizes(sim) -> dict:
+    """Per-node aggregated-attestation-pool entry count — the memory
+    surface a sustained non-finality run must keep bounded (the pool
+    prunes on the slot clock, not on finality)."""
+    return {node.name: len(node.att_pool) for node in sim.nodes}
+
+
+def state_cache_sizes(sim) -> dict:
+    """Per-node (state_cache, block_cache) entry counts — bounded by
+    MAX_CACHED_STATES / MAX_CACHED_BLOCKS regardless of how long
+    finality has been stalled."""
+    return {
+        node.name: (
+            len(node.chain._states), len(node.chain._blocks)
+        )
+        for node in sim.nodes
+    }
+
+
+def max_import_ms(node) -> float:
+    """Slowest block-import total from the node's trace ring buffer
+    (metrics/tracing.py), 0.0 when no tracer is attached or nothing
+    was recorded. Attach a Tracer with slow_ms=0 to capture EVERY
+    import, not just the slow ones."""
+    tracer = getattr(node.chain, "tracer", None)
+    if tracer is None:
+        return 0.0
+    items = tracer.buffer.snapshot()
+    if not items:
+        return 0.0
+    return max(float(t.get("total_ms", 0.0)) for t in items)

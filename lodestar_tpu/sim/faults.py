@@ -17,11 +17,24 @@ internals:
   `SimNode.builder` expects (`available`/`register_fault`/
   `register_success` + the relay API).
 * `GossipFaultInjector` — drop / delay / duplicate outbound gossip
-  frames of one node, by wrapping its GossipNode's mesh send.
+  frames of one node (optionally only for selected topics), by
+  wrapping its GossipNode's mesh send.
+* `LateBlockReplayer` — holds one node's outbound block publications
+  for a fixed delay so peers attest before the block arrives (the
+  late-block half of a reorg storm).
+* `propose_equivocation` / `republish_head_block` — proposer
+  equivocation: a conflicting sibling of the current head block
+  (same slot, same proposer, different body), plus a duplicate-block
+  flood the peers' gossip seen-cache must absorb.
 * `kill_node` / `restart_node` — take a node's network down
   mid-run and bring it back, resyncing its chain from a healthy peer.
 * `FaultSchedule` — slot-driven fault windows riding the simulation's
   `on_slot_hooks`.
+* `FaultRegistry` — aggregates every injector's delivered-fault
+  counters into one `{kind: count}` view, exported as
+  `lodestar_sim_injected_faults_total{kind}` via
+  `bind_sim_fault_collectors` so a scenario SLO can assert the fault
+  actually FIRED instead of trusting the schedule.
 """
 
 from __future__ import annotations
@@ -50,6 +63,9 @@ class FlakyEngine:
 
     def set_failing(self, failing: bool) -> None:
         self.failing = bool(failing)
+
+    def injected_fault_counts(self) -> dict:
+        return {"engine_error": self.injected_errors}
 
     def _gate(self) -> None:
         if self.failing:
@@ -89,6 +105,9 @@ class FlakyRelay:
 
     def set_outage(self, outage: bool) -> None:
         self.outage = bool(outage)
+
+    def injected_fault_counts(self) -> dict:
+        return {"relay_outage": self.injected_errors}
 
     def _gate(self) -> None:
         from ..execution.builder import BuilderError
@@ -144,15 +163,20 @@ class SimBuilder:
 class GossipFaultInjector:
     """Wraps one node's GossipNode outbound mesh send with a lossy
     policy: fraction/flags for drop, delay (seconds), duplicate.
-    Deterministic when given an rng."""
+    Deterministic when given an rng. `topics` (substrings matched
+    against the full topic name) scopes the policy — e.g.
+    ("beacon_attestation",) blacks out attestation gossip while
+    blocks still flow, the sustained-non-finality shape."""
 
     def __init__(self, gossip_node, rng=None, drop: float = 0.0,
-                 delay: float = 0.0, duplicate: float = 0.0):
+                 delay: float = 0.0, duplicate: float = 0.0,
+                 topics=None):
         self.gossip = gossip_node
         self.rng = rng
         self.drop = drop
         self.delay = delay
         self.duplicate = duplicate
+        self.topics = tuple(topics) if topics else None
         self.dropped = 0
         self.delayed = 0
         self.duplicated = 0
@@ -162,12 +186,27 @@ class GossipFaultInjector:
     def detach(self) -> None:
         self.gossip._send_to_mesh = self._orig
 
+    def injected_fault_counts(self) -> dict:
+        return {
+            "gossip_drop": self.dropped,
+            "gossip_delay": self.delayed,
+            "gossip_duplicate": self.duplicated,
+        }
+
     def _roll(self) -> float:
         import random
 
         return (self.rng or random).random()
 
+    def _matches(self, topic) -> bool:
+        if self.topics is None:
+            return True
+        t = str(topic)
+        return any(want in t for want in self.topics)
+
     async def _send(self, topic, data, exclude):
+        if not self._matches(topic):
+            return await self._orig(topic, data, exclude)
         if self.drop and self._roll() < self.drop:
             self.dropped += 1
             return 0  # message never leaves this node
@@ -189,6 +228,118 @@ class GossipFaultInjector:
         return await self._orig(topic, data, exclude)
 
 
+class LateBlockReplayer:
+    """Holds one node's outbound block publications for `delay_s`:
+    peers have already attested to the previous head when the block
+    lands, so the next proposer builds a sibling and the network
+    reorgs — attach during a window for a reorg storm. Only the
+    publish is delayed; the proposer's own import is untouched."""
+
+    def __init__(self, node, delay_s: float = 0.35):
+        self.node = node
+        self.delay_s = delay_s
+        self.held = 0
+        self._orig = node.network.publish_block
+        node.network.publish_block = self._publish
+
+    def detach(self) -> None:
+        self.node.network.publish_block = self._orig
+
+    def injected_fault_counts(self) -> dict:
+        return {"late_block": self.held}
+
+    async def _publish(self, fork, signed_block):
+        self.held += 1
+
+        async def later():
+            await asyncio.sleep(self.delay_s)
+            try:
+                await self._orig(fork, signed_block)
+            except Exception:
+                pass  # network stopped mid-delay
+
+        asyncio.ensure_future(later())
+        return 0
+
+
+_EQUIVOCATION_GRAFFITI = b"equivocation".ljust(32, b"\x00")
+
+
+async def propose_equivocation(node, graffiti: bytes | None = None):
+    """Proposer equivocation: build, import, and publish a CONFLICTING
+    sibling of the node's current head block — same slot, same
+    proposer, same parent, different body. Returns the equivocating
+    block's root, or None when this node does not hold the head
+    proposer's key (or the head is the anchor)."""
+    from ..params import (
+        DOMAIN_BEACON_PROPOSER,
+        DOMAIN_RANDAO,
+        ForkSeq,
+    )
+    from ..ssz import uint64 as ssz_uint64
+    from ..statetransition import util
+    from ..statetransition.block import compute_signing_root, get_domain
+    from ..statetransition.slot import process_slots
+    from ..chain.chain import _clone
+    from ..crypto.bls.signature import sign
+
+    chain = node.chain
+    signed = chain.get_block(chain.head_root)
+    if signed is None:
+        return None
+    block = getattr(signed, "message", signed)
+    slot = int(block.slot)
+    parent = chain.get_or_regen_state(bytes(block.parent_root))
+    if parent is None:
+        return None
+    work = _clone(parent, node.types)
+    process_slots(node.cfg, work, slot, node.types)
+    st = work.state
+    proposer = util.get_beacon_proposer_index(
+        st, electra=work.fork_seq >= ForkSeq.electra
+    )
+    if proposer not in node.keys:
+        return None
+    epoch = util.get_current_epoch(st)
+    randao = sign(
+        node.keys[proposer],
+        compute_signing_root(
+            ssz_uint64, epoch, get_domain(node.cfg, st, DOMAIN_RANDAO)
+        ),
+    )
+    evil, post = chain.produce_block(
+        slot,
+        randao,
+        graffiti=(graffiti or _EQUIVOCATION_GRAFFITI)[:32].ljust(
+            32, b"\x00"
+        ),
+        work=work,
+    )
+    ns = node.types.by_fork[post.fork]
+    signed_evil = ns.SignedBeaconBlock.default()
+    signed_evil.message = evil
+    domain = get_domain(node.cfg, post.state, DOMAIN_BEACON_PROPOSER)
+    root = compute_signing_root(ns.BeaconBlock, evil, domain)
+    signed_evil.signature = sign(node.keys[proposer], root)
+    await chain.process_block(signed_evil, is_timely=False)
+    await node.network.publish_block(post.fork, signed_evil)
+    return ns.BeaconBlock.hash_tree_root(evil)
+
+
+async def republish_head_block(node, times: int = 3) -> int:
+    """Duplicate-block flood: re-publish the node's current head block
+    `times` times. Peers' gossip seen-cache must absorb every copy
+    (GossipNode.duplicates_received counts the containment)."""
+    chain = node.chain
+    signed = chain.get_block(chain.head_root)
+    view = chain.get_state(chain.head_root)
+    if signed is None or view is None:
+        return 0
+    for _ in range(times):
+        await node.network.publish_block(view.fork, signed)
+    return times
+
+
 async def kill_node(sim, index: int) -> None:
     """Take a node off the network mid-run (process kill analog: its
     chain state survives, its sockets don't, its duties stop)."""
@@ -198,11 +349,13 @@ async def kill_node(sim, index: int) -> None:
 
 
 async def restart_node(sim, index: int, resync_from: int | None = None
-                       ) -> None:
+                       ) -> int:
     """Bring a killed node back: restart its network, reconnect the
     mesh, and catch its chain up from a healthy peer's canonical chain
     (the range-sync step, collapsed to direct imports since both nodes
-    live in this process)."""
+    live in this process). Returns the number of blocks imported
+    during catch-up (0 when no resync peer was given), also stored on
+    the node as `caught_up_blocks` for scenario SLOs."""
     node = sim.nodes[index]
     node.alive = True
     await node.network.start()
@@ -215,14 +368,27 @@ async def restart_node(sim, index: int, resync_from: int | None = None
             )
         except Exception:
             pass
+    imported = 0
     if resync_from is not None:
-        await catch_up(node, sim.nodes[resync_from])
+        imported = await catch_up(node, sim.nodes[resync_from])
+    node.caught_up_blocks = imported
     await asyncio.sleep(0.05)
+    return imported
 
 
-async def catch_up(node, healthy) -> None:
+async def catch_up(node, healthy) -> int:
     """Import the healthy node's canonical blocks that `node` missed,
-    oldest first (BeaconBlocksByRange over an in-process shortcut)."""
+    oldest first (BeaconBlocksByRange over an in-process shortcut).
+    Returns the number of blocks actually imported.
+
+    Blocks `node` already holds are skipped without touching the
+    import path; an unknown-parent failure before anything imported is
+    the pre-anchor case (the healthy chain extends past this node's
+    anchor) and ends the walk the same way checkpoint sync would. ANY
+    other import failure re-raises — a node that cannot catch up must
+    look failed, not caught-up."""
+    from ..chain.chain import ChainError
+
     chain = healthy.chain
     blocks = []
     root = chain.head_root
@@ -233,16 +399,25 @@ async def catch_up(node, healthy) -> None:
         blk = chain.get_block(root)
         if blk is None:
             break
-        blocks.append(blk)
+        blocks.append((root, blk))
         n = proto.get_node(root)
         if n is None or n.parent_root is None:
             break
         root = bytes(n.parent_root)
-    for blk in reversed(blocks):
+    imported = 0
+    for root, blk in reversed(blocks):
+        if node.chain.get_block(root) is not None:
+            continue  # raced in via gossip while we walked
         try:
             await node.chain.process_block(blk, is_timely=False)
-        except Exception:
-            pass  # already known / pre-anchor
+        except ChainError as e:
+            if imported == 0 and "unknown parent" in str(e):
+                # pre-anchor: nothing imported yet and the oldest
+                # missing block's parent predates this node's anchor
+                continue
+            raise
+        imported += 1
+    return imported
 
 
 class FaultSchedule:
@@ -257,6 +432,14 @@ class FaultSchedule:
 
     def window(self, start_slot: int, end_slot: int, on_enter,
                on_exit=None) -> None:
+        if end_slot < start_slot:
+            # such a window would silently never enter — a scheduled
+            # fault that never fires makes every downstream assertion
+            # vacuous, so reject it at registration
+            raise ValueError(
+                f"fault window end_slot {end_slot} < start_slot "
+                f"{start_slot} would never activate"
+            )
         self.windows.append(
             {
                 "start": start_slot,
@@ -285,7 +468,73 @@ class FaultSchedule:
             return None
 
         async def run():
+            # every window's hook runs even when an earlier one fails
+            # (an exit hook must still detach its injector if another
+            # window's enter hook blew up mid-tick); failures surface
+            # after the full sweep
+            errors = []
             for c in coros:
-                await c
+                try:
+                    await c
+                except Exception as e:
+                    errors.append(e)
+            if errors:
+                if len(errors) == 1:
+                    raise errors[0]
+                raise RuntimeError(
+                    f"{len(errors)} fault window hooks failed: "
+                    + "; ".join(repr(e) for e in errors)
+                ) from errors[0]
 
         return run()
+
+
+class FaultRegistry:
+    """Delivered-fault accounting across every injector in a scenario.
+
+    Injectors expose `injected_fault_counts() -> {kind: n}`
+    (GossipFaultInjector, FlakyEngine, FlakyRelay, LateBlockReplayer);
+    scripted faults without a wrapper object (equivocation, restarts)
+    record through `record()`. Scenario SLOs call `assert_fired` so a
+    run whose fault never actually fired FAILS instead of passing
+    vacuously; `bind_sim_fault_collectors` exports the same view as
+    `lodestar_sim_injected_faults_total{kind}`."""
+
+    def __init__(self):
+        self._injectors: list = []
+        self._manual: dict[str, int] = {}
+
+    def track(self, injector):
+        """Register an injector; returned unchanged for inline use:
+        `inj = registry.track(GossipFaultInjector(...))`."""
+        self._injectors.append(injector)
+        return injector
+
+    def record(self, kind: str, n: int = 1) -> None:
+        self._manual[kind] = self._manual.get(kind, 0) + int(n)
+
+    def counts(self) -> dict[str, int]:
+        out = dict(self._manual)
+        for inj in self._injectors:
+            for kind, n in inj.injected_fault_counts().items():
+                out[kind] = out.get(kind, 0) + int(n)
+        return out
+
+    def assert_fired(self, *kinds: str) -> None:
+        counts = self.counts()
+        missing = [k for k in kinds if counts.get(k, 0) <= 0]
+        assert not missing, (
+            f"scheduled faults never fired: {missing} "
+            f"(delivered counts: {counts})"
+        )
+
+
+def bind_sim_fault_collectors(metrics, registry: FaultRegistry) -> None:
+    """Wire the m.sim namespace (metrics/beacon.py) to sample the
+    registry's delivered-fault counts at scrape time."""
+
+    def _sample(g):
+        for kind, n in registry.counts().items():
+            g.set(n, kind=kind)
+
+    metrics.injected_faults_total.add_collect(_sample)

@@ -68,8 +68,10 @@ class SimNode:
         self.blocks_via_builder = 0
         self.blocks_via_local = 0
         # cleared by sim/faults.kill_node: a dead node neither proposes
-        # nor attests until restarted
+        # nor attests until restarted; restart_node records how many
+        # blocks its catch-up actually imported
         self.alive = True
+        self.caught_up_blocks = 0
 
     def _install_gossip_handlers(self) -> None:
         from ..network.gossip import ValidationResult
@@ -430,6 +432,10 @@ class Simulation:
         for node in self.nodes:
             if node.alive:
                 await node.sync_commit(self.slot)
+            # prune on the SLOT clock, not on finality: a sustained
+            # non-finality regime must not grow the pool without bound
+            # (scenario SLO: sim/assertions.op_pool_sizes stays flat)
+            node.att_pool.prune(self.slot)
         await asyncio.sleep(0.1)
 
     async def run_until_slot(self, slot: int) -> None:

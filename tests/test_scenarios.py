@@ -1,0 +1,167 @@
+"""Scenario fleet tests (lodestar_tpu/sim/scenarios.py).
+
+Tier 1 runs the ENGINE unit tests plus the fast smoke slice — the
+two single-process regimes (device-executor blob firehose with the
+autotuner-holds-still invariant, and the gossip-burst processor
+run). The four multi-node regimes cost minutes each under pure-python
+BLS, so their smoke AND full profiles are slow-marked into tier 2
+(tools/run_tests.sh; LODESTAR_SLOW_TESTS=1). The operator CLI
+(tools/run_scenarios.py) runs the same registry.
+"""
+
+import pytest
+
+from lodestar_tpu.sim.scenarios import (
+    SCENARIOS,
+    ScenarioResult,
+    SloResult,
+    run_all,
+    run_scenario,
+    scenario,
+)
+
+EXPECTED_FLEET = (
+    "sustained_nonfinality",
+    "reorg_storm",
+    "equivocation_flood",
+    "mainnet_gossip_burst",
+    "blob_firehose_under_load",
+    "checkpoint_thundering_herd",
+)
+
+FAST_SMOKE = ("blob_firehose_under_load", "mainnet_gossip_burst")
+SLOW_SMOKE = tuple(n for n in EXPECTED_FLEET if n not in FAST_SMOKE)
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_fleet_registered(self):
+        for name in EXPECTED_FLEET:
+            assert name in SCENARIOS, name
+            spec = SCENARIOS[name]
+            assert spec.summary
+            assert spec.faults, f"{name} declares no faults"
+            assert spec.slo_names, f"{name} declares no SLOs"
+
+    def test_unknown_scenario_raises(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            run_scenario("no_such_regime")
+
+    def test_bad_profile_raises(self):
+        with pytest.raises(ValueError, match="smoke|full"):
+            run_scenario("reorg_storm", profile="chaos")
+
+    def test_run_all_unknown_only_raises(self):
+        with pytest.raises(KeyError, match="no_such"):
+            run_all(only=["no_such"])
+
+    def test_scenario_body_crash_lands_in_error_not_raise(self):
+        @scenario("__crashes__", "test-only", faults=("x",),
+                  slos=("y",))
+        async def _crashes(ctx):
+            raise RuntimeError("scenario blew up")
+
+        try:
+            res = run_scenario("__crashes__")
+            assert not res.passed
+            assert "scenario blew up" in res.error
+            # a crashed scenario still reports what DID fire
+            assert res.faults_injected == {}
+        finally:
+            del SCENARIOS["__crashes__"]
+
+    def test_failed_slo_fails_result_and_serializes(self):
+        @scenario("__failing_slo__", "test-only", faults=("x",),
+                  slos=("y",))
+        async def _failing(ctx):
+            ctx.slo_le("too_big", 10, 3, "must fail")
+            ctx.slo_true("fine", True)
+            ctx.registry.record("x", 2)
+
+        try:
+            res = run_scenario("__failing_slo__", seed=7)
+            assert res.error is None
+            assert not res.passed
+            d = res.to_dict()
+            assert d["passed"] is False
+            assert d["seed"] == 7
+            rows = {s["name"]: s["passed"] for s in d["slos"]}
+            assert rows == {"too_big": False, "fine": True}
+            assert d["faults_injected"] == {"x": 2}
+            assert "FAIL" in res.summary()
+        finally:
+            del SCENARIOS["__failing_slo__"]
+
+    def test_result_passed_semantics(self):
+        ok = SloResult("a", True, 1, 1)
+        bad = SloResult("b", False, 2, 1)
+        assert ScenarioResult("n", "smoke", 1, slos=[ok]).passed
+        assert not ScenarioResult("n", "smoke", 1, slos=[ok, bad]).passed
+        assert not ScenarioResult(
+            "n", "smoke", 1, slos=[ok], error="boom"
+        ).passed
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke slice: the fast single-process regimes
+# ---------------------------------------------------------------------------
+
+
+class TestSmokeSlice:
+    @pytest.mark.parametrize("name", FAST_SMOKE)
+    def test_smoke_green(self, name):
+        res = run_scenario(name, profile="smoke")
+        assert res.passed, res.summary() + ("\n" + res.error
+                                            if res.error else "")
+
+    def test_blob_firehose_restores_knobs(self):
+        """The firehose scenario re-tunes through the REAL setters at
+        the end — it must leave the process knobs exactly as found."""
+        from lodestar_tpu.bls import kernels as K
+        from lodestar_tpu.device import autotune as AT
+        from lodestar_tpu.ops import limbs as L
+
+        before = (K.INGEST_MIN_BUCKET, tuple(K.BUCKET_LADDER),
+                  L.get_backend(), AT._APPLIED)
+        res = run_scenario("blob_firehose_under_load")
+        after = (K.INGEST_MIN_BUCKET, tuple(K.BUCKET_LADDER),
+                 L.get_backend(), AT._APPLIED)
+        assert res.passed, res.summary()
+        assert before == after
+
+    def test_determinism_same_seed_same_verdicts(self):
+        """Same seed, same profile -> same SLO verdict vector (the
+        observed latencies vary; the contract must not)."""
+        a = run_scenario("blob_firehose_under_load", seed=99)
+        b = run_scenario("blob_firehose_under_load", seed=99)
+        va = [(s.name, s.passed) for s in a.slos]
+        vb = [(s.name, s.passed) for s in b.slos]
+        assert va == vb
+        assert a.faults_injected == b.faults_injected
+
+
+# ---------------------------------------------------------------------------
+# tier 2: the multi-node regimes (smoke) and the full-length fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFleetSmoke:
+    @pytest.mark.parametrize("name", SLOW_SMOKE)
+    def test_smoke_green(self, name):
+        res = run_scenario(name, profile="smoke")
+        assert res.passed, res.summary() + ("\n" + res.error
+                                            if res.error else "")
+
+
+@pytest.mark.slow
+class TestFleetFull:
+    @pytest.mark.parametrize("name", EXPECTED_FLEET)
+    def test_full_green(self, name):
+        res = run_scenario(name, profile="full")
+        assert res.passed, res.summary() + ("\n" + res.error
+                                            if res.error else "")

@@ -1,0 +1,431 @@
+"""Fault-layer unit tests: FaultSchedule edge cases, the catch_up
+and assert_no_missed_blocks regression fixes, FaultRegistry
+accounting, and the injected-fault metrics bridge.
+
+Everything here runs against lightweight fakes — no Simulation, no
+network, no BLS — so the whole file is tier-1 cheap. The scenario
+fleet itself is covered by tests/test_scenarios.py.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.chain import ChainError
+from lodestar_tpu.sim.assertions import (
+    assert_no_missed_blocks,
+    missed_slots,
+)
+from lodestar_tpu.sim.faults import (
+    FaultRegistry,
+    FaultSchedule,
+    GossipFaultInjector,
+    bind_sim_fault_collectors,
+    catch_up,
+)
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule
+# ---------------------------------------------------------------------------
+
+
+class _FakeSim:
+    def __init__(self):
+        self.on_slot_hooks = []
+        self.slot = 0
+
+    async def run_slot(self):
+        self.slot += 1
+        for hook in self.on_slot_hooks:
+            got = hook(self.slot)
+            if asyncio.iscoroutine(got):
+                await got
+
+
+class TestFaultSchedule:
+    def test_end_before_start_raises_at_registration(self):
+        sched = FaultSchedule(_FakeSim())
+        with pytest.raises(ValueError, match="never activate"):
+            sched.window(5, 3, lambda: None)
+
+    def test_single_slot_window_fires(self):
+        sim = _FakeSim()
+        sched = FaultSchedule(sim)
+        fired = []
+        sched.window(2, 2, lambda: fired.append("enter"),
+                     lambda: fired.append("exit"))
+
+        async def go():
+            for _ in range(4):
+                await sim.run_slot()
+
+        asyncio.run(go())
+        assert fired == ["enter", "exit"]
+
+    def test_overlapping_windows_fire_independently(self):
+        sim = _FakeSim()
+        sched = FaultSchedule(sim)
+        log = []
+        sched.window(1, 3, lambda: log.append("a+"),
+                     lambda: log.append("a-"))
+        sched.window(2, 4, lambda: log.append("b+"),
+                     lambda: log.append("b-"))
+
+        async def go():
+            for _ in range(6):
+                await sim.run_slot()
+
+        asyncio.run(go())
+        assert log == ["a+", "b+", "a-", "b-"]
+
+    def test_raising_enter_hook_surfaces_and_other_hooks_still_run(self):
+        """One window's hook blowing up mid-tick must not eat another
+        window's enter/exit — the error surfaces AFTER the sweep."""
+        sim = _FakeSim()
+        sched = FaultSchedule(sim)
+        ran = []
+
+        async def bad():
+            raise RuntimeError("injector exploded")
+
+        async def good():
+            ran.append("good")
+
+        # same slot: both windows enter on slot 1
+        sched.window(1, 2, lambda: bad())
+        sched.window(1, 2, lambda: good())
+
+        async def go():
+            await sim.run_slot()
+
+        with pytest.raises(RuntimeError, match="injector exploded"):
+            asyncio.run(go())
+        assert ran == ["good"]
+
+    def test_two_raising_hooks_aggregate(self):
+        sim = _FakeSim()
+        sched = FaultSchedule(sim)
+
+        async def bad(tag):
+            raise RuntimeError(tag)
+
+        sched.window(1, 2, lambda: bad("first"))
+        sched.window(1, 2, lambda: bad("second"))
+
+        async def go():
+            await sim.run_slot()
+
+        with pytest.raises(RuntimeError, match="2 fault window hooks"):
+            asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# catch_up (regression: bare except swallowed real import failures)
+# ---------------------------------------------------------------------------
+
+
+class _Proto:
+    def __init__(self, parents):
+        self._parents = parents
+
+    def get_node(self, root):
+        if root not in self._parents:
+            return None
+
+        class N:
+            parent_root = self._parents[root]
+
+        return N
+
+
+class _FakeChain:
+    """Minimal chain surface catch_up touches: head_root, get_block,
+    fork_choice.proto.get_node, process_block."""
+
+    def __init__(self, blocks, parents, head):
+        self._blocks = dict(blocks)
+        self.head_root = head
+        self.fork_choice = type(
+            "FC", (), {"proto": _Proto(parents)}
+        )()
+        self.import_log = []
+        self.fail_with = None  # root -> exception to raise
+
+    def get_block(self, root):
+        return self._blocks.get(root)
+
+    async def process_block(self, blk, is_timely=None, **kw):
+        root = blk["root"]
+        if self.fail_with and root in self.fail_with:
+            raise self.fail_with[root]
+        self.import_log.append(root)
+        self._blocks[root] = blk
+
+
+def _chain_pair(n_missing=3):
+    """healthy has blocks g<-a<-b<-c; node only has g."""
+    roots = [b"g" * 32, b"a" * 32, b"b" * 32, b"c" * 32]
+    blocks = {r: {"root": r} for r in roots}
+    parents = {
+        roots[i]: roots[i - 1] for i in range(1, len(roots))
+    }
+    parents[roots[0]] = None
+    healthy = _FakeChain(blocks, parents, head=roots[-1])
+    node = _FakeChain({roots[0]: blocks[roots[0]]}, parents,
+                      head=roots[0])
+    return healthy, node, roots
+
+
+class _NodeShim:
+    def __init__(self, chain):
+        self.chain = chain
+
+
+class TestCatchUp:
+    def test_imports_missing_blocks_oldest_first(self):
+        healthy, node, roots = _chain_pair()
+
+        async def go():
+            return await catch_up(_NodeShim(node), _NodeShim(healthy))
+
+        imported = asyncio.run(go())
+        assert imported == 3
+        assert node.import_log == roots[1:]  # oldest first
+
+    def test_already_known_blocks_skipped_not_imported(self):
+        healthy, node, roots = _chain_pair()
+        node._blocks[roots[1]] = healthy._blocks[roots[1]]
+        node._blocks[roots[2]] = healthy._blocks[roots[2]]
+
+        async def go():
+            return await catch_up(_NodeShim(node), _NodeShim(healthy))
+
+        assert asyncio.run(go()) == 1
+        assert node.import_log == [roots[3]]
+
+    def test_real_import_failure_reraises(self):
+        """The regression: a mid-walk ChainError (bad signature, bad
+        state root...) used to be swallowed by `except: pass`, making
+        a broken node look caught-up."""
+        healthy, node, roots = _chain_pair()
+        node.fail_with = {
+            roots[2]: ChainError("block signature verification failed")
+        }
+
+        async def go():
+            return await catch_up(_NodeShim(node), _NodeShim(healthy))
+
+        with pytest.raises(ChainError, match="signature"):
+            asyncio.run(go())
+
+    def test_pre_anchor_unknown_parent_tolerated(self):
+        """The one legitimate skip: the healthy chain extends past
+        this node's anchor, so the OLDEST missing block has an
+        unknown parent — checkpoint-sync semantics, walk continues."""
+        healthy, node, roots = _chain_pair()
+        # node's anchor is mid-chain: it has NOTHING the healthy walk
+        # reaches until roots[1] fails as pre-anchor
+        node._blocks = {}
+        node.fail_with = {
+            roots[0]: ChainError("unknown parent state"),
+            roots[1]: ChainError("unknown parent state"),
+        }
+
+        async def go():
+            return await catch_up(_NodeShim(node), _NodeShim(healthy))
+
+        assert asyncio.run(go()) == 2  # b and c import fine
+        assert node.import_log == [roots[2], roots[3]]
+
+    def test_unknown_parent_after_first_import_reraises(self):
+        """unknown-parent is only the pre-anchor case while NOTHING
+        has imported; once the chain is connected it is a real hole."""
+        healthy, node, roots = _chain_pair()
+        node._blocks = {}
+        node.fail_with = {
+            roots[2]: ChainError("unknown parent state"),
+        }
+
+        async def go():
+            return await catch_up(_NodeShim(node), _NodeShim(healthy))
+
+        with pytest.raises(ChainError, match="unknown parent"):
+            asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# missed_slots / assert_no_missed_blocks (regression: trailing
+# missed slots passed vacuously when end_slot defaulted to max(have))
+# ---------------------------------------------------------------------------
+
+
+class _CanonNode:
+    def __init__(self, name, slots):
+        self.name = name
+        self._slots = slots
+        roots = {s: bytes([s]) * 32 for s in slots}
+        self._by_root = {}
+        parent = None
+        parents = {}
+        for s in slots:
+            parents[roots[s]] = parent
+            parent = roots[s]
+
+            class B:
+                def __init__(self, slot):
+                    self.slot = slot
+
+            self._by_root[roots[s]] = B(s)
+        self.chain = type(
+            "C",
+            (),
+            {
+                "head_root": roots[slots[-1]],
+                "get_block": lambda _self, r: self._by_root.get(r),
+                "fork_choice": type(
+                    "FC", (), {"proto": _Proto(parents)}
+                )(),
+            },
+        )()
+
+
+class _CanonSim:
+    def __init__(self, slot, nodes):
+        self.slot = slot
+        self.nodes = nodes
+
+
+class TestMissedSlots:
+    def test_trailing_missed_slots_fail_with_default_end(self):
+        """Blocks at slots 1..3, sim clock at 6: slots 4-6 MISSED.
+        The old default (end = newest canonical block) passed this."""
+        sim = _CanonSim(6, [_CanonNode("n0", [1, 2, 3])])
+        assert missed_slots(sim)["n0"] == [4, 5, 6]
+        with pytest.raises(AssertionError, match=r"\[4, 5, 6\]"):
+            assert_no_missed_blocks(sim)
+
+    def test_clean_run_passes_with_default_end(self):
+        sim = _CanonSim(3, [_CanonNode("n0", [1, 2, 3])])
+        assert missed_slots(sim)["n0"] == []
+        assert_no_missed_blocks(sim)
+
+    def test_explicit_end_still_honored(self):
+        sim = _CanonSim(6, [_CanonNode("n0", [1, 2, 3])])
+        assert_no_missed_blocks(sim, 1, 3)
+        assert missed_slots(sim, 2, 5)["n0"] == [4, 5]
+
+    def test_gap_in_middle_detected(self):
+        sim = _CanonSim(4, [_CanonNode("n0", [1, 3, 4])])
+        assert missed_slots(sim)["n0"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# FaultRegistry + the metrics bridge
+# ---------------------------------------------------------------------------
+
+
+class _StubInjector:
+    def __init__(self, counts):
+        self._counts = counts
+
+    def injected_fault_counts(self):
+        return dict(self._counts)
+
+
+class TestFaultRegistry:
+    def test_counts_merge_injectors_and_manual(self):
+        reg = FaultRegistry()
+        reg.track(_StubInjector({"gossip_drop": 3}))
+        reg.track(_StubInjector({"gossip_drop": 2, "late_block": 1}))
+        reg.record("node_kill")
+        reg.record("node_kill")
+        assert reg.counts() == {
+            "gossip_drop": 5,
+            "late_block": 1,
+            "node_kill": 2,
+        }
+
+    def test_assert_fired_passes_and_fails(self):
+        reg = FaultRegistry()
+        reg.record("engine_error", 4)
+        reg.assert_fired("engine_error")
+        with pytest.raises(AssertionError, match="never fired"):
+            reg.assert_fired("engine_error", "relay_outage")
+
+    def test_track_returns_injector(self):
+        reg = FaultRegistry()
+        inj = _StubInjector({})
+        assert reg.track(inj) is inj
+
+    def test_metrics_bridge_exposes_kinds(self):
+        from lodestar_tpu.metrics import (
+            RegistryMetricCreator,
+            create_lodestar_metrics,
+        )
+
+        mreg = RegistryMetricCreator()
+        m = create_lodestar_metrics(mreg)
+        freg = FaultRegistry()
+        freg.record("gossip_drop", 7)
+        freg.record("equivocating_block", 2)
+        bind_sim_fault_collectors(m.sim, freg)
+        text = mreg.expose()
+        assert (
+            'lodestar_sim_injected_faults_total{kind="gossip_drop"} 7'
+            in text
+        )
+        assert (
+            'lodestar_sim_injected_faults_total'
+            '{kind="equivocating_block"} 2' in text
+        )
+
+
+# ---------------------------------------------------------------------------
+# GossipFaultInjector topic scoping (drives sustained_nonfinality)
+# ---------------------------------------------------------------------------
+
+
+class _FakeGossip:
+    def __init__(self):
+        self.sent = []
+
+        async def send(topic, data, exclude):
+            self.sent.append(topic)
+            return 1
+
+        self._send_to_mesh = send
+
+
+class TestGossipInjectorTopics:
+    def test_topic_filter_scopes_the_policy(self):
+        g = _FakeGossip()
+        inj = GossipFaultInjector(
+            g, drop=1.0, topics=("beacon_attestation",)
+        )
+
+        async def go():
+            await g._send_to_mesh(
+                "/eth2/abc/beacon_attestation_0/ssz", b"x", None
+            )
+            await g._send_to_mesh(
+                "/eth2/abc/beacon_block/ssz", b"y", None
+            )
+
+        asyncio.run(go())
+        # the attestation frame dropped, the block frame passed
+        assert g.sent == ["/eth2/abc/beacon_block/ssz"]
+        assert inj.injected_fault_counts()["gossip_drop"] == 1
+        inj.detach()
+
+    def test_no_topic_filter_applies_to_all(self):
+        g = _FakeGossip()
+        inj = GossipFaultInjector(g, drop=1.0)
+
+        async def go():
+            await g._send_to_mesh("/any/topic", b"x", None)
+
+        asyncio.run(go())
+        assert g.sent == []
+        assert inj.dropped == 1
+        inj.detach()

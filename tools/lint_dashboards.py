@@ -104,6 +104,10 @@ ORPHAN_ALLOWLIST = {
     "lodestar_sync_status",
     "lodestar_sync_unknown_block_requests_total",
     "lodestar_forkchoice_indices_count",
+    # sim-only series: the scenario fleet's delivered-fault counter
+    # (sim/faults.FaultRegistry) — asserted by scenario SLOs and the
+    # tier-1 smoke slice, never charted on a production dashboard
+    "lodestar_sim_injected_faults_total",
     # raw operands of charted ratios / rollups
     "lodestar_gossip_validation_queue_job_time_seconds",
     "lodestar_oppool_sync_contribution_and_proof_pool_size",

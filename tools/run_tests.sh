@@ -33,6 +33,19 @@ run_tier1() {
   # regression surfaces in seconds instead of minutes into the run
   JAX_PLATFORMS=cpu python -m pytest tests/test_device_executor.py -q \
     -m 'not slow' -p no:cacheprovider || exit 1
+  # scenario-fleet smoke slice, standalone for the same reason: the
+  # two single-process regimes (device-executor blob firehose with
+  # the autotuner-holds-still invariant, gossip-burst backpressure)
+  # plus the fault-layer unit tests run in seconds; the four
+  # multi-node regimes cost minutes each and live in tier 2
+  JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py \
+    tests/test_sim_faults.py -q -m 'not slow' -p no:cacheprovider \
+    || exit 1
+  # the same slice through the operator CLI: exercises the registry
+  # -> SLO-contract -> provenance-stamped artifact path end to end
+  JAX_PLATFORMS=cpu python tools/run_scenarios.py \
+    --only blob_firehose_under_load \
+    --json /tmp/lodestar_scenarios_smoke.json || exit 1
   # pytest line matches ROADMAP.md "Tier-1 verify" plus --durations=25:
   # the per-test timing artifact tracks suite-runtime creep per PR
   # (slowest offenders land in /tmp/lodestar_tier1_durations.txt and
