@@ -894,6 +894,35 @@ def _maintenance_checkpoint() -> None:
             pass
 
 
+# health gate (device/health.py): the node wires this to the health
+# tracker's device_allowed so warmup SUSPENDS while the device is
+# quarantined — a compile storm is the last thing a sick chip needs,
+# and the probes need the device to themselves. Sizes left cold are
+# re-warmed by the reinstatement warmup kick. None = no tracker:
+# warmup runs unconditionally (tests, tools, standalone verifiers).
+_HEALTH_GATE = None
+
+
+def set_health_gate(gate) -> None:
+    """Install (or clear, with None) the device-allowed predicate
+    consulted before each warmup compile."""
+    global _HEALTH_GATE
+    _HEALTH_GATE = gate
+
+
+def _device_dispatch_allowed() -> bool:
+    """Consult the installed health gate, tolerating any failure (a
+    broken gate must never block warmup — fail open, like the
+    maintenance gate fails silent)."""
+    gate = _HEALTH_GATE
+    if gate is None:
+        return True
+    try:
+        return bool(gate())
+    except Exception:
+        return True
+
+
 def ingest_is_warm(b: int, kind: str = "batch") -> bool:
     return (kind, b) in _INGEST_WARM
 
@@ -1038,6 +1067,17 @@ def warmup_ingest(
 
     def warm_sizes(seq, log):
         for b in sorted(set(seq)):
+            if not _device_dispatch_allowed():
+                # device quarantined (device/health.py): suspend —
+                # the remaining sizes stay cold and the reinstatement
+                # warmup kick re-runs this loop when the device comes
+                # back. Warming THROUGH a quarantine would race the
+                # known-answer probes for a chip being judged.
+                log.warn(
+                    "warmup suspended: device path quarantined",
+                    {"remaining": sorted(set(seq))},
+                )
+                return
             if not ingest_is_warm(b, "batch"):
                 # yield the device to pending deadline work before
                 # each compile (maintenance-class discipline,

@@ -77,6 +77,7 @@ from ..crypto.bls import curve as oc
 # because the verifier's own histograms predate it and tests/tools
 # reach it as verifier.LatencyHistogram.
 from ..device.executor import LatencyHistogram  # noqa: F401
+from ..device.health import classify_device_error, watchdog_deadline_s
 from ..metrics import device as _device
 from ..ops import curve as C
 from . import api, kernels
@@ -158,6 +159,84 @@ class _Job:
     device_s: float = 0.0
 
 
+# -- host-oracle failover verdicts (device/health.py fault domain) -----
+# Exact per-set pairing checks — the same math OracleBlsVerifier runs,
+# so verdicts are bit-identical to the device path (the differential
+# suite proves all three agree). Used while the device is quarantined;
+# they deliberately touch NO jax arrays (jnp.asarray on a sick TPU
+# could hang the failover itself).
+
+
+def _host_oracle_sets(sets) -> bool:
+    """Verdict for raw api.SignatureSet items (a _Job's .sets)."""
+    from ..crypto.bls import pairing as op
+
+    try:
+        for s in sets:
+            pk = api.decompress_pubkey(s.pubkey)
+            h = api.message_to_g2(s.message)
+            sig = api.decompress_signature(s.signature)
+            if sig is None:
+                return False
+            if not op.pairing_product_is_one(
+                [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+            ):
+                return False
+        return True
+    except api.InvalidPointError:
+        return False
+
+
+def _host_oracle_prepared(sets) -> bool:
+    """Verdict for _PreparedSet items (a packed bucket): the pubkey is
+    already decompressed; signature/message reconstruct from the raw
+    bytes (or the parsed x-coordinate / hash draws)."""
+    from ..crypto.bls import pairing as op
+
+    try:
+        for s in sets:
+            sig = (
+                api.decompress_signature(s.sig_raw)
+                if s.sig_raw
+                else api.decompress_signature_parsed(
+                    s.sig_x, s.sig_sign
+                )
+            )
+            if sig is None:
+                return False
+            h = (
+                api.message_to_g2(s.msg_raw)
+                if s.msg_raw
+                else api.draws_to_g2(s.draws)
+            )
+            if not op.pairing_product_is_one(
+                [(s.pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+            ):
+                return False
+        return True
+    except api.InvalidPointError:
+        return False
+
+
+def _host_oracle_same_message(pairs, h) -> bool:
+    """Verdict for same-message (pk, sig_x, sig_sign) triples against
+    one already-hashed G2 point h."""
+    from ..crypto.bls import pairing as op
+
+    try:
+        for pk, sx, sg in pairs:
+            sig = api.decompress_signature_parsed(sx, sg)
+            if sig is None:
+                return False
+            if not op.pairing_product_is_one(
+                [(pk, h), (oc.g1_neg(oc.G1_GEN), sig)]
+            ):
+                return False
+        return True
+    except api.InvalidPointError:
+        return False
+
+
 class BlsVerifierMetrics:
     """Counter names mirror lodestar_bls_thread_pool_* so the reference
     Grafana dashboard maps 1:1 (metrics/metrics/lodestar.ts:403-506)."""
@@ -187,7 +266,14 @@ class BlsVerifierMetrics:
         # host fallback), rolling-bucket flush triggers, and the
         # submit-to-verdict latency histogram
         self.dispatch_by_bucket: dict[int, int] = {}
-        self.dispatch_by_path = {"ingest": 0, "host": 0, "host_cold": 0}
+        self.dispatch_by_path = {
+            "ingest": 0,
+            "host": 0,
+            "host_cold": 0,
+            # device path quarantined (device/health.py): the bucket
+            # rode the bit-identical host oracle instead
+            "failover": 0,
+        }
         # dispatches count from executor threads; scrapes copy under
         # the same lock so iteration never races an insertion
         self.dispatch_lock = threading.Lock()
@@ -292,6 +378,14 @@ class TpuBlsVerifier:
         self._finalizers: set[asyncio.Task] = set()
         self._closed = False
         self._executor = None  # node DeviceExecutor (attach_executor)
+        # device fault domain (device/health.py, attach_health):
+        # while the tracker quarantines the device every bucket rides
+        # the bit-identical host oracle, and — when a wave timeout is
+        # armed — a wave stuck on a hung device fails over instead of
+        # leaving its verdict futures pending forever
+        self._health = None
+        self._wave_timeout_s: float | None = None
+        self._log = None
         if mesh is None:
             import jax
 
@@ -440,6 +534,33 @@ class TpuBlsVerifier:
             )
             executor.register_quiescence_probe(self.is_quiescent)
 
+    def attach_health(self, tracker, wave_timeout_s=None) -> None:
+        """Join the device fault domain (device/health.py): while the
+        tracker quarantines the device, every bucket short-circuits to
+        the bit-identical host oracle (verdicts exact per-set pairing
+        checks — the differential tests prove identity), device errors
+        report through the taxonomy, and `wave_timeout_s` arms a wave
+        watchdog — a wave stuck past the deadline trips the tracker
+        and resolves every pending verdict via host failover (zero
+        lost, zero wrong). wave_timeout_s=None adopts the
+        deadline-class default derived from the fused stage budget;
+        pass 0/False to leave the wave watchdog unarmed (CPU
+        emulation, where the TPU budget means nothing)."""
+        self._health = tracker
+        if wave_timeout_s is None:
+            self._wave_timeout_s = watchdog_deadline_s("deadline")
+        elif wave_timeout_s:
+            self._wave_timeout_s = float(wave_timeout_s)
+        else:
+            self._wave_timeout_s = None
+
+    def _health_log(self):
+        if self._log is None:
+            from ..logger import get_logger
+
+            self._log = get_logger("bls-verifier")
+        return self._log
+
     def _flush_target(self) -> int:
         """Rolling-bucket full threshold: the smallest device-ingest-
         eligible bucket size."""
@@ -456,7 +577,7 @@ class TpuBlsVerifier:
             return False
         return True
 
-    def _count_dispatch(self, b: int, use_ingest: bool):
+    def _count_dispatch(self, b: int, use_ingest: bool, failover: bool = False):
         """Per-bucket-size and per-path dispatch counters (the proof
         that trickle traffic coalesces into device-ingest buckets).
         Runs on executor threads — the lock keeps concurrent
@@ -467,7 +588,9 @@ class TpuBlsVerifier:
             m.dispatch_by_bucket[b] = (
                 m.dispatch_by_bucket.get(b, 0) + 1
             )
-            if use_ingest:
+            if failover:
+                path = "failover"  # device quarantined: host oracle
+            elif use_ingest:
                 path = "ingest"
             elif b >= self._ingest_gate():
                 path = "host_cold"  # eligible, but compile still cold
@@ -890,12 +1013,28 @@ class TpuBlsVerifier:
         overlapped = self._inflight() > 1  # this task counts as one
         tp = time.monotonic()
         try:
-            wave = await self._prep_and_dispatch(jobs)
+            wave = await self._await_device(
+                self._prep_and_dispatch(jobs)
+            )
         except asyncio.CancelledError:
             self._fail_jobs(jobs, RuntimeError("BLS verifier closed"))
             raise
-        except Exception as e:  # defensive: fail the waiters
-            self._fail_jobs(jobs, e)
+        except asyncio.TimeoutError:
+            # wave watchdog: the dispatch overran the deadline-class
+            # budget (a hung device program). Trip the tracker and
+            # resolve every pending verdict on the host oracle — the
+            # callers get correct verdicts, not a timeout error.
+            if self._health is not None:
+                self._health.note_watchdog_trip("deadline")
+            await self._failover_jobs(jobs)
+            return
+        except Exception as e:
+            # device-error taxonomy (device/health.py): classify;
+            # programming errors propagate to the waiters (our bug,
+            # not the device's), device kinds report to the tracker
+            # and the waiters get host-oracle verdicts instead
+            if not await self._handle_wave_error(e, jobs):
+                self._fail_jobs(jobs, e)
             return
         if overlapped:
             self.metrics.prep_overlap_hidden_s += (
@@ -920,6 +1059,60 @@ class TpuBlsVerifier:
                 self.metrics.verify_latency.observe(
                     time.monotonic() - j.created_at
                 )
+
+    # -- device fault domain (device/health.py) -------------------------
+
+    async def _await_device(self, coro):
+        """Apply the armed wave-watchdog deadline (attach_health) to
+        one device-bound await; pass-through when unarmed."""
+        if self._wave_timeout_s is None or self._health is None:
+            return await coro
+        return await asyncio.wait_for(coro, timeout=self._wave_timeout_s)
+
+    async def _handle_wave_error(self, e, jobs) -> bool:
+        """Taxonomy routing for a failed wave: returns True when the
+        jobs were resolved via host failover, False when the caller
+        must propagate the error to the waiters (programming errors —
+        TypeError/KeyError from our own code must surface as the bug
+        they are — or no tracker attached, the legacy behavior)."""
+        health = self._health
+        if health is None:
+            return False
+        kind = classify_device_error(e)
+        if kind == "programming":
+            return False
+        try:
+            health.record_fault(kind, client="bls")
+        except ValueError:
+            return False
+        if health.should_log("bls"):
+            self._health_log().warn(
+                "device wave failed; verdicts riding host oracle",
+                {"kind": kind, "err": repr(e)},
+            )
+        await self._failover_jobs(jobs)
+        return True
+
+    async def _failover_jobs(self, jobs) -> None:
+        """Resolve every still-pending job with HOST-ORACLE verdicts
+        computed from its raw signature sets — exact per-set pairing
+        checks, bit-identical to OracleBlsVerifier (and to the device
+        path: the differential suite proves all three agree). Zero
+        lost verdicts, zero wrong verdicts; runs in the prep pool so
+        the ~ms-per-set pairing math stays off the event loop."""
+        loop = asyncio.get_event_loop()
+        live = [j for j in jobs if not j.future.done()]
+        if not live:
+            return
+        if self._health is not None:
+            self._health.note_failover("bls")
+
+        def verdicts():
+            return [_host_oracle_sets(j.sets) for j in live]
+
+        out = await loop.run_in_executor(self._prep_pool, verdicts)
+        for j, ok in zip(live, out):
+            self._resolve_job(j, bool(ok))
 
     async def _prep_and_dispatch(self, jobs: list[_Job]):
         """Host prep (thread pool, parallel per job) + bucket packing +
@@ -1075,7 +1268,7 @@ class TpuBlsVerifier:
         per job, then per set (worker.ts:88-103 isolation)."""
         buckets, oks, t_dispatch = wave
         try:
-            verdicts = await self._readback(oks)
+            verdicts = await self._await_device(self._readback(oks))
             # verdicts are on host: the device work for every job in
             # the wave is done — stamp the first-dispatch-to-readback
             # interval on each job so its awaiting caller can graft a
@@ -1121,8 +1314,8 @@ class TpuBlsVerifier:
                     retry.append(j)
             if retry:
                 self.metrics.batch_retries += 1
-                verdicts = await self._verdict_wave(
-                    [j.prepared for j in retry]
+                verdicts = await self._await_device(
+                    self._verdict_wave([j.prepared for j in retry])
                 )
                 per_set: list[_Job] = []
                 for j, ok in zip(retry, verdicts):
@@ -1138,7 +1331,9 @@ class TpuBlsVerifier:
                         for j in per_set
                         for s in j.prepared
                     ]
-                    singles = await self._verdict_wave(flat)
+                    singles = await self._await_device(
+                        self._verdict_wave(flat)
+                    )
                     i = 0
                     for j in per_set:
                         n = len(j.prepared)
@@ -1152,8 +1347,22 @@ class TpuBlsVerifier:
                 RuntimeError("BLS verifier closed"),
             )
             raise
+        except asyncio.TimeoutError:
+            # wave watchdog: readback (or a retry dispatch) stuck past
+            # the deadline-class budget — trip the tracker and resolve
+            # the pending verdicts on the host oracle
+            if self._health is not None:
+                self._health.note_watchdog_trip("deadline")
+            await self._failover_jobs(
+                [j for b in buckets for j, _ in b]
+            )
         except Exception as e:
-            self._fail_jobs([j for b in buckets for j, _ in b], e)
+            # taxonomy routing (device/health.py): device kinds fail
+            # over to host-oracle verdicts; programming errors (and
+            # tracker-less verifiers) propagate to the waiters
+            jobs = [j for b in buckets for j, _ in b]
+            if not await self._handle_wave_error(e, jobs):
+                self._fail_jobs(jobs, e)
         finally:
             dt = time.monotonic() - t0
             self.metrics.total_device_time_s += dt
@@ -1173,6 +1382,18 @@ class TpuBlsVerifier:
 
         n = len(sets)
         b = kernels.bucket_size(n)
+        health = self._health
+        if health is not None and not health.device_allowed():
+            # device quarantined: bit-identical host-oracle verdict,
+            # no jax array is built (touching a sick TPU could hang
+            # the failover itself). Plain bool — _readback handles it.
+            self._count_dispatch(b, False, failover=True)
+            if health.note_failover("bls"):
+                self._health_log().warn(
+                    "device quarantined: buckets riding host oracle",
+                    {"state": health.state.value},
+                )
+            return _host_oracle_prepared(sets)
         pad = b - n
         pad_set = _pad_prepared()
         full = sets + [pad_set] * pad
@@ -1286,6 +1507,11 @@ class TpuBlsVerifier:
 
             if not oks:
                 return []
+            if all(isinstance(v, bool) for v in oks):
+                # all-failover wave: the verdicts are host bools
+                # already — don't build a device array just to read
+                # it back (and don't touch a quarantined chip at all)
+                return list(oks)
             _device.record_transfer("d2h", oks)
             if len(oks) == 1:
                 return [bool(oks[0])]
@@ -1353,6 +1579,18 @@ class TpuBlsVerifier:
 
             n = len(pairs)
             b = kernels.bucket_size(n)
+            health = self._health
+            if health is not None and not health.device_allowed():
+                # quarantined: exact host pairing checks against the
+                # one already-hashed message point (bit-identical)
+                self._count_dispatch(b, False, failover=True)
+                if health.note_failover("bls"):
+                    self._health_log().warn(
+                        "device quarantined: same-message riding"
+                        " host oracle",
+                        {"state": health.state.value},
+                    )
+                return _host_oracle_same_message(pairs, h)
             pad = b - n
             pad_set = _pad_prepared()
             rand = _rand_scalars(b)

@@ -186,6 +186,26 @@ def _build_parser() -> argparse.ArgumentParser:
         help="a queued maintenance job runs no later than this even "
         "under continuous bulk pressure (anti-starvation)",
     )
+    # -- device fault domain (device/health.py) -----------------------
+    beacon.add_argument(
+        "--device-health",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="device fault domain: wave watchdog (deadlines derived "
+        "from the fused stage budget, armed on real accelerators), "
+        "error taxonomy (OOM shrinks the bucket ladder before "
+        "quarantining; compile failures quarantine one stage "
+        "program; device-lost quarantines the device), node-wide "
+        "host failover with bit-identical verdicts, and live "
+        "reinstatement via known-answer probes; "
+        "--no-device-health leaves device errors to their callers",
+    )
+    beacon.add_argument(
+        "--health-probe-interval-s", type=float, default=5.0,
+        help="cadence of the reinstatement probe loop while the "
+        "device is quarantined (the tracker's exponential backoff "
+        "decides which ticks actually probe)",
+    )
     # -- observability knobs ------------------------------------------
     beacon.add_argument(
         "--monitored-validators", default=None,
@@ -462,6 +482,8 @@ async def _run_beacon(args) -> int:
         executor_bulk_queue=args.executor_bulk_queue,
         executor_maintenance_queue=args.executor_maintenance_queue,
         executor_aging_ms=args.executor_aging_ms,
+        device_health=args.device_health,
+        health_probe_interval_s=args.health_probe_interval_s,
     )
     node.notify_status()
     try:

@@ -203,6 +203,67 @@ def _submit_bulk(fn):
         return False, None
 
 
+# device fault domain (device/health.py): while the tracker
+# quarantines the device, MSM/Fr ride their host tiers (counted per
+# client on lodestar_device_failover_dispatches_total), and dispatch
+# exceptions route through the error taxonomy instead of a blanket
+# swallow — programming errors re-raise as the bugs they are.
+_HEALTH = None
+_LOG = None
+
+
+def set_health_tracker(tracker) -> None:
+    """Install (or clear, with None) the DeviceHealthTracker this
+    module's device tiers consult before dispatching."""
+    global _HEALTH
+    _HEALTH = tracker
+
+
+def _klog():
+    global _LOG
+    if _LOG is None:
+        from ..logger import get_logger
+
+        _LOG = get_logger("kzg")
+    return _LOG
+
+
+def _device_blocked(client: str) -> bool:
+    """True while the health tracker quarantines the device path.
+    Counts the failed-over dispatch and logs once per state
+    transition (not per call — a quarantined node sees thousands)."""
+    h = _HEALTH
+    if h is None or h.device_allowed():
+        return False
+    if h.note_failover(client):
+        _klog().warn(
+            "device quarantined: dispatches riding host tier",
+            {"client": client, "state": h.state.value},
+        )
+    return True
+
+
+def _report_device_fault(e: BaseException, client: str) -> None:
+    """Taxonomy routing for a device-dispatch exception: classify,
+    report to the tracker, log once per transition. PROGRAMMING
+    errors (TypeError/KeyError from our own code) re-raise — they
+    must surface as the bugs they are, not masquerade as device
+    flakiness absorbed by a fallback counter."""
+    from ..device.health import classify_device_error
+
+    kind = classify_device_error(e)
+    if kind == "programming":
+        raise e
+    h = _HEALTH
+    if h is not None:
+        h.record_fault(kind, client=client)
+        if h.should_log(client):
+            _klog().warn(
+                "device dispatch failed; host tier serves",
+                {"client": client, "kind": kind, "err": repr(e)},
+            )
+
+
 def msm_backend() -> str:
     """The live MSM backend mode."""
     return _msm_backend
@@ -313,6 +374,8 @@ def _evaluate_polynomials_batch(
         import jax
 
         use_device = jax.default_backend() == "tpu"
+    if use_device and _device_blocked("kzg_fr"):
+        use_device = False  # quarantined: Python ints serve exactly
     if use_device:
         roots = _roots_brp()
         ys: list[int | None] = [None] * len(zs)
@@ -356,8 +419,12 @@ def _evaluate_polynomials_batch(
                 _FR_DISPATCH["device"] += 1
                 return ys
             _FR_DEVICE_FALLBACKS += 1
-        except Exception:
+        except Exception as e:
+            # taxonomy (device/health.py): classify + report; a
+            # programming error re-raises inside, everything else
+            # stays a counted fallback onto the Python ints
             _FR_DEVICE_FALLBACKS += 1
+            _report_device_fault(e, "kzg_fr")
     _FR_DISPATCH["python"] += 1
     return [
         evaluate_polynomial_in_evaluation_form(p, z)
@@ -408,6 +475,10 @@ def _g1_lincomb_many(tasks):
     for pts, ks in tasks:
         assert len(pts) == len(ks)
     path = _resolve_msm_path(max(len(p) for p, _ in tasks))
+    if path == "device" and _device_blocked("kzg_msm"):
+        # quarantined: the host tiers serve bit-exactly (the
+        # differential suite proves device == native == oracle)
+        path = "native" if native.available() else "oracle"
     if path == "device":
         from ..ops import msm as _msm
 
@@ -423,8 +494,12 @@ def _g1_lincomb_many(tasks):
                 return out
             _MSM_DEVICE_FALLBACKS += 1
             path = "native" if native.available() else "oracle"
-        except Exception:
+        except Exception as e:
+            # taxonomy (device/health.py): classify + report; a
+            # programming error re-raises inside, device kinds stay
+            # counted fallbacks onto the host tiers
             _MSM_DEVICE_FALLBACKS += 1
+            _report_device_fault(e, "kzg_msm")
             path = "native" if native.available() else "oracle"
     if path == "native":
         _MSM_DISPATCH["native"] += 1
@@ -529,7 +604,11 @@ def dev_trusted_setup(cache_dir: str | None = None) -> TrustedSetup:
             g1 = [oc_from_hex(h) for h in data["g1_lagrange"]]
             g2 = [g2_from_json(v) for v in data["g2_monomial"]]
             return TrustedSetup(g1, g2)
-        except Exception:
+        except (ValueError, KeyError, OSError):
+            # corrupt/stale cache data (bad JSON, missing keys, bad
+            # hex/point bytes, unreadable file): regenerate below.
+            # Anything else — a programming error in the parse path —
+            # re-raises instead of silently burning the cache.
             cache.unlink()
 
     tau = int.from_bytes(sha256(_DEV_TAU_SEED).digest(), "big") % BLS_MODULUS

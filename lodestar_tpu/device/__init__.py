@@ -33,3 +33,12 @@ from .executor import (  # noqa: F401
     DeviceExecutor,
     bind_executor_collectors,
 )
+from .health import (  # noqa: F401
+    DeviceHealthTracker,
+    DeviceTimeout,
+    HealthState,
+    bind_health_collectors,
+    classify_device_error,
+    default_watchdog_deadlines,
+    make_device_probe,
+)

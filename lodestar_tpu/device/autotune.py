@@ -620,8 +620,14 @@ class DeviceAutotuner:
         clock=time.monotonic,
         logger=None,
         executor=None,
+        health=None,
     ):
         self.verifier = verifier
+        # device/health.py DeviceHealthTracker: while the device is
+        # quarantined every tune is a no-op — the tuner must neither
+        # probe a sick device nor mutate the knob config the host
+        # failover path was frozen under
+        self.health = health
         # node DeviceExecutor (device/executor.py): probes are
         # maintenance-class work — between candidates the tuner
         # yields the device to pending deadline traffic
@@ -646,6 +652,7 @@ class DeviceAutotuner:
         self.candidates_measured = 0
         self.last_duration_s = 0.0
         self.best_sets_per_sec = 0.0
+        self.suspended_runs = 0
         self.last_decision: dict | None = None
 
     # -- probing --------------------------------------------------------
@@ -798,6 +805,28 @@ class DeviceAutotuner:
         return backends, policy
 
     def _tune_locked(self, trigger: str) -> dict:
+        if (
+            self.health is not None
+            and not self.health.device_allowed()
+        ):
+            # frozen-config invariant: a quarantined device gets no
+            # probes and the live config stays exactly as it was at
+            # quarantine time (scenario fabric asserts this)
+            self.suspended_runs += 1
+            decision = {
+                "source": "suspended",
+                "trigger": trigger,
+                "reason": "device quarantined",
+                "state": self.health.state.value,
+                # the frozen live config — collectors index ["config"]
+                "config": current_config(self.verifier).to_dict(),
+            }
+            self.last_decision = decision
+            self.log.warn(
+                "autotune suspended: device quarantined",
+                {"trigger": trigger},
+            )
+            return decision
         t_start = self._clock()
         prev = current_config(self.verifier)
         platform = self._platform()
@@ -956,8 +985,17 @@ class DriftMonitor:
         min_window_s: float = 0.05,
         clock=time.monotonic,
         executor=None,
+        health=None,
     ):
         self.tuner = tuner
+        # device/health.py: a pending re-tune DEFERS while the device
+        # is quarantined (pending_stage is kept, so the re-tune lands
+        # after reinstatement instead of being lost)
+        self.health = (
+            health
+            if health is not None
+            else getattr(tuner, "health", None)
+        )
         self.telemetry = telemetry
         self.verifier = (
             verifier if verifier is not None else tuner.verifier
@@ -1063,6 +1101,14 @@ class DriftMonitor:
         correctness."""
         stage = self.pending_stage
         if stage is None:
+            return False
+        if (
+            self.health is not None
+            and not self.health.device_allowed()
+        ):
+            # defer, don't drop: pending_stage survives quarantine so
+            # the re-tune fires once the device is reinstated
+            self.retunes_blocked += 1
             return False
         if self.executor is not None:
             # executor path: one drain closes intake for EVERY device

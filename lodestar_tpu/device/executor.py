@@ -57,6 +57,8 @@ import time
 from collections import deque
 from concurrent.futures import Future
 
+from .health import DeviceTimeout
+
 QOS_DEADLINE = "deadline"
 QOS_BULK = "bulk"
 QOS_MAINTENANCE = "maintenance"
@@ -138,12 +140,35 @@ class LatencyHistogram:
 
 
 class _QueuedJob:
-    __slots__ = ("fn", "future", "submitted_at")
+    __slots__ = ("fn", "future", "submitted_at", "deadline_s", "abandoned")
 
-    def __init__(self, fn, future, submitted_at):
+    def __init__(self, fn, future, submitted_at, deadline_s=None):
         self.fn = fn
         self.future = future
         self.submitted_at = submitted_at
+        # per-job watchdog deadline override (None = the per-class
+        # default from watchdog_deadlines)
+        self.deadline_s = deadline_s
+        # set by the watchdog when it gives up on this job: the future
+        # is already failed with DeviceTimeout and a replacement worker
+        # owns the queues — the stuck worker must not touch shared
+        # state on its way out (if fn ever returns)
+        self.abandoned = False
+
+
+def _resolve_future(fut: Future, result, exc) -> None:
+    """Resolve a future, tolerating a concurrent resolution: the
+    watchdog may have already failed it with `DeviceTimeout` by the
+    time the worker's fn finally returns (or vice versa). First
+    writer wins; the late writer is a no-op instead of an
+    InvalidStateError crash in the worker thread."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except Exception:
+        pass
 
 
 class DeviceExecutor:
@@ -164,6 +189,8 @@ class DeviceExecutor:
         ),
         drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
         clock=time.monotonic,
+        watchdog_deadlines: dict | None = None,
+        watchdog_poll_s: float = 0.05,
     ):
         self._clock = clock
         self._bounds = dict(DEFAULT_QUEUE_BOUNDS)
@@ -185,6 +212,22 @@ class DeviceExecutor:
             cls: deque() for cls in QOS_CLASSES
         }
         self._running_cls: str | None = None
+        self._running_job: _QueuedJob | None = None
+        self._running_since = 0.0
+        # wave watchdog: per-class deadlines (seconds, None =
+        # unbounded). OFF by default — the node arms it with
+        # health.default_watchdog_deadlines() (the COVERAGE.md fused
+        # stage budget × per-class multiples) on real accelerators;
+        # under CPU emulation the budget doesn't hold, so deadlines
+        # stay explicit and opt-in.
+        self._watchdog_deadlines: dict[str, float | None] = {}
+        if watchdog_deadlines:
+            for cls, s in watchdog_deadlines.items():
+                self._check_cls(cls)
+                self._watchdog_deadlines[cls] = s
+        self._watchdog_poll_s = max(0.001, float(watchdog_poll_s))
+        self._watchdog_thread: threading.Thread | None = None
+        self._health = None  # DeviceHealthTracker, via set_health_tracker
         self._intake_closed = 0  # drained() nesting depth
         self._closed = False
         self._deferring = False  # current defer streak (count once)
@@ -200,10 +243,15 @@ class DeviceExecutor:
         self.maintenance_yields = 0
         self.drains = 0
         self.drains_blocked = 0
-        self._worker = threading.Thread(
-            target=self._run, name="device-executor", daemon=True
-        )
-        self._worker.start()
+        self.watchdog_trips = {cls: 0 for cls in QOS_CLASSES}
+        self.close_timeouts = 0
+        # worker generation: the watchdog abandons a hung worker by
+        # bumping the generation and spawning a replacement; a stale
+        # worker exits the moment it next observes the queues
+        self._worker_gen = 0
+        self._spawn_worker_locked()
+        if any(s is not None for s in self._watchdog_deadlines.values()):
+            self._ensure_watchdog_thread_locked()
 
     # -- client registration -------------------------------------------
 
@@ -223,6 +271,26 @@ class DeviceExecutor:
         with self._lock:
             self._quiescence_probes.append(probe)
 
+    def set_health_tracker(self, tracker, deadlines=None) -> None:
+        """Attach the DeviceHealthTracker: watchdog trips report to it
+        (`note_watchdog_trip`), and `deadlines` (per-class seconds,
+        e.g. health.default_watchdog_deadlines()) arm the wave
+        watchdog when given. deadlines=None leaves the configured
+        deadlines untouched — arming stays an explicit decision
+        because the fused-budget deadlines only mean something on the
+        hardware the budget was measured on."""
+        with self._lock:
+            self._health = tracker
+            if deadlines:
+                for cls, s in deadlines.items():
+                    self._check_cls(cls)
+                    self._watchdog_deadlines[cls] = s
+            if any(
+                s is not None
+                for s in self._watchdog_deadlines.values()
+            ):
+                self._ensure_watchdog_thread_locked()
+
     # -- admission ------------------------------------------------------
 
     def can_accept_work(self, cls: str = QOS_DEADLINE) -> bool:
@@ -240,11 +308,14 @@ class DeviceExecutor:
         bound = self._bounds[cls]
         return bound is None or len(self._queues[cls]) < bound
 
-    def submit(self, cls: str, fn) -> Future | None:
+    def submit(self, cls: str, fn, timeout_s: float | None = None) -> Future | None:
         """Queue fn() for the worker; returns a concurrent Future, or
         None when admission control sheds the job (bounded queue full,
         intake drained, or executor closed — counted per class+reason).
-        Shed callers fall back to their host tier; they never block."""
+        Shed callers fall back to their host tier; they never block.
+        timeout_s overrides the per-class watchdog deadline for this
+        one job (health probes pass their own explicit timeout since
+        the maintenance class is otherwise unbounded)."""
         self._check_cls(cls)
         with self._cond:
             if self._closed:
@@ -259,9 +330,11 @@ class DeviceExecutor:
                 return None
             fut: Future = Future()
             self._queues[cls].append(
-                _QueuedJob(fn, fut, self._clock())
+                _QueuedJob(fn, fut, self._clock(), deadline_s=timeout_s)
             )
             self._cond.notify_all()
+            if timeout_s is not None:
+                self._ensure_watchdog_thread_locked()
             return fut
 
     def note_shed(self, cls: str, reason: str) -> None:
@@ -429,11 +502,29 @@ class DeviceExecutor:
             return QOS_BULK, bq.popleft()
         return None
 
-    def _run(self) -> None:
+    def _spawn_worker_locked(self) -> None:
+        gen = self._worker_gen
+        self._worker = threading.Thread(
+            target=self._run,
+            args=(gen,),
+            # replacement workers carry the generation; clients key
+            # on the base name (the KZG bulk-lane test does)
+            name=(
+                "device-executor"
+                if gen == 0
+                else f"device-executor-r{gen}"
+            ),
+            daemon=True,
+        )
+        self._worker.start()
+
+    def _run(self, gen: int) -> None:
         while True:
             with self._cond:
                 picked = None
                 while picked is None:
+                    if gen != self._worker_gen:
+                        return  # abandoned: a replacement owns the queues
                     if self._closed:
                         self._reject_queued_locked()
                         return
@@ -450,20 +541,108 @@ class DeviceExecutor:
                         )
                 cls, job = picked
                 self._running_cls = cls
+                self._running_job = job
+                self._running_since = self._clock()
+            ran = False
+            res = exc = None
             try:
                 if job.future.set_running_or_notify_cancel():
+                    ran = True
                     try:
-                        job.future.set_result(job.fn())
+                        res = job.fn()
                     except BaseException as e:
-                        job.future.set_exception(e)
+                        exc = e
             finally:
                 with self._cond:
-                    self._running_cls = None
-                    self.completed[cls] += 1
-                    self.latency[cls].observe(
-                        self._clock() - job.submitted_at
-                    )
-                    self._cond.notify_all()
+                    if not job.abandoned:
+                        self._running_cls = None
+                        self._running_job = None
+                        self.completed[cls] += 1
+                        self.latency[cls].observe(
+                            self._clock() - job.submitted_at
+                        )
+                        self._cond.notify_all()
+            if ran:
+                # outside the lock; a no-op if the watchdog already
+                # failed this future with DeviceTimeout
+                _resolve_future(job.future, res, exc)
+            if job.abandoned:
+                # this thread was given up on while fn was stuck; the
+                # replacement worker owns _running_* and the queues
+                return
+
+    # -- wave watchdog --------------------------------------------------
+
+    def _ensure_watchdog_thread_locked(self) -> None:
+        if self._watchdog_thread is not None or self._closed:
+            return
+        t = threading.Thread(
+            target=self._watchdog_loop,
+            name="device-executor-watchdog",
+            daemon=True,
+        )
+        self._watchdog_thread = t
+        t.start()
+
+    def _watchdog_loop(self) -> None:
+        # real sleep for pacing, but all deadline math goes through
+        # self._clock so tests drive watchdog_check() with ManualClock
+        while not self._closed:
+            time.sleep(self._watchdog_poll_s)
+            try:
+                self.watchdog_check()
+            except Exception:
+                continue
+
+    def _effective_deadline_locked(self, job, cls) -> float | None:
+        if job.deadline_s is not None:
+            return job.deadline_s
+        return self._watchdog_deadlines.get(cls)
+
+    def watchdog_check(self) -> list[str]:
+        """One watchdog pass: if the running job has overrun its
+        per-class deadline, fail its future with `DeviceTimeout`, mark
+        it abandoned, bump the worker generation, and spawn a
+        replacement worker — the queue keeps moving while the stuck
+        thread blocks on the device call forever. Reports the trip to
+        the attached health tracker. Public so tests (and the
+        scenario fabric) can drive it with a ManualClock instead of
+        waiting out the poll loop. Returns the classes tripped."""
+        tripped = []
+        with self._cond:
+            job = self._running_job
+            cls = self._running_cls
+            if job is not None and cls is not None and not job.abandoned:
+                deadline = self._effective_deadline_locked(job, cls)
+                if deadline is not None:
+                    elapsed = self._clock() - self._running_since
+                    if elapsed > deadline:
+                        job.abandoned = True
+                        self.watchdog_trips[cls] = (
+                            self.watchdog_trips.get(cls, 0) + 1
+                        )
+                        self._running_job = None
+                        self._running_cls = None
+                        self._worker_gen += 1
+                        self._spawn_worker_locked()
+                        self._cond.notify_all()
+                        tripped.append((cls, job, elapsed, deadline))
+            health = self._health
+        for cls, job, elapsed, deadline in tripped:
+            _resolve_future(
+                job.future,
+                None,
+                DeviceTimeout(
+                    f"{cls} dispatch overran its watchdog deadline "
+                    f"({elapsed:.3f}s > {deadline:.3f}s)"
+                ),
+            )
+            if health is not None:
+                try:
+                    health.note_watchdog_trip(cls)
+                except Exception:
+                    pass
+        return [cls for cls, *_ in tripped]
 
     def _reject_queued_locked(self) -> None:
         for cls in QOS_CLASSES:
@@ -479,13 +658,34 @@ class DeviceExecutor:
     def close(self, timeout_s: float = 5.0) -> None:
         """Stop admitting, let the running job finish, cancel queued
         futures (counted as sheds, reason='closed'), stop the worker.
-        Idempotent."""
+        Idempotent.
+
+        A PERMANENTLY HUNG running job cannot hold close hostage: the
+        join is bounded by timeout_s, after which the hang is counted
+        (`close_timeouts`, exported as
+        lodestar_device_executor_close_timeouts_total) and the queued
+        futures are cancelled HERE — the hung worker is blocked
+        inside job.fn() and may never reach its own
+        _reject_queued_locked, so waiting on it would leak every
+        queued future as forever-pending. The running job itself is
+        NOT failed by close: a merely-slow job still resolves its
+        future when fn returns (and the worker then exits on the
+        closed flag); a truly hung job's future is the wave
+        watchdog's to fail with `DeviceTimeout` when deadlines are
+        armed. The left-behind thread is a daemon; it dies with the
+        process."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
             self._cond.notify_all()
-        self._worker.join(timeout=timeout_s)
+            worker = self._worker
+        worker.join(timeout=timeout_s)
+        if not worker.is_alive():
+            return
+        with self._cond:
+            self.close_timeouts += 1
+            self._reject_queued_locked()
 
     @property
     def closed(self) -> bool:
@@ -544,4 +744,7 @@ def bind_executor_collectors(metrics, executor: DeviceExecutor) -> None:
     )
     metrics.intake_open.add_collect(
         lambda g: g.set(1.0 if executor.intake_open() else 0.0)
+    )
+    metrics.close_timeouts_total.add_collect(
+        lambda g: g.set(executor.close_timeouts)
     )

@@ -855,6 +855,64 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
         "1 while the executor admits work; 0 during a drain or"
         " after close",
     )
+    dx.close_timeouts_total = reg.gauge(
+        "lodestar_device_executor_close_timeouts_total",
+        "close(timeout_s) calls that timed out joining the worker (a"
+        " hung running job): close returned anyway with queued"
+        " futures cancelled and the hang counted here",
+    )
+
+    # -- device health (device/health.py fault domain) -------------------
+    # The accelerator's fault domain: the ONLINE/DEGRADED/QUARANTINED/
+    # PROBING state machine, wave-watchdog trips, node-wide host
+    # failover accounting, and probe-driven reinstatement. Drives the
+    # "Device fault domain" rows of
+    # dashboards/lodestar_tpu_device.json.
+    dh = SimpleNamespace()
+    m.device_health = dh
+    dh.state = reg.gauge(
+        "lodestar_device_health_state",
+        "Device health state: 0=online 1=degraded 2=quarantined"
+        " 3=probing (device/health.py HEALTH_STATE_INDEX)",
+    )
+    dh.watchdog_trips_total = reg.gauge(
+        "lodestar_device_watchdog_trips_total",
+        "Wave-watchdog deadline overruns by QoS class: the dispatch"
+        " was abandoned, its future failed with DeviceTimeout, and a"
+        " replacement worker took the queues",
+        label_names=("cls",),
+    )
+    dh.failover_dispatches_total = reg.gauge(
+        "lodestar_device_failover_dispatches_total",
+        "Dispatches served by a host tier because the device path was"
+        " quarantined, by client (bls / kzg_msm / kzg_fr) — verdicts"
+        " stay bit-identical on the host oracle",
+        label_names=("client",),
+    )
+    dh.probe_total = reg.gauge(
+        "lodestar_device_probe_total",
+        "Known-answer reinstatement probes by outcome"
+        " (success / failure); N consecutive successes reopen the"
+        " device path and re-kick warmup",
+        label_names=("outcome",),
+    )
+    dh.faults_total = reg.gauge(
+        "lodestar_device_faults_total",
+        "Device faults recorded by taxonomy kind (oom / compile /"
+        " device_lost / timeout / unknown); programming errors"
+        " re-raise at the call site and never land here",
+        label_names=("kind",),
+    )
+    dh.quarantines_total = reg.gauge(
+        "lodestar_device_quarantines_total",
+        "Times the health breaker opened (node-wide failover to the"
+        " host tiers; warmup/autotune suspended)",
+    )
+    dh.reinstatements_total = reg.gauge(
+        "lodestar_device_reinstatements_total",
+        "Times a probe sequence reopened the device path (warmup"
+        " re-kicked for whatever went cold)",
+    )
 
     # -- kzg / data availability (crypto/kzg.py three-tier MSM) ----------
     # The second device workload: blob-batch KZG verification routes

@@ -99,6 +99,13 @@ class BeaconNode:
         executor_bulk_queue: int = 64,
         executor_maintenance_queue: int = 32,
         executor_aging_ms: float = 2000.0,
+        # -- device fault domain (device/health.py) --
+        # wave watchdog + error taxonomy + circuit-broken host
+        # failover + live probe reinstatement. Watchdog deadlines
+        # (multiples of the fused stage budget) arm only on a real
+        # accelerator: CPU dispatches legitimately dwarf the budget
+        device_health: bool = True,
+        health_probe_interval_s: float = 5.0,
     ):
         self.cfg = cfg
         self.types = types
@@ -158,6 +165,10 @@ class BeaconNode:
         self.executor_maintenance_queue = executor_maintenance_queue
         self.executor_aging_ms = executor_aging_ms
         self.executor = None
+        self.device_health_enabled = device_health
+        self.health_probe_interval_s = health_probe_interval_s
+        self.health_tracker = None
+        self._probe_task: asyncio.Task | None = None
         # device/compiler telemetry: singleton installed here so the
         # jax.monitoring listeners and the kernels' instrumented stage
         # wrappers route into THIS node's registry
@@ -364,6 +375,68 @@ class BeaconNode:
                     "aging_ms": node.executor_aging_ms,
                 },
             )
+        # device fault domain: one tracker is the single source of
+        # truth every accelerator client consults. Wired AFTER the
+        # executor (the watchdog + probe ride it) and BEFORE autotune
+        # (a tune against a quarantined device must suspend).
+        if node.device_health_enabled:
+            from .bls import kernels as _kernels_h
+            from .crypto import kzg as _kzg_h
+            from .device import health as _health
+
+            warm_kick = None
+            if node.bls_warmup and hasattr(
+                node.chain.verifier, "start_warmup"
+            ):
+                warm_kick = node.chain.verifier.start_warmup
+            node.health_tracker = _health.DeviceHealthTracker(
+                warmup_kick=warm_kick,
+                logger=get_logger("device-health"),
+            )
+            import jax as _jax
+
+            on_accel = _jax.default_backend() != "cpu"
+            # warmup suspends while quarantined; kzg MSM/Fr ride
+            # their host tiers; the verifier's buckets short-circuit
+            # to the bit-identical host oracle
+            _kernels_h.set_health_gate(
+                node.health_tracker.device_allowed
+            )
+            _kzg_h.set_health_tracker(node.health_tracker)
+            if hasattr(node.chain.verifier, "attach_health"):
+                node.chain.verifier.attach_health(
+                    node.health_tracker,
+                    # None adopts the fused-budget deadline; 0 leaves
+                    # the wave watchdog unarmed (CPU backends)
+                    wave_timeout_s=None if on_accel else 0,
+                )
+            if node.executor is not None:
+                node.executor.set_health_tracker(
+                    node.health_tracker,
+                    deadlines=(
+                        _health.default_watchdog_deadlines()
+                        if on_accel
+                        else None
+                    ),
+                )
+            node.health_tracker.set_probe(
+                _health.make_device_probe(executor=node.executor)
+            )
+            node._probe_task = asyncio.ensure_future(
+                node._health_probe_loop()
+            )
+            _health.bind_health_collectors(
+                node.metrics.device_health, node.health_tracker
+            )
+            log.info(
+                "device fault domain up",
+                {
+                    "watchdog_armed": on_accel,
+                    "probe_interval_s": (
+                        node.health_probe_interval_s
+                    ),
+                },
+            )
         # device auto-tuning: close the telemetry->knobs loop. The
         # startup tune micro-benches the candidate grid through the
         # persistent compilation cache and applies the winner via the
@@ -384,6 +457,7 @@ class BeaconNode:
                 mode=node.autotune_mode,
                 logger=get_logger("autotune"),
                 executor=node.executor,
+                health=node.health_tracker,
             )
             await asyncio.get_running_loop().run_in_executor(
                 None, node.autotuner.tune
@@ -394,6 +468,7 @@ class BeaconNode:
                     node.device_telemetry,
                     verifier=node.chain.verifier,
                     executor=node.executor,
+                    health=node.health_tracker,
                 )
                 node._drift_task = asyncio.ensure_future(
                     node.drift_monitor.run()
@@ -1019,11 +1094,43 @@ class BeaconNode:
             self.chain.justified_checkpoint.epoch
         )
 
+    async def _health_probe_loop(self) -> None:
+        """Reinstatement driver: while the device path is closed, run
+        the maintenance-class known-answer probe on the tracker's
+        backoff schedule. The probe blocks on device work, so it runs
+        in an executor thread; the tracker itself decides whether a
+        probe is due (breaker backoff), this loop only supplies the
+        cadence."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.health_probe_interval_s)
+            tracker = self.health_tracker
+            if tracker is None or tracker.device_allowed():
+                continue
+            try:
+                await loop.run_in_executor(None, tracker.maybe_probe)
+            except Exception as e:  # the loop must outlive any probe
+                self.log.warn(
+                    "device health probe loop error", {"err": repr(e)}
+                )
+
     async def close(self) -> None:
         """Reverse-order shutdown (graceful SIGINT path)."""
         if self._drift_task is not None:
             self._drift_task.cancel()
             self._drift_task = None
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
+        if self.health_tracker is not None:
+            # detach the module-level health hooks (other nodes or
+            # tests in this process must not consult a dead tracker)
+            from .bls import kernels as _kernels_health
+            from .crypto import kzg as _kzg_health
+
+            _kernels_health.set_health_gate(None)
+            _kzg_health.set_health_tracker(None)
+            self.health_tracker = None
         if self.executor is not None:
             # detach the module-level hooks FIRST (other nodes or
             # tests in this process must not route through a closed

@@ -28,6 +28,12 @@ internals:
   flood the peers' gossip seen-cache must absorb.
 * `kill_node` / `restart_node` — take a node's network down
   mid-run and bring it back, resyncing its chain from a healthy peer.
+* `DeviceFaultInjector` (+ `device_hang` / `device_error` /
+  `device_oom` / `device_flaky` factories) — wraps the BLS kernel
+  entry points with fabricated JAX-runtime-shaped failures so the
+  device fault domain (device/health.py watchdog, taxonomy, breaker,
+  host failover, probe reinstatement) is exercised end-to-end without
+  a sick chip.
 * `FaultSchedule` — slot-driven fault windows riding the simulation's
   `on_slot_hooks`.
 * `FaultRegistry` — aggregates every injector's delivered-fault
@@ -40,6 +46,7 @@ internals:
 from __future__ import annotations
 
 import asyncio
+import threading
 
 from ..execution.engine import ExecutionEngineError
 from ..resilience import FaultInspectionWindow
@@ -260,6 +267,156 @@ class LateBlockReplayer:
 
         asyncio.ensure_future(later())
         return 0
+
+
+_DEVICE_ERROR_MESSAGES = {
+    # messages are crafted to hit health.classify_device_error's
+    # status-code markers — the same message-based routing a real
+    # XlaRuntimeError takes, so injected and organic faults classify
+    # identically (oom checked before compile before device_lost)
+    "oom": (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "2147483648 bytes (injected)"
+    ),
+    "compile": (
+        "Mosaic compilation failed: unsupported lowering for fused "
+        "pairing stage (injected)"
+    ),
+    "device_lost": (
+        "INTERNAL: device lost: TPU runtime halted (injected)"
+    ),
+    "unknown": "injected device fault of no particular shape",
+}
+
+
+class InjectedDeviceError(RuntimeError):
+    """Fabricated JAX-runtime-shaped device failure. The taxonomy
+    buckets it by MESSAGE (status-code markers), exactly as it would
+    a real runtime error whose type jaxlib keeps moving around."""
+
+
+class DeviceFaultInjector:
+    """Wraps the BLS kernel entry points (bls/kernels.py module
+    attributes — the verifier binds them late, at dispatch time, so a
+    module-attribute patch intercepts every device dispatch) with a
+    fault policy:
+
+    * ``hang``  — every dispatch blocks on an Event until `release()`
+      or `detach()`, then raises; the wave watchdog must fire and the
+      worker thread must not wedge the executor.
+    * ``error`` — every dispatch raises an InjectedDeviceError whose
+      message classifies as `kind` ('oom' | 'compile' | 'device_lost'
+      | 'unknown').
+    * ``flaky`` — each dispatch raises with probability `p`
+      (deterministic when given an rng), else passes through.
+
+    Use the `device_hang` / `device_error` / `device_oom` /
+    `device_flaky` factories; `active` toggles the policy without
+    unpatching (for FaultSchedule windows)."""
+
+    ENTRY_POINTS = (
+        "run_verify_batch_async",
+        "run_verify_batch",
+        "run_verify_same_message",
+        "run_verify_batch_ingest_async",
+        "run_verify_same_message_ingest_async",
+        "run_verify_batch_mesh",
+        "run_verify_same_message_mesh",
+        "run_verify_batch_ingest_mesh",
+    )
+
+    def __init__(self, mode: str = "error", kind: str = "device_lost",
+                 p: float = 1.0, rng=None, label: str | None = None):
+        if mode not in ("hang", "error", "flaky"):
+            raise ValueError(f"unknown device fault mode {mode!r}")
+        if kind not in _DEVICE_ERROR_MESSAGES:
+            raise ValueError(f"unknown device fault kind {kind!r}")
+        from ..bls import kernels
+
+        self._kernels = kernels
+        self.mode = mode
+        self.kind = kind
+        self.p = float(p)
+        self.rng = rng
+        self.label = label or f"device_{mode}"
+        self.active = True
+        self.injected = 0
+        self.passed = 0
+        self._release = threading.Event()
+        self._orig: dict = {}
+        for name in self.ENTRY_POINTS:
+            fn = getattr(kernels, name)
+            self._orig[name] = fn
+            setattr(kernels, name, self._wrap(fn))
+
+    def set_active(self, active: bool) -> None:
+        self.active = bool(active)
+
+    def release(self) -> None:
+        """Unblock every dispatch hung in `hang` mode (they raise on
+        wake — by then the watchdog has already failed their futures,
+        so the late error is discarded, not surfaced as a verdict)."""
+        self._release.set()
+
+    def detach(self) -> None:
+        for name, fn in self._orig.items():
+            setattr(self._kernels, name, fn)
+        self._orig.clear()
+        self._release.set()
+
+    def injected_fault_counts(self) -> dict:
+        return {self.label: self.injected}
+
+    def _roll(self) -> float:
+        import random
+
+        return (self.rng or random).random()
+
+    def _wrap(self, fn):
+        def dispatch(*a, **kw):
+            if not self.active:
+                self.passed += 1
+                return fn(*a, **kw)
+            if self.mode == "flaky" and self._roll() >= self.p:
+                self.passed += 1
+                return fn(*a, **kw)
+            self.injected += 1
+            if self.mode == "hang":
+                self._release.wait()
+                raise InjectedDeviceError(
+                    _DEVICE_ERROR_MESSAGES["device_lost"]
+                    + " (released after hang)"
+                )
+            raise InjectedDeviceError(_DEVICE_ERROR_MESSAGES[self.kind])
+
+        dispatch.__name__ = getattr(fn, "__name__", "dispatch")
+        return dispatch
+
+
+def device_hang() -> DeviceFaultInjector:
+    """Every device dispatch hangs until release()/detach()."""
+    return DeviceFaultInjector(mode="hang", label="device_hang")
+
+
+def device_error(kind: str = "device_lost") -> DeviceFaultInjector:
+    """Every device dispatch raises a `kind`-shaped runtime error."""
+    return DeviceFaultInjector(
+        mode="error", kind=kind, label="device_error"
+    )
+
+
+def device_oom() -> DeviceFaultInjector:
+    """Every device dispatch raises RESOURCE_EXHAUSTED (the shrink-
+    ladder-before-quarantine path)."""
+    return DeviceFaultInjector(mode="error", kind="oom",
+                               label="device_oom")
+
+
+def device_flaky(p: float, rng=None,
+                 kind: str = "device_lost") -> DeviceFaultInjector:
+    """Each device dispatch fails with probability `p`."""
+    return DeviceFaultInjector(mode="flaky", kind=kind, p=p, rng=rng,
+                               label="device_flaky")
 
 
 _EQUIVOCATION_GRAFFITI = b"equivocation".ljust(32, b"\x00")

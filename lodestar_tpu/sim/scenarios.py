@@ -45,6 +45,11 @@ Regimes (SCENARIOS registry, also tabulated in SCENARIOS.md):
   catches up at once; catch-up completes (caught_up_blocks matches
   what was missed), the surviving node's duties never stop, and
   finality resumes.
+* device_loss_under_load — the ISSUE-19 fault drill: a mid-wave
+  device hang trips the wave watchdog, quarantines the device, and
+  the remaining gossip fails over to the host path bit-identically;
+  the autotuner freezes while quarantined, and known-answer probes
+  reinstate the device live (warmup re-kicked).
 
 `tools/run_scenarios.py` is the operator CLI (runs the registry,
 emits a provenance-stamped SCENARIOS.json); tests/test_scenarios.py
@@ -970,6 +975,201 @@ def _drifted_shares(AT, stage: str = "pairing", delta: float = 0.16):
         shares[s] -= give
         remaining -= give
     return shares
+
+
+# ---------------------------------------------------------------------------
+# regime 5b: device loss under live gossip load (the device fault
+# domain end-to-end: watchdog -> taxonomy -> quarantine -> host
+# failover -> probe reinstatement)
+# ---------------------------------------------------------------------------
+
+
+@scenario(
+    "device_loss_under_load",
+    "every device dispatch hangs mid-gossip: the wave watchdog trips, "
+    "verdicts ride the bit-identical host oracle (zero lost, zero "
+    "wrong), the autotuner freezes while QUARANTINED, and a "
+    "known-answer probe sequence reinstates the device path",
+    faults=("device_hang",),
+    slos=("verdicts_none_lost", "verdicts_bit_identical",
+          "watchdog_tripped", "device_quarantined",
+          "failover_served_gossip", "failover_p99_bounded",
+          "autotuner_frozen_while_quarantined",
+          "probe_reinstates_device"),
+)
+async def device_loss_under_load(ctx: ScenarioContext) -> None:
+    from types import SimpleNamespace
+
+    from ..bls import SignatureSet, kernels as K
+    from ..bls.verifier import TpuBlsVerifier
+    from ..crypto.bls import signature as sig
+    from ..device import autotune as AT
+    from ..device.health import DeviceHealthTracker, HealthState
+    from ..resilience.clock import ManualClock
+    from .faults import device_hang
+
+    def mk_sets(tag: int, n: int = 2, good: bool = True):
+        out = []
+        for i in range(n):
+            sk = 4200 + tag * 8 + i
+            msg = bytes([tag, i]) + b"\x00" * 30
+            s = sig.sign(sk, msg)
+            if not good and i == n - 1:
+                b = bytearray(s)
+                b[20] ^= 0xFF
+                s = bytes(b)
+            out.append(SignatureSet(sig.sk_to_pk(sk), msg, s))
+        return out
+
+    n_calls = 8 if ctx.smoke else 16
+    bad_call = 3  # one tampered job proves failover verdicts can say NO
+    calls = [
+        (mk_sets(t, good=(t != bad_call)), t != bad_call)
+        for t in range(n_calls)
+    ]
+
+    clock = ManualClock()
+    kicked: list[int] = []
+    tracker = DeviceHealthTracker(
+        name="scenario-device",
+        clock=clock,
+        failure_threshold=2,
+        quarantine_reset_s=0.05,
+        probe_successes=2,
+        ladder_shrink=lambda: False,  # no OOM here; never touch knobs
+        warmup_kick=lambda: kicked.append(1),
+        logger=SimpleNamespace(
+            info=lambda *a, **k: None, warn=lambda *a, **k: None
+        ),
+    )
+    verifier = TpuBlsVerifier(max_buffer_wait_ms=5, mesh=False)
+    # short real-clock wave deadline: the hang must trip it, not the
+    # test runner's patience (the watchdog rides asyncio.wait_for, so
+    # the injected ManualClock only drives the probe backoff)
+    verifier.attach_health(tracker, wave_timeout_s=0.35)
+    injector = ctx.registry.track(device_hang())
+    try:
+        results: list[bool] = []
+        failover_lat: list[float] = []
+        saw_quarantined = False
+        for sets, _want in calls:
+            pre_failover = not tracker.device_allowed()
+            t0 = time.monotonic()
+            ok = await verifier.verify_signature_sets(sets)
+            dt = time.monotonic() - t0
+            results.append(bool(ok))
+            if pre_failover:
+                # post-quarantine calls short-circuit to the host
+                # oracle — the failover latency the SLO bounds
+                failover_lat.append(dt)
+            saw_quarantined = (
+                saw_quarantined
+                or tracker.state is HealthState.quarantined
+            )
+
+        ctx.slo(
+            "verdicts_none_lost",
+            len(results) == n_calls,
+            {"resolved": len(results), "submitted": n_calls},
+            f"{n_calls} resolved",
+            "every gossip verdict resolves despite the hung device",
+        )
+        expected = [want for _, want in calls]
+        ctx.slo(
+            "verdicts_bit_identical",
+            results == expected,
+            {"wrong": [i for i, (r, w) in
+                       enumerate(zip(results, expected)) if r != w]},
+            "[]",
+            "host-failover verdicts match the known ground truth "
+            "(including the tampered job's False)",
+        )
+        ctx.slo_ge(
+            "watchdog_tripped",
+            tracker.watchdog_trips.get("deadline", 0), 1,
+            "the wave watchdog fired on the hung dispatch",
+        )
+        ctx.slo(
+            "device_quarantined",
+            saw_quarantined and tracker.quarantines >= 1,
+            {"saw_quarantined": saw_quarantined,
+             "quarantines": tracker.quarantines},
+            "quarantined >= once",
+            "consecutive watchdog trips opened the breaker",
+        )
+        ctx.slo(
+            "failover_served_gossip",
+            tracker.failover_dispatches.get("bls", 0) >= 1
+            and verifier.metrics.dispatch_by_path["failover"] >= 1
+            and len(failover_lat) >= 1,
+            {"failovers": tracker.failover_dispatches,
+             "path": dict(verifier.metrics.dispatch_by_path),
+             "failover_calls": len(failover_lat)},
+            "failover dispatches > 0",
+            "post-quarantine buckets rode the host oracle",
+        )
+        ctx.slo_le(
+            "failover_p99_bounded",
+            round(_quantile(failover_lat, 0.99), 3), 2.0,
+            "host-failover verdict turnaround (no watchdog wait)",
+        )
+
+        # frozen-config invariant: a tune attempted while QUARANTINED
+        # must suspend — no probes, no knob movement
+        quiet_log = SimpleNamespace(
+            info=lambda *a, **k: None, warn=lambda *a, **k: None
+        )
+        bench = lambda backend, bucket: AT.Measurement(
+            backend=backend, bucket=bucket, pipeline="batch",
+            seconds_per_dispatch=bucket / 400.0, sets_per_sec=400.0,
+            runs=3, warm_seconds=0.0,
+        )
+        tuner = AT.DeviceAutotuner(
+            verifier=_KnobVerifier(), grid=AT.parse_grid("backend=vpu"),
+            bench=bench, artifact_path=None, logger=quiet_log,
+            health=tracker,
+        )
+        cfg_before = (K.ingest_min_bucket(), K.ladder_top())
+        decision = tuner.tune(trigger="drift:scenario")
+        cfg_after = (K.ingest_min_bucket(), K.ladder_top())
+        ctx.slo(
+            "autotuner_frozen_while_quarantined",
+            decision.get("source") == "suspended"
+            and tuner.suspended_runs >= 1
+            and cfg_before == cfg_after,
+            {"source": decision.get("source"),
+             "suspended_runs": tuner.suspended_runs,
+             "before": cfg_before, "after": cfg_after},
+            "source=suspended, knobs frozen",
+            "no probe and no knob movement while QUARANTINED",
+        )
+
+        # reinstatement: restore the kernels FIRST (the probe's device
+        # would still hang), then drive the backoff + probe sequence
+        injector.detach()
+        clock.advance(0.06)  # past quarantine_reset_s
+        first = tracker.maybe_probe(lambda: True)
+        second = tracker.maybe_probe(lambda: True)
+        ctx.slo(
+            "probe_reinstates_device",
+            first is True and second is True
+            and tracker.state is HealthState.online
+            and tracker.device_allowed()
+            and tracker.reinstatements == 1
+            and len(kicked) == 1,
+            {"probes": tracker.probes, "state": tracker.state.value,
+             "reinstatements": tracker.reinstatements,
+             "warmup_kicks": len(kicked)},
+            "2 probe successes -> ONLINE + warmup re-kick",
+            "the known-answer probe sequence reopened the device path",
+        )
+        ctx.slo_faults_fired("device_hang")
+    finally:
+        # detach is idempotent; it also releases any dispatch still
+        # hung in the default executor so asyncio.run can shut its
+        # thread pool down instead of joining a wedged thread forever
+        injector.detach()
+        await verifier.close()
 
 
 # ---------------------------------------------------------------------------

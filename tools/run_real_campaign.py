@@ -36,6 +36,12 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
                       saturates the bulk lane: deadline p50/p99 with
                       vs without contention, bulk sheds, deferral
                       counts -> EXECUTOR_CONTENTION_real.json
+  10. fault_drill   — the device fault domain end to end: injected
+                      wave hang -> watchdog trip -> quarantine ->
+                      bit-identical host failover -> probe
+                      reinstatement (the device_loss_under_load
+                      scenario, full profile, per-SLO verdicts)
+                      -> FAULT_DRILL_real.json
 
 `--dry-run` emits the full campaign plan (commands, artifacts,
 prerequisites) as JSON without executing anything — reviewable on
@@ -210,6 +216,18 @@ def build_plan(args) -> list[dict]:
             "artifact": "EXECUTOR_CONTENTION_real.json",
             "needs": ["autotune"],
         },
+        {
+            "name": "fault_drill",
+            "why": "the robustness guarantee next to the perf "
+            "numbers: a hung device mid-wave must cost the node its "
+            "speed-up, never its correctness — wave-watchdog trip -> "
+            "quarantine -> bit-identical host failover -> autotuner "
+            "frozen -> probe reinstatement, each an SLO row "
+            "(device/health.py; scenario device_loss_under_load)",
+            "fn": "fault_drill",
+            "artifact": "FAULT_DRILL_real.json",
+            "needs": ["preflight"],
+        },
     ]
 
 
@@ -355,6 +373,41 @@ def step_executor_contention(args) -> dict:
     return out
 
 
+def step_fault_drill(args) -> dict:
+    """The device fault domain exercised end to end: the
+    device_loss_under_load scenario at the full profile — an injected
+    mid-wave hang trips the wave watchdog, quarantines the device,
+    fails the remaining buckets over to the host path (verdicts
+    bit-identical), freezes the autotuner, then reinstates via
+    known-answer probes — with every guarantee an explicit SLO row in
+    FAULT_DRILL_real.json. Deterministic (injected faults + manual
+    breaker clock), so the same drill gates tier-1 on CPU; here it
+    proves the failover seams against the real dispatch stack. A
+    failed SLO row fails the step (and so the campaign)."""
+    from lodestar_tpu.sim.scenarios import run_scenario
+    from lodestar_tpu.utils.provenance import provenance
+
+    res = run_scenario(
+        "device_loss_under_load", profile="full", seed=args.drill_seed
+    )
+    out = dict(res.to_dict())
+    out["provenance"] = provenance()
+    with open(os.path.join(REPO, "FAULT_DRILL_real.json"), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    if res.error:
+        raise RuntimeError(
+            f"fault drill crashed:\n{res.error}"
+        )
+    failed = [s.name for s in res.slos if not s.passed]
+    if failed:
+        raise RuntimeError(
+            f"fault drill SLO rows failed: {failed} "
+            "(see FAULT_DRILL_real.json)"
+        )
+    return out
+
+
 def run(args) -> int:
     plan = build_plan(args)
     want = (
@@ -400,6 +453,7 @@ def run(args) -> int:
         "preflight": step_preflight,
         "autotune": step_autotune,
         "executor_contention": step_executor_contention,
+        "fault_drill": step_fault_drill,
     }
     for st in plan:
         if st["name"] not in want:
@@ -496,6 +550,14 @@ def main() -> int:
         type=float,
         default=20.0,
         help="gossip arrival gap in the executor-contention step",
+    )
+    p.add_argument(
+        "--drill-seed",
+        type=int,
+        default=20260807,
+        help="scenario seed for the fault_drill step (matches the "
+        "scenario fleet's default; the drill is deterministic, so "
+        "one seed reproduces one transcript)",
     )
     p.add_argument(
         "--allow-cpu",

@@ -33,6 +33,13 @@ run_tier1() {
   # regression surfaces in seconds instead of minutes into the run
   JAX_PLATFORMS=cpu python -m pytest tests/test_device_executor.py -q \
     -m 'not slow' -p no:cacheprovider || exit 1
+  # device fault-domain suite (watchdog / taxonomy / quarantine
+  # failover / probe reinstatement), standalone and ahead of the main
+  # line: it drives the health state machine with manual clocks and
+  # stubbed kernels, so a fault-handling regression surfaces in
+  # seconds — the deterministic fault drill of the tier
+  JAX_PLATFORMS=cpu python -m pytest tests/test_device_health.py -q \
+    -m 'not slow' -p no:cacheprovider || exit 1
   # scenario-fleet smoke slice, standalone for the same reason: the
   # two single-process regimes (device-executor blob firehose with
   # the autotuner-holds-still invariant, gossip-burst backpressure)
@@ -42,9 +49,11 @@ run_tier1() {
     tests/test_sim_faults.py -q -m 'not slow' -p no:cacheprovider \
     || exit 1
   # the same slice through the operator CLI: exercises the registry
-  # -> SLO-contract -> provenance-stamped artifact path end to end
+  # -> SLO-contract -> provenance-stamped artifact path end to end;
+  # device_loss_under_load is the injected-fault drill (hang -> wave
+  # watchdog -> quarantine -> host failover -> probe reinstatement)
   JAX_PLATFORMS=cpu python tools/run_scenarios.py \
-    --only blob_firehose_under_load \
+    --only blob_firehose_under_load,device_loss_under_load \
     --json /tmp/lodestar_scenarios_smoke.json || exit 1
   # pytest line matches ROADMAP.md "Tier-1 verify" plus --durations=25:
   # the per-test timing artifact tracks suite-runtime creep per PR
