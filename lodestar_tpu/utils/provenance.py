@@ -63,19 +63,46 @@ def provenance() -> dict:
     except Exception:
         pass
     stamp["git_rev"] = _git_rev()
+    stamp["git_dirty"] = _git_dirty()
     return stamp
+
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(__file__))
+)
 
 
 def _git_rev() -> str | None:
     try:
         out = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            cwd=_REPO_ROOT,
             capture_output=True,
             text=True,
             timeout=5,
         )
         rev = out.stdout.strip()
         return rev or None
+    except Exception:
+        return None
+
+
+def _git_dirty() -> bool | None:
+    """True when tracked files differ from git_rev — an artifact
+    stamped dirty cannot be reproduced from its revision, so the rev
+    alone must not be read as provenance. Untracked files are
+    ignored: generated artifacts and review scratch sit untracked
+    next to the repo without changing the code under measurement."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            cwd=_REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if out.returncode != 0:
+            return None
+        return bool(out.stdout.strip())
     except Exception:
         return None

@@ -47,7 +47,10 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
 prerequisites) as JSON without executing anything — reviewable on
 this CPU container, runnable on the TPU host. `--steps` selects a
 subset; a failed step aborts the remainder (later steps consume
-earlier artifacts).
+earlier artifacts). The `_real` artifact suffix is reserved for
+TPU-attached runs: off-TPU (`--allow-cpu` smoke) every step writes
+`*_cpu.json` instead, so an emulation rehearsal can never be
+mistaken for the hardware measurement.
 
 Usage:
   python tools/run_real_campaign.py --dry-run
@@ -68,14 +71,37 @@ sys.path.insert(0, REPO)
 
 PY = sys.executable
 
-AUTOTUNE_ARTIFACT = "AUTOTUNE_real.json"
+_SUFFIX: str | None = None
+
+
+def artifact_suffix() -> str:
+    """`real` on a TPU-attached host, `cpu` anywhere else. The
+    `_real` artifact suffix is a provenance claim — these numbers
+    measured hardware — so an `--allow-cpu` smoke run must never be
+    able to produce a `*_real.json` file: a CPU-emulation drill
+    committed under the real-campaign name records a robustness
+    guarantee as demonstrated when it was only rehearsed (the exact
+    emulation-vs-chip confusion COVERAGE.md exists to prevent)."""
+    global _SUFFIX
+    if _SUFFIX is None:
+        try:
+            import jax
+
+            _SUFFIX = "real" if jax.default_backend() == "tpu" else "cpu"
+        except Exception:
+            _SUFFIX = "cpu"
+    return _SUFFIX
 
 
 def build_plan(args) -> list[dict]:
     """The campaign as data: each step is {name, why, cmd | fn,
     artifact, needs}. Commands are plain argv lists so the dry-run
-    plan is copy-pasteable."""
-    at = args.autotune_artifact
+    plan is copy-pasteable. Artifact names carry artifact_suffix():
+    on the TPU host this plan writes the *_real.json files the
+    runbook commits; off-TPU every name degrades to *_cpu.json."""
+    sfx = artifact_suffix()
+    at = args.autotune_artifact or f"AUTOTUNE_{sfx}.json"
+    args.autotune_artifact = at
     return [
         {
             "name": "preflight",
@@ -102,8 +128,8 @@ def build_plan(args) -> list[dict]:
             "tuned config (the number COVERAGE.md's 'Measured "
             "performance' table tracks; 10x north star ~22,200)",
             "cmd": [PY, "bench.py", "--autotune-from", at],
-            "stdout": "BENCH_real.json",
-            "artifact": "BENCH_real.json",
+            "stdout": f"BENCH_{sfx}.json",
+            "artifact": f"BENCH_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -121,8 +147,8 @@ def build_plan(args) -> list[dict]:
                 "--pipeline-depth",
                 "1,2,4",
             ],
-            "stdout": "BENCH_pipeline_real.json",
-            "artifact": "BENCH_pipeline_real.json",
+            "stdout": f"BENCH_pipeline_{sfx}.json",
+            "artifact": f"BENCH_pipeline_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -139,8 +165,8 @@ def build_plan(args) -> list[dict]:
                 "--n",
                 "2048",
             ],
-            "stdout": "STAGE_BUDGET_real.txt",
-            "artifact": "STAGE_BUDGET_real.txt",
+            "stdout": f"STAGE_BUDGET_{sfx}.txt",
+            "artifact": f"STAGE_BUDGET_{sfx}.txt",
             "needs": ["autotune"],
         },
         {
@@ -156,9 +182,9 @@ def build_plan(args) -> list[dict]:
                 "--autotune-from",
                 at,
                 "--json-out",
-                "BENCH_trickle_real.json",
+                f"BENCH_trickle_{sfx}.json",
             ],
-            "artifact": "BENCH_trickle_real.json",
+            "artifact": f"BENCH_trickle_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -177,9 +203,9 @@ def build_plan(args) -> list[dict]:
                 "--autotune-from",
                 at,
                 "--json-out",
-                "BENCH_blobs_real.json",
+                f"BENCH_blobs_{sfx}.json",
             ],
-            "artifact": "BENCH_blobs_real.json",
+            "artifact": f"BENCH_blobs_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -199,9 +225,9 @@ def build_plan(args) -> list[dict]:
                 "--reps",
                 "3",
                 "--json-out",
-                "MULTICHIP_real.json",
+                f"MULTICHIP_{sfx}.json",
             ],
-            "artifact": "MULTICHIP_real.json",
+            "artifact": f"MULTICHIP_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -213,7 +239,7 @@ def build_plan(args) -> list[dict]:
             "with the pressure showing up as bulk sheds and "
             "deferrals instead (device/executor.py)",
             "fn": "executor_contention",
-            "artifact": "EXECUTOR_CONTENTION_real.json",
+            "artifact": f"EXECUTOR_CONTENTION_{sfx}.json",
             "needs": ["autotune"],
         },
         {
@@ -225,7 +251,7 @@ def build_plan(args) -> list[dict]:
             "frozen -> probe reinstatement, each an SLO row "
             "(device/health.py; scenario device_loss_under_load)",
             "fn": "fault_drill",
-            "artifact": "FAULT_DRILL_real.json",
+            "artifact": f"FAULT_DRILL_{sfx}.json",
             "needs": ["preflight"],
         },
     ]
@@ -366,7 +392,10 @@ def step_executor_contention(args) -> dict:
         "provenance": provenance(),
     }
     with open(
-        os.path.join(REPO, "EXECUTOR_CONTENTION_real.json"), "w"
+        os.path.join(
+            REPO, f"EXECUTOR_CONTENTION_{artifact_suffix()}.json"
+        ),
+        "w",
     ) as f:
         json.dump(out, f, indent=2)
         f.write("\n")
@@ -380,9 +409,12 @@ def step_fault_drill(args) -> dict:
     fails the remaining buckets over to the host path (verdicts
     bit-identical), freezes the autotuner, then reinstates via
     known-answer probes — with every guarantee an explicit SLO row in
-    FAULT_DRILL_real.json. Deterministic (injected faults + manual
-    breaker clock), so the same drill gates tier-1 on CPU; here it
-    proves the failover seams against the real dispatch stack. A
+    the artifact. Deterministic (injected faults + manual breaker
+    clock), so the same drill gates tier-1 on CPU; on the TPU host it
+    proves the failover seams against the real dispatch stack and
+    writes FAULT_DRILL_real.json. Off-TPU (--allow-cpu) the identical
+    drill is a rehearsal against the emulated dispatch stack and
+    writes FAULT_DRILL_cpu.json — never the real-campaign name. A
     failed SLO row fails the step (and so the campaign)."""
     from lodestar_tpu.sim.scenarios import run_scenario
     from lodestar_tpu.utils.provenance import provenance
@@ -392,7 +424,8 @@ def step_fault_drill(args) -> dict:
     )
     out = dict(res.to_dict())
     out["provenance"] = provenance()
-    with open(os.path.join(REPO, "FAULT_DRILL_real.json"), "w") as f:
+    artifact = f"FAULT_DRILL_{artifact_suffix()}.json"
+    with open(os.path.join(REPO, artifact), "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     if res.error:
@@ -403,7 +436,7 @@ def step_fault_drill(args) -> dict:
     if failed:
         raise RuntimeError(
             f"fault drill SLO rows failed: {failed} "
-            "(see FAULT_DRILL_real.json)"
+            f"(see {artifact})"
         )
     return out
 
@@ -424,6 +457,14 @@ def run(args) -> int:
             "campaign": "first TPU-attached measurement round",
             "runbook": "REAL_CAMPAIGN.md",
             "cwd": REPO,
+            "platform": (
+                "tpu" if artifact_suffix() == "real" else "cpu"
+            ),
+            "artifact_suffix": artifact_suffix(),
+            "note": "artifact names reflect THIS host: on the TPU "
+            "host they are *_real.json; off-TPU every step writes "
+            "*_cpu.json so an emulation run can never masquerade as "
+            "a hardware measurement",
             "steps": [
                 {
                     "name": st["name"],
@@ -461,14 +502,24 @@ def run(args) -> int:
         missing = [n for n in st["needs"] if n not in done]
         if missing:
             # a skipped prerequisite is fine when its artifact
-            # already exists on disk (resuming a campaign)
+            # already exists on disk (resuming a campaign). A
+            # prerequisite WITHOUT an artifact (preflight) can only
+            # be satisfied by running it: letting `--steps
+            # fault_drill` skip preflight is how a CPU run once
+            # produced an artifact under the real-campaign name.
             for n in missing:
                 art = next(
                     p["artifact"] for p in plan if p["name"] == n
                 )
-                if art is not None and not os.path.exists(
-                    os.path.join(REPO, art)
-                ):
+                if art is None:
+                    print(
+                        f"step {st['name']}: prerequisite {n} "
+                        "leaves no artifact and must run in this "
+                        f"invocation — use --steps {n},{st['name']}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                if not os.path.exists(os.path.join(REPO, art)):
                     print(
                         f"step {st['name']}: prerequisite {n} not "
                         f"run and artifact {art} absent",
@@ -530,7 +581,10 @@ def main() -> int:
         "run pays real compiles; repeats ride the persistent cache)",
     )
     p.add_argument(
-        "--autotune-artifact", default=AUTOTUNE_ARTIFACT
+        "--autotune-artifact",
+        default=None,
+        help="tune-decision artifact name (default AUTOTUNE_real"
+        ".json on the TPU host, AUTOTUNE_cpu.json elsewhere)",
     )
     p.add_argument(
         "--contention-sets",
