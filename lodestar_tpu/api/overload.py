@@ -1,0 +1,650 @@
+"""Serving-tier fault domain: admission control, brownout shedding,
+and the head-keyed response cache (ISSUE 20, ROADMAP item 3a).
+
+Reference analog: the rest server's bodyLimit / activeSockets plumbing
+(beacon-node/src/api/rest) plus the QoS treatment the device executor
+(device/executor.py) already gives the accelerator — here the scarce
+resource is the node's single asyncio loop, which imports blocks and
+schedules duties on the same thread every REST bridge hop lands on.
+At north-star scale ("millions of light clients", arxiv 2302.00418's
+signature-load model) read overload is the NORMAL regime, so every
+request is classified into a QoS class and the cheap classes are shed
+first, on an accounted ledger, never silently:
+
+* classes — validator-duty > consensus-read > light-client/
+  historical-read > admin/debug (`ROUTE_CLASSES`, completeness pinned
+  by tests/test_api_overload.py);
+* admission — per-class token buckets + concurrency budgets with
+  queue-with-deadline semantics; refusals are 429/503 + Retry-After
+  and land on the `lodestar_api_sheds_total{cls,reason}` ledger
+  exactly like `lodestar_device_sheds_total`;
+* brownout ladder — an event-loop-lag probe trips a per-class
+  resilience/breaker.py circuit, cheapest class first, recovering
+  half-open, so the loop protects block import before reads;
+* response cache — hot idempotent routes serialize once per head; the
+  ChainEventEmitter's head/finality events invalidate, and under
+  brownout a stale body is served rather than a refusal
+  (stale-while-revalidate).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..resilience.breaker import (
+    BREAKER_STATE_INDEX,
+    BreakerState,
+    CircuitBreaker,
+)
+from ..resilience.clock import SYSTEM_CLOCK
+
+# ---------------------------------------------------------------------------
+# QoS classes + route classification
+# ---------------------------------------------------------------------------
+
+CLS_DUTY = "duty"  # validator duties + consensus message intake
+CLS_CONSENSUS = "consensus"  # cheap head/consensus reads, node status
+CLS_LIGHT = "light"  # light-client + historical/heavy reads
+CLS_ADMIN = "admin"  # debug + lodestar admin namespace
+CLS_CONN = "conn"  # pre-route: connection refused at the pool
+
+CLASSES = (CLS_DUTY, CLS_CONSENSUS, CLS_LIGHT, CLS_ADMIN)
+
+# the SSE stream is not in ROUTES (the server special-cases it); it
+# still needs a class for its admission + shed accounting
+EVENTSTREAM_OP = "eventstream"
+
+# every operation_id in api/routes.py maps to EXACTLY one class —
+# tests/test_api_overload.py fails when a new route lands unmapped,
+# so nothing ever ships in the implicit (most-shed) default class
+ROUTE_CLASSES: dict[str, str] = {
+    # validator-duty: the VC-facing hot path — shedding these misses
+    # duties, so they are the LAST class the ladder touches (never)
+    "getProposerDuties": CLS_DUTY,
+    "getAttesterDuties": CLS_DUTY,
+    "getSyncCommitteeDuties": CLS_DUTY,
+    "getLiveness": CLS_DUTY,
+    "produceAttestationData": CLS_DUTY,
+    "produceBlockV2": CLS_DUTY,
+    "produceBlockV3": CLS_DUTY,
+    "produceSyncCommitteeContribution": CLS_DUTY,
+    "getAggregatedAttestation": CLS_DUTY,
+    "publishBlock": CLS_DUTY,
+    "publishBlindedBlock": CLS_DUTY,
+    "publishBlindedBlockV2": CLS_DUTY,
+    "publishAggregateAndProofs": CLS_DUTY,
+    "publishContributionAndProofs": CLS_DUTY,
+    "prepareBeaconCommitteeSubnet": CLS_DUTY,
+    "prepareSyncCommitteeSubnets": CLS_DUTY,
+    "prepareBeaconProposer": CLS_DUTY,
+    "registerValidator": CLS_DUTY,
+    "submitPoolAttestations": CLS_DUTY,
+    "submitPoolSyncCommitteeSignatures": CLS_DUTY,
+    "submitPoolVoluntaryExit": CLS_DUTY,
+    "submitPoolAttesterSlashings": CLS_DUTY,
+    "submitPoolProposerSlashings": CLS_DUTY,
+    "submitPoolBLSToExecutionChanges": CLS_DUTY,
+    # consensus-read: cheap current-head reads and node/config status
+    "getGenesis": CLS_CONSENSUS,
+    "getStateFork": CLS_CONSENSUS,
+    "getStateFinalityCheckpoints": CLS_CONSENSUS,
+    "getBlockHeader": CLS_CONSENSUS,
+    "getBlockHeaders": CLS_CONSENSUS,
+    "getBlockV2": CLS_CONSENSUS,
+    "getBlockRoot": CLS_CONSENSUS,
+    "getBlockAttestations": CLS_CONSENSUS,
+    "getPoolAttestations": CLS_CONSENSUS,
+    "getPoolAttesterSlashings": CLS_CONSENSUS,
+    "getPoolProposerSlashings": CLS_CONSENSUS,
+    "getPoolVoluntaryExits": CLS_CONSENSUS,
+    "getPoolBLSToExecutionChanges": CLS_CONSENSUS,
+    "getHealth": CLS_CONSENSUS,
+    "getNodeVersion": CLS_CONSENSUS,
+    "getSyncingStatus": CLS_CONSENSUS,
+    "getNetworkIdentity": CLS_CONSENSUS,
+    "getPeers": CLS_CONSENSUS,
+    "getPeer": CLS_CONSENSUS,
+    "getPeerCount": CLS_CONSENSUS,
+    "getSpec": CLS_CONSENSUS,
+    "getForkSchedule": CLS_CONSENSUS,
+    "getDepositContract": CLS_CONSENSUS,
+    # light-client / historical: the "millions of light clients" front
+    # door plus full-state walks — first useful class to shed
+    "getLightClientBootstrap": CLS_LIGHT,
+    "getLightClientFinalityUpdate": CLS_LIGHT,
+    "getLightClientOptimisticUpdate": CLS_LIGHT,
+    "getStateProof": CLS_LIGHT,
+    "getBlockProof": CLS_LIGHT,
+    "getStateValidators": CLS_LIGHT,
+    "getStateValidator": CLS_LIGHT,
+    "getStateValidatorBalances": CLS_LIGHT,
+    "getEpochCommittees": CLS_LIGHT,
+    "getEpochSyncCommittees": CLS_LIGHT,
+    "getStateRandao": CLS_LIGHT,
+    "getStateRoot": CLS_LIGHT,
+    "getBlobSidecars": CLS_LIGHT,
+    "getBlockRewards": CLS_LIGHT,
+    "getAttestationsRewards": CLS_LIGHT,
+    "getSyncCommitteeRewards": CLS_LIGHT,
+    "getDepositSnapshot": CLS_LIGHT,
+    EVENTSTREAM_OP: CLS_LIGHT,
+    # admin/debug: operator introspection — cheapest to live without
+    "getStateV2": CLS_ADMIN,
+    "getDebugForkChoice": CLS_ADMIN,
+    "writeProfile": CLS_ADMIN,
+    "writeHeapdump": CLS_ADMIN,
+    "getGossipQueueItems": CLS_ADMIN,
+    "getStateCacheItems": CLS_ADMIN,
+    "getGossipPeerScoreStats": CLS_ADMIN,
+    "getSyncChainsDebugState": CLS_ADMIN,
+    "getBlockImportTraces": CLS_ADMIN,
+    "writeDeviceTrace": CLS_ADMIN,
+}
+
+
+def classify(operation_id: str) -> str:
+    """Unmapped operations land in the admin class — the most-shed
+    bucket — but the completeness test keeps the map exhaustive so
+    that default never actually decides anything."""
+    return ROUTE_CLASSES.get(operation_id, CLS_ADMIN)
+
+
+# ---------------------------------------------------------------------------
+# budgets + token buckets
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ClassBudget:
+    """Per-class admission budget: token-bucket rate + concurrency
+    slots + how long an over-budget request may QUEUE for a slot
+    before the deadline sheds it (queue-with-deadline)."""
+
+    rate: float  # sustained requests/second
+    burst: float  # bucket depth
+    max_concurrent: int  # concurrency slots (pool workers it may hold)
+    queue_deadline_s: float  # max wait for a slot before 503
+
+
+# documented in COVERAGE.md's serving-budget table — change both.
+# Rates are per-node REST budgets: generous enough that a healthy
+# validator client or test suite never notices them, tight enough
+# that a flood drains the cheap classes' buckets long before the
+# duty class feels anything. Scenarios/benches pass tighter budgets
+# explicitly to make the sheds observable at small scale.
+DEFAULT_BUDGETS: dict[str, ClassBudget] = {
+    CLS_DUTY: ClassBudget(1000.0, 400.0, 64, 5.0),
+    CLS_CONSENSUS: ClassBudget(500.0, 200.0, 32, 1.0),
+    CLS_LIGHT: ClassBudget(200.0, 100.0, 16, 0.5),
+    CLS_ADMIN: ClassBudget(50.0, 25.0, 4, 0.25),
+}
+
+
+class TokenBucket:
+    """Classic token bucket over an injectable monotonic clock."""
+
+    def __init__(self, rate: float, burst: float, clock=None):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.clock = clock or SYSTEM_CLOCK
+        self.tokens = float(burst)
+        self._t = self.clock.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> float:
+        """0.0 = token granted; > 0 = refused, value is the seconds
+        until `n` tokens will have refilled (the Retry-After hint)."""
+        with self._lock:
+            now = self.clock.monotonic()
+            self.tokens = min(
+                self.burst, self.tokens + (now - self._t) * self.rate
+            )
+            self._t = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return 0.0
+            if self.rate <= 0:
+                return 60.0
+            return (n - self.tokens) / self.rate
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+# loop-lag thresholds (seconds) that trip each class's breaker; the
+# cheapest class browns out first and duty NEVER does — the ladder
+# exists to keep block import + duty scheduling responsive, so the
+# duty class rides whatever lag remains after the sheds
+DEFAULT_BROWNOUT_THRESHOLDS: dict[str, float] = {
+    CLS_ADMIN: 0.05,
+    CLS_LIGHT: 0.10,
+    CLS_CONSENSUS: 0.25,
+}
+
+
+class BrownoutLadder:
+    """Per-class circuit breakers driven by loop-lag samples.
+
+    `sample(lag)` judges every class's breaker: lag at/over the class
+    threshold is a failure, lag under half the threshold a success
+    (the gap is hysteresis — mid-band samples leave the state alone).
+    `allows(cls)` gates admission; an open breaker re-probes half-open
+    after `reset_timeout` with a bounded probe budget per sample
+    interval, so recovery is gradual, not a stampede.
+    """
+
+    def __init__(
+        self,
+        thresholds: dict[str, float] | None = None,
+        clock=None,
+        failure_threshold: int = 2,
+        reset_timeout: float = 2.0,
+        half_open_max: int = 4,
+        on_transition=None,
+    ):
+        self.clock = clock or SYSTEM_CLOCK
+        self.thresholds = dict(
+            DEFAULT_BROWNOUT_THRESHOLDS
+            if thresholds is None
+            else thresholds
+        )
+        self.breakers = {
+            cls: CircuitBreaker(
+                name=f"brownout:{cls}",
+                failure_threshold=failure_threshold,
+                reset_timeout=reset_timeout,
+                half_open_max=half_open_max,
+                clock=self.clock,
+                on_transition=on_transition,
+            )
+            for cls in self.thresholds
+        }
+        self.samples = 0
+        self.last_lag = 0.0
+
+    def sample(self, lag: float) -> None:
+        """Feed one loop-lag observation to every class breaker."""
+        self.samples += 1
+        self.last_lag = lag
+        for cls, thr in self.thresholds.items():
+            b = self.breakers[cls]
+            if lag >= thr:
+                b.on_failure()
+            elif lag <= thr * 0.5:
+                b.on_success()
+
+    def allows(self, cls: str) -> bool:
+        b = self.breakers.get(cls)
+        return True if b is None else b.allows()
+
+    def state(self, cls: str) -> BreakerState:
+        b = self.breakers.get(cls)
+        return BreakerState.closed if b is None else b.state
+
+    def active(self) -> bool:
+        """Any class browned out right now? (The cache serves stale
+        under brownout instead of refusing.)"""
+        return any(
+            b.state is not BreakerState.closed
+            for b in self.breakers.values()
+        )
+
+    def retry_after(self, cls: str) -> float:
+        """Seconds the refused client should back off: the remainder
+        of the breaker's open window (floor 0.5 s)."""
+        b = self.breakers.get(cls)
+        if b is None or b.state is BreakerState.closed:
+            return 0.5
+        remaining = b.reset_timeout - (
+            self.clock.monotonic() - b.opened_at
+        )
+        return max(0.5, remaining)
+
+    def states_indexed(self) -> dict[str, int]:
+        """{cls: 0|1|2} for the lodestar_api_brownout_state gauge."""
+        return {
+            cls: BREAKER_STATE_INDEX[b.state]
+            for cls, b in self.breakers.items()
+        }
+
+
+class LoopLagProbe:
+    """Measures asyncio scheduling lag: sleep(interval) and see how
+    late the wakeup lands. The excess IS the time the loop spent on
+    other work — block import, bridge hops — per tick. Feeds the
+    ladder and (when attached) the lodestar_event_loop_lag_seconds
+    histogram. Tests bypass `run` and call `ladder.sample` with a
+    ManualClock directly."""
+
+    def __init__(self, ladder: BrownoutLadder, interval: float = 0.25,
+                 clock=None, histogram=None):
+        self.ladder = ladder
+        self.interval = interval
+        self.clock = clock or SYSTEM_CLOCK
+        self.histogram = histogram
+        self.ticks = 0
+        self._task = None
+
+    async def run(self) -> None:
+        import asyncio
+
+        while True:
+            t0 = self.clock.monotonic()
+            await asyncio.sleep(self.interval)
+            lag = max(
+                0.0, self.clock.monotonic() - t0 - self.interval
+            )
+            self.ticks += 1
+            self.ladder.sample(lag)
+            if self.histogram is not None:
+                self.histogram.observe(lag)
+
+    def start(self, loop) -> None:
+        self._task = loop.create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+# ---------------------------------------------------------------------------
+# head-keyed response cache
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CacheEntry:
+    generation: int
+    head_root: str
+    body: bytes  # serialized once, served many
+    status: int
+    headers: dict = field(default_factory=dict)
+
+
+class ResponseCache:
+    """Serialize-once cache for hot idempotent GET routes, keyed on
+    the full request path+query and scoped to the chain generation.
+
+    `attach(emitter)` registers an inline listener on the chain event
+    bus: head / finalized_checkpoint / chain_reorg bump the
+    generation, so a cached body is FRESH exactly while the head that
+    produced it stands (head-root-keyed). Stale entries are kept for
+    stale-while-revalidate service under brownout and age out by LRU.
+    """
+
+    INVALIDATING_TOPICS = ("head", "finalized_checkpoint", "chain_reorg")
+
+    def __init__(self, max_entries: int = 1024):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, CacheEntry] = OrderedDict()
+        self.max_entries = max_entries
+        self.generation = 0
+        self.head_root = ""
+        self.hits = 0
+        self.misses = 0
+        self.stale_hits = 0
+        self.invalidations = 0
+
+    def attach(self, emitter) -> None:
+        emitter.add_listener(self.on_event)
+
+    def on_event(self, topic: str, data) -> None:
+        if topic not in self.INVALIDATING_TOPICS:
+            return
+        root = ""
+        if isinstance(data, dict):
+            root = str(data.get("block") or data.get("root") or "")
+        self.invalidate(head_root=root)
+
+    def invalidate(self, head_root: str = "") -> None:
+        with self._lock:
+            self.generation += 1
+            if head_root:
+                self.head_root = head_root
+            self.invalidations += 1
+
+    def lookup(self, key: str, allow_stale: bool = False):
+        """Fresh CacheEntry, or a stale one when `allow_stale` (the
+        brownout path), else None. Counts hit/miss/stale."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            if entry.generation == self.generation:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            if allow_stale:
+                self._entries.move_to_end(key)
+                self.stale_hits += 1
+                return entry
+            self.misses += 1
+            return None
+
+    def store(self, key: str, body: bytes, status: int = 200,
+              headers: dict | None = None) -> None:
+        with self._lock:
+            self._entries[key] = CacheEntry(
+                generation=self.generation,
+                head_root=self.head_root,
+                body=body,
+                status=status,
+                headers=dict(headers or {}),
+            )
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "hit": self.hits,
+                "miss": self.misses,
+                "stale": self.stale_hits,
+            }
+
+    def hit_ratio(self) -> float:
+        with self._lock:
+            served = self.hits + self.stale_hits
+            total = served + self.misses
+            return served / total if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# admission controller (the facade the server drives)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Admission:
+    """Outcome of try_admit: either a held concurrency slot (release()
+    in a finally) or a refusal the server turns into 429/503 +
+    Retry-After."""
+
+    ok: bool
+    cls: str
+    status: int = 0
+    reason: str = ""
+    retry_after: float = 0.0
+    _release: object = None
+
+    def release(self) -> None:
+        if self._release is not None:
+            rel, self._release = self._release, None
+            rel()
+
+
+class ServingOverload:
+    """The serving-tier fault domain in one object: classification,
+    budgets, buckets, brownout ladder, response cache, and the shed /
+    response / timeout ledgers the metrics + scenarios read.
+
+    Thread model: `try_admit` / `note_*` are called from pool worker
+    threads; the ladder is sampled from the loop's lag probe; the
+    cache listener runs inline on `emit`. All ledgers are
+    lock-guarded dict bumps, same discipline as DeviceExecutor's.
+    """
+
+    def __init__(
+        self,
+        budgets: dict[str, ClassBudget] | None = None,
+        ladder: BrownoutLadder | None = None,
+        cache: ResponseCache | None = None,
+        clock=None,
+        pool_workers: int = 16,
+        pool_backlog: int = 32,
+        max_body_bytes: int = 16 * 1024 * 1024,
+        sse_max_subscribers: int = 8,
+        bridge_timeout_s: float = 30.0,
+    ):
+        self.clock = clock or SYSTEM_CLOCK
+        self.budgets = dict(DEFAULT_BUDGETS)
+        if budgets:
+            self.budgets.update(budgets)
+        self.buckets = {
+            cls: TokenBucket(b.rate, b.burst, clock=self.clock)
+            for cls, b in self.budgets.items()
+        }
+        self._sems = {
+            cls: threading.Semaphore(b.max_concurrent)
+            for cls, b in self.budgets.items()
+        }
+        self.ladder = ladder if ladder is not None else BrownoutLadder(
+            clock=self.clock
+        )
+        self.cache = cache if cache is not None else ResponseCache()
+        self.pool_workers = pool_workers
+        self.pool_backlog = pool_backlog
+        self.max_body_bytes = max_body_bytes
+        self.sse_max_subscribers = sse_max_subscribers
+        self.bridge_timeout_s = bridge_timeout_s
+        self._lock = threading.Lock()
+        # ledgers (lodestar_api_* gauges sample these at scrape)
+        self.sheds: dict[tuple[str, str], int] = {}
+        self.admitted: dict[str, int] = {}
+        self.inflight: dict[str, int] = {cls: 0 for cls in self.budgets}
+        self.responses: dict[int, int] = {}  # status code -> count
+        self.timeouts = 0  # bridge timeouts (504s)
+
+    # -- classification ------------------------------------------------
+
+    def classify(self, operation_id: str) -> str:
+        return classify(operation_id)
+
+    # -- ledgers -------------------------------------------------------
+
+    def note_shed(self, cls: str, reason: str) -> None:
+        with self._lock:
+            key = (cls, reason)
+            self.sheds[key] = self.sheds.get(key, 0) + 1
+
+    def shed_counts(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            return dict(self.sheds)
+
+    def note_response(self, status: int) -> None:
+        with self._lock:
+            self.responses[status] = self.responses.get(status, 0) + 1
+
+    def response_counts(self) -> dict[int, int]:
+        with self._lock:
+            return dict(self.responses)
+
+    def note_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def inflight_counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.inflight)
+
+    # -- admission -----------------------------------------------------
+
+    def try_admit(self, cls: str) -> Admission:
+        """Brownout first (cheapest refusal), then the rate bucket,
+        then a concurrency slot with the class's queue deadline."""
+        budget = self.budgets.get(cls) or self.budgets[CLS_ADMIN]
+        if not self.ladder.allows(cls):
+            self.note_shed(cls, "brownout")
+            return Admission(
+                False, cls, 503, "brownout",
+                retry_after=self.ladder.retry_after(cls),
+            )
+        wait = self.buckets[cls].take()
+        if wait > 0:
+            self.note_shed(cls, "rate_limited")
+            return Admission(
+                False, cls, 429, "rate_limited", retry_after=wait
+            )
+        sem = self._sems[cls]
+        if not sem.acquire(timeout=budget.queue_deadline_s):
+            self.note_shed(cls, "queue_deadline")
+            return Admission(
+                False, cls, 503, "queue_deadline",
+                retry_after=max(0.5, budget.queue_deadline_s),
+            )
+        with self._lock:
+            self.admitted[cls] = self.admitted.get(cls, 0) + 1
+            self.inflight[cls] = self.inflight.get(cls, 0) + 1
+
+        def _release():
+            sem.release()
+            with self._lock:
+                self.inflight[cls] -= 1
+
+        return Admission(True, cls, _release=_release)
+
+
+# ---------------------------------------------------------------------------
+# metrics bridge (node.py wiring; mirrors bind_executor_collectors)
+# ---------------------------------------------------------------------------
+
+
+def bind_api_collectors(metrics, overload: ServingOverload,
+                        emitter=None) -> None:
+    """Wire the m.api registry namespace (metrics/beacon.py) to sample
+    the serving-tier ledgers at scrape time."""
+
+    def _sheds(g):
+        for (cls, reason), n in overload.shed_counts().items():
+            g.set(n, cls=cls, reason=reason)
+
+    metrics.sheds_total.add_collect(_sheds)
+    metrics.inflight.add_collect(
+        lambda g: [
+            g.set(n, cls=c)
+            for c, n in overload.inflight_counts().items()
+        ]
+    )
+    metrics.brownout_state.add_collect(
+        lambda g: [
+            g.set(v, cls=c)
+            for c, v in overload.ladder.states_indexed().items()
+        ]
+    )
+    metrics.response_cache_total.add_collect(
+        lambda g: [
+            g.set(n, result=r)
+            for r, n in overload.cache.counts().items()
+        ]
+    )
+    metrics.request_timeouts_total.add_collect(
+        lambda g: g.set(overload.timeouts)
+    )
+    if emitter is not None:
+        metrics.sse_subscribers.add_collect(
+            lambda g: g.set(emitter.subscriber_count())
+        )
+        metrics.sse_dropped_total.add_collect(
+            lambda g: [
+                g.set(n, topic=t) for t, n in emitter.dropped.items()
+            ]
+        )
+        metrics.sse_evictions_total.add_collect(
+            lambda g: g.set(emitter.evictions)
+        )

@@ -22,22 +22,34 @@ class Route:
     wrap_data: bool = True  # beacon-api {"data": ...} envelope
     raw_body: bool = False  # pass the parsed JSON body through as-is
     query_params: tuple = ()  # query-string params appended in order
+    # idempotent hot GET whose body is a pure function of the current
+    # head: serialized once into the api/overload.py response cache,
+    # invalidated by the chain event bus (ISSUE 20)
+    cacheable: bool = False
 
 
 ROUTES: list[Route] = [
     # beacon
-    Route("getGenesis", "GET", "/eth/v1/beacon/genesis", "get_genesis"),
+    Route(
+        "getGenesis",
+        "GET",
+        "/eth/v1/beacon/genesis",
+        "get_genesis",
+        cacheable=True,
+    ),
     Route(
         "getStateFork",
         "GET",
         "/eth/v1/beacon/states/{state_id}/fork",
         "get_state_fork",
+        cacheable=True,
     ),
     Route(
         "getStateFinalityCheckpoints",
         "GET",
         "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
         "get_state_finality_checkpoints",
+        cacheable=True,
     ),
     Route(
         "getStateValidators",
@@ -50,6 +62,7 @@ ROUTES: list[Route] = [
         "GET",
         "/eth/v1/beacon/headers/{block_id}",
         "get_block_header",
+        cacheable=True,
     ),
     # validator
     Route(
@@ -57,6 +70,7 @@ ROUTES: list[Route] = [
         "GET",
         "/eth/v1/validator/duties/proposer/{epoch}",
         "get_proposer_duties",
+        cacheable=True,
     ),
     Route(
         "getAttesterDuties",
@@ -76,6 +90,7 @@ ROUTES: list[Route] = [
         "GET",
         "/eth/v1/beacon/blocks/{block_id}/root",
         "get_block_root",
+        cacheable=True,
     ),
     Route(
         "publishBlock",
@@ -183,18 +198,21 @@ ROUTES: list[Route] = [
         "GET",
         "/eth/v1/beacon/light_client/bootstrap/{block_root}",
         "get_light_client_bootstrap",
+        cacheable=True,
     ),
     Route(
         "getLightClientFinalityUpdate",
         "GET",
         "/eth/v1/beacon/light_client/finality_update",
         "get_light_client_finality_update",
+        cacheable=True,
     ),
     Route(
         "getLightClientOptimisticUpdate",
         "GET",
         "/eth/v1/beacon/light_client/optimistic_update",
         "get_light_client_optimistic_update",
+        cacheable=True,
     ),
     # beacon: state detail
     Route(
@@ -331,18 +349,26 @@ ROUTES: list[Route] = [
         "getPeer", "GET", "/eth/v1/node/peers/{peer_id}", "get_peer"
     ),
     # config
-    Route("getSpec", "GET", "/eth/v1/config/spec", "get_spec"),
+    Route(
+        "getSpec",
+        "GET",
+        "/eth/v1/config/spec",
+        "get_spec",
+        cacheable=True,
+    ),
     Route(
         "getForkSchedule",
         "GET",
         "/eth/v1/config/fork_schedule",
         "get_fork_schedule",
+        cacheable=True,
     ),
     Route(
         "getDepositContract",
         "GET",
         "/eth/v1/config/deposit_contract",
         "get_deposit_contract",
+        cacheable=True,
     ),
     Route(
         "getBlockHeaders",
@@ -350,6 +376,7 @@ ROUTES: list[Route] = [
         "/eth/v1/beacon/headers",
         "get_block_headers",
         query_params=("slot", "parent_root"),
+        cacheable=True,
     ),
     Route(
         "getDepositSnapshot",

@@ -1,10 +1,19 @@
 """Beacon REST API HTTP server.
 
 Reference analog: BeaconRestApiServer on fastify
-(beacon-node/src/api/rest/index.ts:38). stdlib ThreadingHTTPServer in a
+(beacon-node/src/api/rest/index.ts:38). stdlib HTTP server in a
 daemon thread; async impl methods are bridged onto the node's asyncio
 loop with run_coroutine_threadsafe (the fastify->chain boundary in the
 reference is the same thread-hop, worker bridge §1).
+
+Serving fault domain (ISSUE 20, api/overload.py): connections are
+handled by a BOUNDED worker pool (over-backlog connections get a raw
+503 + Retry-After instead of an unbounded thread), every matched
+route passes per-class admission control (token bucket + concurrency
+budget + brownout ladder), hot idempotent GETs are served from the
+head-keyed response cache (stale under brownout), the async bridge
+CANCELS the loop-side task on timeout (504), and SSE rides the
+broadcast emitter's pre-serialized frames behind a subscriber cap.
 """
 
 from __future__ import annotations
@@ -13,10 +22,76 @@ import asyncio
 import inspect
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 from .impl import ApiError, BeaconApiImpl
+from .overload import EVENTSTREAM_OP, CLS_CONN, ServingOverload
 from .routes import match_route
+
+
+class _PooledHTTPServer(HTTPServer):
+    """Bounded worker pool replacing ThreadingHTTPServer's
+    thread-per-connection model: accepted connections are handed to a
+    fixed pool, and once `pool_workers + pool_backlog` connections are
+    in flight the listener refuses with a raw 503 + Retry-After on
+    the socket — an accounted shed, never an unbounded thread."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler_cls, overload: ServingOverload):
+        super().__init__(addr, handler_cls)
+        self.overload = overload
+        self._pool = ThreadPoolExecutor(
+            max_workers=overload.pool_workers,
+            thread_name_prefix="api-worker",
+        )
+        self._pending = 0
+        self._plock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._plock:
+            over = self._pending >= (
+                self.overload.pool_workers + self.overload.pool_backlog
+            )
+            if not over:
+                self._pending += 1
+        if over:
+            self.overload.note_shed(CLS_CONN, "pool_backlog")
+            self.overload.note_response(503)
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Retry-After: 1\r\n"
+                    b"Content-Length: 0\r\n"
+                    b"Connection: close\r\n\r\n"
+                )
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        self._pool.submit(self._work, request, client_address)
+
+    def _work(self, request, client_address):
+        # mirrors ThreadingMixIn.process_request_thread
+        try:
+            self.finish_request(request, client_address)
+        except Exception:
+            self.handle_error(request, client_address)
+        finally:
+            self.shutdown_request(request)
+            with self._plock:
+                self._pending -= 1
+
+    def handle_error(self, request, client_address):
+        pass  # disconnects mid-response are the client's business
+
+    def server_close(self):
+        super().server_close()
+        self._pool.shutdown(wait=False)
 
 
 class BeaconRestApiServer:
@@ -26,21 +101,28 @@ class BeaconRestApiServer:
         host: str = "127.0.0.1",
         port: int = 9596,
         loop: asyncio.AbstractEventLoop | None = None,
+        overload: ServingOverload | None = None,
+        metrics=None,  # the m.api namespace (metrics/beacon.py)
     ):
         self.impl = impl
         self.host = host
         self.port = port
         self.loop = loop
-        self._httpd: ThreadingHTTPServer | None = None
+        self.overload = overload if overload is not None else ServingOverload()
+        self.metrics = metrics
+        self._httpd: _PooledHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._closing = False
 
     def start(self) -> int:
         impl = self.impl
         server = self
+        ov = self.overload
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # idle keep-alive connections release their pool worker
+            timeout = 10
 
             def _run(self):
                 from urllib.parse import parse_qs
@@ -55,12 +137,63 @@ class BeaconRestApiServer:
                     self._json(404, {"code": 404, "message": "route not found"})
                     return
                 route, params = m
-                body = None
-                if self.command == "POST":
-                    n = int(self.headers.get("Content-Length") or 0)
-                    raw = self.rfile.read(n) if n else b""
-                    body = json.loads(raw) if raw else None
+                cls = ov.classify(route.operation_id)
+                t0 = time.monotonic()
+                if server.metrics is not None:
+                    server.metrics.requests_total.inc(
+                        operation=route.operation_id
+                    )
+                # hot idempotent GETs: a FRESH cached body costs no
+                # admission and no loop hop — that is the whole point
+                # of the cache under a read flood
+                cache_key = None
+                if route.cacheable and self.command == "GET":
+                    cache_key = self.path
+                    entry = ov.cache.lookup(cache_key)
+                    if entry is not None:
+                        self._cached(route, entry, "hit", t0)
+                        return
+                adm = ov.try_admit(cls)
+                if not adm.ok:
+                    # stale-while-revalidate: under brownout/refusal a
+                    # cacheable route serves its last good body
+                    # instead of an error
+                    if cache_key is not None:
+                        entry = ov.cache.lookup(
+                            cache_key, allow_stale=True
+                        )
+                        if entry is not None:
+                            self._cached(route, entry, "stale", t0)
+                            return
+                    self._refused(route, adm)
+                    return
                 try:
+                    self._handle(route, params, query, cache_key, t0)
+                finally:
+                    adm.release()
+
+            def _handle(self, route, params, query, cache_key, t0):
+                try:
+                    body = None
+                    if self.command == "POST":
+                        n = int(self.headers.get("Content-Length") or 0)
+                        if n > ov.max_body_bytes:
+                            # refuse before reading: drop the
+                            # connection rather than drain the body
+                            self.close_connection = True
+                            self._json(
+                                413,
+                                {
+                                    "code": 413,
+                                    "message": (
+                                        f"body {n} over limit "
+                                        f"{ov.max_body_bytes}"
+                                    ),
+                                },
+                            )
+                            return
+                        raw = self.rfile.read(n) if n else b""
+                        body = json.loads(raw) if raw else None
                     args = list(params.values())
                     # numeric path params (epoch) arrive as strings
                     args = [
@@ -83,34 +216,106 @@ class BeaconRestApiServer:
                     if inspect.iscoroutine(result):
                         if server.loop is None:
                             raise ApiError(500, "no loop for async route")
-                        result = asyncio.run_coroutine_threadsafe(
+                        fut = asyncio.run_coroutine_threadsafe(
                             result, server.loop
-                        ).result(timeout=30)
+                        )
+                        try:
+                            result = fut.result(
+                                timeout=ov.bridge_timeout_s
+                            )
+                        except _FutureTimeout:
+                            # cancel the loop-side task: an abandoned
+                            # coroutine must not keep piling work onto
+                            # the loop after its client gave up
+                            fut.cancel()
+                            ov.note_timeout()
+                            self._json(
+                                504,
+                                {
+                                    "code": 504,
+                                    "message": "bridge timeout",
+                                },
+                                operation=route.operation_id,
+                            )
+                            return
                 except ApiError as e:
                     self._json(
-                        e.status, {"code": e.status, "message": e.message}
+                        e.status,
+                        {"code": e.status, "message": e.message},
+                        operation=route.operation_id,
                     )
                     return
                 except (ValueError, TypeError, KeyError) as e:
                     # malformed params/bodies are the client's fault
-                    self._json(400, {"code": 400, "message": repr(e)})
+                    self._json(
+                        400,
+                        {"code": 400, "message": repr(e)},
+                        operation=route.operation_id,
+                    )
                     return
                 except Exception as e:
-                    self._json(500, {"code": 500, "message": repr(e)})
+                    self._json(
+                        500,
+                        {"code": 500, "message": repr(e)},
+                        operation=route.operation_id,
+                    )
                     return
+                if server.metrics is not None:
+                    server.metrics.response_time.observe(
+                        time.monotonic() - t0,
+                        operation=route.operation_id,
+                    )
                 if not route.wrap_data:
                     if isinstance(result, int):  # health: status only
+                        ov.note_response(result)
                         self.send_response(result)
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                         return
-                    self._json(200, result)
+                    self._json(200, result, cache_key=cache_key)
                     return
-                self._json(200, {"data": result})
+                self._json(200, {"data": result}, cache_key=cache_key)
+
+            def _cached(self, route, entry, state, t0) -> None:
+                """Serve a pre-serialized cache entry (hit or stale)."""
+                ov.note_response(entry.status)
+                if server.metrics is not None:
+                    server.metrics.response_time.observe(
+                        time.monotonic() - t0,
+                        operation=route.operation_id,
+                    )
+                self.send_response(entry.status)
+                self.send_header("Content-Type", "application/json")
+                for k, v in entry.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Lodestar-Cache", state)
+                self.send_header(
+                    "Content-Length", str(len(entry.body))
+                )
+                self.end_headers()
+                self.wfile.write(entry.body)
+
+            def _refused(self, route, adm) -> None:
+                """429/503 + Retry-After for an admission refusal."""
+                retry = max(1, int(adm.retry_after + 0.999))
+                self._json(
+                    adm.status,
+                    {
+                        "code": adm.status,
+                        "message": (
+                            f"{adm.reason} ({adm.cls} class)"
+                        ),
+                    },
+                    headers={"Retry-After": str(retry)},
+                    operation=route.operation_id,
+                )
 
             def _sse(self, query) -> None:
                 """Server-sent events stream (api/impl/events; topics
-                via ?topics=head,block&topics=...)."""
+                via ?topics=head,block&topics=...). Frames arrive from
+                the broadcast emitter pre-serialized; a subscriber that
+                stops draining is evicted by the emitter and the
+                stream ends at its next tick."""
                 import queue as _queue
 
                 from ..chain.events import TOPICS
@@ -139,7 +344,35 @@ class BeaconRestApiServer:
                         503, {"code": 503, "message": "events unavailable"}
                     )
                     return
-                q = emitter.subscribe(topics)
+                cls = ov.classify(EVENTSTREAM_OP)
+                wait = ov.buckets[cls].take()
+                if wait > 0:
+                    ov.note_shed(cls, "rate_limited")
+                    self._json(
+                        429,
+                        {"code": 429, "message": "rate_limited"},
+                        headers={
+                            "Retry-After": str(max(1, int(wait + 0.999)))
+                        },
+                    )
+                    return
+                sub = None
+                if emitter.subscriber_count() < ov.sse_max_subscribers:
+                    sub = emitter.subscribe(topics)
+                if sub is None:
+                    # server-side cap or the emitter's own cap: the
+                    # stream is refused, not queued
+                    ov.note_shed(cls, "sse_subscriber_cap")
+                    self._json(
+                        503,
+                        {
+                            "code": 503,
+                            "message": "subscriber cap reached",
+                        },
+                        headers={"Retry-After": "5"},
+                    )
+                    return
+                ov.note_response(200)
                 try:
                     # the stream has no Content-Length: close the
                     # connection when it ends or a keep-alive client
@@ -153,25 +386,29 @@ class BeaconRestApiServer:
                     self.send_header("Connection", "close")
                     self.end_headers()
                     while not server._closing:
+                        if sub.evicted:
+                            # emitter dropped us as a slow consumer
+                            self.wfile.write(b": evicted\n\n")
+                            self.wfile.flush()
+                            break
                         try:
-                            topic, data = q.get(timeout=1.0)
+                            frame = sub.q.get(timeout=1.0)
                         except _queue.Empty:
                             # keep-alive comment frame
                             self.wfile.write(b":\n\n")
                             self.wfile.flush()
                             continue
-                        frame = (
-                            f"event: {topic}\n"
-                            f"data: {json.dumps(data)}\n\n"
-                        ).encode()
                         self.wfile.write(frame)
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
-                    emitter.unsubscribe(q)
+                    emitter.unsubscribe(sub)
 
-            def _json(self, status: int, obj, headers=None) -> None:
+            def _json(
+                self, status: int, obj, headers=None,
+                cache_key=None, operation=None,
+            ) -> None:
                 # impl methods attach spec response headers (e.g.
                 # produceBlockV3's Eth-Execution-Payload-Blinded) via
                 # a "__headers__" key, stripped before serializing
@@ -181,6 +418,18 @@ class BeaconRestApiServer:
                         **obj.pop("__headers__"),
                     }
                 data = json.dumps(obj).encode()
+                ov.note_response(status)
+                if status >= 400 and operation is not None \
+                        and server.metrics is not None:
+                    server.metrics.errors_total.inc(
+                        operation=operation
+                    )
+                if cache_key is not None and status == 200:
+                    # serialize-once: the bytes just built are what
+                    # every cache hit serves until the head moves
+                    ov.cache.store(
+                        cache_key, data, status, headers
+                    )
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 for k, v in (headers or {}).items():
@@ -198,7 +447,9 @@ class BeaconRestApiServer:
             def log_message(self, *a):
                 pass
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _PooledHTTPServer(
+            (self.host, self.port), Handler, ov
+        )
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True

@@ -3,10 +3,23 @@
 Reference analog: ChainEventEmitter + the events route
 (api/impl/events) — block import / head update / finality emit typed
 events that SSE subscribers stream.
+
+Broadcast model (ISSUE 20): `emit` serializes each event to its SSE
+wire frame ONCE and fans the bytes out to bounded per-subscriber
+queues. A subscriber whose queue is full is EVICTED (its frames were
+already being dropped — a wedged consumer never slows the emitter or
+other subscribers) and the drop is counted per topic, never silent.
+A subscriber cap bounds the fan-out itself; the REST server turns a
+refused subscribe into a 503 + Retry-After.
+
+Synchronous listeners (`add_listener`) ride the same emit path for
+in-process consumers that must see every event without a queue — the
+API response cache invalidates on head/finality through one.
 """
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 
@@ -19,33 +32,99 @@ TOPICS = (
 )
 
 
+def encode_sse_frame(topic: str, data: dict) -> bytes:
+    """The SSE wire frame for one event — built once per emit, not
+    once per subscriber."""
+    return (f"event: {topic}\ndata: {json.dumps(data)}\n\n").encode()
+
+
+class Subscription:
+    """One SSE consumer: a topic filter and a bounded frame queue.
+
+    `evicted` flips (under the emitter lock) when the queue overflowed
+    and the emitter dropped the subscriber; the SSE handler checks it
+    on its keep-alive tick and terminates the stream.
+    """
+
+    __slots__ = ("topics", "q", "evicted")
+
+    def __init__(self, topics, max_queued: int):
+        self.topics = set(topics)
+        self.q: queue.Queue = queue.Queue(max_queued)
+        self.evicted = False
+
+
 class ChainEventEmitter:
     """Thread-safe fan-out: the chain emits on the asyncio loop; SSE
     handlers consume from server threads via per-subscriber queues."""
 
-    def __init__(self, max_queued: int = 256):
-        self._subs: list[tuple[set, queue.Queue]] = []
+    def __init__(self, max_queued: int = 256, max_subscribers: int = 64):
+        self._subs: list[Subscription] = []
+        self._listeners: list = []
         self._lock = threading.Lock()
         self.max_queued = max_queued
+        self.max_subscribers = max_subscribers
         self.emitted = 0
+        # telemetry ledgers (lodestar_api_sse_* at scrape time)
+        self.dropped: dict[str, int] = {}  # topic -> frames dropped
+        self.evictions = 0
+        self.subscribe_refusals = 0
 
-    def subscribe(self, topics) -> queue.Queue:
-        q: queue.Queue = queue.Queue(self.max_queued)
+    def subscriber_count(self) -> int:
         with self._lock:
-            self._subs.append((set(topics), q))
-        return q
+            return len(self._subs)
 
-    def unsubscribe(self, q: queue.Queue) -> None:
+    def subscribe(self, topics) -> Subscription | None:
+        """Returns None when the subscriber cap is reached (the caller
+        must refuse the stream, not queue it)."""
         with self._lock:
-            self._subs = [(t, s) for t, s in self._subs if s is not q]
+            if len(self._subs) >= self.max_subscribers:
+                self.subscribe_refusals += 1
+                return None
+            sub = Subscription(topics, self.max_queued)
+            self._subs.append(sub)
+            return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            self._subs = [s for s in self._subs if s is not sub]
+
+    def add_listener(self, fn) -> None:
+        """Register a synchronous `fn(topic, data)` called inline on
+        every emit (cache invalidation, tests). Exceptions are
+        swallowed: a broken listener must not break block import."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def emit(self, topic: str, data: dict) -> None:
         self.emitted += 1
         with self._lock:
-            subs = list(self._subs)
-        for topics, q in subs:
-            if topic in topics:
-                try:
-                    q.put_nowait((topic, data))
-                except queue.Full:
-                    pass  # slow consumer: drop (SSE is lossy by design)
+            listeners = list(self._listeners)
+            subs = [s for s in self._subs if topic in s.topics]
+        for fn in listeners:
+            try:
+                fn(topic, data)
+            except Exception:
+                pass
+        if not subs:
+            return
+        frame = encode_sse_frame(topic, data)  # serialize once
+        evicted = []
+        for sub in subs:
+            try:
+                sub.q.put_nowait(frame)
+            except queue.Full:
+                # slow consumer: count the drop and evict the
+                # subscriber — the emitter never blocks, the event is
+                # never silently lost from the accounting
+                evicted.append(sub)
+        if evicted:
+            with self._lock:
+                for sub in evicted:
+                    self.dropped[topic] = self.dropped.get(topic, 0) + 1
+                    if not sub.evicted:
+                        sub.evicted = True
+                        self.evictions += 1
+                self._subs = [
+                    s for s in self._subs if not s.evicted
+                ]

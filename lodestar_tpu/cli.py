@@ -36,6 +36,13 @@ def _build_parser() -> argparse.ArgumentParser:
     dev.add_argument("--bellatrix-epoch", type=int, default=2**64 - 1)
     dev.add_argument("--db", default=None, help="persist chain to this dir")
     dev.add_argument("--api-port", type=int, default=None)
+    dev.add_argument(
+        "--api-workers",
+        type=int,
+        default=16,
+        help="REST worker-pool size (api/overload.py admission "
+        "control bounds everything else)",
+    )
     dev.add_argument("--metrics-port", type=int, default=None)
     dev.add_argument(
         "--real-time",
@@ -46,6 +53,13 @@ def _build_parser() -> argparse.ArgumentParser:
     beacon = sub.add_parser("beacon", help="run a beacon node from a db")
     beacon.add_argument("--db", required=True)
     beacon.add_argument("--api-port", type=int, default=9596)
+    beacon.add_argument(
+        "--api-workers",
+        type=int,
+        default=16,
+        help="REST worker-pool size (api/overload.py admission "
+        "control bounds everything else)",
+    )
     beacon.add_argument("--metrics-port", type=int, default=None)
     beacon.add_argument(
         "--port", type=int, default=None,
@@ -325,11 +339,17 @@ async def _run_dev(args) -> int:
     api_server = None
     if args.api_port is not None:
         from .api.impl import BeaconApiImpl
+        from .api.overload import ServingOverload
         from .api.server import BeaconRestApiServer
 
         impl = BeaconApiImpl(cfg, types, node.chain)
+        overload = ServingOverload(pool_workers=args.api_workers)
+        overload.cache.attach(node.chain.events)
         api_server = BeaconRestApiServer(
-            impl, port=args.api_port, loop=asyncio.get_event_loop()
+            impl,
+            port=args.api_port,
+            loop=asyncio.get_event_loop(),
+            overload=overload,
         )
         log.info("rest api", {"port": api_server.start()})
     metrics_server = None
@@ -442,6 +462,7 @@ async def _run_beacon(args) -> int:
         types=types,
         db=db,
         api_port=args.api_port,
+        api_workers=args.api_workers,
         metrics_port=args.metrics_port,
         tcp_port=args.port,
         udp_port=args.discovery_port,

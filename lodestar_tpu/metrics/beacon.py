@@ -479,7 +479,52 @@ def create_lodestar_metrics(reg: RegistryMetricCreator) -> SimpleNamespace:
     a.response_time = reg.histogram(
         "lodestar_api_rest_response_time_seconds",
         "REST api handler time",
+        label_names=("operation",),
         buckets=(0.001, 0.01, 0.05, 0.25, 1, 5),
+    )
+    # serving fault domain (api/overload.py, ISSUE 20): sampled from
+    # the ServingOverload / ChainEventEmitter ledgers at scrape time
+    # via bind_api_collectors — the REST analog of the device
+    # executor's shed accounting
+    a.sheds_total = reg.gauge(
+        "lodestar_api_sheds_total",
+        "REST requests refused by admission control, by QoS class "
+        "and reason (rate_limited / queue_deadline / brownout / "
+        "pool_backlog / sse_subscriber_cap)",
+        label_names=("cls", "reason"),
+    )
+    a.inflight = reg.gauge(
+        "lodestar_api_inflight_requests",
+        "Admitted REST requests currently holding a concurrency slot",
+        label_names=("cls",),
+    )
+    a.brownout_state = reg.gauge(
+        "lodestar_api_brownout_state",
+        "Per-class brownout breaker state "
+        "(0=closed 1=open 2=half_open)",
+        label_names=("cls",),
+    )
+    a.response_cache_total = reg.gauge(
+        "lodestar_api_response_cache_total",
+        "Head-keyed response cache outcomes (hit / miss / stale)",
+        label_names=("result",),
+    )
+    a.request_timeouts_total = reg.gauge(
+        "lodestar_api_request_timeouts_total",
+        "Async-bridge timeouts: loop-side task cancelled, 504 served",
+    )
+    a.sse_subscribers = reg.gauge(
+        "lodestar_api_sse_subscribers",
+        "Live SSE event-stream subscribers",
+    )
+    a.sse_dropped_total = reg.gauge(
+        "lodestar_api_sse_dropped_total",
+        "SSE frames dropped on full subscriber queues, by topic",
+        label_names=("topic",),
+    )
+    a.sse_evictions_total = reg.gauge(
+        "lodestar_api_sse_evictions_total",
+        "Slow SSE consumers evicted by the broadcast emitter",
     )
 
     # -- eth1 / execution (eth1/, execution/) ----------------------------

@@ -39,6 +39,7 @@ class BeaconNode:
         db: BeaconDb | None = None,
         verifier=None,
         api_port: int = 0,
+        api_workers: int = 16,
         metrics_port: int | None = None,
         peer_id: str = "node",
         transport: InProcessTransport | None = None,
@@ -122,11 +123,13 @@ class BeaconNode:
         self.anchor = anchor_state_view
         self.verifier = verifier
         self.api_port = api_port
+        self.api_workers = api_workers
         self.metrics_port = metrics_port
         self.peer_id = peer_id
         self.transport = transport or InProcessTransport()
         self.chain: BeaconChain | None = None
         self.api_server = None
+        self.loop_lag_probe = None
         self.metrics_server = None
         self.processor = None
         self.range_sync = None
@@ -796,10 +799,33 @@ class BeaconNode:
             node.network.on_unknown_parent = (
                 node.unknown_block_sync.on_unknown_block
             )
-        # REST API
+        # REST API behind the serving fault domain (api/overload.py):
+        # bounded pool + per-class admission, brownout ladder fed by
+        # the loop-lag probe, and the head-keyed response cache
+        # invalidated straight off the chain event bus
+        from .api.overload import (
+            LoopLagProbe,
+            ServingOverload,
+            bind_api_collectors,
+        )
+
         impl = BeaconApiImpl(node.cfg, node.types, node.chain, node)
+        overload = ServingOverload(pool_workers=node.api_workers)
+        overload.cache.attach(node.chain.events)
         node.api_server = BeaconRestApiServer(
-            impl, port=node.api_port, loop=asyncio.get_event_loop()
+            impl,
+            port=node.api_port,
+            loop=asyncio.get_event_loop(),
+            overload=overload,
+            metrics=node.metrics.api,
+        )
+        node.loop_lag_probe = LoopLagProbe(
+            overload.ladder,
+            histogram=node.metrics.clock.event_loop_lag,
+        )
+        node.loop_lag_probe.start(asyncio.get_event_loop())
+        bind_api_collectors(
+            node.metrics.api, overload, node.chain.events
         )
         port = node.api_server.start()
         log.info("rest api listening", {"port": port})
@@ -1147,6 +1173,9 @@ class BeaconNode:
             self.clock.stop()
         if self.monitoring is not None:
             await self.monitoring.stop()
+        if getattr(self, "loop_lag_probe", None) is not None:
+            self.loop_lag_probe.stop()
+            self.loop_lag_probe = None
         if self.api_server is not None:
             self.api_server.stop()
         if self.metrics_server is not None:

